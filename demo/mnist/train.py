@@ -1,0 +1,78 @@
+"""MNIST training demo — v1_api_demo/mnist + v2 quick-start parity.
+
+Runs on the TPU when one is attached (paddle.init(use_tpu=True) — the
+`use_gpu` of the reference), or CPU otherwise. With no cached MNIST files it
+trains on the deterministic synthetic fallback (see
+paddle_tpu/dataset/common.py).
+"""
+
+import argparse
+import os
+import sys
+
+import paddle_tpu as paddle
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--use_tpu", action="store_true", default=None)
+    ap.add_argument("--num_passes", type=int, default=5)
+    ap.add_argument("--batch_size", type=int, default=128)
+    ap.add_argument("--output", default="./mnist_output")
+    args = ap.parse_args()
+
+    paddle.init(use_tpu=args.use_tpu, trainer_count=1, seed=42)
+
+    # -- network: 784 -> 128 -> 64 -> softmax(10) (the classic MLP config)
+    img = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+    h1 = paddle.layer.fc(img, size=128, act=paddle.activation.Relu())
+    h2 = paddle.layer.fc(h1, size=64, act=paddle.activation.Relu())
+    out = paddle.layer.fc(h2, size=10, act=paddle.activation.Softmax(),
+                          name="output")
+    lbl = paddle.layer.data("label", paddle.data_type.integer_value(10))
+    cost = paddle.layer.classification_cost(out, lbl, name="cost")
+    err = paddle.layer.classification_error(out, lbl, name="error")
+
+    parameters = paddle.create_parameters(paddle.Topology(cost))
+    optimizer = paddle.optimizer.Momentum(
+        learning_rate=0.1 / args.batch_size, momentum=0.9,
+        regularization=paddle.optimizer.L2Regularization(5e-4))
+    trainer = paddle.SGD(cost=cost, parameters=parameters,
+                         update_equation=optimizer, extra_layers=[err])
+
+    def event_handler(e):
+        if isinstance(e, paddle.event.EndIteration) and e.batch_id % 16 == 0:
+            print(f"pass {e.pass_id} batch {e.batch_id} "
+                  f"cost {e.cost:.4f} {e.evaluator}")
+        if isinstance(e, paddle.event.EndPass):
+            print(f"== pass {e.pass_id} done: {e.evaluator}")
+
+    train_reader = paddle.reader.batch(
+        paddle.reader.shuffle(paddle.dataset.mnist.train(), 8192, seed=1),
+        args.batch_size, drop_last=True)
+    trainer.train(train_reader, num_passes=args.num_passes,
+                  event_handler=event_handler)
+
+    result = trainer.test(paddle.reader.batch(paddle.dataset.mnist.test(),
+                                              args.batch_size))
+    print(f"test cost {result.cost:.4f} {result.evaluator}")
+
+    trainer.save_pass(args.output, args.num_passes - 1)
+    print(f"saved checkpoint under {args.output}")
+
+    # inference round-trip through the saved checkpoint
+    ckpt = os.path.join(args.output, f"pass-{args.num_passes - 1:05d}",
+                        "params.tar")
+    with open(ckpt, "rb") as f:
+        loaded = paddle.Parameters.from_tar(f)
+    samples = [(s[0],) for _, s in zip(range(8),
+                                       paddle.dataset.mnist.test()())]
+    probs = paddle.infer(output_layer=out, parameters=loaded, input=samples,
+                         feeding={"pixel": 0})
+    print("inference probs shape:", probs.shape,
+          "argmax:", probs.argmax(-1).tolist())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

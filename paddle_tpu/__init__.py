@@ -1,0 +1,57 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Re-implements the capability surface of 2017-era PaddlePaddle (reference:
+lixu18/Paddle) as an idiomatic JAX/XLA framework: functional ops compiled by
+XLA, `jax.sharding.Mesh` + jit-sharded training replacing the multi-GPU
+trainer and parameter-server stack, a scan-based dynamic recurrent engine
+with beam search, and a `paddle.v2`-shaped user API.
+
+Reference parity map (reference file:line cites live in each module):
+  - paddle/math + paddle/cuda        -> XLA (+ paddle_tpu/ops/pallas_*)
+  - paddle/gserver layers            -> paddle_tpu/ops, paddle_tpu/layers
+  - config_parser / ModelConfig      -> paddle_tpu/core/topology.py
+  - paddle/trainer                   -> paddle_tpu/trainer
+  - paddle/parameter optimizers      -> paddle_tpu/optimizer
+  - MultiGradientMachine / pserver   -> paddle_tpu/parallel (mesh + collectives)
+  - go/master elastic runtime        -> paddle_tpu/trainer/coordinator.py
+  - python/paddle/v2 API             -> paddle_tpu (this package's top level)
+"""
+
+__version__ = "0.1.0"
+
+from paddle_tpu import config as _config
+from paddle_tpu.config import init
+from paddle_tpu import layers as layer  # paddle.v2 calls this module `layer`
+from paddle_tpu import optimizer
+from paddle_tpu import trainer
+from paddle_tpu.trainer import event
+from paddle_tpu.trainer.parameters import Parameters, create as create_parameters
+from paddle_tpu.trainer.trainer import SGD
+from paddle_tpu.trainer.inference import infer, Inference
+from paddle_tpu import reader
+from paddle_tpu import dataset
+from paddle_tpu.core.topology import Topology
+from paddle_tpu.core import data_type
+from paddle_tpu import activation
+from paddle_tpu import attr
+from paddle_tpu import pooling
+
+__all__ = [
+    "init",
+    "layer",
+    "optimizer",
+    "trainer",
+    "event",
+    "Parameters",
+    "create_parameters",
+    "SGD",
+    "infer",
+    "Inference",
+    "reader",
+    "dataset",
+    "Topology",
+    "data_type",
+    "activation",
+    "attr",
+    "pooling",
+]

@@ -1,0 +1,49 @@
+"""paddle.v2.attr-compatible attribute classes.
+
+Reference: python/paddle/trainer_config_helpers/attrs.py —
+ParameterAttribute (Param), ExtraLayerAttribute (Extra). The heavy lifting
+lives in core/registry.ParamAttr; these are thin API-parity wrappers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.core.registry import ParamAttr
+
+
+def Param(name: Optional[str] = None, learning_rate: float = 1.0,
+          l1_rate: Optional[float] = None, l2_rate: Optional[float] = None,
+          initial_std: Optional[float] = None, initial_mean: float = 0.0,
+          is_static: bool = False, sparse_update: bool = False,
+          gradient_clipping_threshold: Optional[float] = None,
+          initializer=None, **kwargs) -> ParamAttr:
+    return ParamAttr(name=name, learning_rate=learning_rate,
+                     l1_rate=l1_rate, l2_rate=l2_rate,
+                     initial_std=initial_std, initial_mean=initial_mean,
+                     is_static=is_static, sparse=sparse_update,
+                     gradient_clipping_threshold=gradient_clipping_threshold,
+                     initializer=initializer)
+
+
+ParameterAttribute = Param
+
+
+class ExtraLayerAttribute:
+    """Extra layer attrs: drop_rate and error clipping.
+
+    Reference attrs.py ExtraLayerAttribute(drop_rate=, device=,
+    error_clipping_threshold=). `device` pinning is obsolete under XLA
+    (GSPMD shards instead); accepted and ignored.
+    """
+
+    def __init__(self, drop_rate: Optional[float] = None,
+                 device: Optional[int] = None,
+                 error_clipping_threshold: Optional[float] = None):
+        self.drop_rate = drop_rate
+        self.device = device
+        self.error_clipping_threshold = error_clipping_threshold
+
+
+Extra = ExtraLayerAttribute
+ExtraAttr = ExtraLayerAttribute

@@ -1,0 +1,3 @@
+from paddle_tpu.core import data_type, sequence, initializers, registry, topology
+
+__all__ = ["data_type", "sequence", "initializers", "registry", "topology"]

@@ -1,0 +1,69 @@
+"""Parameter initialization policies.
+
+Reference: paddle/parameter/Parameter.cpp randomize() — default init is
+uniform(-sqrt(3/width), sqrt(3/width)) keyed off `initial_std`/`initial_mean`
+/`initial_strategy` in ParameterConfig.proto, with `initial_smart` choosing
+1/sqrt(fan_in). Exposed through ParamAttr (trainer_config_helpers/attrs.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Initializer = Callable[[jax.Array, Tuple[int, ...], jnp.dtype], jax.Array]
+
+
+def normal(std: float = 0.01, mean: float = 0.0) -> Initializer:
+    def init(key, shape, dtype=jnp.float32):
+        return mean + std * jax.random.normal(key, shape, dtype)
+    return init
+
+
+def uniform(scale: float) -> Initializer:
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+    return init
+
+
+def constant(value: float = 0.0) -> Initializer:
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+    return init
+
+
+zeros = constant(0.0)
+ones = constant(1.0)
+
+
+def smart_normal(fan_in_axis: int = 0) -> Initializer:
+    """The reference's `initial_smart`: std = 1/sqrt(fan_in)."""
+    def init(key, shape, dtype=jnp.float32):
+        fan_in = shape[fan_in_axis] if shape else 1
+        std = 1.0 / math.sqrt(max(fan_in, 1))
+        return std * jax.random.normal(key, shape, dtype)
+    return init
+
+
+def xavier(fan_in_axes: Sequence[int] = (0,)) -> Initializer:
+    def init(key, shape, dtype=jnp.float32):
+        fan_in = 1
+        for a in fan_in_axes:
+            fan_in *= shape[a]
+        scale = math.sqrt(3.0 / max(fan_in, 1))
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+    return init
+
+
+def msra(fan_in_axes: Sequence[int] = (0,)) -> Initializer:
+    """He/MSRA init for conv/relu stacks."""
+    def init(key, shape, dtype=jnp.float32):
+        fan_in = 1
+        for a in fan_in_axes:
+            fan_in *= shape[a]
+        std = math.sqrt(2.0 / max(fan_in, 1))
+        return std * jax.random.normal(key, shape, dtype)
+    return init

@@ -1,0 +1,210 @@
+"""Layer/op registries and graph node types.
+
+Reference parity: the `@config_layer('fc')` registry in
+python/paddle/trainer/config_parser.py (:1786 and siblings) validated configs
+and computed output sizes in Python; REGISTER_LAYER (gserver/layers/Layer.h:31)
+bound the C++ compute. Here both halves live together: a registered LayerImpl
+carries `build` (validate + shape-infer + declare params — the config_parser
+half) and `apply` (pure JAX compute — the gserver half, compiled by XLA).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers
+
+# ---------------------------------------------------------------------------
+# Parameter declaration
+
+
+@dataclasses.dataclass
+class ParamAttr:
+    """Per-parameter attributes — reference: ParameterConfig.proto +
+    trainer_config_helpers/attrs.py ParameterAttribute (lr, l2, sparse,
+    is_static, shared name)."""
+    name: Optional[str] = None
+    learning_rate: float = 1.0
+    l1_rate: Optional[float] = None
+    l2_rate: Optional[float] = None
+    is_static: bool = False
+    sparse: bool = False            # row-sparse gradient (embedding tables)
+    initializer: Optional[Any] = None
+    initial_std: Optional[float] = None
+    initial_mean: float = 0.0
+    gradient_clipping_threshold: Optional[float] = None
+
+    @staticmethod
+    def of(x) -> "ParamAttr":
+        if x is None:
+            return ParamAttr()
+        if isinstance(x, ParamAttr):
+            return x
+        if isinstance(x, dict):
+            return ParamAttr(**x)
+        raise TypeError(f"cannot convert {x!r} to ParamAttr")
+
+
+@dataclasses.dataclass
+class ParamSpec:
+    name: str
+    shape: Tuple[int, ...]
+    initializer: Any
+    attr: ParamAttr
+    dtype: Any = jnp.float32
+
+
+@dataclasses.dataclass
+class StateSpec:
+    """Non-trainable state (e.g. batch-norm moving stats). Reference keeps
+    these as parameters with is_static + moving-average update hooks; we keep
+    them in a separate 'state' collection updated functionally."""
+    name: str
+    shape: Tuple[int, ...]
+    init_value: float = 0.0
+    dtype: Any = jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# Graph nodes
+
+
+@dataclasses.dataclass
+class LayerMeta:
+    """Static description of one layer's output (what config_parser tracked:
+    size, image dims, sequence level)."""
+    size: int                       # feature dimension
+    seq_level: int = 0              # 0: sample, 1: sequence, 2: nested
+    height: int = 0                 # spatial dims for image layers
+    width: int = 0
+    channels: int = 0
+    depth: int = 0                  # for 3D conv
+    is_integer: bool = False        # integer ids (embedding input)
+
+
+_name_counters: Dict[str, "itertools.count"] = {}
+
+
+def _auto_name(layer_type: str) -> str:
+    c = _name_counters.setdefault(layer_type, itertools.count())
+    return f"__{layer_type}_{next(c)}__"
+
+
+def reset_name_counters():
+    _name_counters.clear()
+
+
+class LayerOutput:
+    """The object a DSL call returns; doubles as the graph node.
+
+    Mirrors trainer_config_helpers.layers.LayerOutput: holds name, type,
+    parents, and the static config. The full graph is recovered by walking
+    `parents` from the requested outputs (python/paddle/v2/layer.py
+    parse_network:263 does the same trim).
+    """
+
+    def __init__(self, layer_type: str, name: Optional[str], parents:
+                 Sequence["LayerOutput"], config: Dict[str, Any],
+                 meta: LayerMeta, params: List[ParamSpec],
+                 states: List[StateSpec]):
+        self.type = layer_type
+        self.name = name or _auto_name(layer_type)
+        self.parents = list(parents)
+        self.config = config
+        self.meta = meta
+        self.params = params
+        self.states = states
+
+    @property
+    def size(self) -> int:
+        return self.meta.size
+
+    def __repr__(self):
+        return f"LayerOutput({self.type}:{self.name}, size={self.meta.size})"
+
+
+# ---------------------------------------------------------------------------
+# Apply-time context
+
+
+class ApplyContext:
+    """Runtime context threaded through layer `apply` calls."""
+
+    def __init__(self, mode: str, rng: Optional[jax.Array], state: Dict[str, Any]):
+        self.mode = mode                  # 'train' | 'test'
+        self._rng = rng
+        self.state = dict(state)          # read view
+        self.state_updates: Dict[str, Any] = {}
+
+    @property
+    def is_train(self) -> bool:
+        return self.mode == "train"
+
+    def rng_for(self, layer_name: str) -> jax.Array:
+        if self._rng is None:
+            return jax.random.PRNGKey(0)
+        # deterministic digest — python hash() is salted per process and
+        # would break seeded reproducibility of dropout/NCE sampling
+        digest = zlib.crc32(layer_name.encode()) & 0x7FFFFFFF
+        return jax.random.fold_in(self._rng, digest)
+
+    def get_state(self, name: str):
+        return self.state[name]
+
+    def set_state(self, name: str, value):
+        self.state_updates[name] = value
+
+
+# ---------------------------------------------------------------------------
+# Registry
+
+# layer type -> dict(build=..., apply=...)
+_LAYER_REGISTRY: Dict[str, Dict[str, Callable]] = {}
+
+
+def register_layer(layer_type: str):
+    """Register a layer implementation.
+
+    build(name, cfg, input_metas) -> (LayerMeta, [ParamSpec], [StateSpec])
+    apply(ctx, name, cfg, params, inputs) -> output (array or SequenceBatch)
+    """
+    def deco(cls):
+        _LAYER_REGISTRY[layer_type] = {
+            "build": cls.build, "apply": cls.apply, "cls": cls}
+        return cls
+    return deco
+
+
+def get_layer_impl(layer_type: str) -> Dict[str, Callable]:
+    if layer_type not in _LAYER_REGISTRY:
+        raise KeyError(f"unknown layer type {layer_type!r}; registered: "
+                       f"{sorted(_LAYER_REGISTRY)}")
+    return _LAYER_REGISTRY[layer_type]
+
+
+def registered_layer_types() -> List[str]:
+    return sorted(_LAYER_REGISTRY)
+
+
+def make_layer(layer_type: str, name: Optional[str],
+               inputs: Sequence[LayerOutput], **config) -> LayerOutput:
+    """Construct a graph node: run the build half, wrap the result."""
+    impl = get_layer_impl(layer_type)
+    name = name or _auto_name(layer_type)
+    metas = [i.meta for i in inputs]
+    meta, params, states = impl["build"](name, config, metas)
+    return LayerOutput(layer_type, name, inputs, config, meta, params, states)
+
+
+def default_weight_init(attr: ParamAttr, fan_in_axes=(0,)):
+    if attr.initializer is not None:
+        return attr.initializer
+    if attr.initial_std is not None:
+        return initializers.normal(attr.initial_std, attr.initial_mean)
+    return initializers.xavier(fan_in_axes)

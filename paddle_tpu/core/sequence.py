@@ -1,0 +1,152 @@
+"""Ragged / nested sequence batches — the framework's `Argument` sequence layout.
+
+Reference: paddle/parameter/Argument.h:84-93 threads
+`sequenceStartPositions` / `subSequenceStartPositions` (two levels of offsets)
+through every layer so variable-length and nested sequences train without
+per-sample looping; gserver/layers/SequenceToBatch.h re-packs ragged rows into
+dense per-timestep batches for RNNs.
+
+TPU-native design: XLA wants static shapes, so a batch of ragged sequences is
+a dense padded array plus integer lengths — masking replaces re-packing
+(`SequenceToBatch` is unnecessary: a scan over the padded time axis with a
+`t < length` mask does the same work without gather/scatter, and XLA fuses the
+mask into the cell math). Nested (sub-)sequences carry a per-position
+`segment_ids` plane mapping each timestep to its inner sequence, which is what
+segment-reductions need (`jax.ops.segment_sum` style) — the generalization the
+reference later called LoD (framework/lod_tensor.h:51).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence as PySequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class SequenceBatch:
+    """A batch of padded variable-length sequences.
+
+    data:        [batch, max_len, *feature_dims]  (or [batch, max_len] for ids)
+    lengths:     [batch] int32 — valid timesteps per row
+    segment_ids: optional [batch, max_len] int32 — inner-sequence index per
+                 position (for nested sequences); -1 on padding
+    num_segments: optional [batch] int32 — inner sequences per row
+    """
+
+    def __init__(self, data, lengths, segment_ids=None, num_segments=None):
+        self.data = data
+        self.lengths = lengths
+        self.segment_ids = segment_ids
+        self.num_segments = num_segments
+
+    # --- pytree protocol -------------------------------------------------
+    def tree_flatten(self):
+        children = (self.data, self.lengths, self.segment_ids, self.num_segments)
+        return children, None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # --- basic properties ------------------------------------------------
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def is_nested(self) -> bool:
+        return self.segment_ids is not None
+
+    def mask(self, dtype=jnp.float32) -> jnp.ndarray:
+        """[batch, max_len] 1.0 on valid positions, 0.0 on padding."""
+        t = jnp.arange(self.max_len, dtype=jnp.int32)[None, :]
+        return (t < self.lengths[:, None]).astype(dtype)
+
+    def bool_mask(self) -> jnp.ndarray:
+        t = jnp.arange(self.max_len, dtype=jnp.int32)[None, :]
+        return t < self.lengths[:, None]
+
+    def masked_data(self) -> jnp.ndarray:
+        """Zero out padding positions."""
+        m = self.mask(self.data.dtype)
+        return self.data * m.reshape(m.shape + (1,) * (self.data.ndim - 2))
+
+    def with_data(self, data) -> "SequenceBatch":
+        return SequenceBatch(data, self.lengths, self.segment_ids,
+                             self.num_segments)
+
+    def total_tokens(self) -> jnp.ndarray:
+        return jnp.sum(self.lengths)
+
+    def __repr__(self):
+        return (f"SequenceBatch(data={getattr(self.data, 'shape', None)}, "
+                f"lengths={getattr(self.lengths, 'shape', None)}, "
+                f"nested={self.is_nested})")
+
+
+def pack_sequences(rows: PySequence[np.ndarray], max_len: Optional[int] = None,
+                   pad_value=0, dtype=None) -> SequenceBatch:
+    """Pack a list of per-sample [len, ...] arrays into a padded SequenceBatch.
+
+    This is the host-side converter that plays the role of
+    py_paddle/dataprovider_converter.py (numpy -> Argument with
+    sequenceStartPositions).
+    """
+    rows = [np.asarray(r) for r in rows]
+    lengths = np.asarray([r.shape[0] for r in rows], dtype=np.int32)
+    ml = int(max_len if max_len is not None else (lengths.max() if len(rows) else 0))
+    ml = max(ml, 1)
+    feat = rows[0].shape[1:] if rows else ()
+    if dtype is None:
+        dtype = rows[0].dtype if rows else np.float32
+    out = np.full((len(rows), ml) + feat, pad_value, dtype=dtype)
+    for i, r in enumerate(rows):
+        n = min(r.shape[0], ml)
+        out[i, :n] = r[:n]
+    return SequenceBatch(jnp.asarray(out), jnp.asarray(np.minimum(lengths, ml)))
+
+
+def pack_nested_sequences(rows: PySequence[PySequence[np.ndarray]],
+                          pad_value=0, dtype=None) -> SequenceBatch:
+    """Pack a list of per-sample lists of subsequences (nested sequences).
+
+    Each sample is a list of [sub_len, ...] arrays. Flattened along time with
+    segment_ids marking subsequence membership — the two-level
+    subSequenceStartPositions layout (Argument.h:89-90) as dense planes.
+    """
+    flat_rows, seg_rows, num_segs = [], [], []
+    for sample in rows:
+        parts = [np.asarray(p) for p in sample]
+        flat_rows.append(np.concatenate(parts, axis=0) if parts
+                         else np.zeros((0,), dtype=np.float32))
+        seg = np.concatenate([np.full(p.shape[0], i, dtype=np.int32)
+                              for i, p in enumerate(parts)]) if parts else \
+            np.zeros((0,), dtype=np.int32)
+        seg_rows.append(seg)
+        num_segs.append(len(parts))
+    packed = pack_sequences(flat_rows, pad_value=pad_value, dtype=dtype)
+    ml = packed.max_len
+    seg_arr = np.full((len(rows), ml), -1, dtype=np.int32)
+    for i, s in enumerate(seg_rows):
+        seg_arr[i, :min(len(s), ml)] = s[:ml]
+    return SequenceBatch(packed.data, packed.lengths, jnp.asarray(seg_arr),
+                         jnp.asarray(np.asarray(num_segs, dtype=np.int32)))
+
+
+def bucket_length(n: int, buckets: PySequence[int] = (16, 32, 64, 128, 256, 512, 1024)) -> int:
+    """Round a max length up to a bucket to bound XLA recompilation.
+
+    The reference pays zero padding via SequenceToBatch; on TPU we instead pay
+    bounded padding for static shapes, amortised by bucketing.
+    """
+    for b in buckets:
+        if n <= b:
+            return b
+    return int(np.ceil(n / buckets[-1]) * buckets[-1])

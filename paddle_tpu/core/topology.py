@@ -1,0 +1,213 @@
+"""Topology — the serializable model graph and its traced executor.
+
+Reference parity: python/paddle/v2/topology.py:25 wraps the ModelConfig
+protobuf produced by config_parser; paddle/gserver NeuralNetwork walks layers
+in topological order (NeuralNetwork.cpp:235-260) calling forward/backward.
+
+Here the graph is recovered from output LayerOutputs (parse_network-style
+trim, python/paddle/v2/layer.py:263), serialized as JSON (the
+serialized-topology-as-contract pattern replacing ModelConfig.proto), and
+executed as ONE pure function `forward(params, state, feed, ...)` that jit
+traces — autodiff via `jax.grad` replaces every per-layer backward().
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import (ApplyContext, LayerOutput, ParamSpec,
+                                      StateSpec, get_layer_impl, make_layer)
+from paddle_tpu.core.sequence import SequenceBatch
+
+
+def _collect(outputs: Sequence[LayerOutput]) -> List[LayerOutput]:
+    """Topological order (parents first) of the sub-graph reaching `outputs`."""
+    order: List[LayerOutput] = []
+    seen: Dict[int, bool] = {}
+    # iterative DFS to survive deep graphs
+    stack = [(o, False) for o in reversed(list(outputs))]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        if seen.get(id(node)):
+            continue
+        seen[id(node)] = True
+        stack.append((node, True))
+        for p in reversed(node.parents):
+            if not seen.get(id(p)):
+                stack.append((p, False))
+    return order
+
+
+class Topology:
+    """The model: layers in topo order + parameter/state specs."""
+
+    def __init__(self, outputs: Union[LayerOutput, Sequence[LayerOutput]],
+                 extra_outputs: Sequence[LayerOutput] = ()):
+        if isinstance(outputs, LayerOutput):
+            outputs = [outputs]
+        self.outputs = list(outputs) + list(extra_outputs)
+        self.layers = _collect(self.outputs)
+        names = [l.name for l in self.layers]
+        dup = {n for n in names if names.count(n) > 1}
+        if dup:
+            raise ValueError(f"duplicate layer names in topology: {sorted(dup)}")
+        self.by_name = {l.name: l for l in self.layers}
+        # merge param specs (shared params must agree on shape)
+        self.param_specs: Dict[str, ParamSpec] = {}
+        self.state_specs: Dict[str, StateSpec] = {}
+        for l in self.layers:
+            for ps in l.params:
+                if ps.name in self.param_specs:
+                    prev = self.param_specs[ps.name]
+                    if tuple(prev.shape) != tuple(ps.shape):
+                        raise ValueError(
+                            f"shared parameter {ps.name!r} shape mismatch: "
+                            f"{prev.shape} vs {ps.shape}")
+                else:
+                    self.param_specs[ps.name] = ps
+            for ss in l.states:
+                self.state_specs[ss.name] = ss
+
+    # ------------------------------------------------------------------ init
+    def init_params(self, rng: Optional[jax.Array] = None) -> Dict[str, jax.Array]:
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        params = {}
+        for i, (name, ps) in enumerate(sorted(self.param_specs.items())):
+            key = jax.random.fold_in(rng, i)
+            params[name] = ps.initializer(key, tuple(ps.shape), ps.dtype)
+        return params
+
+    def init_state(self) -> Dict[str, jax.Array]:
+        return {name: jnp.full(tuple(ss.shape), ss.init_value, ss.dtype)
+                for name, ss in sorted(self.state_specs.items())}
+
+    # --------------------------------------------------------------- forward
+    def forward(self, params: Dict[str, jax.Array],
+                state: Dict[str, jax.Array],
+                feed: Dict[str, Any], *, mode: str = "train",
+                rng: Optional[jax.Array] = None,
+                output_names: Optional[Sequence[str]] = None):
+        """Pure forward pass.
+
+        Returns (outputs_dict, new_state). `outputs_dict` maps layer name ->
+        value for requested outputs (default: self.outputs).
+        """
+        ctx = ApplyContext(mode, rng, state)
+        values: Dict[str, Any] = {}
+        wanted = set(output_names) if output_names is not None else \
+            {o.name for o in self.outputs}
+        for layer in self.layers:
+            impl = get_layer_impl(layer.type)
+            if layer.type == "data":
+                if layer.name not in feed:
+                    raise KeyError(f"missing feed for data layer {layer.name!r}")
+                values[layer.name] = impl["apply"](ctx, layer.name,
+                                                   layer.config, {},
+                                                   [feed[layer.name]])
+            else:
+                lparams = {ps.name: params[ps.name] for ps in layer.params}
+                inputs = [values[p.name] for p in layer.parents]
+                values[layer.name] = impl["apply"](ctx, layer.name,
+                                                   layer.config, lparams,
+                                                   inputs)
+        new_state = dict(state)
+        new_state.update(ctx.state_updates)
+        outs = {n: values[n] for n in wanted if n in values}
+        return outs, new_state
+
+    # ------------------------------------------------------------ data layers
+    def data_layers(self) -> Dict[str, LayerOutput]:
+        """Name -> data layer, in declaration order (feeding order contract,
+        mirrors Topology.data_layers in v2/topology.py)."""
+        return {l.name: l for l in self.layers if l.type == "data"}
+
+    def data_type(self):
+        """[(name, InputType)] — v2 API compatibility for DataFeeder."""
+        from paddle_tpu.core import data_type as dt
+        out = []
+        for name, l in self.data_layers().items():
+            out.append((name, l.config["input_type"]))
+        return out
+
+    # ----------------------------------------------------------- serialization
+    def serialize(self) -> str:
+        """JSON model config — the ModelConfig.proto contract equivalent."""
+        layers = []
+        for l in self.layers:
+            layers.append({
+                "name": l.name,
+                "type": l.type,
+                "inputs": [p.name for p in l.parents],
+                "config": _jsonify(l.config),
+            })
+        return json.dumps({
+            "format": "paddle_tpu.topology.v1",
+            "layers": layers,
+            "outputs": [o.name for o in self.outputs],
+        }, indent=1)
+
+    @staticmethod
+    def deserialize(blob: Union[str, bytes]) -> "Topology":
+        spec = json.loads(blob)
+        assert spec.get("format") == "paddle_tpu.topology.v1", "bad topology blob"
+        built: Dict[str, LayerOutput] = {}
+        for ld in spec["layers"]:
+            cfg = _unjsonify(ld["config"])
+            inputs = [built[n] for n in ld["inputs"]]
+            node = make_layer(ld["type"], ld["name"], inputs, **cfg)
+            built[ld["name"]] = node
+        return Topology([built[n] for n in spec["outputs"]])
+
+    def proto(self) -> str:
+        """v2 API compat alias (Topology.proto() returned the ModelConfig pb)."""
+        return self.serialize()
+
+
+def _jsonify(obj):
+    from paddle_tpu.core.data_type import InputType, SeqType
+    from paddle_tpu.core.registry import ParamAttr
+    if isinstance(obj, dict):
+        # "_obj_*" keys hold runtime-only objects (e.g. captured
+        # sub-topologies) rebuilt on deserialize — never serialized.
+        return {k: _jsonify(v) for k, v in obj.items()
+                if not k.startswith("_obj_")}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    if isinstance(obj, InputType):
+        return {"__input_type__": [obj.dim, obj.kind, obj.seq_type.value]}
+    if isinstance(obj, SeqType):
+        return {"__seq_type__": obj.value}
+    if isinstance(obj, ParamAttr):
+        # initializer callables are init-time only; dropped in serialization
+        return {"__param_attr__": {
+            "name": obj.name, "learning_rate": obj.learning_rate,
+            "l1_rate": obj.l1_rate, "l2_rate": obj.l2_rate,
+            "is_static": obj.is_static, "sparse": obj.sparse,
+            "initial_std": obj.initial_std, "initial_mean": obj.initial_mean,
+            "gradient_clipping_threshold": obj.gradient_clipping_threshold}}
+    return obj
+
+
+def _unjsonify(obj):
+    from paddle_tpu.core.data_type import InputType, SeqType
+    from paddle_tpu.core.registry import ParamAttr
+    if isinstance(obj, dict):
+        if "__input_type__" in obj:
+            d, k, s = obj["__input_type__"]
+            return InputType(d, k, SeqType(s))
+        if "__seq_type__" in obj:
+            return SeqType(obj["__seq_type__"])
+        if "__param_attr__" in obj:
+            return ParamAttr(**obj["__param_attr__"])
+        return {k: _unjsonify(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unjsonify(v) for v in obj]
+    return obj

@@ -1,0 +1,5 @@
+from paddle_tpu.dataset import mnist, cifar, uci_housing, imdb, imikolov
+from paddle_tpu.dataset import synthetic, common
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov", "synthetic",
+           "common"]

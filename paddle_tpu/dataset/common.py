@@ -1,0 +1,26 @@
+"""Dataset cache helpers.
+
+Reference: python/paddle/v2/dataset/common.py (download + md5 cache under
+~/.cache/paddle/dataset). This environment has no network egress, so every
+loader first checks the cache dir for real data and otherwise falls back to
+a DETERMINISTIC synthetic generator with the same shapes/vocab — keeping
+demos, tests, and benchmarks hermetic. Drop real files into DATA_HOME to
+train on true data with zero code changes.
+"""
+
+from __future__ import annotations
+
+import os
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def cache_path(module: str, filename: str) -> str:
+    d = os.path.join(DATA_HOME, module)
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, filename)
+
+
+def has_cached(module: str, filename: str) -> bool:
+    return os.path.exists(os.path.join(DATA_HOME, module, filename))

@@ -1,0 +1,30 @@
+"""IMDB sentiment — python/paddle/v2/dataset/imdb.py parity.
+Samples: (token ids int64[seq_len], label 0/1). Synthetic fallback matches
+the benchmark config (dict 30k, seq ~100) from benchmark/paddle/rnn."""
+
+from __future__ import annotations
+
+from paddle_tpu.dataset import synthetic
+
+_VOCAB = 30000
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    def reader():
+        for toks, lab in synthetic.token_sequences(
+                n, _VOCAB, 2, seed, min_len=50, max_len=100,
+                profile_seed=1000):
+            yield toks, lab
+    return reader
+
+
+def train(word_idx=None):
+    return _reader(4096, 11)
+
+
+def test(word_idx=None):
+    return _reader(512, 12)

@@ -1,0 +1,503 @@
+"""The layer DSL — paddle.v2.layer-compatible construction functions.
+
+Reference: python/paddle/trainer_config_helpers/layers.py (~120 wrappers)
+re-exported by python/paddle/v2/layer.py under short names (fc, data,
+embedding, img_conv, ...). Each function normalizes arguments (activation
+objects -> names, attrs -> ParamAttr) and creates a graph node via the
+build half of the registered layer implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from paddle_tpu import activation as act_mod
+from paddle_tpu import pooling as pool_mod
+from paddle_tpu.core.data_type import InputType
+from paddle_tpu.core.registry import LayerOutput, make_layer
+
+# import implementations to populate the registry
+from paddle_tpu.layers import base as _base            # noqa: F401
+from paddle_tpu.layers import conv_layers as _conv     # noqa: F401
+from paddle_tpu.layers import seq_layers as _seq       # noqa: F401
+from paddle_tpu.layers import cost_layers as _cost     # noqa: F401
+from paddle_tpu.layers import recurrent_layers as _rec  # noqa: F401
+from paddle_tpu.layers import group as _group          # noqa: F401
+from paddle_tpu.layers.group import (recurrent_group, memory, beam_search,
+                                     StaticInput, GeneratedInput)
+from paddle_tpu.layers import crf_layers as _crf       # noqa: F401
+
+
+def _listify(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+# ---------------------------------------------------------------------------
+# data & core
+
+
+def data(name: str, type: InputType, height: int = 0, width: int = 0,
+         **kw) -> LayerOutput:
+    return make_layer("data", name, [], input_type=type, height=height,
+                      width=width)
+
+
+data_layer = data
+
+
+def fc(input, size: int, act=None, name: Optional[str] = None,
+       param_attr=None, bias_attr=None, layer_attr=None, **kw) -> LayerOutput:
+    inputs = _listify(input)
+    node = make_layer("fc", name, inputs, size=size,
+                      act=act_mod.to_name(act), param_attr=param_attr,
+                      bias_attr=bias_attr)
+    return _maybe_dropout(node, layer_attr)
+
+
+fc_layer = fc
+
+
+def embedding(input, size: int, name: Optional[str] = None, param_attr=None,
+              **kw) -> LayerOutput:
+    return make_layer("embedding", name, [input], size=size,
+                      param_attr=param_attr)
+
+
+embedding_layer = embedding
+
+
+def dropout(input, dropout_rate: float = 0.5,
+            name: Optional[str] = None) -> LayerOutput:
+    return make_layer("dropout", name, [input], dropout_rate=dropout_rate)
+
+
+dropout_layer = dropout
+
+
+def _maybe_dropout(node: LayerOutput, layer_attr) -> LayerOutput:
+    if layer_attr is not None and getattr(layer_attr, "drop_rate", None):
+        return dropout(node, layer_attr.drop_rate)
+    return node
+
+
+def addto(input, act=None, name: Optional[str] = None,
+          bias_attr=None, **kw) -> LayerOutput:
+    return make_layer("addto", name, _listify(input),
+                      act=act_mod.to_name(act), bias_attr=bias_attr)
+
+
+addto_layer = addto
+
+
+def concat(input, act=None, name: Optional[str] = None, **kw) -> LayerOutput:
+    return make_layer("concat", name, _listify(input),
+                      act=act_mod.to_name(act))
+
+
+concat_layer = concat
+
+
+def batch_norm(input, act=None, name: Optional[str] = None, num_channels=None,
+               param_attr=None, bias_attr=None, use_global_stats=None,
+               moving_average_fraction: float = 0.9, **kw) -> LayerOutput:
+    return make_layer("batch_norm", name, [input], act=act_mod.to_name(act),
+                      param_attr=param_attr, bias_attr=bias_attr,
+                      channels=num_channels,
+                      use_global_stats=use_global_stats,
+                      moving_average_fraction=moving_average_fraction)
+
+
+batch_norm_layer = batch_norm
+
+
+def scaling(weight, input, name: Optional[str] = None, **kw) -> LayerOutput:
+    return make_layer("scaling", name, [weight, input])
+
+
+scaling_layer = scaling
+
+
+def dotmul(a, b, scale: float = 1.0, name: Optional[str] = None) -> LayerOutput:
+    return make_layer("dotmul", name, [a, b], scale=scale)
+
+
+def interpolation(input, weight, name: Optional[str] = None, **kw) -> LayerOutput:
+    a, b = input
+    return make_layer("interpolation", name, [weight, a, b])
+
+
+interpolation_layer = interpolation
+
+
+def slope_intercept(input, slope: float = 1.0, intercept: float = 0.0,
+                    name: Optional[str] = None, **kw) -> LayerOutput:
+    return make_layer("slope_intercept", name, [input], slope=slope,
+                      intercept=intercept)
+
+
+slope_intercept_layer = slope_intercept
+
+
+def cos_sim(a, b, scale: float = 1.0, size: int = 1,
+            name: Optional[str] = None, **kw) -> LayerOutput:
+    return make_layer("cos_sim", name, [a, b], scale=scale)
+
+
+def outer_prod(a, b, name: Optional[str] = None) -> LayerOutput:
+    return make_layer("outer_prod", name, [a, b])
+
+
+def sum_to_one_norm(input, name: Optional[str] = None) -> LayerOutput:
+    return make_layer("sum_to_one_norm", name, [input])
+
+
+sum_to_one_norm_layer = sum_to_one_norm
+
+
+def trans(input, name: Optional[str] = None) -> LayerOutput:
+    return make_layer("trans", name, [input])
+
+
+trans_layer = trans
+
+
+def resize(input, size: int, name: Optional[str] = None) -> LayerOutput:
+    return make_layer("resize", name, [input], size=size)
+
+
+resize_layer = resize
+
+
+def mixed(size: int = 0, input=None, act=None, name: Optional[str] = None,
+          bias_attr=None, **kw) -> LayerOutput:
+    """mixed_layer: sum of projections. Projections are expressed as layer
+    nodes already (full_matrix_projection etc. return nodes); mixed sums
+    them (addto semantics) with optional bias+activation."""
+    return make_layer("addto", name, _listify(input),
+                      act=act_mod.to_name(act), bias_attr=bias_attr)
+
+
+mixed_layer = mixed
+
+
+# Projections (reference: 12 Projection subclasses under MixedLayer). In this
+# graph they are plain nodes summed by mixed()/addto.
+
+def full_matrix_projection(input, size: int, param_attr=None, **kw) -> LayerOutput:
+    return make_layer("fc", None, [input], size=size, act="linear",
+                      param_attr=param_attr, bias_attr=False)
+
+
+def identity_projection(input, offset: int = 0, size: Optional[int] = None, **kw):
+    if offset == 0 and size is None:
+        return input
+    sz = size if size is not None else input.size - offset
+    return slice_projection(input, offset, offset + sz)
+
+
+def slice_projection(input, start: int, end: int, **kw) -> LayerOutput:
+    return make_layer("slice", None, [input], start=start, end=end)
+
+
+def table_projection(input, size: int, param_attr=None, **kw) -> LayerOutput:
+    return make_layer("embedding", None, [input], size=size,
+                      param_attr=param_attr)
+
+
+def scaling_projection(input, param_attr=None, **kw) -> LayerOutput:
+    return make_layer("scaling_projection", None, [input],
+                      param_attr=param_attr)
+
+
+def dotmul_projection(input, param_attr=None, **kw) -> LayerOutput:
+    return make_layer("dotmul_projection", None, [input],
+                      param_attr=param_attr)
+
+
+def trans_full_matrix_projection(input, size: int, param_attr=None, **kw) -> LayerOutput:
+    return make_layer("trans_fc", None, [input], size=size,
+                      param_attr=param_attr)
+
+
+def context_projection(input, context_len: int, context_start=None,
+                       padding_attr=False, **kw) -> LayerOutput:
+    trainable = padding_attr not in (False, None)
+    return make_layer(
+        "context_projection", None, [input], context_len=context_len,
+        context_start=(context_start if context_start is not None
+                       else -(context_len // 2)),
+        trainable_padding=trainable,
+        param_attr=None if padding_attr in (False, True, None) else padding_attr)
+
+
+# ---------------------------------------------------------------------------
+# image layers
+
+
+def img_conv(input, filter_size: int, num_filters: int, name=None,
+             num_channels=None, act=None, groups: int = 1, stride: int = 1,
+             padding: int = 0, dilation: int = 1, bias_attr=None,
+             param_attr=None, trans: bool = False, layer_attr=None,
+             **kw) -> LayerOutput:
+    node = make_layer("conv", name, [input], filter_size=filter_size,
+                      num_filters=num_filters, channels=num_channels,
+                      act=act_mod.to_name(act), groups=groups, stride=stride,
+                      padding=padding, dilation=dilation, bias_attr=bias_attr,
+                      param_attr=param_attr, trans=trans)
+    return _maybe_dropout(node, layer_attr)
+
+
+img_conv_layer = img_conv
+
+
+def img_pool(input, pool_size: int, name=None, num_channels=None,
+             pool_type=None, stride: int = 1, padding: int = 0,
+             **kw) -> LayerOutput:
+    return make_layer("pool", name, [input], pool_size=pool_size,
+                      channels=num_channels, pool_type=pool_mod.to_name(
+                          pool_type or "max"),
+                      stride=stride, padding=padding)
+
+
+img_pool_layer = img_pool
+
+
+def img_cmrnorm(input, size: int = 5, scale: float = 0.0128,
+                power: float = 0.75, name=None, **kw) -> LayerOutput:
+    return make_layer("img_cmrnorm", name, [input], size=size, scale=scale,
+                      power=power)
+
+
+img_cmrnorm_layer = img_cmrnorm
+
+
+def maxout(input, groups: int, name=None, **kw) -> LayerOutput:
+    return make_layer("maxout", name, [input], groups=groups)
+
+
+maxout_layer = maxout
+
+
+def spp(input, pyramid_height: int = 3, pool_type=None, name=None,
+        **kw) -> LayerOutput:
+    return make_layer("spp", name, [input], pyramid_height=pyramid_height,
+                      pool_type=pool_mod.to_name(pool_type or "max"))
+
+
+spp_layer = spp
+
+
+def pad(input, pad_c=None, pad_h=None, pad_w=None, name=None, **kw) -> LayerOutput:
+    return make_layer("pad", name, [input], pad_c=pad_c or [0, 0],
+                      pad_h=pad_h or [0, 0], pad_w=pad_w or [0, 0])
+
+
+pad_layer = pad
+
+
+def crop(input, shape, offset=None, name=None, **kw) -> LayerOutput:
+    return make_layer("crop", name, [input], shape=shape,
+                      offset=offset or [0, 0, 0])
+
+
+def bilinear_interp(input, out_size_x: int, out_size_y: int, name=None,
+                    **kw) -> LayerOutput:
+    return make_layer("bilinear_interp", name, [input], out_size_x=out_size_x,
+                      out_size_y=out_size_y)
+
+
+bilinear_interp_layer = bilinear_interp
+
+
+def block_expand(input, block_x: int, block_y: int, stride_x: int = 1,
+                 stride_y: int = 1, padding_x: int = 0, padding_y: int = 0,
+                 num_channels=None, name=None, **kw) -> LayerOutput:
+    return make_layer("block_expand", name, [input], block_x=block_x,
+                      block_y=block_y, stride_x=stride_x, stride_y=stride_y,
+                      padding_x=padding_x, padding_y=padding_y,
+                      channels=num_channels)
+
+
+block_expand_layer = block_expand
+
+
+# ---------------------------------------------------------------------------
+# sequence layers
+
+
+def pooling(input, pooling_type=None, agg_level: int = 0, name=None,
+            max_segments=None, **kw) -> LayerOutput:
+    return make_layer("seqpool", name, [input],
+                      pool_type=pool_mod.to_name(pooling_type),
+                      agg_level=agg_level, max_segments=max_segments)
+
+
+pooling_layer = pooling
+
+
+def last_seq(input, name=None, agg_level: int = 0, **kw) -> LayerOutput:
+    return make_layer("seqlastins", name, [input], first=False)
+
+
+def first_seq(input, name=None, agg_level: int = 0, **kw) -> LayerOutput:
+    return make_layer("seqlastins", name, [input], first=True)
+
+
+def expand(input, expand_as, name=None, expand_level: int = 0, **kw) -> LayerOutput:
+    return make_layer("expand", name, [input, expand_as])
+
+
+expand_layer = expand
+
+
+def seq_concat(a, b, name=None, **kw) -> LayerOutput:
+    return make_layer("seqconcat", name, [a, b])
+
+
+seq_concat_layer = seq_concat
+
+
+def seq_reshape(input, reshape_size: int, name=None, **kw) -> LayerOutput:
+    return make_layer("seqreshape", name, [input], reshape_size=reshape_size)
+
+
+seq_reshape_layer = seq_reshape
+
+
+def seq_slice(input, starts=None, ends=None, name=None, **kw) -> LayerOutput:
+    nodes = [input] + [n for n in (starts, ends) if n is not None]
+    return make_layer("seqslice", name, nodes)
+
+
+seq_slice_layer = seq_slice
+
+
+def seq_reverse(input, name=None, **kw) -> LayerOutput:
+    return make_layer("seqreverse", name, [input])
+
+
+# ---------------------------------------------------------------------------
+# recurrent layers
+
+
+def lstmemory(input, name=None, reverse: bool = False, act=None,
+              gate_act=None, state_act=None, bias_attr=None, param_attr=None,
+              **kw) -> LayerOutput:
+    return make_layer("lstmemory", name, [input], reverse=reverse,
+                      act=act_mod.to_name(act or "tanh"),
+                      gate_act=act_mod.to_name(gate_act or "sigmoid"),
+                      state_act=act_mod.to_name(state_act or "tanh"),
+                      bias_attr=bias_attr, param_attr=param_attr)
+
+
+def grumemory(input, name=None, reverse: bool = False, act=None,
+              gate_act=None, bias_attr=None, param_attr=None, **kw) -> LayerOutput:
+    return make_layer("gru", name, [input], reverse=reverse,
+                      act=act_mod.to_name(act or "tanh"),
+                      gate_act=act_mod.to_name(gate_act or "sigmoid"),
+                      bias_attr=bias_attr, param_attr=param_attr)
+
+
+def recurrent(input, name=None, reverse: bool = False, act=None,
+              bias_attr=None, param_attr=None, **kw) -> LayerOutput:
+    return make_layer("recurrent", name, [input], reverse=reverse,
+                      act=act_mod.to_name(act or "tanh"),
+                      bias_attr=bias_attr, param_attr=param_attr)
+
+
+recurrent_layer = recurrent
+
+
+# ---------------------------------------------------------------------------
+# cost layers
+
+
+def classification_cost(input, label, weight=None, name=None,
+                        **kw) -> LayerOutput:
+    """CE over softmax probabilities (v2 classification_cost). The input is
+    expected to carry a softmax activation already."""
+    nodes = [input, label] + ([weight] if weight is not None else [])
+    return make_layer("multi-class-cross-entropy", name, nodes)
+
+
+def cross_entropy_cost(input, label, name=None, **kw) -> LayerOutput:
+    return make_layer("multi-class-cross-entropy", name, [input, label])
+
+
+def cross_entropy_with_selfnorm_cost(input, label, name=None,
+                                     softmax_selfnorm_alpha: float = 0.1,
+                                     **kw) -> LayerOutput:
+    return make_layer("cross_entropy_with_selfnorm", name, [input, label],
+                      softmax_selfnorm_alpha=softmax_selfnorm_alpha)
+
+
+def square_error_cost(input, label, weight=None, name=None, **kw) -> LayerOutput:
+    nodes = [input, label] + ([weight] if weight is not None else [])
+    return make_layer("square_error", name, nodes)
+
+
+mse_cost = square_error_cost
+regression_cost = square_error_cost
+
+
+def soft_binary_class_cross_entropy_cost(input, label, name=None, **kw):
+    return make_layer("soft_binary_class_cross_entropy", name, [input, label])
+
+
+def multi_binary_label_cross_entropy_cost(input, label, name=None, **kw):
+    return make_layer("multi_binary_label_cross_entropy", name, [input, label])
+
+
+def rank_cost(left, right, label, weight=None, name=None, **kw) -> LayerOutput:
+    nodes = [left, right, label] + ([weight] if weight is not None else [])
+    return make_layer("rank-cost", name, nodes)
+
+
+def lambda_cost(input, score, NDCG_num: int = 5, name=None, **kw) -> LayerOutput:
+    return make_layer("lambda_cost", name, [input, score], NDCG_num=NDCG_num)
+
+
+def huber_regression_cost(input, label, delta: float = 1.0, name=None, **kw):
+    return make_layer("huber_regression", name, [input, label], delta=delta)
+
+
+def huber_classification_cost(input, label, name=None, **kw) -> LayerOutput:
+    return make_layer("huber_classification", name, [input, label])
+
+
+def smooth_l1_cost(input, label, sigma: float = 1.0, name=None, **kw):
+    return make_layer("smooth_l1", name, [input, label], sigma=sigma)
+
+
+def sum_cost(input, name=None, **kw) -> LayerOutput:
+    return make_layer("sum_cost", name, [input])
+
+
+def nce(input, label, num_classes: int, num_neg_samples: int = 10,
+        param_attr=None, bias_attr=None, name=None, **kw) -> LayerOutput:
+    return make_layer("nce", name, [input, label], num_classes=num_classes,
+                      num_neg_samples=num_neg_samples, param_attr=param_attr,
+                      bias_attr=bias_attr)
+
+
+nce_layer = nce
+
+
+def hsigmoid(input, label, num_classes: int, param_attr=None, bias_attr=None,
+             name=None, **kw) -> LayerOutput:
+    nodes = _listify(input) + [label]
+    return make_layer("hsigmoid", name, nodes, num_classes=num_classes,
+                      param_attr=param_attr, bias_attr=bias_attr)
+
+
+def classification_error(input, label, name=None, **kw) -> LayerOutput:
+    return make_layer("classification_error", name, [input, label])
+
+
+# crf / ctc re-exported from crf_layers
+from paddle_tpu.layers.crf_layers import (crf, crf_decoding, ctc,
+                                          warp_ctc)  # noqa: E402,F401

@@ -1,0 +1,473 @@
+"""Core layers: data, fc, embedding, mixed/projections, elementwise glue.
+
+Reference: python/paddle/trainer_config_helpers/layers.py (fc_layer:991,
+data_layer, embedding_layer, mixed_layer:847, addto_layer, concat_layer,
+dropout, slope_intercept, interpolation, cos_sim, bilinear...), compute in
+gserver/layers/{FullyConnectedLayer,MixedLayer,*Projection,AddtoLayer,
+ConcatenateLayer,...}.
+
+Conventions:
+  - non-sequence values are [batch, size]; sequences are SequenceBatch with
+    data [batch, T, size] (ids: [batch, T]).
+  - `apply(ctx, name, cfg, params, inputs)` is pure; params is a dict of this
+    layer's parameters keyed by full parameter name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers
+from paddle_tpu.core.data_type import InputType, SeqType
+from paddle_tpu.core.registry import (LayerMeta, ParamAttr, ParamSpec,
+                                      StateSpec, default_weight_init,
+                                      make_layer, register_layer)
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import activations as act_ops
+from paddle_tpu.ops import linear as linear_ops
+from paddle_tpu.ops import norm as norm_ops
+from paddle_tpu.ops import embedding as emb_ops
+from paddle_tpu import activation as act_mod
+from paddle_tpu import attr as attr_mod
+
+
+def _apply_act(x, act_name: str, mask=None):
+    if act_name == "sequence_softmax":
+        return act_ops.sequence_softmax(x, mask)
+    return act_ops.get(act_name)(x)
+
+
+def _map_seq(fn, value):
+    """Apply fn to the dense payload whether value is a SequenceBatch or array."""
+    if isinstance(value, SequenceBatch):
+        return value.with_data(fn(value.data))
+    return fn(value)
+
+
+def _payload(value):
+    return value.data if isinstance(value, SequenceBatch) else value
+
+
+def _norm_attrs(param_attr, n: int) -> List[ParamAttr]:
+    if param_attr is None:
+        return [ParamAttr() for _ in range(n)]
+    if isinstance(param_attr, (list, tuple)):
+        out = [ParamAttr.of(a) for a in param_attr]
+        assert len(out) == n, "param_attr list length mismatch"
+        return out
+    return [ParamAttr.of(param_attr) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+
+
+@register_layer("data")
+class DataLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        it: InputType = cfg["input_type"]
+        seq_level = it.seq_type.value
+        height = cfg.get("height", 0)
+        width = cfg.get("width", 0)
+        channels = 0
+        if height and width:
+            channels = it.dim // (height * width)
+        return (LayerMeta(size=it.dim, seq_level=seq_level, height=height,
+                          width=width, channels=channels,
+                          is_integer=(it.kind == "integer")), [], [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        return inputs[0]
+
+
+@register_layer("fc")
+class FCLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        size = cfg["size"]
+        attrs = _norm_attrs(cfg.get("param_attr"), len(input_metas))
+        cfg["param_attr"] = attrs
+        specs = []
+        for i, (m, a) in enumerate(zip(input_metas, attrs)):
+            pname = a.name or (f"_{name}.w{i}" if i else f"_{name}.w0")
+            specs.append(ParamSpec(pname, (m.size, size),
+                                   default_weight_init(a, (0,)), a))
+        battr = ParamAttr.of(cfg.get("bias_attr")) if not isinstance(
+            cfg.get("bias_attr"), bool) else ParamAttr()
+        if cfg.get("bias_attr") is not False:
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (size,),
+                                   battr.initializer or initializers.zeros,
+                                   battr))
+            cfg["_bias_name"] = bname
+        seq_level = max(m.seq_level for m in input_metas)
+        return LayerMeta(size=size, seq_level=seq_level), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        attrs = cfg["param_attr"]
+        ws = []
+        for i, a in enumerate(attrs):
+            pname = a.name or f"_{name}.w{i}"
+            ws.append(params[pname])
+        b = params.get(cfg.get("_bias_name")) if cfg.get("_bias_name") else None
+        out = None
+        ref = None
+        for val, w in zip(inputs, ws):
+            x = _payload(val)
+            if not isinstance(val, SequenceBatch) and x.ndim > 2:
+                x = x.reshape(x.shape[0], -1)   # flatten image NHWC -> [b, hwc]
+            y = linear_ops.matmul(x, w)
+            out = y if out is None else out + y
+            if isinstance(val, SequenceBatch):
+                ref = val
+        if b is not None:
+            out = out + b
+        mask = ref.mask() if ref is not None else None
+        out = _apply_act(out, cfg.get("act", "linear"), mask)
+        return ref.with_data(out) if ref is not None else out
+
+
+@register_layer("embedding")
+class EmbeddingLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        assert m.is_integer, "embedding input must be integer ids"
+        size = cfg["size"]
+        a = ParamAttr.of(cfg.get("param_attr"))
+        pname = a.name or f"_{name}.w0"
+        init = a.initializer or (initializers.normal(a.initial_std or 0.01))
+        specs = [ParamSpec(pname, (m.size, size), init, a)]
+        cfg["_w_name"] = pname
+        return LayerMeta(size=size, seq_level=m.seq_level), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        table = params[cfg["_w_name"]]
+        val = inputs[0]
+        ids = _payload(val)
+        out = emb_ops.embedding_lookup(table, ids, pad_id=cfg.get("pad_id", -1))
+        if isinstance(val, SequenceBatch):
+            return val.with_data(out)
+        return out
+
+
+@register_layer("dropout")
+class DropoutLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=m.seq_level, height=m.height,
+                         width=m.width, channels=m.channels), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        rate = cfg.get("dropout_rate", 0.5)
+        val = inputs[0]
+        if not ctx.is_train or rate <= 0.0:
+            return val
+
+        def drop(x):
+            keep = 1.0 - rate
+            mask = jax.random.bernoulli(ctx.rng_for(name), keep, x.shape)
+            return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+        return _map_seq(drop, val)
+
+
+@register_layer("addto")
+class AddtoLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        size = input_metas[0].size
+        for m in input_metas:
+            assert m.size == size, "addto inputs must agree in size"
+        specs = []
+        if cfg.get("bias_attr") not in (False, None):
+            a = ParamAttr.of(None if cfg.get("bias_attr") is True
+                             else cfg.get("bias_attr"))
+            bname = a.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (size,), initializers.zeros, a))
+            cfg["_bias_name"] = bname
+        m0 = input_metas[0]
+        return LayerMeta(size=size, seq_level=max(m.seq_level for m in input_metas),
+                         height=m0.height, width=m0.width,
+                         channels=m0.channels), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        ref = next((v for v in inputs if isinstance(v, SequenceBatch)), None)
+        out = sum(_payload(v) for v in inputs)
+        if cfg.get("_bias_name"):
+            out = out + params[cfg["_bias_name"]]
+        out = _apply_act(out, cfg.get("act", "linear"))
+        return ref.with_data(out) if ref is not None else out
+
+
+@register_layer("concat")
+class ConcatLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        size = sum(m.size for m in input_metas)
+        return LayerMeta(size=size,
+                         seq_level=max(m.seq_level for m in input_metas)), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        ref = next((v for v in inputs if isinstance(v, SequenceBatch)), None)
+        out = jnp.concatenate([_payload(v) for v in inputs], axis=-1)
+        out = _apply_act(out, cfg.get("act", "linear"))
+        return ref.with_data(out) if ref is not None else out
+
+
+@register_layer("batch_norm")
+class BatchNormLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        c = m.channels if m.channels else m.size
+        a = ParamAttr.of(cfg.get("param_attr"))
+        gname = a.name or f"_{name}.w0"
+        specs = [ParamSpec(gname, (c,), initializers.ones, a)]
+        battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                             else cfg.get("bias_attr"))
+        bname = battr.name or f"_{name}.wbias"
+        specs.append(ParamSpec(bname, (c,), initializers.zeros, battr))
+        states = [StateSpec(f"_{name}.moving_mean", (c,), 0.0),
+                  StateSpec(f"_{name}.moving_var", (c,), 1.0)]
+        cfg["_g_name"], cfg["_b_name"] = gname, bname
+        cfg["_channels"] = c
+        return (LayerMeta(size=m.size, seq_level=m.seq_level, height=m.height,
+                          width=m.width, channels=m.channels), specs, states)
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        val = inputs[0]
+        x = _payload(val)
+        c = cfg["_channels"]
+        gamma = params[cfg["_g_name"]]
+        beta = params[cfg["_b_name"]]
+        mm = ctx.get_state(f"_{name}.moving_mean")
+        mv = ctx.get_state(f"_{name}.moving_var")
+        shape = x.shape
+        xr = x.reshape((-1, c)) if x.shape[-1] != c or x.ndim == 2 else x
+        if x.ndim == 2 and shape[-1] != c:
+            # image stored flat [b, c*h*w] channel-major (paddle layout)
+            xr = x.reshape(shape[0], c, -1).transpose(0, 2, 1).reshape(-1, c)
+        use_global = cfg.get("use_global_stats") or not ctx.is_train
+        if use_global:
+            y = norm_ops.batch_norm_infer(xr, gamma, beta, mm, mv)
+        else:
+            y, nm, nv = norm_ops.batch_norm_train(
+                xr, gamma, beta, mm, mv,
+                momentum=cfg.get("moving_average_fraction", 0.9))
+            ctx.set_state(f"_{name}.moving_mean", nm)
+            ctx.set_state(f"_{name}.moving_var", nv)
+        if x.ndim == 2 and shape[-1] != c:
+            y = y.reshape(shape[0], -1, c).transpose(0, 2, 1).reshape(shape)
+        else:
+            y = y.reshape(shape)
+        y = _apply_act(y, cfg.get("act", "linear"))
+        return val.with_data(y) if isinstance(val, SequenceBatch) else y
+
+
+@register_layer("scaling")
+class ScalingLayer:
+    """ScalingLayer: per-row scalar (input0 [b,1]) times input1 [b,d]."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=input_metas[1].size,
+                         seq_level=input_metas[1].seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        w, v = inputs
+        ref = v if isinstance(v, SequenceBatch) else None
+        out = _payload(w) * _payload(v)
+        return ref.with_data(out) if ref is not None else out
+
+
+@register_layer("dotmul")
+class DotMulLayer:
+    """dotmul_operator as a layer: elementwise a*b (optionally scaled)."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=input_metas[0].size,
+                         seq_level=max(m.seq_level for m in input_metas)), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        a, b = inputs
+        ref = next((v for v in inputs if isinstance(v, SequenceBatch)), None)
+        out = cfg.get("scale", 1.0) * _payload(a) * _payload(b)
+        return ref.with_data(out) if ref is not None else out
+
+
+@register_layer("interpolation")
+class InterpolationLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=input_metas[1].size,
+                         seq_level=input_metas[1].seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        w, a, b = inputs
+        out = linear_ops.interpolation(_payload(w), _payload(a), _payload(b))
+        ref = next((v for v in (a, b) if isinstance(v, SequenceBatch)), None)
+        return ref.with_data(out) if ref is not None else out
+
+
+@register_layer("slope_intercept")
+class SlopeInterceptLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=m.seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        return _map_seq(
+            lambda x: linear_ops.slope_intercept(
+                x, cfg.get("slope", 1.0), cfg.get("intercept", 0.0)),
+            inputs[0])
+
+
+@register_layer("cos_sim")
+class CosSimLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=1,
+                         seq_level=max(m.seq_level for m in input_metas)), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        a, b = inputs
+        out = linear_ops.cos_sim(_payload(a), _payload(b),
+                                 cfg.get("scale", 1.0))[..., None]
+        ref = next((v for v in inputs if isinstance(v, SequenceBatch)), None)
+        return ref.with_data(out) if ref is not None else out
+
+
+@register_layer("outer_prod")
+class OuterProdLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=input_metas[0].size * input_metas[1].size), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        return linear_ops.outer(_payload(inputs[0]), _payload(inputs[1]))
+
+
+@register_layer("sum_to_one_norm")
+class SumToOneNormLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=m.seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        return _map_seq(linear_ops.sum_to_one_norm, inputs[0])
+
+
+@register_layer("trans")
+class TransLayer:
+    """TransLayer: transpose a [b, n] weight-matrix-like activation. The
+    reference transposes a full matrix within a sample batch (b=n use only)."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=input_metas[0].size), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        return jnp.swapaxes(_payload(inputs[0]), -1, -2) \
+            if _payload(inputs[0]).ndim > 2 else _payload(inputs[0]).T
+
+
+@register_layer("slice")
+class SliceLayer:
+    """Feature slice [start, end) — identity_projection with offset."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=cfg["end"] - cfg["start"],
+                         seq_level=m.seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        return _map_seq(lambda x: x[..., cfg["start"]:cfg["end"]], inputs[0])
+
+
+@register_layer("scaling_projection")
+class ScalingProjection:
+    """w * x with one scalar learned weight (ScalingProjection)."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        a = ParamAttr.of(cfg.get("param_attr"))
+        pname = a.name or f"_{name}.w0"
+        cfg["_w_name"] = pname
+        return (LayerMeta(size=m.size, seq_level=m.seq_level),
+                [ParamSpec(pname, (1,), initializers.ones, a)], [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        return _map_seq(lambda x: params[cfg["_w_name"]] * x, inputs[0])
+
+
+@register_layer("dotmul_projection")
+class DotMulProjection:
+    """x * w elementwise with a learned [size] weight (DotMulProjection)."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        a = ParamAttr.of(cfg.get("param_attr"))
+        pname = a.name or f"_{name}.w0"
+        cfg["_w_name"] = pname
+        return (LayerMeta(size=m.size, seq_level=m.seq_level),
+                [ParamSpec(pname, (m.size,), initializers.ones, a)], [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        return _map_seq(lambda x: x * params[cfg["_w_name"]], inputs[0])
+
+
+@register_layer("trans_fc")
+class TransFCLayer:
+    """trans_full_matrix_projection: y = x @ W^T with W [size, in] — lets a
+    weight be shared between a projection and its transpose (tied embeddings).
+    """
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        size = cfg["size"]
+        a = ParamAttr.of(cfg.get("param_attr"))
+        pname = a.name or f"_{name}.w0"
+        cfg["_w_name"] = pname
+        return (LayerMeta(size=size, seq_level=m.seq_level),
+                [ParamSpec(pname, (size, m.size),
+                           default_weight_init(a, (1,)), a)], [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        w = params[cfg["_w_name"]]
+        return _map_seq(lambda x: linear_ops.matmul(x, w.T), inputs[0])
+
+
+@register_layer("resize")
+class ResizeLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=cfg["size"]), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = _payload(inputs[0])
+        return x.reshape(-1, cfg["size"])

@@ -1,0 +1,225 @@
+"""Beam-search sequence generation.
+
+Reference: RecurrentGradientMachine::generateSequence / beamSearch / Path
+(RecurrentGradientMachine.h:186-419, .cpp) — decoder states are re-indexed
+as beams are pruned; trainer_config_helpers beam_search(:4101) +
+SequenceGenerator in the SWIG api.
+
+TPU design: fixed-width beam kept as dense [batch, beam] tensors inside one
+`lax.scan`; beam pruning is a top-k over (beam*vocab) scores followed by a
+gather that re-indexes every memory — the same state shuffling the reference
+did with Path copying, but batched and jit-compiled. Finished beams are
+frozen with an additive -inf mask (only EOS continues a finished beam with
+zero added score, the standard length-neutral trick).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.data_type import InputType
+from paddle_tpu.core.registry import (LayerMeta, LayerOutput, make_layer,
+                                      register_layer)
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layers import group as group_mod
+
+_NEG = -1e9
+
+
+def build_beam_search(step, input, *, bos_id: int, eos_id: int,
+                      beam_size: int, max_length: int,
+                      name: Optional[str] = None) -> LayerOutput:
+    from paddle_tpu.core.registry import _auto_name
+    from paddle_tpu.core.topology import Topology
+
+    gname = name or _auto_name("beam_search")
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    gen_inputs = [i for i in inputs if isinstance(i, group_mod.GeneratedInput)]
+    static_inputs = [i for i in inputs
+                     if isinstance(i, group_mod.StaticInput)]
+    assert len(gen_inputs) == 1, "beam_search needs exactly one GeneratedInput"
+    gen = gen_inputs[0]
+
+    group = {"name": gname, "memories": [], "boot_layers": []}
+    # placeholder for the previous generated token (integer ids)
+    tok_ph = make_layer("data", f"@gen@{gname}", [],
+                        input_type=InputType(gen.size, "integer"))
+    static_phs = []
+    for i, si in enumerate(static_inputs):
+        kind = "integer" if si.input.meta.is_integer else "dense"
+        ph = make_layer("data", f"@static@{gname}@{i}", [],
+                        input_type=InputType(si.input.meta.size, kind))
+        if si.is_seq:
+            ph.meta.seq_level = si.input.meta.seq_level
+        static_phs.append(ph)
+
+    group_mod._build_ctx.stack.append(group)
+    try:
+        out = step(tok_ph, *static_phs)
+    finally:
+        group_mod._build_ctx.stack.pop()
+    assert isinstance(out, LayerOutput), "beam_search step must return probs"
+
+    probe = Topology([out])
+    extra = []
+    for mem in group["memories"]:
+        extra.append(probe.by_name[mem["link_name"]])
+    sub_topo = Topology([out], extra_outputs=extra)
+
+    outer_inputs = [s.input for s in static_inputs] + group["boot_layers"]
+    node = make_layer(
+        "beam_search", gname, outer_inputs,
+        n_static=len(static_inputs),
+        memories=group["memories"],
+        tok_name=tok_ph.name,
+        static_names=[p.name for p in static_phs],
+        static_is_seq=[s.is_seq for s in static_inputs],
+        out_name=out.name,
+        vocab=out.meta.size,
+        bos_id=bos_id, eos_id=eos_id, beam_size=beam_size,
+        max_length=max_length,
+        sub_topology=sub_topo.serialize(),
+    )
+    node.params = list(sub_topo.param_specs.values())
+    node.meta = LayerMeta(size=1, seq_level=1, is_integer=True)
+    node.config["_obj_sub_topo"] = sub_topo
+    return node
+
+
+@register_layer("beam_search")
+class BeamSearchLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        from paddle_tpu.core.topology import Topology
+        sub = cfg.get("_obj_sub_topo")
+        if sub is None:
+            sub = Topology.deserialize(cfg["sub_topology"])
+            cfg["_obj_sub_topo"] = sub
+        params = list(sub.param_specs.values())
+        return LayerMeta(size=1, seq_level=1, is_integer=True), params, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        sub = cfg["_obj_sub_topo"]
+        K = cfg["beam_size"]
+        V = cfg["vocab"]
+        L = cfg["max_length"]
+        eos = cfg["eos_id"]
+        n_static = cfg["n_static"]
+        statics = list(inputs[:n_static])
+        boots = list(inputs[n_static:])
+
+        # batch size from first static/boot input, else 1
+        if statics:
+            s0 = statics[0]
+            b = (s0.batch_size if isinstance(s0, SequenceBatch)
+                 else s0.shape[0])
+        elif boots:
+            b = boots[0].shape[0]
+        else:
+            b = 1
+
+        def tile_beam(x):
+            """[b, ...] -> [b*K, ...]"""
+            if isinstance(x, SequenceBatch):
+                return SequenceBatch(
+                    tile_beam(x.data), tile_beam(x.lengths),
+                    None if x.segment_ids is None else tile_beam(x.segment_ids),
+                    None if x.num_segments is None else tile_beam(x.num_segments))
+            return jnp.repeat(x, K, axis=0)
+
+        static_feed = {sname: tile_beam(sv) for sname, sv in
+                       zip(cfg["static_names"], statics)}
+
+        # memory init (tiled over beams)
+        mems = []
+        boot_i = 0
+        for m in cfg["memories"]:
+            if m["has_boot_layer"]:
+                bv = boots[boot_i]
+                boot_i += 1
+                mems.append(jnp.repeat(
+                    bv.data if isinstance(bv, SequenceBatch) else bv, K,
+                    axis=0))
+            elif m["boot_const_id"] is not None:
+                mems.append(jnp.full((b * K,), m["boot_const_id"], jnp.int32))
+            else:
+                mems.append(jnp.zeros((b * K, m["size"]), jnp.float32))
+        mems = tuple(mems)
+
+        tokens0 = jnp.full((b, K), cfg["bos_id"], jnp.int32)
+        # only beam 0 live at t=0 so duplicates don't fill the beam
+        scores0 = jnp.where(jnp.arange(K)[None, :] == 0, 0.0, _NEG) * \
+            jnp.ones((b, 1))
+        finished0 = jnp.zeros((b, K), bool)
+
+        link_names = [m["link_name"] for m in cfg["memories"]]
+        out_name = cfg["out_name"]
+
+        def body(carry, _):
+            tokens, scores, finished, mem_state, hist = carry
+            feed = dict(static_feed)
+            feed[cfg["tok_name"]] = tokens.reshape(b * K)
+            for fname, mv in zip([m["feed_name"] for m in cfg["memories"]],
+                                 mem_state):
+                feed[fname] = mv
+            outs, _ = sub.forward(params, {}, feed, mode="test",
+                                  output_names=[out_name] + link_names)
+            probs = outs[out_name]
+            probs = probs.data if isinstance(probs, SequenceBatch) else probs
+            logp = jnp.log(jnp.maximum(probs, 1e-12)).reshape(b, K, V)
+            # finished beams: only EOS allowed, with zero added score
+            eos_only = jnp.full((V,), _NEG).at[eos].set(0.0)
+            logp = jnp.where(finished[..., None], eos_only[None, None, :],
+                             logp)
+            total = scores[..., None] + logp                  # [b, K, V]
+            flat = total.reshape(b, K * V)
+            new_scores, idx = lax.top_k(flat, K)              # [b, K]
+            beam_idx = idx // V
+            tok_idx = (idx % V).astype(jnp.int32)
+            new_finished = jnp.take_along_axis(finished, beam_idx, axis=1) | \
+                (tok_idx == eos)
+
+            def reindex(mv):
+                mvk = mv.reshape((b, K) + mv.shape[1:])
+                bi = beam_idx.reshape((b, K) + (1,) * (mv.ndim - 1))
+                out = jnp.take_along_axis(mvk, bi, axis=1)
+                return out.reshape((b * K,) + mv.shape[1:])
+
+            new_mems = tuple(
+                reindex(outs[ln].data if isinstance(outs[ln], SequenceBatch)
+                        else outs[ln]) for ln in link_names)
+            # history re-indexing: hist [b, K, L] gathered by beam_idx
+            hist = jnp.take_along_axis(
+                hist, beam_idx[..., None].astype(jnp.int32), axis=1)
+            return ((tok_idx, new_scores, new_finished, new_mems, hist),
+                    tok_idx)
+
+        # History is pre-allocated [b, K, L]; each step writes column t and
+        # the gather inside `body` keeps it consistent with beam re-indexing.
+        hist0 = jnp.zeros((b, K, L), jnp.int32)
+
+        def step_t(carry, t):
+            new_carry, tok_idx = body(carry, None)
+            tokens_n, scores_n, fin_n, mems_n, hist_n = new_carry
+            hist_n = lax.dynamic_update_slice(hist_n, tok_idx[:, :, None],
+                                              (0, 0, t))
+            return (tokens_n, scores_n, fin_n, mems_n, hist_n), None
+
+        carry0 = (tokens0, scores0, finished0, mems, hist0)
+        (tokens, scores, finished, _, hist), _ = lax.scan(
+            step_t, carry0, jnp.arange(L))
+
+        # pick best beam per sample; sequence length = position of eos + 1
+        best = jnp.argmax(scores, axis=1)                      # [b]
+        best_seq = jnp.take_along_axis(
+            hist, best[:, None, None], axis=1)[:, 0, :]        # [b, L]
+        is_eos = best_seq == eos
+        has_eos = jnp.any(is_eos, axis=1)
+        first_eos = jnp.argmax(is_eos, axis=1)
+        lengths = jnp.where(has_eos, first_eos + 1, L).astype(jnp.int32)
+        return SequenceBatch(best_seq, lengths)

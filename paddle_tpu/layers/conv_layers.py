@@ -1,0 +1,257 @@
+"""Image layers: conv, pool, norm, pad/crop, maxout, spp, bilinear.
+
+Reference: gserver/layers/{ExpandConvLayer,CudnnConvLayer,ConvBaseLayer,
+PoolLayer,CudnnPoolLayer,NormLayer(CMRProjectionNorm),SpatialPyramidPoolLayer,
+MaxOutLayer,PadLayer,CropLayer,BilinearInterpLayer,BlockExpandLayer,
+Conv3DLayer,DeConv3DLayer}; shape arithmetic from config_parser.py
+(cnn_output_size). Internal image tensors are NHWC [b,h,w,c] (TPU layout);
+flat channel-major feeds (paddle convention [b, c*h*w]) are reshaped on
+entry.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers
+from paddle_tpu.core.registry import (LayerMeta, ParamAttr, ParamSpec,
+                                      default_weight_init, register_layer)
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import pool as pool_ops
+from paddle_tpu.ops import norm as norm_ops
+from paddle_tpu.ops import activations as act_ops
+
+
+def ensure_nhwc(x: jnp.ndarray, meta_c: int, meta_h: int, meta_w: int) -> jnp.ndarray:
+    """Accept [b, c*h*w] flat channel-major or already-NHWC [b,h,w,c]."""
+    if x.ndim == 4:
+        return x
+    b = x.shape[0]
+    return x.reshape(b, meta_c, meta_h, meta_w).transpose(0, 2, 3, 1)
+
+
+@register_layer("conv")
+class ConvLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        ic = cfg.get("channels") or m.channels
+        assert ic, f"conv layer {name}: input channel count unknown"
+        ih = m.height or cfg.get("input_height", 0)
+        iw = m.width or cfg.get("input_width", 0)
+        oc = cfg["num_filters"]
+        k = cfg["filter_size"]
+        s = cfg.get("stride", 1)
+        p = cfg.get("padding", 0)
+        d = cfg.get("dilation", 1)
+        g = cfg.get("groups", 1)
+        oh = conv_ops.conv_out_size(ih, k, s, p, d, cfg.get("caffe_mode", True))
+        ow = conv_ops.conv_out_size(iw, k, s, p, d, cfg.get("caffe_mode", True))
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        init = a.initializer or initializers.msra((0, 1, 2))
+        specs = [ParamSpec(wname, (k, k, ic // g, oc), init, a)]
+        cfg["_w_name"] = wname
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (oc,), initializers.zeros, battr))
+            cfg["_bias_name"] = bname
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = ic, ih, iw
+        return (LayerMeta(size=oc * oh * ow, height=oh, width=ow, channels=oc),
+                specs, [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        w = params[cfg["_w_name"]]
+        if cfg.get("trans"):
+            y = conv_ops.conv2d_transpose(x, w, stride=cfg.get("stride", 1),
+                                          padding=cfg.get("padding", 0))
+        else:
+            y = conv_ops.conv2d(x, w, stride=cfg.get("stride", 1),
+                                padding=cfg.get("padding", 0),
+                                dilation=cfg.get("dilation", 1),
+                                groups=cfg.get("groups", 1))
+        if cfg.get("_bias_name"):
+            y = y + params[cfg["_bias_name"]]
+        return act_ops.get(cfg.get("act", "linear"))(y)
+
+
+@register_layer("pool")
+class PoolLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        c = cfg.get("channels") or m.channels
+        ih, iw = m.height, m.width
+        k = cfg["pool_size"]
+        s = cfg.get("stride", 1)
+        p = cfg.get("padding", 0)
+        oh = pool_ops.pool_out_size(ih, k, s, p)
+        ow = pool_ops.pool_out_size(iw, k, s, p)
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = c, ih, iw
+        return (LayerMeta(size=c * oh * ow, height=oh, width=ow, channels=c),
+                [], [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        k = cfg["pool_size"]
+        s = cfg.get("stride", 1)
+        p = cfg.get("padding", 0)
+        ptype = cfg.get("pool_type", "max")
+        if ptype in ("max", "cudnn-max"):
+            return pool_ops.max_pool2d(x, k, s, p)
+        return pool_ops.avg_pool2d(x, k, s, p)
+
+
+@register_layer("img_cmrnorm")
+class CMRNormLayer:
+    """Cross-map response norm (LRN)."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = m.channels, m.height, m.width
+        return (LayerMeta(size=m.size, height=m.height, width=m.width,
+                          channels=m.channels), [], [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        return norm_ops.lrn_cross_map(x, cfg.get("size", 5),
+                                      cfg.get("scale", 0.0128),
+                                      cfg.get("power", 0.75))
+
+
+@register_layer("maxout")
+class MaxOutLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        g = cfg["groups"]
+        oc = m.channels // g
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = m.channels, m.height, m.width
+        return (LayerMeta(size=oc * m.height * m.width, height=m.height,
+                          width=m.width, channels=oc), [], [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        return pool_ops.maxout(x, cfg["groups"])
+
+
+@register_layer("spp")
+class SPPLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        h = cfg.get("pyramid_height", 3)
+        total_bins = sum(4 ** l for l in range(h))
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = m.channels, m.height, m.width
+        return LayerMeta(size=m.channels * total_bins), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        return pool_ops.spatial_pyramid_pool(
+            x, cfg.get("pyramid_height", 3), cfg.get("pool_type", "max"))
+
+
+@register_layer("pad")
+class PadLayer:
+    """PadLayer: zero-pad channel/height/width dims (paddle/function/PadOp)."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        pc = cfg.get("pad_c", [0, 0])
+        ph = cfg.get("pad_h", [0, 0])
+        pw = cfg.get("pad_w", [0, 0])
+        oc = m.channels + sum(pc)
+        oh = m.height + sum(ph)
+        ow = m.width + sum(pw)
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = m.channels, m.height, m.width
+        return (LayerMeta(size=oc * oh * ow, height=oh, width=ow, channels=oc),
+                [], [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        pc = cfg.get("pad_c", [0, 0])
+        ph = cfg.get("pad_h", [0, 0])
+        pw = cfg.get("pad_w", [0, 0])
+        return jnp.pad(x, ((0, 0), tuple(ph), tuple(pw), tuple(pc)))
+
+
+@register_layer("crop")
+class CropLayer:
+    """CropLayer (paddle/function/CropOp): crop h/w/c with offsets."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        shape = cfg["shape"]          # [c, h, w] target
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = m.channels, m.height, m.width
+        oc, oh, ow = shape
+        return (LayerMeta(size=oc * oh * ow, height=oh, width=ow, channels=oc),
+                [], [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        oc, oh, ow = cfg["shape"]
+        off = cfg.get("offset", [0, 0, 0])
+        return x[:, off[1]:off[1] + oh, off[2]:off[2] + ow,
+                 off[0]:off[0] + oc]
+
+
+@register_layer("bilinear_interp")
+class BilinearInterpLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        oh, ow = cfg["out_size_y"], cfg["out_size_x"]
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = m.channels, m.height, m.width
+        return (LayerMeta(size=m.channels * oh * ow, height=oh, width=ow,
+                          channels=m.channels), [], [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        import jax
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        oh, ow = cfg["out_size_y"], cfg["out_size_x"]
+        return jax.image.resize(x, (x.shape[0], oh, ow, x.shape[3]),
+                                method="bilinear")
+
+
+@register_layer("block_expand")
+class BlockExpandLayer:
+    """BlockExpandLayer: image -> sequence of flattened patches (for OCR
+    pipelines feeding RNN/CTC)."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        bx, by = cfg["block_x"], cfg["block_y"]
+        sx, sy = cfg.get("stride_x", 1), cfg.get("stride_y", 1)
+        px, py = cfg.get("padding_x", 0), cfg.get("padding_y", 0)
+        c = cfg.get("channels") or m.channels
+        oh = conv_ops.conv_out_size(m.height, by, sy, py, caffe_mode=False)
+        ow = conv_ops.conv_out_size(m.width, bx, sx, px, caffe_mode=False)
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = c, m.height, m.width
+        cfg["_steps"] = oh * ow
+        return LayerMeta(size=bx * by * c, seq_level=1), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        from paddle_tpu.core.sequence import SequenceBatch
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        patches = conv_ops.im2col(
+            x, (cfg["block_y"], cfg["block_x"]),
+            (cfg.get("stride_y", 1), cfg.get("stride_x", 1)),
+            (cfg.get("padding_y", 0), cfg.get("padding_x", 0)))
+        b, oh, ow, d = patches.shape
+        data = patches.reshape(b, oh * ow, d)
+        lengths = jnp.full((b,), oh * ow, jnp.int32)
+        return SequenceBatch(data, lengths)

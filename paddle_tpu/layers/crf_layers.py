@@ -1,0 +1,207 @@
+"""Linear-chain CRF and CTC layers.
+
+Reference: gserver/layers/{CRFLayer, CRFDecodingLayer, LinearChainCRF.cpp}
+(forward-algorithm NLL + viterbi decode; parameter layout (n+2, n): row 0 =
+start scores a, row 1 = end scores b, rows 2.. = transition matrix w — see
+LinearChainCRF.h comments) and {CTCLayer, LinearChainCTC.cpp,
+WarpCTCLayer.cpp}. CTC uses optax.ctc_loss (the XLA-native equivalent of
+warp-ctc).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import initializers
+from paddle_tpu.core.registry import (LayerMeta, ParamAttr, ParamSpec,
+                                      make_layer, register_layer)
+from paddle_tpu.core.sequence import SequenceBatch
+
+_NEG = -1e30
+
+
+def crf_nll(emissions: jnp.ndarray, labels: jnp.ndarray, lengths: jnp.ndarray,
+            start: jnp.ndarray, end: jnp.ndarray,
+            trans: jnp.ndarray) -> jnp.ndarray:
+    """Negative log-likelihood of label paths under a linear-chain CRF.
+
+    emissions: [b, T, n]; labels: [b, T] int; lengths: [b];
+    start,end: [n]; trans: [n, n] (trans[i, j] = score i -> j).
+    """
+    b, T, n = emissions.shape
+    labels = labels.astype(jnp.int32)
+
+    # --- score of the gold path ---------------------------------------
+    t_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+    valid = (t_idx < lengths[:, None])
+    emit_scores = jnp.take_along_axis(emissions, labels[..., None],
+                                      axis=-1)[..., 0]
+    gold_emit = jnp.sum(jnp.where(valid, emit_scores, 0.0), axis=1)
+    prev_lab = labels[:, :-1]
+    next_lab = labels[:, 1:]
+    trans_scores = trans[prev_lab, next_lab]
+    pair_valid = valid[:, 1:]
+    gold_trans = jnp.sum(jnp.where(pair_valid, trans_scores, 0.0), axis=1)
+    first_lab = labels[:, 0]
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_lab = jnp.take_along_axis(labels, last_idx[:, None], axis=1)[:, 0]
+    gold = gold_emit + gold_trans + start[first_lab] + end[last_lab]
+
+    # --- log partition via forward algorithm ---------------------------
+    def step(alpha, inp):
+        t, e_t = inp                                  # e_t: [b, n]
+        prev = alpha[:, :, None] + trans[None, :, :]  # [b, n, n]
+        new = jax.nn.logsumexp(prev, axis=1) + e_t
+        keep = (t < lengths)[:, None]
+        return jnp.where(keep, new, alpha), None
+
+    alpha0 = start[None, :] + emissions[:, 0, :]
+    es = jnp.moveaxis(emissions[:, 1:, :], 1, 0)
+    ts = jnp.arange(1, T, dtype=jnp.int32)
+    alphaT, _ = lax.scan(step, alpha0, (ts, es))
+    log_z = jax.nn.logsumexp(alphaT + end[None, :], axis=-1)
+    return log_z - gold
+
+
+def crf_viterbi(emissions: jnp.ndarray, lengths: jnp.ndarray,
+                start: jnp.ndarray, end: jnp.ndarray,
+                trans: jnp.ndarray) -> jnp.ndarray:
+    """Viterbi decode -> best path [b, T] (padding positions hold 0)."""
+    b, T, n = emissions.shape
+
+    def fwd(carry, inp):
+        t, e_t = inp
+        score = carry
+        cand = score[:, :, None] + trans[None, :, :]      # [b, n_prev, n]
+        best_prev = jnp.argmax(cand, axis=1)              # [b, n]
+        new = jnp.max(cand, axis=1) + e_t
+        keep = (t < lengths)[:, None]
+        new = jnp.where(keep, new, score)
+        return new, best_prev
+
+    score0 = start[None, :] + emissions[:, 0, :]
+    es = jnp.moveaxis(emissions[:, 1:, :], 1, 0)
+    ts = jnp.arange(1, T, dtype=jnp.int32)
+    scoreT, backptrs = lax.scan(fwd, score0, (ts, es))    # backptrs [T-1,b,n]
+    last = jnp.argmax(scoreT + end[None, :], axis=-1)     # [b]
+
+    def bwd(carry, inp):
+        t, bp_t = inp                                     # bp_t: [b, n]
+        lab = carry
+        prev = jnp.take_along_axis(bp_t, lab[:, None], axis=1)[:, 0]
+        # only move back while t < length (position t is inside the sequence)
+        lab_new = jnp.where(t < lengths, prev, lab)
+        return lab_new, lab_new
+
+    ts_rev = jnp.arange(1, T, dtype=jnp.int32)[::-1]
+    bp_rev = backptrs[::-1]
+    _, labs_rev = lax.scan(bwd, last, (ts_rev, bp_rev))   # labels for t-1
+    path = jnp.concatenate([labs_rev[::-1].T, last[:, None]], axis=1)  # [b, T]
+    t_idx = jnp.arange(T, dtype=jnp.int32)[None, :]
+    return jnp.where(t_idx < lengths[:, None], path, 0)
+
+
+def _crf_param_specs(name, cfg, n):
+    a = ParamAttr.of(cfg.get("param_attr"))
+    pname = a.name or f"_{name}.w0"
+    cfg["_w_name"] = pname
+    # (n+2, n) layout matching LinearChainCRF.h: [start; end; trans]
+    return [ParamSpec(pname, (n + 2, n),
+                      a.initializer or initializers.normal(0.01), a)]
+
+
+@register_layer("crf")
+class CRFLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        n = cfg.get("size") or input_metas[0].size
+        return LayerMeta(size=1), _crf_param_specs(name, cfg, n), []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq: SequenceBatch = inputs[0]
+        labels = inputs[1]
+        lab = labels.data if isinstance(labels, SequenceBatch) else labels
+        w = params[cfg["_w_name"]]
+        start, endw, trans = w[0], w[1], w[2:]
+        return crf_nll(seq.data, lab, seq.lengths, start, endw, trans)
+
+
+@register_layer("crf_decoding")
+class CRFDecodingLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        n = cfg.get("size") or input_metas[0].size
+        return LayerMeta(size=1, seq_level=1,
+                         is_integer=True), _crf_param_specs(name, cfg, n), []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq: SequenceBatch = inputs[0]
+        w = params[cfg["_w_name"]]
+        path = crf_viterbi(seq.data, seq.lengths, w[0], w[1], w[2:])
+        if len(inputs) > 1:
+            # with a label input, output per-position error indicator
+            labels = inputs[1]
+            lab = labels.data if isinstance(labels, SequenceBatch) else labels
+            err = (path != lab).astype(jnp.float32)
+            return seq.with_data(err)
+        return SequenceBatch(path, seq.lengths)
+
+
+@register_layer("ctc")
+class CTCLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=1), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        import optax
+        seq: SequenceBatch = inputs[0]       # [b, T, n] probs or logits
+        labels: SequenceBatch = inputs[1]    # [b, U] int
+        logits = seq.data
+        if not cfg.get("from_logits", True):
+            logits = jnp.log(jnp.maximum(logits, 1e-10))
+        logit_pad = 1.0 - seq.mask()
+        lab = labels.data if isinstance(labels, SequenceBatch) else labels
+        lab_pad = 1.0 - labels.mask() if isinstance(labels, SequenceBatch) \
+            else jnp.zeros_like(lab, jnp.float32)
+        # optax blank convention: blank id = 0 by default; paddle uses
+        # size-1 as blank for warp_ctc and 0.. hmm, reference CTCLayer uses
+        # last index as blank (norm_by_times etc.); optax supports blank_id.
+        blank = cfg.get("blank", 0)
+        return optax.ctc_loss(logits, logit_pad, lab.astype(jnp.int32),
+                              lab_pad, blank_id=blank)
+
+
+def crf(input, label, size=None, param_attr=None, name=None, **kw):
+    return make_layer("crf", name, [input, label], size=size,
+                      param_attr=param_attr)
+
+
+crf_layer = crf
+
+
+def crf_decoding(input, size=None, label=None, param_attr=None, name=None, **kw):
+    nodes = [input] + ([label] if label is not None else [])
+    return make_layer("crf_decoding", name, nodes, size=size,
+                      param_attr=param_attr)
+
+
+crf_decoding_layer = crf_decoding
+
+
+def ctc(input, label, size=None, blank=0, name=None, **kw):
+    return make_layer("ctc", name, [input, label], size=size, blank=blank)
+
+
+ctc_layer = ctc
+
+
+def warp_ctc(input, label, size=None, blank=0, name=None, **kw):
+    """warp_ctc parity — same XLA CTC under the hood."""
+    return make_layer("ctc", name, [input, label], size=size, blank=blank,
+                      from_logits=True)

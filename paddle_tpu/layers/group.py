@@ -1,0 +1,284 @@
+"""recurrent_group — the dynamic recurrent engine.
+
+Reference: RecurrentGradientMachine (gserver/gradientmachines/
+RecurrentGradientMachine.h:32) unrolls a sub-network per timestep with
+"memory" links across frames (in-links/out-links/memories in
+SubModelConfig, ModelConfig.proto:608), driven from the DSL's
+recurrent_group (trainer_config_helpers/layers.py:3818) with memory(),
+StaticInput, and beam_search (:4101).
+
+TPU design: the step sub-network is captured as its own Topology at build
+time (the user's step function runs ONCE, on placeholder nodes); apply runs
+it under `lax.scan` over the padded time axis with the memory pytree as the
+scan carry — XLA compiles the step once and pipelines it, replacing the
+reference's per-frame re-execution. Padded steps freeze the carry, matching
+ragged semantics. Generation-time beam search lives in
+paddle_tpu/layers/beam.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.data_type import InputType
+from paddle_tpu.core.registry import (ApplyContext, LayerMeta, LayerOutput,
+                                      ParamSpec, make_layer, register_layer)
+from paddle_tpu.core.sequence import SequenceBatch
+
+
+class StaticInput:
+    """Per-sample constant visible at every step (reference StaticInput)."""
+
+    def __init__(self, input: LayerOutput, is_seq: bool = False, size=None):
+        self.input = input
+        self.is_seq = is_seq
+
+
+class GeneratedInput:
+    """Generation-mode input: the step consumes its own previous prediction
+    (reference GeneratedInput for beam_search). Used by layers/beam.py."""
+
+    def __init__(self, size: int, embedding_name: str, embedding_size: int,
+                 bos_id: int = 0, eos_id: int = 1):
+        self.size = size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+
+
+class _GroupBuildCtx(threading.local):
+    def __init__(self):
+        self.stack: List[Dict[str, Any]] = []
+
+
+_build_ctx = _GroupBuildCtx()
+
+
+def memory(name: str, size: int, boot_layer: Optional[LayerOutput] = None,
+           boot_with_const_id: Optional[int] = None, is_seq: bool = False,
+           **kw) -> LayerOutput:
+    """Inside a recurrent_group step: the value the layer called `name`
+    produced at the previous timestep (zero / boot_layer value at t=0)."""
+    assert _build_ctx.stack, "memory() must be called inside recurrent_group"
+    group = _build_ctx.stack[-1]
+    feed_name = f"@mem@{group['name']}@{name}@{len(group['memories'])}"
+    node = make_layer(
+        "data", feed_name, [],
+        input_type=InputType(size, "integer" if boot_with_const_id is not None
+                             else "dense"))
+    group["memories"].append({
+        "feed_name": feed_name,
+        "link_name": name,
+        "size": size,
+        "boot_const_id": boot_with_const_id,
+        "has_boot_layer": boot_layer is not None,
+    })
+    if boot_layer is not None:
+        group["boot_layers"].append(boot_layer)
+    return node
+
+
+def recurrent_group(step, input, reverse: bool = False,
+                    name: Optional[str] = None, **kw) -> LayerOutput:
+    """Run `step` over every timestep of the input sequence(s).
+
+    input: LayerOutput sequence(s) and/or StaticInput(s). Returns the
+    sequence of step outputs (a level-1 SequenceBatch node).
+    """
+    from paddle_tpu.core.registry import _auto_name
+    from paddle_tpu.core.topology import Topology
+
+    gname = name or _auto_name("recurrent_group")
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    seq_inputs = [i for i in inputs if isinstance(i, LayerOutput)]
+    static_inputs = [i for i in inputs if isinstance(i, StaticInput)]
+    assert seq_inputs, "recurrent_group needs at least one sequence input"
+
+    # Build step placeholders (seq inputs with one seq level peeled off).
+    group = {"name": gname, "memories": [], "boot_layers": []}
+    placeholders = []
+    for i, si in enumerate(seq_inputs):
+        ph = make_layer(
+            "data", f"@in@{gname}@{i}", [],
+            input_type=InputType(si.meta.size,
+                                 "integer" if si.meta.is_integer else "dense"))
+        placeholders.append(ph)
+    static_phs = []
+    for i, si in enumerate(static_inputs):
+        kind = "integer" if si.input.meta.is_integer else "dense"
+        ph = make_layer("data", f"@static@{gname}@{i}", [],
+                        input_type=InputType(si.input.meta.size, kind))
+        if si.is_seq:
+            # a full sequence visible at each step (e.g. attention source)
+            ph.meta.seq_level = si.input.meta.seq_level
+        static_phs.append(ph)
+
+    _build_ctx.stack.append(group)
+    try:
+        step_args = placeholders + static_phs
+        out = step(*step_args)
+    finally:
+        _build_ctx.stack.pop()
+    step_outputs = out if isinstance(out, (list, tuple)) else [out]
+
+    # Sub-topology: step outputs + every memory's linked layer.
+    sub_nodes = list(step_outputs)
+    probe = Topology(sub_nodes)
+    extra = []
+    for mem in group["memories"]:
+        if mem["link_name"] not in probe.by_name:
+            raise ValueError(
+                f"recurrent_group {gname}: memory links to layer "
+                f"{mem['link_name']!r} but the step graph doesn't define it")
+        extra.append(probe.by_name[mem["link_name"]])
+    sub_topo = Topology(step_outputs, extra_outputs=extra)
+
+    # Hoist sub-params into the group node.
+    outer_inputs = seq_inputs + [s.input for s in static_inputs] + \
+        group["boot_layers"]
+    node = make_layer(
+        "recurrent_group", gname, outer_inputs,
+        n_seq=len(seq_inputs), n_static=len(static_inputs),
+        reverse=reverse,
+        memories=group["memories"],
+        step_in_names=[p.name for p in placeholders],
+        static_names=[p.name for p in static_phs],
+        static_is_seq=[s.is_seq for s in static_inputs],
+        out_name=step_outputs[0].name,
+        sub_topology=sub_topo.serialize(),
+    )
+    # attach hoisted params and rebuild meta
+    node.params = list(sub_topo.param_specs.values())
+    node.meta = LayerMeta(size=step_outputs[0].meta.size, seq_level=1,
+                          is_integer=step_outputs[0].meta.is_integer)
+    node.config["_obj_sub_topo"] = sub_topo
+    return node
+
+
+@register_layer("recurrent_group")
+class RecurrentGroupLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        # When rebuilt from JSON, reconstruct the sub-topology object and
+        # re-hoist its params.
+        from paddle_tpu.core.topology import Topology
+        sub = cfg.get("_obj_sub_topo")
+        if sub is None:
+            sub = Topology.deserialize(cfg["sub_topology"])
+            cfg["_obj_sub_topo"] = sub
+        out_meta = sub.by_name[cfg["out_name"]].meta
+        params = list(sub.param_specs.values())
+        meta = LayerMeta(size=out_meta.size, seq_level=1,
+                         is_integer=out_meta.is_integer)
+        return meta, params, []
+
+    @staticmethod
+    def apply(ctx: ApplyContext, name, cfg, params, inputs):
+        sub = cfg["_obj_sub_topo"]
+        n_seq = cfg["n_seq"]
+        n_static = cfg["n_static"]
+        seqs: List[SequenceBatch] = list(inputs[:n_seq])
+        statics = list(inputs[n_seq:n_seq + n_static])
+        boots = list(inputs[n_seq + n_static:])
+        lengths = seqs[0].lengths
+        T = seqs[0].max_len
+        b = seqs[0].batch_size
+        reverse = cfg.get("reverse", False)
+
+        # memory init
+        mems = []
+        boot_i = 0
+        for m in cfg["memories"]:
+            if m["has_boot_layer"]:
+                bv = boots[boot_i]
+                boot_i += 1
+                mems.append(bv.data if isinstance(bv, SequenceBatch) else bv)
+            elif m["boot_const_id"] is not None:
+                mems.append(jnp.full((b,), m["boot_const_id"], jnp.int32))
+            else:
+                mems.append(jnp.zeros((b, m["size"]), jnp.float32))
+
+        # time-major step inputs (reversed per-row if requested)
+        def time_major(s: SequenceBatch):
+            x = s.data
+            if reverse:
+                idx = jnp.clip(s.lengths[:, None] - 1 -
+                               jnp.arange(T, dtype=jnp.int32)[None, :], 0,
+                               T - 1)
+                x = jnp.take_along_axis(
+                    x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1) \
+                    if x.ndim > 2 else jnp.take_along_axis(x, idx, axis=1)
+            return jnp.moveaxis(x, 1, 0)
+
+        xs = tuple(time_major(s) for s in seqs)
+        static_feed = {}
+        for sname, sval, is_seq in zip(cfg["static_names"], statics,
+                                       cfg["static_is_seq"]):
+            static_feed[sname] = sval
+
+        mem_feed_names = [m["feed_name"] for m in cfg["memories"]]
+        link_names = [m["link_name"] for m in cfg["memories"]]
+        out_name = cfg["out_name"]
+
+        def body(carry, inp):
+            t, x_t = inp
+            feed = dict(static_feed)
+            for ph_name, xv in zip(cfg["step_in_names"], x_t):
+                feed[ph_name] = xv
+            for fname, mv in zip(mem_feed_names, carry):
+                feed[fname] = mv
+            outs, _ = sub.forward(params, {}, feed, mode=ctx.mode,
+                                  rng=ctx.rng_for(f"{name}@{0}"),
+                                  output_names=[out_name] + link_names)
+            new_mems = tuple(
+                outs[ln].data if isinstance(outs[ln], SequenceBatch)
+                else outs[ln] for ln in link_names)
+            out_t = outs[out_name]
+            out_t = out_t.data if isinstance(out_t, SequenceBatch) else out_t
+            valid = t < lengths
+
+            def freeze(n, o):
+                v = valid.reshape((-1,) + (1,) * (n.ndim - 1))
+                return jnp.where(v, n, o)
+
+            merged = tuple(jax.tree_util.tree_map(freeze, n, o)
+                           for n, o in zip(new_mems, carry))
+            vo = valid.reshape((-1,) + (1,) * (out_t.ndim - 1))
+            return merged, jnp.where(vo, out_t, jnp.zeros_like(out_t))
+
+        tidx = jnp.arange(T, dtype=jnp.int32)
+        _, outs = lax.scan(body, tuple(mems), (tidx, xs))
+        outs = jnp.moveaxis(outs, 0, 1)
+        if reverse:
+            idx = jnp.clip(lengths[:, None] - 1 -
+                           jnp.arange(T, dtype=jnp.int32)[None, :], 0, T - 1)
+            outs = jnp.take_along_axis(
+                outs, idx.reshape(idx.shape + (1,) * (outs.ndim - 2)), axis=1) \
+                if outs.ndim > 2 else jnp.take_along_axis(outs, idx, axis=1)
+            m = (jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None])
+            outs = jnp.where(m.reshape(m.shape + (1,) * (outs.ndim - 2)),
+                             outs, jnp.zeros_like(outs))
+        return SequenceBatch(outs, lengths)
+
+
+def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int,
+                max_length: int = 100, name: Optional[str] = None, **kw):
+    """Generation-time beam search (reference beam_search:4101 +
+    RecurrentGradientMachine::generateSequence). Implemented in
+    layers/beam.py; wired here for API parity."""
+    from paddle_tpu.layers.beam import build_beam_search
+    return build_beam_search(step, input, bos_id=bos_id, eos_id=eos_id,
+                             beam_size=beam_size, max_length=max_length,
+                             name=name)
+
+
+def get_output(input: LayerOutput, arg_name: str, **kw) -> LayerOutput:
+    """get_output_layer parity: select a non-default output of a group.
+    With single-output groups this is the identity."""
+    return input

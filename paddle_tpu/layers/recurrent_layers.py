@@ -1,0 +1,117 @@
+"""Recurrent layers: simple RNN, LSTM, GRU memories (full-sequence scans).
+
+Reference: gserver/layers/{RecurrentLayer, LstmLayer, GatedRecurrentLayer}
+with their fused CUDA kernels (hl_cuda_lstm.cu, hl_gpu_gru.cuh). Paddle's
+API convention: the input to lstmemory/grumemory is ALREADY projected by a
+preceding fc/mixed layer to 4*size (LSTM) or 3*size (GRU)
+(trainer_config_helpers/layers.py lstmemory:1414 docstring); the layer owns
+only the recurrent weight and bias. The step-level counterparts for
+recurrent_group live in group_layers.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers
+from paddle_tpu.core.registry import (LayerMeta, ParamAttr, ParamSpec,
+                                      register_layer)
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import recurrent as rnn_ops
+
+
+@register_layer("lstmemory")
+class LstmemoryLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        assert m.size % 4 == 0, "lstmemory input must be projected to 4*size"
+        h = m.size // 4
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        specs = [ParamSpec(wname, (h, 4 * h),
+                           a.initializer or initializers.smart_normal(0), a)]
+        cfg["_w_name"] = wname
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            # 7h bias = 4h gate bias + 3h peephole (reference LstmLayer bias
+            # layout with check_input/forget/output weights)
+            specs.append(ParamSpec(bname, (7 * h,), initializers.zeros, battr))
+            cfg["_b_name"] = bname
+        return LayerMeta(size=h, seq_level=1), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq: SequenceBatch = inputs[0]
+        h = seq.data.shape[-1] // 4
+        w = params[cfg["_w_name"]]
+        bias = peep = None
+        if cfg.get("_b_name"):
+            full = params[cfg["_b_name"]]
+            bias, peep = full[:4 * h], full[4 * h:]
+        return rnn_ops.lstm_scan(
+            seq, w, bias, peep, reverse=cfg.get("reverse", False),
+            act=cfg.get("act", "tanh"),
+            gate_act=cfg.get("gate_act", "sigmoid"),
+            state_act=cfg.get("state_act", "tanh"))
+
+
+@register_layer("gru")
+class GrumemoryLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        assert m.size % 3 == 0, "grumemory input must be projected to 3*size"
+        h = m.size // 3
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        specs = [ParamSpec(wname, (h, 3 * h),
+                           a.initializer or initializers.smart_normal(0), a)]
+        cfg["_w_name"] = wname
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (3 * h,), initializers.zeros, battr))
+            cfg["_b_name"] = bname
+        return LayerMeta(size=h, seq_level=1), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq: SequenceBatch = inputs[0]
+        w = params[cfg["_w_name"]]
+        bias = params.get(cfg.get("_b_name")) if cfg.get("_b_name") else None
+        return rnn_ops.gru_scan(
+            seq, w, bias, reverse=cfg.get("reverse", False),
+            act=cfg.get("act", "tanh"), gate_act=cfg.get("gate_act", "sigmoid"))
+
+
+@register_layer("recurrent")
+class SimpleRecurrentLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        h = m.size
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        specs = [ParamSpec(wname, (h, h),
+                           a.initializer or initializers.smart_normal(0), a)]
+        cfg["_w_name"] = wname
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (h,), initializers.zeros, battr))
+            cfg["_b_name"] = bname
+        return LayerMeta(size=h, seq_level=1), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq: SequenceBatch = inputs[0]
+        w = params[cfg["_w_name"]]
+        bias = params.get(cfg.get("_b_name")) if cfg.get("_b_name") else None
+        return rnn_ops.rnn_scan(seq, w, bias,
+                                reverse=cfg.get("reverse", False),
+                                act=cfg.get("act", "tanh"))

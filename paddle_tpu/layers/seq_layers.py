@@ -1,0 +1,158 @@
+"""Sequence manipulation layers.
+
+Reference: gserver/layers/{SequencePoolLayer, SequenceLastInstanceLayer,
+ExpandLayer, SequenceConcatLayer, SequenceReshapeLayer, SequenceSliceLayer,
+SubSequenceLayer}; trainer_config_helpers wrappers pooling_layer, last_seq,
+first_seq, expand_layer, seq_concat_layer, ...
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import LayerMeta, register_layer
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import sequence_ops as seq_ops
+
+
+@register_layer("seqpool")
+class SeqPoolLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        assert m.seq_level >= 1, "sequence pooling needs a sequence input"
+        # agg_level 0 ('to sample'): pool whole sequence -> level 0.
+        # agg_level 1 ('to sequence', nested input): pool each subsequence ->
+        # a level-1 sequence of pooled vectors (AggregateLevel.TO_SEQUENCE).
+        agg_level = cfg.get("agg_level", 0)
+        out_level = 1 if (m.seq_level == 2 and agg_level != 0) else 0
+        return LayerMeta(size=m.size, seq_level=out_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq: SequenceBatch = inputs[0]
+        ptype = cfg.get("pool_type", "average")
+        if seq.is_nested and cfg.get("agg_level", 0) != 0:
+            return seq_ops.sub_seq_pool(seq, ptype,
+                                        cfg.get("max_segments"))
+        return seq_ops.seq_pool(seq, ptype)
+
+
+@register_layer("seqlastins")
+class SeqLastInsLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=max(m.seq_level - 1, 0)), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq: SequenceBatch = inputs[0]
+        if cfg.get("first"):
+            return seq_ops.first_instance(seq)
+        return seq_ops.last_instance(seq)
+
+
+@register_layer("expand")
+class ExpandLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        like = input_metas[1]
+        return LayerMeta(size=m.size, seq_level=like.seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x, like = inputs
+        payload = x.data if isinstance(x, SequenceBatch) else x
+        return seq_ops.expand_to_sequence(payload, like)
+
+
+@register_layer("seqconcat")
+class SeqConcatLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=1), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        return seq_ops.seq_concat(inputs[0], inputs[1])
+
+
+@register_layer("seqreshape")
+class SeqReshapeLayer:
+    """SequenceReshapeLayer: reinterpret [b, T, d] as [b, T*d/size, size]."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=cfg["reshape_size"], seq_level=1), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq: SequenceBatch = inputs[0]
+        ns = cfg["reshape_size"]
+        b, T = seq.data.shape[0], seq.data.shape[1]
+        d = seq.data.shape[-1]
+        total = T * d
+        assert total % ns == 0, "seq reshape size must divide T*d"
+        new_t = total // ns
+        data = seq.data.reshape(b, new_t, ns)
+        new_len = (seq.lengths * d) // ns
+        return SequenceBatch(data, new_len.astype(jnp.int32))
+
+
+@register_layer("seqslice")
+class SeqSliceLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=m.seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq = inputs[0]
+        starts = inputs[1] if len(inputs) > 1 else None
+        ends = inputs[2] if len(inputs) > 2 else None
+        s = starts[..., 0].astype(jnp.int32) if starts is not None else \
+            jnp.zeros((seq.batch_size,), jnp.int32)
+        e = ends[..., 0].astype(jnp.int32) if ends is not None else seq.lengths
+        return seq_ops.seq_slice(seq, s, e)
+
+
+@register_layer("seqreverse")
+class SeqReverseLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=m.seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        return seq_ops.seq_reverse(inputs[0])
+
+
+@register_layer("context_projection")
+class ContextProjectionLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        from paddle_tpu.core.registry import ParamAttr, ParamSpec
+        from paddle_tpu.core import initializers
+        m = input_metas[0]
+        clen = cfg["context_len"]
+        specs = []
+        if cfg.get("trainable_padding"):
+            cstart = cfg.get("context_start", -(clen // 2))
+            n_pad = max(0, -cstart) + max(0, cstart + clen - 1)
+            a = ParamAttr.of(cfg.get("param_attr"))
+            pname = a.name or f"_{name}.w0"
+            specs = [ParamSpec(pname, (max(n_pad, 1), m.size),
+                               initializers.zeros, a)]
+            cfg["_pad_name"] = pname
+        return LayerMeta(size=m.size * clen, seq_level=1), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        clen = cfg["context_len"]
+        cstart = cfg.get("context_start", -(clen // 2))
+        pad = params.get(cfg.get("_pad_name")) if cfg.get("_pad_name") else None
+        return seq_ops.context_projection(inputs[0], clen, cstart, pad)

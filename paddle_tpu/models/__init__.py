@@ -1,0 +1,3 @@
+"""Model zoo — benchmark-parity network builders (populated per
+SURVEY.md §6: MNIST MLP, SmallNet/VGG/AlexNet/GoogleNet/ResNet CNNs,
+stacked-LSTM text classification, seq2seq NMT, Wide&Deep CTR, CRF tagger)."""

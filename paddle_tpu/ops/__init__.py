@@ -1,0 +1,10 @@
+"""Pure functional ops — the compute substrate.
+
+Replaces paddle/math (25k LoC) + paddle/cuda (20k LoC) + paddle/function
+(11k LoC): every hand-written CUDA/SSE kernel family becomes a jnp/lax
+expression XLA fuses and tiles onto MXU/VPU; the few genuinely hot fused
+loops (LSTM cell, top-k beam step) get Pallas kernels in ops/pallas_kernels.py.
+"""
+
+from paddle_tpu.ops import activations, linear, conv, pool, norm, cost
+from paddle_tpu.ops import sequence_ops, embedding, recurrent

@@ -1,0 +1,125 @@
+"""Activation functions.
+
+Reference: paddle/gserver/activations/ActivationFunction.cpp:94-438 registers
+16 activations (sigmoid, softmax, sequence_softmax, relu, brelu, tanh, stanh,
+hard_sigmoid?, linear, exponential, log, square, sqrt, reciprocal, abs,
+softrelu). Each had hand-written forward+backward; here backward is jax.grad.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_REGISTRY = {}
+
+
+def register(name):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def get(name: str):
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown activation {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+@register("linear")
+def linear(x):
+    return x
+
+
+identity = linear
+_REGISTRY["identity"] = linear
+
+
+@register("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register("stanh")
+def stanh(x):
+    # scaled tanh: 1.7159 * tanh(2/3 x) (ActivationFunction.cpp STanh)
+    return 1.7159 * jnp.tanh(2.0 / 3.0 * x)
+
+
+@register("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register("brelu")
+def brelu(x):
+    # bounded relu: min(max(x, 0), 24) (reference BRelu default bound 24)
+    return jnp.clip(x, 0.0, 24.0)
+
+
+@register("softrelu")
+def softrelu(x):
+    # log(1 + exp(x)), input clipped to [-40, 40] like the reference
+    return jnp.log1p(jnp.exp(jnp.clip(x, -40.0, 40.0)))
+
+
+@register("leaky_relu")
+def leaky_relu(x):
+    return jax.nn.leaky_relu(x)
+
+
+@register("exponential")
+def exponential(x):
+    return jnp.exp(x)
+
+
+@register("log")
+def log_act(x):
+    return jnp.log(x)
+
+
+@register("square")
+def square(x):
+    return jnp.square(x)
+
+
+@register("sqrt")
+def sqrt_act(x):
+    return jnp.sqrt(x)
+
+
+@register("reciprocal")
+def reciprocal(x):
+    return 1.0 / x
+
+
+@register("abs")
+def abs_act(x):
+    return jnp.abs(x)
+
+
+@register("softmax")
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register("sequence_softmax")
+def sequence_softmax(x, mask=None):
+    """Softmax across the time axis of a [batch, time, 1]-ish sequence score,
+    honoring the padding mask (reference: SequenceSoftmaxActivation operates
+    per-sequence over the ragged layout)."""
+    if mask is not None:
+        while mask.ndim < x.ndim:
+            mask = mask[..., None]
+        x = jnp.where(mask > 0, x, -1e30)
+    return jax.nn.softmax(x, axis=1)

@@ -1,0 +1,135 @@
+"""Convolution ops.
+
+Replaces the reference's conv stack — paddle/function/{GemmConvOp,Im2Col,
+DepthwiseConvOp,NaiveConvOp}, gserver ExpandConvLayer/CudnnConvLayer and the
+hl_cnn.h CUDA kernels — with lax.conv_general_dilated, which XLA lowers
+straight onto the MXU. Data layout is NHWC (TPU-preferred), weights HWIO.
+The reference's NCHW<->NHWC SwitchOp is unnecessary internally; feeds arrive
+flat [batch, c*h*w] (paddle image convention, channel-major) and are reshaped
+at the data boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.linear import compute_dtype
+
+
+def _prec():
+    import jax
+    return None if compute_dtype() != jnp.float32 else jax.lax.Precision.HIGHEST
+
+
+def _pair(v: Union[int, Sequence[int]]) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0,
+           dilation=1, groups: int = 1) -> jnp.ndarray:
+    """x: [N,H,W,C], w: [kh,kw,C//groups,OC] -> [N,H',W',OC]."""
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    cd = compute_dtype()
+    out_dtype = x.dtype
+    if cd != jnp.float32:
+        x = x.astype(cd)
+        w = w.astype(cd)
+    y = lax.conv_general_dilated(
+        x, w,
+        window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)),
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+        precision=_prec(),
+        preferred_element_type=jnp.float32,
+    )
+    return y.astype(out_dtype)
+
+
+def conv2d_transpose(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0) -> jnp.ndarray:
+    """Deconv / transposed conv (ExpandConvTransLayer). w: [kh,kw,OC,IC]
+    stored like forward conv with in/out swapped."""
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    kh, kw = w.shape[0], w.shape[1]
+    cd = compute_dtype()
+    out_dtype = x.dtype
+    if cd != jnp.float32:
+        x = x.astype(cd)
+        w = w.astype(cd)
+    y = lax.conv_transpose(
+        x, w,
+        strides=(sh, sw),
+        padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=_prec(),
+        preferred_element_type=jnp.float32,
+    )
+    return y.astype(out_dtype)
+
+
+def conv3d(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0) -> jnp.ndarray:
+    """x: [N,D,H,W,C], w: [kd,kh,kw,IC,OC] (Conv3DLayer)."""
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+    if isinstance(padding, int):
+        padding = (padding,) * 3
+    pads = tuple((p, p) for p in padding)
+    cd = compute_dtype()
+    out_dtype = x.dtype
+    if cd != jnp.float32:
+        x = x.astype(cd)
+        w = w.astype(cd)
+    y = lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=pads,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        precision=_prec(),
+        preferred_element_type=jnp.float32)
+    return y.astype(out_dtype)
+
+
+def conv_out_size(in_size: int, kernel: int, stride: int, padding: int,
+                  dilation: int = 1, caffe_mode: bool = True) -> int:
+    """Output spatial size. Reference: config_parser.py cnn_output_size —
+    caffe_mode floor((i + 2p - k)/s) + 1; else ceil variant."""
+    eff_k = dilation * (kernel - 1) + 1
+    if caffe_mode:
+        return (in_size + 2 * padding - eff_k) // stride + 1
+    return (in_size + 2 * padding - eff_k + stride - 1) // stride + 1
+
+
+def im2col(x: jnp.ndarray, kernel, stride=1, padding=0) -> jnp.ndarray:
+    """Patch extraction (BlockExpandLayer / Im2Col) -> [N, H', W', kh*kw*C]."""
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    return lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), ((ph, ph), (pw, pw)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def row_conv(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Lookahead row convolution (paddle/function/RowConvOp, RowConvLayer).
+
+    x: [batch, time, d]; w: [context, d]. y[t] = sum_{i<context} x[t+i] * w[i].
+    """
+    context = w.shape[0]
+    d = x.shape[-1]
+    # depthwise conv over time with right-side (future) context; HWIO layout
+    # for feature_group_count=d is [kh, kw, 1, d]
+    xt = x[:, :, None, :]                      # [N, T, 1, d]
+    wt = w[:, None, None, :]                   # [context, 1, 1, d]
+    y = lax.conv_general_dilated(
+        xt, wt, window_strides=(1, 1),
+        padding=((0, context - 1), (0, 0)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=d)
+    return y[:, :, 0, :]

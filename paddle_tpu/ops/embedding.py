@@ -1,0 +1,38 @@
+"""Embedding / table lookup ops.
+
+Reference: TableProjection (gserver/layers/TableProjection.cpp) +
+SparseRowCpuMatrix row-sparse gradients (math/SparseRowMatrix.h) + the
+sparse-remote prefetch path (MultiGradientMachine.h:99-166). On TPU a lookup
+is a gather XLA vectorizes; row-sparse gradients are unnecessary for
+correctness (dense grads) but the trainer supports sharding big tables over
+the mesh 'model' axis (parallel/sharding.py) which is the pserver-block
+equivalent.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def embedding_lookup(table: jnp.ndarray, ids: jnp.ndarray,
+                     pad_id: int = -1) -> jnp.ndarray:
+    """table: [vocab, d]; ids: [...] int -> [..., d]. ids == pad_id yields 0."""
+    safe = jnp.clip(ids, 0, table.shape[0] - 1).astype(jnp.int32)
+    out = jnp.take(table, safe, axis=0)
+    if pad_id is not None:
+        out = out * (ids != pad_id)[..., None].astype(out.dtype)
+    return out
+
+
+def one_hot(ids: jnp.ndarray, depth: int, dtype=jnp.float32) -> jnp.ndarray:
+    return (ids[..., None] == jnp.arange(depth, dtype=jnp.int32)).astype(dtype)
+
+
+def sparse_dot(table: jnp.ndarray, ids: jnp.ndarray,
+               weights: jnp.ndarray = None) -> jnp.ndarray:
+    """Sum of table rows selected by ids (sparse_binary_vector x matrix —
+    the SelectiveFC / sparse input FC pattern). ids: [b, k] padded with -1."""
+    rows = embedding_lookup(table, ids)                    # [b, k, d]
+    if weights is not None:
+        rows = rows * weights[..., None]
+    return jnp.sum(rows, axis=-2)

@@ -1,0 +1,80 @@
+"""Dense linear algebra ops with TPU dtype policy.
+
+Replaces the GEMM paths of paddle/math (Matrix::mul over cuBLAS,
+hl_matrix_mul) and paddle/function/MulOp. On TPU all matmuls go through one
+helper that casts to the configured compute dtype (bfloat16 keeps the MXU
+fed) while accumulating/returning float32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.config import global_config
+
+
+def compute_dtype():
+    return jnp.dtype(global_config().compute_dtype)
+
+
+def matmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """MXU-friendly matmul with f32 accumulation.
+
+    compute_dtype float32 -> full-precision MXU passes (precision=highest;
+    TPUs otherwise default to bf16 passes even for f32 inputs);
+    compute_dtype bfloat16 -> cast inputs, single fast MXU pass.
+    """
+    cd = compute_dtype()
+    out_dtype = jnp.promote_types(a.dtype, b.dtype)
+    if cd != jnp.float32:
+        a = a.astype(cd)
+        b = b.astype(cd)
+        prec = None
+    else:
+        prec = jax.lax.Precision.HIGHEST
+    return jnp.matmul(a, b, precision=prec,
+                      preferred_element_type=jnp.float32).astype(out_dtype)
+
+
+def fc(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """x: [..., in], w: [in, out], b: [out]."""
+    y = matmul(x, w)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def dot(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(a * b, axis=-1)
+
+
+def outer(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise outer product [b, m], [b, n] -> [b, m*n] (OuterProdLayer)."""
+    o = a[..., :, None] * b[..., None, :]
+    return o.reshape(o.shape[:-2] + (o.shape[-2] * o.shape[-1],))
+
+
+def cos_sim(a: jnp.ndarray, b: jnp.ndarray, scale: float = 1.0,
+            eps: float = 1e-8) -> jnp.ndarray:
+    """Row-wise cosine similarity (paddle/function/CosSimOp, CosSimLayer)."""
+    num = jnp.sum(a * b, axis=-1)
+    den = jnp.sqrt(jnp.sum(a * a, axis=-1) * jnp.sum(b * b, axis=-1))
+    return scale * num / jnp.maximum(den, eps)
+
+
+def interpolation(w: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """w*a + (1-w)*b with per-row scalar w [batch, 1] (InterpolationLayer)."""
+    return w * a + (1.0 - w) * b
+
+
+def slope_intercept(x: jnp.ndarray, slope: float, intercept: float) -> jnp.ndarray:
+    return slope * x + intercept
+
+
+def sum_to_one_norm(x: jnp.ndarray, eps: float = 1e-8) -> jnp.ndarray:
+    """Row-normalize to sum 1 (SumToOneNormLayer)."""
+    return x / jnp.maximum(jnp.sum(x, axis=-1, keepdims=True), eps)

@@ -1,0 +1,72 @@
+"""Normalization ops.
+
+Reference: paddle/gserver/layers/BatchNormalizationLayer (+Cudnn twin,
+BatchNormBaseLayer keeps moving mean/var as MOVING_AVERAGE parameters),
+CrossMapNormalLayer (LRN, paddle/function/CrossMapNormalOp), DataNormLayer,
+CrossChannelNormLayer, L2 row norm (NormLayer 'l2' type).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def batch_norm_train(x: jnp.ndarray, gamma, beta, moving_mean, moving_var,
+                     *, momentum: float = 0.9, eps: float = 1e-5,
+                     axes: Optional[Tuple[int, ...]] = None):
+    """Training-mode batch norm over all axes but the last (feature) axis.
+
+    Returns (y, new_moving_mean, new_moving_var). Moving stats update matches
+    the reference's movingAvgFraction semantics
+    (BatchNormBaseLayer: moving = moving*m + batch*(1-m)).
+    """
+    if axes is None:
+        axes = tuple(range(x.ndim - 1))
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    y = (x - mean) * lax.rsqrt(var + eps) * gamma + beta
+    new_mean = moving_mean * momentum + mean * (1.0 - momentum)
+    new_var = moving_var * momentum + var * (1.0 - momentum)
+    return y, new_mean, new_var
+
+
+def batch_norm_infer(x: jnp.ndarray, gamma, beta, moving_mean, moving_var,
+                     *, eps: float = 1e-5):
+    return (x - moving_mean) * lax.rsqrt(moving_var + eps) * gamma + beta
+
+
+def lrn_cross_map(x: jnp.ndarray, size: int = 5, scale: float = 1e-4,
+                  power: float = 0.75) -> jnp.ndarray:
+    """Local response norm across channels, x: [N,H,W,C].
+
+    Reference CrossMapNormalOp: denom = 1 + scale/size * sum_{window} x^2;
+    y = x * denom^-power (config_parser img_norm defaults scale=0.0128/size).
+    """
+    sq = jnp.square(x)
+    half = size // 2
+    # sum over a channel window via padding + cumulative trick
+    padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, size - 1 - half)))
+    window = sum(padded[..., i:i + x.shape[-1]] for i in range(size))
+    denom = (1.0 + (scale / size) * window) ** power
+    return x / denom
+
+
+def cross_channel_l2_norm(x: jnp.ndarray, scale, eps: float = 1e-10) -> jnp.ndarray:
+    """CrossChannelNormLayer (SSD): L2-normalize each pixel across channels,
+    multiply per-channel learned scale. x: [N,H,W,C], scale: [C]."""
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True) + eps)
+    return x / norm * scale
+
+
+def l2_normalize(x: jnp.ndarray, axis: int = -1, eps: float = 1e-12) -> jnp.ndarray:
+    return x * lax.rsqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+
+
+def layer_norm(x: jnp.ndarray, gamma, beta, eps: float = 1e-5) -> jnp.ndarray:
+    """Modern extra (not in the 2017 reference) used by the transformer zoo."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * gamma + beta

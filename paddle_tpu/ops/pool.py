@@ -1,0 +1,97 @@
+"""Pooling ops — reference: paddle/gserver/layers/PoolLayer (max/avg,
+CudnnPoolLayer), SpatialPyramidPoolLayer, MaxOutLayer; hl_cnn.h pooling
+kernels. lax.reduce_window lowers these onto the VPU."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def max_pool2d(x: jnp.ndarray, kernel, stride=None, padding=0) -> jnp.ndarray:
+    """x: [N,H,W,C]."""
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride if stride is not None else kernel)
+    ph, pw = _pair(padding)
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max, (1, kh, kw, 1), (1, sh, sw, 1),
+        ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+
+
+def avg_pool2d(x: jnp.ndarray, kernel, stride=None, padding=0,
+               exclude_padding: bool = True) -> jnp.ndarray:
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride if stride is not None else kernel)
+    ph, pw = _pair(padding)
+    sums = lax.reduce_window(
+        x, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
+        ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    if exclude_padding and (ph or pw):
+        ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+        counts = lax.reduce_window(
+            ones, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
+            ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+        return sums / jnp.maximum(counts, 1.0)
+    return sums / float(kh * kw)
+
+
+def global_avg_pool(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(x, axis=(1, 2))
+
+
+def pool_out_size(in_size: int, kernel: int, stride: int, padding: int,
+                  ceil_mode: bool = True) -> int:
+    """config_parser.py cnn_output_size for pooling (paddle pools use ceil)."""
+    if ceil_mode:
+        return int(np.ceil((in_size - kernel + 2 * padding) / stride)) + 1
+    return (in_size - kernel + 2 * padding) // stride + 1
+
+
+def maxout(x: jnp.ndarray, groups: int) -> jnp.ndarray:
+    """MaxOutLayer: [N,H,W,C] -> max over `groups` channel groups ->
+    [N,H,W,C//groups]."""
+    n, h, w, c = x.shape
+    assert c % groups == 0
+    return jnp.max(x.reshape(n, h, w, c // groups, groups), axis=-1)
+
+
+def spatial_pyramid_pool(x: jnp.ndarray, pyramid_height: int,
+                         pool_type: str = "max") -> jnp.ndarray:
+    """SPP (SpatialPyramidPoolLayer): levels 1x1, 2x2, ... 2^(h-1) bins,
+    concatenated. Output [N, C * sum(4^l)].
+
+    Adaptive binning (bin boundaries computed per level from the static
+    spatial dims) so the output size ALWAYS matches C * sum(4^l), even when
+    a level has more bins than pixels — bins then overlap/repeat pixels,
+    matching reference behavior of degenerate windows.
+    """
+    n, h, w, c = x.shape
+    outs = []
+    for lvl in range(pyramid_height):
+        bins = 2 ** lvl
+        hb = np.linspace(0, h, bins + 1)
+        wb = np.linspace(0, w, bins + 1)
+        for bi in range(bins):
+            h0, h1 = int(np.floor(hb[bi])), int(np.ceil(hb[bi + 1]))
+            h1 = max(h1, h0 + 1)
+            h0 = min(h0, h - 1)
+            for bj in range(bins):
+                w0, w1 = int(np.floor(wb[bj])), int(np.ceil(wb[bj + 1]))
+                w1 = max(w1, w0 + 1)
+                w0 = min(w0, w - 1)
+                region = x[:, h0:h1, w0:w1, :]
+                if pool_type == "max":
+                    outs.append(jnp.max(region, axis=(1, 2)))
+                else:
+                    outs.append(jnp.mean(region, axis=(1, 2)))
+    return jnp.concatenate(outs, axis=-1)

@@ -1,0 +1,203 @@
+"""Recurrent cells and masked scans.
+
+Reference: gserver/layers/LstmLayer.cpp + the fused CUDA cells
+(cuda/src/hl_cuda_lstm.cu, hl_gpu_gru.cuh), GatedRecurrentLayer,
+RecurrentLayer; SequenceToBatch re-packing made ragged batches dense per
+timestep. TPU design: time-major `lax.scan` over the padded time axis with a
+per-step validity mask — state freezes on padded steps, so results match the
+ragged semantics exactly while XLA pipelines the whole scan body into fused
+kernels (the same fusion hl_cuda_lstm.cu did by hand).
+
+Layout note: gate order is [input, forget, cell(candidate), output] (paddle's
+hl_lstm gate layout); GRU gates [update(z), reset(r), candidate(c)].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import activations
+from paddle_tpu.ops.linear import matmul
+
+
+def lstm_cell(x4: jnp.ndarray, h: jnp.ndarray, c: jnp.ndarray,
+              w_rec: jnp.ndarray, bias: Optional[jnp.ndarray],
+              peep: Optional[jnp.ndarray] = None,
+              act: str = "tanh", gate_act: str = "sigmoid",
+              state_act: str = "tanh") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One LSTM step.
+
+    x4: [b, 4h] pre-projected input; w_rec: [h, 4h]; bias: [4h];
+    peep: [3h] peephole weights (input|forget|output) or None.
+    Returns (h', c').
+    """
+    hdim = h.shape[-1]
+    z = x4 + matmul(h, w_rec)
+    if bias is not None:
+        z = z + bias
+    zi, zf, zc, zo = (z[..., :hdim], z[..., hdim:2 * hdim],
+                      z[..., 2 * hdim:3 * hdim], z[..., 3 * hdim:])
+    ga = activations.get(gate_act)
+    if peep is not None:
+        pi, pf, po = peep[:hdim], peep[hdim:2 * hdim], peep[2 * hdim:]
+        i = ga(zi + pi * c)
+        f = ga(zf + pf * c)
+    else:
+        i = ga(zi)
+        f = ga(zf)
+    cand = activations.get(act)(zc)
+    c_new = f * c + i * cand
+    if peep is not None:
+        o = ga(zo + po * c_new)
+    else:
+        o = ga(zo)
+    h_new = o * activations.get(state_act)(c_new)
+    return h_new, c_new
+
+
+def gru_cell(x3: jnp.ndarray, h: jnp.ndarray, w_rec: jnp.ndarray,
+             bias: Optional[jnp.ndarray], act: str = "tanh",
+             gate_act: str = "sigmoid") -> jnp.ndarray:
+    """One GRU step (paddle gate layout: update z, reset r, candidate c).
+
+    x3: [b, 3h]; w_rec: [h, 3h] (gate part [h, 2h] + candidate part [h, h]).
+    """
+    hdim = h.shape[-1]
+    gates_x = x3[..., :2 * hdim]
+    cand_x = x3[..., 2 * hdim:]
+    gates_h = matmul(h, w_rec[:, :2 * hdim])
+    zr = gates_x + gates_h
+    if bias is not None:
+        zr = zr + bias[:2 * hdim]
+    ga = activations.get(gate_act)
+    z = ga(zr[..., :hdim])
+    r = ga(zr[..., hdim:])
+    cand = cand_x + matmul(r * h, w_rec[:, 2 * hdim:])
+    if bias is not None:
+        cand = cand + bias[2 * hdim:]
+    c = activations.get(act)(cand)
+    return (1.0 - z) * h + z * c
+
+
+def simple_rnn_cell(x: jnp.ndarray, h: jnp.ndarray, w_rec: jnp.ndarray,
+                    bias: Optional[jnp.ndarray], act: str = "tanh") -> jnp.ndarray:
+    """RecurrentLayer: h' = act(x + h @ W + b)."""
+    z = x + matmul(h, w_rec)
+    if bias is not None:
+        z = z + bias
+    return activations.get(act)(z)
+
+
+def _masked_scan(step_fn, init_carry, seq: SequenceBatch, reverse: bool):
+    """Run step_fn over time with state frozen on padded steps.
+
+    step_fn(carry, x_t) -> (new_carry, out_t); carry is a pytree of [b, ...]
+    arrays. Uses time-major scan.
+    """
+    x = seq.data
+    T = x.shape[1]
+    xs = jnp.moveaxis(x, 1, 0)                       # [T, b, ...]
+    tidx = jnp.arange(T, dtype=jnp.int32)
+    if reverse:
+        # process positions len-1 ... 0 per row: reverse the padded axis and
+        # shift so each row starts at its own end. Simpler: gather per-row
+        # reversed indices.
+        rev_idx = jnp.clip(seq.lengths[:, None] - 1 -
+                           jnp.arange(T, dtype=jnp.int32)[None, :], 0, T - 1)
+        gx = jnp.take_along_axis(
+            x, rev_idx.reshape(rev_idx.shape + (1,) * (x.ndim - 2)), axis=1) \
+            if x.ndim > 2 else jnp.take_along_axis(x, rev_idx, axis=1)
+        xs = jnp.moveaxis(gx, 1, 0)
+
+    def body(carry, inp):
+        t, x_t = inp
+        valid = t < seq.lengths                      # [b] bool
+        new_carry, out_t = step_fn(carry, x_t)
+
+        def merge(n, o):
+            v = valid.reshape((-1,) + (1,) * (n.ndim - 1))
+            return jnp.where(v, n, o)
+
+        merged = jax.tree_util.tree_map(merge, new_carry, carry)
+        vo = valid.reshape((-1,) + (1,) * (out_t.ndim - 1))
+        return merged, jnp.where(vo, out_t, jnp.zeros_like(out_t))
+
+    carry, outs = lax.scan(body, init_carry, (tidx, xs))
+    outs = jnp.moveaxis(outs, 0, 1)                  # [b, T, ...]
+    if reverse:
+        rev_idx = jnp.clip(seq.lengths[:, None] - 1 -
+                           jnp.arange(T, dtype=jnp.int32)[None, :], 0, T - 1)
+        outs = jnp.take_along_axis(
+            outs, rev_idx.reshape(rev_idx.shape + (1,) * (outs.ndim - 2)),
+            axis=1)
+        outs = outs * seq.mask(outs.dtype).reshape(
+            seq.mask().shape + (1,) * (outs.ndim - 2))
+    return carry, outs
+
+
+def lstm_scan(seq4: SequenceBatch, w_rec: jnp.ndarray,
+              bias: Optional[jnp.ndarray], peep: Optional[jnp.ndarray] = None,
+              *, reverse: bool = False, act: str = "tanh",
+              gate_act: str = "sigmoid", state_act: str = "tanh",
+              h0: Optional[jnp.ndarray] = None,
+              c0: Optional[jnp.ndarray] = None,
+              return_state: bool = False):
+    """LSTM over a pre-projected sequence [b, T, 4h] -> hidden [b, T, h]."""
+    b = seq4.data.shape[0]
+    h = w_rec.shape[0]
+    dtype = seq4.data.dtype
+    h_init = h0 if h0 is not None else jnp.zeros((b, h), dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((b, h), dtype)
+
+    def step(carry, x_t):
+        hh, cc = carry
+        h_new, c_new = lstm_cell(x_t, hh, cc, w_rec, bias, peep,
+                                 act, gate_act, state_act)
+        return (h_new, c_new), h_new
+
+    (hT, cT), outs = _masked_scan(step, (h_init, c_init), seq4, reverse)
+    out_seq = seq4.with_data(outs)
+    if return_state:
+        return out_seq, (hT, cT)
+    return out_seq
+
+
+def gru_scan(seq3: SequenceBatch, w_rec: jnp.ndarray,
+             bias: Optional[jnp.ndarray], *, reverse: bool = False,
+             act: str = "tanh", gate_act: str = "sigmoid",
+             h0: Optional[jnp.ndarray] = None,
+             return_state: bool = False):
+    """GRU over pre-projected [b, T, 3h] -> [b, T, h]."""
+    b = seq3.data.shape[0]
+    h = w_rec.shape[0]
+    h_init = h0 if h0 is not None else jnp.zeros((b, h), seq3.data.dtype)
+
+    def step(carry, x_t):
+        h_new = gru_cell(x_t, carry, w_rec, bias, act, gate_act)
+        return h_new, h_new
+
+    hT, outs = _masked_scan(step, h_init, seq3, reverse)
+    out_seq = seq3.with_data(outs)
+    if return_state:
+        return out_seq, hT
+    return out_seq
+
+
+def rnn_scan(seq: SequenceBatch, w_rec: jnp.ndarray,
+             bias: Optional[jnp.ndarray], *, reverse: bool = False,
+             act: str = "tanh", h0: Optional[jnp.ndarray] = None):
+    b = seq.data.shape[0]
+    h = w_rec.shape[0]
+    h_init = h0 if h0 is not None else jnp.zeros((b, h), seq.data.dtype)
+
+    def step(carry, x_t):
+        h_new = simple_rnn_cell(x_t, carry, w_rec, bias, act)
+        return h_new, h_new
+
+    _, outs = _masked_scan(step, h_init, seq, reverse)
+    return seq.with_data(outs)

@@ -1,0 +1,182 @@
+"""Sequence ops over padded+masked SequenceBatch.
+
+Reference: gserver/layers/{SequencePoolLayer (max/avg/sum pooling over
+sequences), SequenceLastInstanceLayer, SequenceConcatLayer,
+SequenceReshapeLayer, SequenceSliceLayer, ExpandLayer, SubSequenceLayer,
+ContextProjection (paddle/function/ContextProjectionOp)}. All of these
+consumed the ragged Argument layout; here each is a masked dense op.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+
+_NEG = -1e30
+
+
+def seq_pool(seq: SequenceBatch, pool_type: str = "average") -> jnp.ndarray:
+    """Pool over time -> [batch, d]. pool_type: average|sum|max|sqrt|last|first."""
+    x = seq.data
+    m = seq.mask(x.dtype)
+    while m.ndim < x.ndim:
+        m = m[..., None]
+    if pool_type in ("average", "avg"):
+        s = jnp.sum(x * m, axis=1)
+        return s / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    if pool_type == "sum":
+        return jnp.sum(x * m, axis=1)
+    if pool_type == "sqrt":
+        s = jnp.sum(x * m, axis=1)
+        return s / jnp.sqrt(jnp.maximum(jnp.sum(m, axis=1), 1.0))
+    if pool_type == "max":
+        return jnp.max(jnp.where(m > 0, x, _NEG), axis=1)
+    if pool_type == "last":
+        return last_instance(seq)
+    if pool_type == "first":
+        return first_instance(seq)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def last_instance(seq: SequenceBatch) -> jnp.ndarray:
+    """SequenceLastInstanceLayer: x[i, len_i - 1]."""
+    idx = jnp.maximum(seq.lengths - 1, 0)
+    return jnp.take_along_axis(
+        seq.data, idx.reshape((-1,) + (1,) * (seq.data.ndim - 1)), axis=1)[:, 0]
+
+
+def first_instance(seq: SequenceBatch) -> jnp.ndarray:
+    return seq.data[:, 0]
+
+
+def expand_to_sequence(x: jnp.ndarray, like: SequenceBatch) -> SequenceBatch:
+    """ExpandLayer: broadcast per-sample [b, d] to every timestep of `like`."""
+    data = jnp.broadcast_to(x[:, None], (x.shape[0], like.max_len) + x.shape[1:])
+    return like.with_data(data)
+
+
+def seq_concat(a: SequenceBatch, b: SequenceBatch) -> SequenceBatch:
+    """SequenceConcatLayer: concatenate along time per sample (a_i ++ b_i).
+
+    Static-shape implementation: allocate max_a+max_b and scatter b after
+    a's valid prefix via a gather index computation.
+    """
+    la, lb = a.lengths, b.lengths
+    total = a.max_len + b.max_len
+    t = jnp.arange(total, dtype=jnp.int32)[None, :]        # [1, T]
+    in_a = t < la[:, None]
+    idx_a = jnp.clip(t, 0, a.max_len - 1)
+    idx_b = jnp.clip(t - la[:, None], 0, b.max_len - 1)
+    ga = jnp.take_along_axis(
+        a.data, idx_a.reshape(idx_a.shape + (1,) * (a.data.ndim - 2)), axis=1) \
+        if a.data.ndim > 2 else jnp.take_along_axis(a.data, idx_a, axis=1)
+    gb = jnp.take_along_axis(
+        b.data, idx_b.reshape(idx_b.shape + (1,) * (b.data.ndim - 2)), axis=1) \
+        if b.data.ndim > 2 else jnp.take_along_axis(b.data, idx_b, axis=1)
+    cond = in_a.reshape(in_a.shape + (1,) * (a.data.ndim - 2))
+    return SequenceBatch(jnp.where(cond, ga, gb), la + lb)
+
+
+def seq_slice(seq: SequenceBatch, starts: jnp.ndarray,
+              ends: jnp.ndarray) -> SequenceBatch:
+    """SequenceSliceLayer: per-sample [start, end) window, re-packed at t=0."""
+    t = jnp.arange(seq.max_len, dtype=jnp.int32)[None, :]
+    src = jnp.clip(t + starts[:, None], 0, seq.max_len - 1)
+    gathered = jnp.take_along_axis(
+        seq.data, src.reshape(src.shape + (1,) * (seq.data.ndim - 2)), axis=1) \
+        if seq.data.ndim > 2 else jnp.take_along_axis(seq.data, src, axis=1)
+    new_len = jnp.clip(jnp.minimum(ends, seq.lengths) - starts, 0, seq.max_len)
+    return SequenceBatch(gathered, new_len.astype(jnp.int32))
+
+
+def seq_reverse(seq: SequenceBatch) -> SequenceBatch:
+    """Reverse each sequence within its valid length (for reverse RNNs —
+    the reference's GatedRecurrentLayer(reversed=True))."""
+    t = jnp.arange(seq.max_len, dtype=jnp.int32)[None, :]
+    src = jnp.clip(seq.lengths[:, None] - 1 - t, 0, seq.max_len - 1)
+    data = jnp.take_along_axis(
+        seq.data, src.reshape(src.shape + (1,) * (seq.data.ndim - 2)), axis=1) \
+        if seq.data.ndim > 2 else jnp.take_along_axis(seq.data, src, axis=1)
+    # positions beyond length are garbage; zero them via mask
+    out = SequenceBatch(data, seq.lengths)
+    return out.with_data(out.masked_data())
+
+
+def context_projection(seq: SequenceBatch, context_len: int,
+                       context_start: int,
+                       pad_weights: Optional[jnp.ndarray] = None) -> SequenceBatch:
+    """ContextProjection: concat a sliding window of neighbors per timestep.
+
+    [b, T, d] -> [b, T, d*context_len]. Out-of-range positions use zeros or
+    trainable pad rows (paddle/function/ContextProjectionOp trainable_padding).
+    pad_weights: [pad_rows, d] where pad_rows = (#left oob)+(#right oob).
+    """
+    x = seq.masked_data()
+    b, T = x.shape[0], x.shape[1]
+    d = x.shape[-1]
+    outs = []
+    n_left = max(0, -context_start)
+    for i in range(context_len):
+        off = context_start + i
+        sh = jnp.roll(x, -off, axis=1)
+        t = jnp.arange(T, dtype=jnp.int32)[None, :]
+        pos = t + off
+        valid = (pos >= 0) & (pos < seq.lengths[:, None])
+        validf = valid.astype(x.dtype)[..., None]
+        part = sh * validf
+        if pad_weights is not None:
+            if off < 0:  # left out-of-range -> pad row (n_left + off) ... rows 0..n_left-1
+                row = pad_weights[i]
+                part = part + (pos < 0).astype(x.dtype)[..., None] * row
+            elif off > 0:
+                row = pad_weights[n_left + context_len - 1 - i] if \
+                    pad_weights.shape[0] > n_left else pad_weights[i]
+                oob = (pos >= seq.lengths[:, None]) & (t < seq.lengths[:, None])
+                part = part + oob.astype(x.dtype)[..., None] * row
+        outs.append(part)
+    return seq.with_data(jnp.concatenate(outs, axis=-1))
+
+
+def sub_seq_pool(seq: SequenceBatch, pool_type: str = "average",
+                 max_segments: Optional[int] = None) -> SequenceBatch:
+    """Pool each inner (sub-)sequence of a nested batch -> sequence of
+    pooled vectors [b, max_segments, d] (SequencePoolLayer at sub-seq level).
+
+    max_segments must be static under jit; defaults to max_len (safe bound).
+    """
+    assert seq.is_nested, "sub_seq_pool needs a nested SequenceBatch"
+    x = seq.data
+    b, T = x.shape[0], x.shape[1]
+    xs = x.reshape(b, T, -1)
+    seg = seq.segment_ids
+    max_segs = max_segments if max_segments is not None else T
+    # one-hot segment matrix [b, T, S]
+    s_ids = jnp.arange(max_segs, dtype=jnp.int32)
+    onehot = (seg[..., None] == s_ids[None, None, :]).astype(xs.dtype)
+    sums = jnp.einsum("btd,bts->bsd", xs, onehot)
+    counts = jnp.sum(onehot, axis=1)[..., None]
+    if pool_type in ("average", "avg"):
+        pooled = sums / jnp.maximum(counts, 1.0)
+    elif pool_type == "sum":
+        pooled = sums
+    elif pool_type == "max":
+        big = jnp.where(onehot[..., None] > 0, xs[:, :, None, :], _NEG)
+        pooled = jnp.max(big, axis=1)
+    elif pool_type == "last":
+        # index of last position of each segment
+        tidx = jnp.arange(T, dtype=jnp.int32)[None, :, None]
+        last_t = jnp.max(jnp.where(onehot > 0, tidx, -1), axis=1)  # [b, S]
+        pooled = jnp.take_along_axis(xs, jnp.maximum(last_t, 0)[..., None],
+                                     axis=1)
+    elif pool_type == "first":
+        tidx = jnp.arange(T, dtype=jnp.int32)[None, :, None]
+        first_t = jnp.min(jnp.where(onehot > 0, tidx, T + 1), axis=1)
+        pooled = jnp.take_along_axis(xs, jnp.clip(first_t, 0, T - 1)[..., None],
+                                     axis=1)
+    else:
+        raise ValueError(pool_type)
+    return SequenceBatch(pooled, seq.num_segments)

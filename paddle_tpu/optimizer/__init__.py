@@ -1,0 +1,10 @@
+from paddle_tpu.optimizer.optimizers import (Optimizer, Momentum, SGD,
+                                             Adam, Adamax, AdaGrad,
+                                             DecayedAdaGrad, AdaDelta,
+                                             RmsProp, ModelAverage,
+                                             L2Regularization)
+from paddle_tpu.optimizer import schedules
+
+__all__ = ["Optimizer", "Momentum", "SGD", "Adam", "Adamax", "AdaGrad",
+           "DecayedAdaGrad", "AdaDelta", "RmsProp", "ModelAverage",
+           "L2Regularization", "schedules"]

@@ -1,0 +1,269 @@
+"""Optimizers — v2-API-shaped, pure-functional update rules.
+
+Reference: paddle/parameter/FirstOrderOptimizer.h:23-331 (Sgd/Momentum,
+SparseMomentum, AdaGrad, AdaDelta, RMSProp, DecayedAdaGrad, Adam, Adamax,
+AddOptimizer) + the device kernels in math/TrainingAlgorithmOp.h:38-114,
+OptimizerWithRegularizer / gradient clipping wrappers, AverageOptimizer,
+and the v2 wrappers in python/paddle/v2/optimizer.py +
+trainer_config_helpers/optimizers.py (settings():358).
+
+Every optimizer is: init_state(params) -> pytree;
+update(params, grads, state, num_samples) -> (params, state). All pure, so
+the whole update jits into the train step (the reference pipelined per-param
+updates with backward — XLA fuses ours into the step program instead).
+
+Per-parameter attributes (ParamAttr.learning_rate / l1 / l2 / is_static /
+gradient_clipping_threshold) are honored via a spec map the Topology
+provides.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer.schedules import make_schedule
+
+
+class L2Regularization:
+    def __init__(self, rate: float = 0.0):
+        self.rate = rate
+
+
+class L1Regularization:
+    def __init__(self, rate: float = 0.0):
+        self.rate = rate
+
+
+class ModelAverage:
+    """AverageOptimizer parity: maintain a sliding average of parameters used
+    at test time (average_window fraction of max_average_window updates)."""
+
+    def __init__(self, average_window: float = 0.5,
+                 max_average_window: Optional[int] = None):
+        self.average_window = average_window
+        self.max_average_window = max_average_window or 10000
+
+
+class Optimizer:
+    """Base class. Subclasses define _init_slot / _apply."""
+
+    def __init__(self, learning_rate: float = 0.01,
+                 regularization: Optional[Any] = None,
+                 gradient_clipping_threshold: Optional[float] = None,
+                 learning_rate_decay_a: float = 0.0,
+                 learning_rate_decay_b: float = 0.0,
+                 learning_rate_schedule: str = "constant",
+                 model_average: Optional[ModelAverage] = None,
+                 batch_size: int = 1, **kwargs):
+        self.learning_rate = learning_rate
+        self.l2 = regularization.rate if isinstance(
+            regularization, L2Regularization) else 0.0
+        self.l1 = regularization.rate if isinstance(
+            regularization, L1Regularization) else 0.0
+        self.clip = gradient_clipping_threshold
+        self.schedule = make_schedule(learning_rate_schedule, learning_rate,
+                                      learning_rate_decay_a,
+                                      learning_rate_decay_b)
+        self.model_average = model_average
+        self.param_attrs: Dict[str, Any] = {}
+
+    def bind(self, param_specs: Dict[str, Any]) -> "Optimizer":
+        """Attach per-parameter attrs from Topology.param_specs."""
+        self.param_attrs = {name: ps.attr for name, ps in param_specs.items()}
+        return self
+
+    # ---- subclass hooks --------------------------------------------------
+    def _init_slot(self, p: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def _apply(self, p, g, slot, lr, step) -> Tuple[jnp.ndarray, Dict]:
+        raise NotImplementedError
+
+    # ---- public API ------------------------------------------------------
+    def init_state(self, params: Dict[str, jnp.ndarray]) -> Dict[str, Any]:
+        state = {"step": jnp.zeros((), jnp.int32),
+                 "num_samples": jnp.zeros((), jnp.float32),
+                 "slots": {k: self._init_slot(v) for k, v in params.items()}}
+        if self.model_average is not None:
+            state["avg"] = {k: v for k, v in params.items()}
+        return state
+
+    def update(self, params: Dict[str, jnp.ndarray],
+               grads: Dict[str, jnp.ndarray], state: Dict[str, Any],
+               batch_size) -> Tuple[Dict[str, jnp.ndarray], Dict[str, Any]]:
+        step = state["step"] + 1
+        num_samples = state["num_samples"] + batch_size
+        base_lr = self.schedule(num_samples)
+        new_params, new_slots = {}, {}
+        for k in params:
+            p, g = params[k], grads[k]
+            attr = self.param_attrs.get(k)
+            if attr is not None and attr.is_static:
+                new_params[k] = p
+                new_slots[k] = state["slots"][k]
+                continue
+            # gradient clipping (per-param threshold overrides global);
+            # reference: GradientClippingOptimizer clips by absolute value
+            clip = attr.gradient_clipping_threshold if (
+                attr and attr.gradient_clipping_threshold) else self.clip
+            if clip:
+                g = jnp.clip(g, -clip, clip)
+            # L2/L1 regularization as grad decay (OptimizerWithRegularizer)
+            l2 = attr.l2_rate if (attr and attr.l2_rate is not None) else self.l2
+            l1 = attr.l1_rate if (attr and attr.l1_rate is not None) else self.l1
+            if l2:
+                g = g + l2 * p
+            if l1:
+                g = g + l1 * jnp.sign(p)
+            lr = base_lr * (attr.learning_rate if attr else 1.0)
+            np_, ns = self._apply(p, g, state["slots"][k], lr, step)
+            new_params[k] = np_
+            new_slots[k] = ns
+        new_state = {"step": step, "num_samples": num_samples,
+                     "slots": new_slots}
+        if self.model_average is not None:
+            # incremental mean over a sliding window (approximated by EMA with
+            # window-matched decay, the standard streaming equivalent)
+            w = self.model_average.max_average_window
+            decay = jnp.minimum(step.astype(jnp.float32) / (step + 1.0),
+                                (w - 1.0) / w)
+            new_state["avg"] = {
+                k: state["avg"][k] * decay + new_params[k] * (1.0 - decay)
+                for k in new_params}
+        return new_params, new_state
+
+    def test_params(self, params, state):
+        """Parameters to evaluate with (model-averaged if enabled)."""
+        if self.model_average is not None and "avg" in state:
+            return state["avg"]
+        return params
+
+
+class Momentum(Optimizer):
+    """SgdOptimizer/MomentumOptimizer (FirstOrderOptimizer.h:23). momentum=0
+    is plain SGD. sparse momentum degenerates to the same dense rule here."""
+
+    def __init__(self, momentum: float = 0.0, sparse: bool = False, **kw):
+        super().__init__(**kw)
+        self.momentum = momentum
+
+    def _init_slot(self, p):
+        if self.momentum:
+            return {"mom": jnp.zeros_like(p)}
+        return {}
+
+    def _apply(self, p, g, slot, lr, step):
+        if not self.momentum:
+            return p - lr * g, slot
+        m = slot["mom"] * self.momentum - lr * g
+        return p + m, {"mom": m}
+
+
+SGD = Momentum
+
+
+class Adam(Optimizer):
+    """AdamOptimizer (FirstOrderOptimizer.h:258; adamApply
+    TrainingAlgorithmOp.h)."""
+
+    def __init__(self, beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, **kw):
+        super().__init__(**kw)
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+
+    def _init_slot(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def _apply(self, p, g, slot, lr, step):
+        t = step.astype(jnp.float32)
+        m = self.b1 * slot["m"] + (1 - self.b1) * g
+        v = self.b2 * slot["v"] + (1 - self.b2) * jnp.square(g)
+        mhat = m / (1 - jnp.power(self.b1, t))
+        vhat = v / (1 - jnp.power(self.b2, t))
+        return p - lr * mhat / (jnp.sqrt(vhat) + self.eps), {"m": m, "v": v}
+
+
+class Adamax(Optimizer):
+    """AdamaxOptimizer (FirstOrderOptimizer.h:303)."""
+
+    def __init__(self, beta1: float = 0.9, beta2: float = 0.999, **kw):
+        super().__init__(**kw)
+        self.b1, self.b2 = beta1, beta2
+
+    def _init_slot(self, p):
+        return {"m": jnp.zeros_like(p), "u": jnp.zeros_like(p)}
+
+    def _apply(self, p, g, slot, lr, step):
+        t = step.astype(jnp.float32)
+        m = self.b1 * slot["m"] + (1 - self.b1) * g
+        u = jnp.maximum(self.b2 * slot["u"], jnp.abs(g))
+        return (p - lr / (1 - jnp.power(self.b1, t)) * m / (u + 1e-12),
+                {"m": m, "u": u})
+
+
+class AdaGrad(Optimizer):
+    """AdagradOptimizer (FirstOrderOptimizer.h:146)."""
+
+    def __init__(self, epsilon: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.eps = epsilon
+
+    def _init_slot(self, p):
+        return {"acc": jnp.zeros_like(p)}
+
+    def _apply(self, p, g, slot, lr, step):
+        acc = slot["acc"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc) + self.eps), {"acc": acc}
+
+
+class DecayedAdaGrad(Optimizer):
+    """DecayedAdagradOptimizer (FirstOrderOptimizer.h:222)."""
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.rho, self.eps = rho, epsilon
+
+    def _init_slot(self, p):
+        return {"acc": jnp.zeros_like(p)}
+
+    def _apply(self, p, g, slot, lr, step):
+        acc = self.rho * slot["acc"] + (1 - self.rho) * jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc) + self.eps), {"acc": acc}
+
+
+class AdaDelta(Optimizer):
+    """AdaDeltaOptimizer (FirstOrderOptimizer.h:168)."""
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.rho, self.eps = rho, epsilon
+
+    def _init_slot(self, p):
+        return {"acc_g": jnp.zeros_like(p), "acc_dx": jnp.zeros_like(p)}
+
+    def _apply(self, p, g, slot, lr, step):
+        acc_g = self.rho * slot["acc_g"] + (1 - self.rho) * jnp.square(g)
+        dx = -jnp.sqrt((slot["acc_dx"] + self.eps) / (acc_g + self.eps)) * g
+        acc_dx = self.rho * slot["acc_dx"] + (1 - self.rho) * jnp.square(dx)
+        return p + lr * dx, {"acc_g": acc_g, "acc_dx": acc_dx}
+
+
+class RmsProp(Optimizer):
+    """RMSPropOptimizer (FirstOrderOptimizer.h:190) — the variant with a
+    first-moment term (rmspropApply in TrainingAlgorithmOp.h)."""
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.rho, self.eps = rho, epsilon
+
+    def _init_slot(self, p):
+        return {"acc": jnp.zeros_like(p), "mean": jnp.zeros_like(p)}
+
+    def _apply(self, p, g, slot, lr, step):
+        acc = self.rho * slot["acc"] + (1 - self.rho) * jnp.square(g)
+        mean = self.rho * slot["mean"] + (1 - self.rho) * g
+        return (p - lr * g / jnp.sqrt(acc - jnp.square(mean) + self.eps),
+                {"acc": acc, "mean": mean})

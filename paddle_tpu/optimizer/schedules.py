@@ -1,0 +1,31 @@
+"""Learning-rate schedules.
+
+Reference: paddle/parameter/LearningRateScheduler.cpp — registered schedules
+keyed by TrainerConfig.learning_rate_schedule: constant, poly, exp, discexp,
+linear, manual, pass_manual (a/b parameters from learning_rate_decay_a/b).
+`t` is the number of processed SAMPLES (the reference feeds num_samples
+processed so far), passed as a traced scalar so the schedule lives inside
+the jitted update.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def make_schedule(name: str, lr: float, a: float = 0.0, b: float = 0.0):
+    """Returns fn(t) -> learning rate, t = samples processed (float)."""
+    name = name or "constant"
+    if name == "constant":
+        return lambda t: jnp.asarray(lr, jnp.float32)
+    if name == "poly":
+        return lambda t: lr * jnp.power(1.0 + a * t, -b)
+    if name == "caffe_poly":
+        return lambda t: lr * jnp.power(1.0 - t / a, b)
+    if name == "exp":
+        return lambda t: lr * jnp.power(a, t / b)
+    if name == "discexp":
+        return lambda t: lr * jnp.power(a, jnp.floor(t / b))
+    if name == "linear":
+        return lambda t: jnp.maximum(lr - a * t, b)
+    raise ValueError(f"unknown learning_rate_schedule {name!r}")

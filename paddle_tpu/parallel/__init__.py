@@ -1,0 +1,6 @@
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.parallel.mesh import (create_mesh, data_parallel_mesh,
+                                      DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS)
+
+__all__ = ["mesh_mod", "create_mesh", "data_parallel_mesh", "DP_AXIS",
+           "MP_AXIS", "PP_AXIS", "SP_AXIS"]

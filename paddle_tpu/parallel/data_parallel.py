@@ -1,0 +1,50 @@
+"""Data-parallel training step sharding.
+
+Reference: MultiGradientMachine (single host, ring allreduce over GPU
+threads, MultiGradientMachine.h:44-98) and RemoteParameterUpdater +
+ParameterServer2 sync barriers (multi-host). Here both collapse into ONE
+jit: batch sharded over the `dp` mesh axis, parameters replicated, and XLA
+emits the gradient all-reduce over ICI automatically because the grads of
+replicated params depend on sharded data. `trainer_count` maps to the dp
+axis size.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.parallel.mesh import DP_AXIS
+
+
+def _feed_shardings(feed, mesh: Mesh):
+    """Batch-shard every feed leaf over dp (leading axis)."""
+    def leaf(x):
+        return NamedSharding(mesh, P(DP_AXIS))
+    return jax.tree_util.tree_map(leaf, feed)
+
+
+def shard_train_step(step_fn: Callable, mesh: Mesh) -> Callable:
+    """Wrap a train step (params, opt_state, state, feed, rng, n_real) so the
+    feed is dp-sharded and params/opt state replicated."""
+    repl = NamedSharding(mesh, P())
+    dp = NamedSharding(mesh, P(DP_AXIS))
+
+    def sharded(params, opt_state, state, feed, rng, n_real):
+        feed = jax.lax.with_sharding_constraint(
+            feed, _feed_shardings(feed, mesh))
+        return step_fn(params, opt_state, state, feed, rng, n_real)
+
+    return jax.jit(
+        sharded,
+        in_shardings=(repl, repl, repl, None, repl, repl),
+        donate_argnums=(0, 1, 2),
+    )
+
+
+def shard_feed(feed, mesh: Mesh):
+    """Place a host feed onto the mesh dp-sharded (device_put)."""
+    return jax.device_put(feed, _feed_shardings(feed, mesh))

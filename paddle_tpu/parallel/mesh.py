@@ -1,0 +1,59 @@
+"""Device meshes — the distribution substrate.
+
+Replaces the reference's entire communication plane: MultiGradientMachine's
+in-process ring allreduce (MultiGradientMachine.h:61-83), the
+pserver sharded-parameter RPC stack (ParameterServer2/ParameterClient2,
+LightNetwork TCP/RDMA), and the Go cloud runtime's gradient plumbing — all
+become sharding annotations over a `jax.sharding.Mesh`; XLA inserts the
+collectives (all-reduce / all-gather / reduce-scatter) and routes them over
+ICI within a slice and DCN across slices.
+
+Axis conventions (the scaling-book recipe):
+  dp — data parallel (batch dim)          <- trainer_count / num_gradient_servers
+  mp — model/tensor parallel (features)   <- parallel_nn device placement
+  sp — sequence/context parallel (time)   <- (new; no 2017 equivalent)
+  pp — pipeline stages                    <- ParallelNeuralNetwork layer pinning
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+MP_AXIS = "mp"
+SP_AXIS = "sp"
+PP_AXIS = "pp"
+
+
+def create_mesh(shape: Sequence[Tuple[str, int]],
+                devices=None) -> Mesh:
+    """create_mesh([("dp", 4), ("mp", 2)]) over local/global devices."""
+    if devices is None:
+        devices = jax.devices()
+    names = [n for n, _ in shape]
+    dims = [d for _, d in shape]
+    total = int(np.prod(dims))
+    assert total <= len(devices), \
+        f"mesh needs {total} devices, have {len(devices)}"
+    arr = np.asarray(devices[:total]).reshape(dims)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def data_parallel_mesh(n: Optional[int] = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    n = n or len(devices)
+    return create_mesh([(DP_AXIS, n)], devices)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard leading (batch) dim over dp."""
+    return NamedSharding(mesh, P(DP_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,134 @@
+"""Reader creators & decorators — python/paddle/v2/reader parity.
+
+Reference: python/paddle/v2/reader/{creator.py,decorator.py}: a *reader* is
+a zero-arg callable returning an iterable of samples; decorators compose
+(map_readers, buffered, shuffle, compose, chain, firstn, batched...).
+`batch` (python/paddle/v2/minibatch.py) groups samples into lists.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random as _random
+import threading
+import queue as _queue
+from typing import Any, Callable, Iterable, List, Sequence
+
+Reader = Callable[[], Iterable[Any]]
+
+
+def batch(reader: Reader, batch_size: int, drop_last: bool = False) -> Reader:
+    """paddle.batch parity: sample reader -> batch reader."""
+    def batch_reader():
+        buf: List[Any] = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batch_reader
+
+
+def shuffle(reader: Reader, buf_size: int, seed=None) -> Reader:
+    def shuffled():
+        rng = _random.Random(seed)
+        buf: List[Any] = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                for s in buf:
+                    yield s
+                buf = []
+        rng.shuffle(buf)
+        for s in buf:
+            yield s
+    return shuffled
+
+
+def map_readers(func, *readers: Reader) -> Reader:
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
+    """Zip several readers into tuple samples (reader.compose parity)."""
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield sum((make_tuple(i) for i in items), ())
+    return reader
+
+
+def chain(*readers: Reader) -> Reader:
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+    return reader
+
+
+def firstn(reader: Reader, n: int) -> Reader:
+    def limited():
+        return itertools.islice(reader(), n)
+    return limited
+
+
+def buffered(reader: Reader, size: int) -> Reader:
+    """Async prefetch via a background thread — the DoubleBuffer equivalent
+    (paddle/gserver/dataproviders/DataProvider.h:249)."""
+    end = object()
+
+    def buffered_reader():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                break
+            yield s
+    return buffered_reader
+
+
+def cache(reader: Reader) -> Reader:
+    data: List[Any] = []
+    filled = [False]
+
+    def cached():
+        if not filled[0]:
+            data.extend(reader())
+            filled[0] = True
+        return iter(data)
+    return cached
+
+
+class creator:
+    """reader.creator parity: build readers from arrays/files."""
+
+    @staticmethod
+    def np_array(arr) -> Reader:
+        def reader():
+            for row in arr:
+                yield row
+        return reader
+
+    @staticmethod
+    def text_file(path: str) -> Reader:
+        def reader():
+            with open(path) as f:
+                for line in f:
+                    yield line.rstrip("\n")
+        return reader

@@ -1,0 +1,6 @@
+from paddle_tpu.trainer import event
+from paddle_tpu.trainer.parameters import Parameters, create
+from paddle_tpu.trainer.trainer import SGD
+from paddle_tpu.trainer.inference import infer, Inference
+
+__all__ = ["event", "Parameters", "create", "SGD", "infer", "Inference"]

@@ -1,0 +1,143 @@
+"""DataFeeder — converts per-sample Python/numpy data into device feeds.
+
+Reference: python/paddle/v2/data_feeder.py + py_paddle/
+dataprovider_converter.py:254 (numpy -> Arguments with
+sequenceStartPositions). Here the conversion targets are plain arrays and
+SequenceBatch, according to each data layer's InputType.
+
+Shape discipline: batches are padded to `fixed_batch_size` (when set) and
+sequence lengths to buckets, so XLA compiles a handful of shapes instead of
+one per batch (the TPU replacement for the reference's fully-dynamic
+batching).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.core.data_type import InputType, SeqType
+from paddle_tpu.core.sequence import (SequenceBatch, bucket_length,
+                                      pack_nested_sequences, pack_sequences)
+
+
+class DataFeeder:
+    def __init__(self, data_types, feeding=None,
+                 fixed_batch_size: Optional[int] = None,
+                 bucket_lengths: Sequence[int] = (16, 32, 64, 128, 256, 512,
+                                                  1024)):
+        """data_types: [(name, InputType)] in feed order (from
+        Topology.data_type()); feeding: name -> column index (v2 parity) or
+        None for positional order."""
+        self.data_types = list(data_types)
+        if feeding is None:
+            self.feeding = {name: i for i, (name, _) in
+                            enumerate(self.data_types)}
+        elif isinstance(feeding, dict):
+            self.feeding = feeding
+        else:
+            self.feeding = {name: i for i, name in enumerate(feeding)}
+        self.fixed_batch_size = fixed_batch_size
+        self.bucket_lengths = bucket_lengths
+
+    def __call__(self, batch: Sequence[Sequence[Any]]) -> Dict[str, Any]:
+        return self.convert(batch)
+
+    def _pad_batch(self, rows: List[Any], pad_row) -> List[Any]:
+        if self.fixed_batch_size and len(rows) < self.fixed_batch_size:
+            rows = list(rows) + [pad_row] * (self.fixed_batch_size - len(rows))
+        return rows
+
+    def convert(self, batch) -> Dict[str, Any]:
+        feed: Dict[str, Any] = {}
+        n_real = len(batch)
+        for name, itype in self.data_types:
+            col = self.feeding[name]
+            rows = [sample[col] for sample in batch]
+            feed[name] = self._convert_column(rows, itype)
+        feed["__batch_size__"] = n_real
+        return feed
+
+    def _convert_column(self, rows: List[Any], itype: InputType):
+        if itype.seq_type == SeqType.NO_SEQUENCE:
+            return self._convert_flat(rows, itype)
+        if itype.seq_type == SeqType.SEQUENCE:
+            return self._convert_seq(rows, itype)
+        return self._convert_nested(rows, itype)
+
+    # ---- non-sequence ----------------------------------------------------
+    def _convert_flat(self, rows, itype):
+        import jax.numpy as jnp
+        if itype.kind == "dense":
+            arr = np.asarray(rows, dtype=np.float32)
+            if arr.ndim == 1:
+                arr = arr[:, None] if itype.dim == 1 else arr.reshape(
+                    len(rows), -1)
+            arr = self._pad0(arr)
+            return jnp.asarray(arr)
+        if itype.kind == "integer":
+            arr = np.asarray(rows, dtype=np.int32).reshape(len(rows))
+            arr = self._pad0(arr)
+            return jnp.asarray(arr)
+        if itype.kind in ("sparse_binary", "sparse_float"):
+            # rows: list of index lists (or (indices, values))
+            dense = np.zeros((len(rows), itype.dim), np.float32)
+            for i, r in enumerate(rows):
+                if itype.kind == "sparse_binary":
+                    dense[i, np.asarray(r, np.int64)] = 1.0
+                else:
+                    idx, vals = r
+                    dense[i, np.asarray(idx, np.int64)] = np.asarray(
+                        vals, np.float32)
+            dense = self._pad0(dense)
+            return jnp.asarray(dense)
+        raise ValueError(f"unsupported input kind {itype.kind}")
+
+    def _pad0(self, arr):
+        if self.fixed_batch_size and arr.shape[0] < self.fixed_batch_size:
+            pad = [(0, self.fixed_batch_size - arr.shape[0])] + \
+                [(0, 0)] * (arr.ndim - 1)
+            arr = np.pad(arr, pad)
+        return arr
+
+    # ---- sequence --------------------------------------------------------
+    def _convert_seq(self, rows, itype) -> SequenceBatch:
+        if itype.kind == "integer":
+            np_rows = [np.asarray(r, np.int32) for r in rows]
+        elif itype.kind == "dense":
+            np_rows = [np.asarray(r, np.float32).reshape(-1, itype.dim)
+                       for r in rows]
+        elif itype.kind == "sparse_binary":
+            np_rows = []
+            for r in rows:
+                d = np.zeros((len(r), itype.dim), np.float32)
+                for t, idxs in enumerate(r):
+                    d[t, np.asarray(idxs, np.int64)] = 1.0
+                np_rows.append(d)
+        else:
+            raise ValueError(f"unsupported sequence kind {itype.kind}")
+        if self.fixed_batch_size and len(np_rows) < self.fixed_batch_size:
+            filler = np.zeros((1,) + np_rows[0].shape[1:], np_rows[0].dtype)
+            np_rows = np_rows + [filler] * (self.fixed_batch_size -
+                                            len(np_rows))
+        max_len = bucket_length(max(r.shape[0] for r in np_rows),
+                                self.bucket_lengths)
+        sb = pack_sequences(np_rows, max_len=max_len)
+        if self.fixed_batch_size and len(rows) < self.fixed_batch_size:
+            # padded rows get length 0 so they contribute nothing
+            import jax.numpy as jnp
+            lengths = np.array(sb.lengths, copy=True)
+            lengths[len(rows):] = 0
+            sb = SequenceBatch(sb.data, jnp.asarray(lengths))
+        return sb
+
+    def _convert_nested(self, rows, itype) -> SequenceBatch:
+        conv = []
+        for sample in rows:
+            if itype.kind == "integer":
+                conv.append([np.asarray(s, np.int32) for s in sample])
+            else:
+                conv.append([np.asarray(s, np.float32).reshape(-1, itype.dim)
+                             for s in sample])
+        return pack_nested_sequences(conv)

@@ -1,0 +1,66 @@
+"""Inference — python/paddle/v2/inference.py:9 parity.
+
+paddle.infer(output_layer=..., parameters=..., input=...) runs the forward
+pass in test mode and returns numpy outputs. The jitted forward is cached
+per output set + feed shape (the serving path; capi-style shared-weight
+multi-threaded serving is native in runtime/ — this is the Python surface).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import LayerOutput
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.core.topology import Topology
+from paddle_tpu.trainer.data_feeder import DataFeeder
+from paddle_tpu.trainer.parameters import Parameters
+
+
+class Inference:
+    def __init__(self, output_layer, parameters: Parameters):
+        outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+            else [output_layer]
+        self.topology = Topology(list(outputs))
+        self.parameters = parameters
+        self.output_names = [o.name for o in outputs]
+
+        def fwd(params, state, feed):
+            outs, _ = self.topology.forward(params, state, feed, mode="test")
+            return [outs[n] for n in self.output_names]
+
+        self._fwd = jax.jit(fwd)
+
+    def iter_infer_field(self, input, feeding=None, batch_size: int = 128):
+        feeder = DataFeeder(self.topology.data_type(), feeding)
+        for start in range(0, len(input), batch_size):
+            chunk = input[start:start + batch_size]
+            feed = feeder(chunk)
+            feed.pop("__batch_size__", None)
+            outs = self._fwd(self.parameters.raw, self.parameters.state, feed)
+            yield [np.asarray(o.data) if isinstance(o, SequenceBatch)
+                   else np.asarray(o) for o in outs]
+
+    def infer(self, input, field="value", feeding=None,
+              batch_size: int = 128):
+        results: List[List[np.ndarray]] = None
+        for outs in self.iter_infer_field(input, feeding, batch_size):
+            if results is None:
+                results = [[] for _ in outs]
+            for i, o in enumerate(outs):
+                results[i].append(o)
+        if results is None:
+            return None
+        cat = [np.concatenate(r, axis=0) for r in results]
+        return cat[0] if len(cat) == 1 else cat
+
+
+def infer(output_layer, parameters: Parameters, input, field="value",
+          feeding=None, batch_size: int = 128):
+    """paddle.infer parity."""
+    return Inference(output_layer, parameters).infer(
+        input, field=field, feeding=feeding, batch_size=batch_size)

@@ -1,0 +1,141 @@
+"""Parameters — named parameter store with checkpoint I/O.
+
+Reference: python/paddle/v2/parameters.py (numpy get/set, `to_tar`/`from_tar`
+checkpoints) over paddle/parameter/Parameter.cpp save/load (:214-229 binary
+blobs, version header). Our tar layout: one `<name>.npy` member per
+parameter plus `_meta.json` (shapes/dtypes and non-trainable state), readable
+with plain numpy — the same "archive of per-parameter blobs" contract.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Parameters:
+    """Dict-like named parameters (+ optional non-trainable state)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 state: Optional[Dict[str, Any]] = None, specs=None):
+        self._params: Dict[str, Any] = dict(params or {})
+        self.state: Dict[str, Any] = dict(state or {})
+        self.specs = specs or {}
+
+    # --- mapping interface ------------------------------------------------
+    def keys(self):
+        return self._params.keys()
+
+    def names(self):
+        return list(self._params.keys())
+
+    def has_key(self, key):
+        return key in self._params
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __getitem__(self, key) -> np.ndarray:
+        return np.asarray(self._params[key])
+
+    def __setitem__(self, key, value):
+        if key in self.specs:
+            exp = tuple(self.specs[key].shape)
+            if tuple(np.shape(value)) != exp:
+                raise ValueError(f"shape mismatch for {key}: "
+                                 f"{np.shape(value)} vs {exp}")
+        self._params[key] = jnp.asarray(value)
+
+    def get(self, key):
+        return self[key]
+
+    def set(self, key, value):
+        self[key] = value
+
+    def get_shape(self, key):
+        return tuple(self._params[key].shape)
+
+    # --- device-side access ----------------------------------------------
+    @property
+    def raw(self) -> Dict[str, Any]:
+        """The live (possibly device-resident) parameter pytree."""
+        return self._params
+
+    def replace(self, new_params: Dict[str, Any]):
+        self._params = new_params
+
+    # --- checkpoints ------------------------------------------------------
+    def to_tar(self, f):
+        """Write a tar checkpoint (v2 Parameters.to_tar parity)."""
+        tf = tarfile.open(fileobj=f, mode="w")
+        meta = {"format": "paddle_tpu.params.v1",
+                "params": {}, "state": sorted(self.state)}
+        for name, val in sorted(self._params.items()):
+            arr = np.asarray(val)
+            meta["params"][name] = {"shape": list(arr.shape),
+                                    "dtype": str(arr.dtype)}
+            self._add_npy(tf, f"{name}.npy", arr)
+        for name, val in sorted(self.state.items()):
+            self._add_npy(tf, f"_state/{name}.npy", np.asarray(val))
+        blob = json.dumps(meta).encode()
+        info = tarfile.TarInfo("_meta.json")
+        info.size = len(blob)
+        tf.addfile(info, io.BytesIO(blob))
+        tf.close()
+
+    @staticmethod
+    def _add_npy(tf, name, arr):
+        buf = io.BytesIO()
+        np.save(buf, arr, allow_pickle=False)
+        data = buf.getvalue()
+        info = tarfile.TarInfo(name)
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+
+    @classmethod
+    def from_tar(cls, f) -> "Parameters":
+        tf = tarfile.open(fileobj=f, mode="r")
+        names = tf.getnames()
+        meta = json.loads(tf.extractfile("_meta.json").read()) \
+            if "_meta.json" in names else {"params": {}, "state": []}
+        params, state = {}, {}
+        for member in tf.getmembers():
+            if not member.name.endswith(".npy"):
+                continue
+            arr = np.load(io.BytesIO(tf.extractfile(member).read()),
+                          allow_pickle=False)
+            if member.name.startswith("_state/"):
+                state[member.name[len("_state/"):-4]] = jnp.asarray(arr)
+            else:
+                params[member.name[:-4]] = jnp.asarray(arr)
+        tf.close()
+        return cls(params, state)
+
+    def init_from_tar(self, f):
+        """Load values for matching names (v2 init_from_tar semantics)."""
+        other = Parameters.from_tar(f)
+        for name in other.names():
+            if name in self._params:
+                self[name] = other[name]
+        for name, val in other.state.items():
+            if name in self.state:
+                self.state[name] = val
+
+
+def create(topology, rng: Optional[jax.Array] = None) -> Parameters:
+    """paddle.v2.parameters.create(topology) parity."""
+    params = topology.init_params(rng)
+    state = topology.init_state()
+    return Parameters(params, state, topology.param_specs)

@@ -1,0 +1,3 @@
+from paddle_tpu.utils import stats
+
+__all__ = ["stats"]

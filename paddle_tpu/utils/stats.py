@@ -1,0 +1,91 @@
+"""Scoped wall-clock stat timers.
+
+Reference: paddle/utils/Stat.h — `REGISTER_TIMER(name)` RAII scopes
+aggregated into `globalStat` (StatSet :63,:111) with periodic printing
+(--log_period) and per-thread breakdown; compiled out unless WITH_TIMER.
+
+Here: a process-global registry of named timers with count/total/max/min,
+a `stat_timer(name)` context manager, and `print_all_status()` — plus a
+bridge to jax.profiler trace annotations so the same scopes show up in
+XPlane traces when profiling on TPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict
+
+import jax
+
+
+class StatItem:
+    __slots__ = ("count", "total", "max", "min")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.min = float("inf")
+
+    def add(self, dt: float):
+        self.count += 1
+        self.total += dt
+        self.max = max(self.max, dt)
+        self.min = min(self.min, dt)
+
+    def __str__(self):
+        avg = self.total / self.count if self.count else 0.0
+        return (f"count={self.count} total={self.total * 1e3:.2f}ms "
+                f"avg={avg * 1e3:.3f}ms max={self.max * 1e3:.3f}ms "
+                f"min={(self.min if self.count else 0.0) * 1e3:.3f}ms")
+
+
+class StatSet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, StatItem] = {}
+        self.enabled = True
+
+    def get(self, name: str) -> StatItem:
+        with self._lock:
+            if name not in self._stats:
+                self._stats[name] = StatItem()
+            return self._stats[name]
+
+    def reset(self):
+        with self._lock:
+            self._stats.clear()
+
+    def items(self):
+        with self._lock:
+            return dict(self._stats)
+
+    def print_all_status(self):
+        for name, item in sorted(self.items().items()):
+            print(f"Stat={name:<30} {item}")
+
+
+global_stat = StatSet()
+
+
+@contextlib.contextmanager
+def stat_timer(name: str):
+    """REGISTER_TIMER parity; also emits a jax.profiler named scope."""
+    if not global_stat.enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    global_stat.get(name).add(time.perf_counter() - t0)
+
+
+def timed(name: str):
+    def deco(fn):
+        def wrapper(*a, **kw):
+            with stat_timer(name):
+                return fn(*a, **kw)
+        return wrapper
+    return deco

@@ -1,0 +1,38 @@
+"""Test config: force an 8-device virtual CPU platform before any backend
+initialization.
+
+This is the 'CPU build as fake device' discipline from the reference
+(paddle/cuda/include/stub/* let everything unit-test without GPUs): the CPU
+XLA backend is the universal fake TPU, and 8 virtual devices exercise every
+mesh/sharding path without hardware. The environment may pin JAX_PLATFORMS
+to a TPU plugin via sitecustomize, so we override via jax.config (which wins
+as long as no computation ran yet).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_layer_names():
+    """Fresh auto-name counters per test so graphs don't collide."""
+    from paddle_tpu.core import registry
+    registry.reset_name_counters()
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,173 @@
+"""Layer gradient checks — the test_LayerGrad.cpp discipline: for each layer
+type, numeric finite-difference vs analytic (jax.grad) gradients through a
+small random topology, in sample / sequence modes."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.sequence import pack_sequences
+from paddle_tpu.core.topology import Topology
+from tests.grad_check import check_topology_grads
+
+
+def dense_feed(rng, name="x", n=4, d=8):
+    import jax.numpy as jnp
+    return {name: jnp.asarray(rng.randn(n, d).astype(np.float32))}
+
+
+def seq_feed(rng, name="s", lens=(3, 5), d=8):
+    rows = [rng.randn(l, d).astype(np.float32) for l in lens]
+    return {name: pack_sequences(rows)}
+
+
+def label_feed(rng, name="label", n=4, k=4):
+    import jax.numpy as jnp
+    return {name: jnp.asarray(rng.randint(0, k, size=n))}
+
+
+class TestDenseLayerGrads:
+    def test_fc_relu(self, rng):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+        out = paddle.layer.fc(x, size=5, act=paddle.activation.Tanh())
+        check_topology_grads(Topology(out), dense_feed(rng))
+
+    def test_fc_multi_input(self, rng):
+        a = paddle.layer.data("a", paddle.data_type.dense_vector(6))
+        b = paddle.layer.data("b", paddle.data_type.dense_vector(4))
+        out = paddle.layer.fc([a, b], size=3,
+                              act=paddle.activation.Sigmoid())
+        import jax.numpy as jnp
+        feed = {"a": jnp.asarray(rng.randn(4, 6).astype(np.float32)),
+                "b": jnp.asarray(rng.randn(4, 4).astype(np.float32))}
+        check_topology_grads(Topology(out), feed)
+
+    def test_classification_cost(self, rng):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+        out = paddle.layer.fc(x, size=4, act=paddle.activation.Softmax())
+        lbl = paddle.layer.data("label", paddle.data_type.integer_value(4))
+        cost = paddle.layer.classification_cost(out, lbl)
+        feed = {**dense_feed(rng), **label_feed(rng)}
+        check_topology_grads(Topology(cost), feed)
+
+    def test_mse_cost(self, rng):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+        y = paddle.layer.data("y", paddle.data_type.dense_vector(3))
+        out = paddle.layer.fc(x, size=3)
+        cost = paddle.layer.mse_cost(out, y)
+        import jax.numpy as jnp
+        feed = {**dense_feed(rng),
+                "y": jnp.asarray(rng.randn(4, 3).astype(np.float32))}
+        check_topology_grads(Topology(cost), feed)
+
+    def test_addto_concat(self, rng):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+        h1 = paddle.layer.fc(x, size=5)
+        h2 = paddle.layer.fc(x, size=5)
+        s = paddle.layer.addto([h1, h2], act=paddle.activation.Relu(),
+                               bias_attr=True)
+        c = paddle.layer.concat([s, h1])
+        out = paddle.layer.fc(c, size=2)
+        check_topology_grads(Topology(out), dense_feed(rng))
+
+    def test_batch_norm(self, rng):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+        bn = paddle.layer.batch_norm(x, act=paddle.activation.Relu())
+        out = paddle.layer.fc(bn, size=2)
+        check_topology_grads(Topology(out), dense_feed(rng, n=8), rtol=5e-2)
+
+    def test_hsigmoid(self, rng):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+        lbl = paddle.layer.data("label", paddle.data_type.integer_value(6))
+        cost = paddle.layer.hsigmoid(x, lbl, num_classes=6)
+        feed = {**dense_feed(rng), **label_feed(rng, k=6)}
+        check_topology_grads(Topology(cost), feed)
+
+    def test_conv_pool(self, rng):
+        import jax.numpy as jnp
+        img = paddle.layer.data("img",
+                                paddle.data_type.dense_vector(3 * 8 * 8),
+                                height=8, width=8)
+        cv = paddle.layer.img_conv(img, filter_size=3, num_filters=4,
+                                   padding=1, act=paddle.activation.Relu())
+        pl = paddle.layer.img_pool(cv, pool_size=2, stride=2)
+        out = paddle.layer.fc(pl, size=2)
+        feed = {"img": jnp.asarray(
+            rng.randn(2, 3 * 8 * 8).astype(np.float32))}
+        check_topology_grads(Topology(out), feed)
+
+
+class TestSeqLayerGrads:
+    def test_lstm_pool(self, rng):
+        s = paddle.layer.data(
+            "s", paddle.data_type.dense_vector_sequence(8))
+        proj = paddle.layer.fc(s, size=16, bias_attr=False)
+        lstm = paddle.layer.lstmemory(proj)
+        pooled = paddle.layer.pooling(lstm,
+                                      pooling_type=paddle.pooling.Avg())
+        out = paddle.layer.fc(pooled, size=2)
+        check_topology_grads(Topology(out), seq_feed(rng), rtol=3e-2)
+
+    def test_gru_last(self, rng):
+        s = paddle.layer.data(
+            "s", paddle.data_type.dense_vector_sequence(8))
+        proj = paddle.layer.fc(s, size=12, bias_attr=False)
+        gru = paddle.layer.grumemory(proj)
+        out = paddle.layer.last_seq(gru)
+        check_topology_grads(Topology(out), seq_feed(rng), rtol=3e-2)
+
+    def test_simple_rnn_reverse(self, rng):
+        s = paddle.layer.data(
+            "s", paddle.data_type.dense_vector_sequence(8))
+        proj = paddle.layer.fc(s, size=6, bias_attr=False)
+        r = paddle.layer.recurrent(proj, reverse=True)
+        out = paddle.layer.first_seq(r)
+        check_topology_grads(Topology(out), seq_feed(rng), rtol=3e-2)
+
+    def test_embedding_seq(self, rng):
+        toks = paddle.layer.data(
+            "toks", paddle.data_type.integer_value_sequence(20))
+        emb = paddle.layer.embedding(toks, size=6)
+        pooled = paddle.layer.pooling(emb,
+                                      pooling_type=paddle.pooling.Sum())
+        out = paddle.layer.fc(pooled, size=2)
+        seqs = pack_sequences([np.array([1, 2, 3], np.int32),
+                               np.array([4, 5], np.int32)])
+        check_topology_grads(Topology(out), {"toks": seqs})
+
+    def test_context_projection_grad(self, rng):
+        s = paddle.layer.data(
+            "s", paddle.data_type.dense_vector_sequence(4))
+        cp = paddle.layer.context_projection(s, context_len=3)
+        out = paddle.layer.fc(cp, size=2)
+        check_topology_grads(Topology(out), seq_feed(rng, d=4))
+
+    def test_crf_grad(self, rng):
+        import jax.numpy as jnp
+        s = paddle.layer.data(
+            "s", paddle.data_type.dense_vector_sequence(5))
+        emit = paddle.layer.fc(s, size=4, bias_attr=False)
+        lbl = paddle.layer.data(
+            "lbl", paddle.data_type.integer_value_sequence(4))
+        cost = paddle.layer.crf(emit, lbl, size=4)
+        lab_rows = [np.array([0, 1, 2], np.int32),
+                    np.array([3, 1, 0, 2, 1], np.int32)]
+        feed = {**seq_feed(rng, d=5),
+                "lbl": pack_sequences(lab_rows)}
+        check_topology_grads(Topology(cost), feed, rtol=3e-2)
+
+    def test_recurrent_group_fc_memory(self, rng):
+        """recurrent_group vs hand semantics: step output feeds back."""
+        s = paddle.layer.data(
+            "s", paddle.data_type.dense_vector_sequence(6))
+
+        def step(x_t):
+            mem = paddle.layer.memory(name="rnn_state", size=4)
+            h = paddle.layer.fc([x_t, mem], size=4,
+                                act=paddle.activation.Tanh(),
+                                name="rnn_state")
+            return h
+
+        out_seq = paddle.layer.recurrent_group(step, s)
+        out = paddle.layer.last_seq(out_seq)
+        check_topology_grads(Topology(out), seq_feed(rng, d=6), rtol=3e-2)

@@ -1,0 +1,151 @@
+"""SequenceBatch + sequence ops tests (Argument/SequenceToBatch parity)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.sequence import (SequenceBatch, pack_nested_sequences,
+                                      pack_sequences)
+from paddle_tpu.ops import sequence_ops as so
+from paddle_tpu.ops import recurrent as rnn_ops
+
+
+def _mk(rng, lens, d=4):
+    rows = [rng.randn(l, d).astype(np.float32) for l in lens]
+    return rows, pack_sequences(rows)
+
+
+class TestPacking:
+    def test_pack_and_mask(self, rng):
+        rows, sb = _mk(rng, [3, 1, 5])
+        assert sb.data.shape == (3, 5, 4)
+        m = np.asarray(sb.mask())
+        assert m.sum() == 9
+        np.testing.assert_allclose(np.asarray(sb.data)[1, 0], rows[1][0])
+        assert np.all(np.asarray(sb.data)[1, 1:] == 0)
+
+    def test_nested_pack(self):
+        s = pack_nested_sequences([
+            [np.ones((2, 3)), np.ones((3, 3)) * 2],
+            [np.ones((1, 3)) * 5],
+        ])
+        assert s.is_nested
+        assert np.asarray(s.lengths).tolist() == [5, 1]
+        assert np.asarray(s.num_segments).tolist() == [2, 1]
+        seg = np.asarray(s.segment_ids)
+        assert seg[0].tolist()[:5] == [0, 0, 1, 1, 1]
+
+
+class TestSeqOps:
+    def test_pool_avg_ignores_padding(self, rng):
+        rows, sb = _mk(rng, [3, 1, 5])
+        got = np.asarray(so.seq_pool(sb, "average"))
+        for i, r in enumerate(rows):
+            np.testing.assert_allclose(got[i], r.mean(0), rtol=1e-5,
+                                       atol=1e-6)
+
+    def test_pool_max(self, rng):
+        rows, sb = _mk(rng, [2, 4])
+        got = np.asarray(so.seq_pool(sb, "max"))
+        for i, r in enumerate(rows):
+            np.testing.assert_allclose(got[i], r.max(0), rtol=1e-5)
+
+    def test_last_first(self, rng):
+        rows, sb = _mk(rng, [3, 1, 5])
+        last = np.asarray(so.last_instance(sb))
+        first = np.asarray(so.first_instance(sb))
+        for i, r in enumerate(rows):
+            np.testing.assert_allclose(last[i], r[-1], rtol=1e-5)
+            np.testing.assert_allclose(first[i], r[0], rtol=1e-5)
+
+    def test_expand(self, rng):
+        rows, sb = _mk(rng, [2, 3])
+        x = rng.randn(2, 6).astype(np.float32)
+        out = so.expand_to_sequence(jnp.asarray(x), sb)
+        arr = np.asarray(out.data)
+        np.testing.assert_allclose(arr[0, 0], x[0])
+        np.testing.assert_allclose(arr[1, 2], x[1])
+
+    def test_seq_concat(self, rng):
+        rows_a, a = _mk(rng, [2, 3])
+        rows_b, b = _mk(rng, [1, 2])
+        out = so.seq_concat(a, b)
+        assert np.asarray(out.lengths).tolist() == [3, 5]
+        arr = np.asarray(out.data)
+        np.testing.assert_allclose(arr[0, :2], rows_a[0], rtol=1e-5)
+        np.testing.assert_allclose(arr[0, 2], rows_b[0][0], rtol=1e-5)
+        np.testing.assert_allclose(arr[1, 3:5], rows_b[1], rtol=1e-5)
+
+    def test_seq_reverse(self, rng):
+        rows, sb = _mk(rng, [3, 2])
+        out = so.seq_reverse(sb)
+        arr = np.asarray(out.data)
+        np.testing.assert_allclose(arr[0, 0], rows[0][2], rtol=1e-5)
+        np.testing.assert_allclose(arr[0, 2], rows[0][0], rtol=1e-5)
+        np.testing.assert_allclose(arr[1, 0], rows[1][1], rtol=1e-5)
+
+    def test_context_projection(self, rng):
+        rows, sb = _mk(rng, [3], d=2)
+        out = so.context_projection(sb, 3, -1)
+        arr = np.asarray(out.data)
+        assert arr.shape == (1, 3, 6)
+        # middle position sees [x0, x1, x2]
+        np.testing.assert_allclose(arr[0, 1],
+                                   np.concatenate([rows[0][0], rows[0][1],
+                                                   rows[0][2]]), rtol=1e-5)
+        # first position: left neighbor is zero-pad
+        np.testing.assert_allclose(arr[0, 0, :2], np.zeros(2), atol=1e-6)
+
+    def test_sub_seq_pool(self):
+        s = pack_nested_sequences([
+            [np.ones((2, 3)), np.ones((3, 3)) * 2],
+            [np.ones((1, 3)) * 5],
+        ])
+        out = so.sub_seq_pool(s, "average", max_segments=2)
+        arr = np.asarray(out.data)
+        np.testing.assert_allclose(arr[0, 0], np.ones(3), rtol=1e-5)
+        np.testing.assert_allclose(arr[0, 1], np.ones(3) * 2, rtol=1e-5)
+        np.testing.assert_allclose(arr[1, 0], np.ones(3) * 5, rtol=1e-5)
+        assert np.asarray(out.lengths).tolist() == [2, 1]
+
+
+class TestRecurrentOps:
+    def test_lstm_state_freezes_on_padding(self, rng):
+        h = 3
+        rows = [rng.randn(4, 4 * h).astype(np.float32),
+                rng.randn(2, 4 * h).astype(np.float32)]
+        sb = pack_sequences(rows)
+        w = jnp.asarray(rng.randn(h, 4 * h).astype(np.float32) * 0.1)
+        out, (hT, cT) = rnn_ops.lstm_scan(sb, w, None, return_state=True)
+        arr = np.asarray(out.data)
+        # padded outputs are zero
+        assert np.all(arr[1, 2:] == 0)
+        # final state of row 1 equals its step-2 hidden
+        np.testing.assert_allclose(np.asarray(hT)[1], arr[1, 1], rtol=1e-5)
+
+    def test_lstm_matches_unbatched(self, rng):
+        """Ragged batch result == each sequence run alone (SequenceToBatch
+        equivalence — the no-padding-waste correctness claim)."""
+        h = 3
+        rows = [rng.randn(5, 4 * h).astype(np.float32),
+                rng.randn(2, 4 * h).astype(np.float32)]
+        w = jnp.asarray(rng.randn(h, 4 * h).astype(np.float32) * 0.1)
+        b = jnp.asarray(rng.randn(4 * h).astype(np.float32) * 0.1)
+        batched = np.asarray(rnn_ops.lstm_scan(pack_sequences(rows), w,
+                                               b).data)
+        for i, r in enumerate(rows):
+            solo = np.asarray(rnn_ops.lstm_scan(pack_sequences([r]), w,
+                                                b).data)
+            np.testing.assert_allclose(batched[i, :r.shape[0]],
+                                       solo[0, :r.shape[0]], rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_gru_reverse(self, rng):
+        h = 2
+        rows = [rng.randn(3, 3 * h).astype(np.float32)]
+        sb = pack_sequences(rows)
+        w = jnp.asarray(rng.randn(h, 3 * h).astype(np.float32) * 0.1)
+        fwd_on_reversed = np.asarray(rnn_ops.gru_scan(
+            pack_sequences([rows[0][::-1]]), w, None).data)
+        rev = np.asarray(rnn_ops.gru_scan(sb, w, None, reverse=True).data)
+        np.testing.assert_allclose(rev[0], fwd_on_reversed[0, ::-1],
+                                   rtol=1e-4, atol=1e-5)

@@ -1,0 +1,106 @@
+"""Topology construction, forward, serialization round-trip
+(mirrors python/paddle/v2/tests/test_topology.py + golden-protostr
+regression discipline)."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.sequence import pack_sequences
+from paddle_tpu.core.topology import Topology
+
+
+def _mlp():
+    img = paddle.layer.data("pixel", paddle.data_type.dense_vector(16))
+    h = paddle.layer.fc(img, size=8, act=paddle.activation.Relu(),
+                        name="hidden")
+    out = paddle.layer.fc(h, size=4, act=paddle.activation.Softmax(),
+                          name="output")
+    lbl = paddle.layer.data("label", paddle.data_type.integer_value(4))
+    cost = paddle.layer.classification_cost(out, lbl, name="cost")
+    return cost, out
+
+
+class TestTopology:
+    def test_build_and_forward(self, rng):
+        cost, out = _mlp()
+        topo = Topology(cost)
+        assert set(topo.data_layers()) == {"pixel", "label"}
+        params = topo.init_params(jax.random.PRNGKey(0))
+        assert "_hidden.w0" in params and params["_hidden.w0"].shape == (16, 8)
+        feed = {"pixel": jnp.asarray(rng.randn(5, 16).astype(np.float32)),
+                "label": jnp.asarray(np.array([0, 1, 2, 3, 0]))}
+        outs, _ = topo.forward(params, {}, feed, mode="test")
+        assert outs["cost"].shape == (5,)
+
+    def test_shared_params(self, rng):
+        a = paddle.layer.data("a", paddle.data_type.dense_vector(6))
+        shared = paddle.attr.Param(name="shared_w")
+        h1 = paddle.layer.fc(a, size=6, param_attr=shared, bias_attr=False)
+        h2 = paddle.layer.fc(h1, size=6, param_attr=shared, bias_attr=False)
+        topo = Topology(h2)
+        params = topo.init_params()
+        assert list(params) == ["shared_w"]
+
+    def test_serialize_roundtrip(self, rng):
+        cost, _ = _mlp()
+        topo = Topology(cost)
+        blob = topo.serialize()
+        topo2 = Topology.deserialize(blob)
+        params = topo.init_params(jax.random.PRNGKey(1))
+        feed = {"pixel": jnp.asarray(rng.randn(3, 16).astype(np.float32)),
+                "label": jnp.asarray(np.array([1, 2, 3]))}
+        o1, _ = topo.forward(params, {}, feed, mode="test")
+        o2, _ = topo2.forward(params, {}, feed, mode="test")
+        np.testing.assert_allclose(np.asarray(o1["cost"]),
+                                   np.asarray(o2["cost"]), rtol=1e-6)
+        # serialization is stable (golden-file regression discipline)
+        assert topo2.serialize() == blob
+
+    def test_parameters_tar_roundtrip(self, rng):
+        cost, _ = _mlp()
+        topo = Topology(cost)
+        params = paddle.create_parameters(topo)
+        buf = io.BytesIO()
+        params.to_tar(buf)
+        buf.seek(0)
+        loaded = paddle.Parameters.from_tar(buf)
+        for name in params.names():
+            np.testing.assert_array_equal(params[name], loaded[name])
+
+    def test_jit_forward(self, rng):
+        """The whole topology forward must trace under jit."""
+        cost, _ = _mlp()
+        topo = Topology(cost)
+        params = topo.init_params()
+
+        @jax.jit
+        def f(p, feed):
+            outs, _ = topo.forward(p, {}, feed, mode="test")
+            return outs["cost"]
+
+        feed = {"pixel": jnp.asarray(rng.randn(4, 16).astype(np.float32)),
+                "label": jnp.asarray(np.array([0, 1, 2, 3]))}
+        v = f(params, feed)
+        assert v.shape == (4,)
+
+    def test_seq_model_forward(self, rng):
+        toks = paddle.layer.data(
+            "words", paddle.data_type.integer_value_sequence(50))
+        emb = paddle.layer.embedding(toks, size=8)
+        proj = paddle.layer.fc(emb, size=32, act=paddle.activation.Linear(),
+                               bias_attr=False)
+        lstm = paddle.layer.lstmemory(proj)
+        pooled = paddle.layer.pooling(
+            lstm, pooling_type=paddle.pooling.Max())
+        out = paddle.layer.fc(pooled, size=2,
+                              act=paddle.activation.Softmax())
+        topo = Topology(out)
+        params = topo.init_params()
+        seqs = pack_sequences([np.array([1, 2, 3], np.int32),
+                               np.array([4, 5], np.int32)])
+        outs, _ = topo.forward(params, {}, {"words": seqs}, mode="test")
+        assert outs[out.name].shape == (2, 2)

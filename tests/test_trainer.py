@@ -1,0 +1,121 @@
+"""End-to-end training tests (mirrors paddle/trainer/tests
+test_Trainer / test_TrainerOnePass: a few batches of a real config must
+run and converge)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _mnist_like_net(dim=64, n_classes=10):
+    img = paddle.layer.data("pixel", paddle.data_type.dense_vector(dim))
+    h1 = paddle.layer.fc(img, size=32, act=paddle.activation.Relu())
+    out = paddle.layer.fc(h1, size=n_classes,
+                          act=paddle.activation.Softmax(), name="output")
+    lbl = paddle.layer.data("label", paddle.data_type.integer_value(n_classes))
+    cost = paddle.layer.classification_cost(out, lbl, name="cost")
+    err = paddle.layer.classification_error(out, lbl, name="error")
+    return cost, out, err
+
+
+def _clustered_reader(n, dim, k, seed):
+    from paddle_tpu.dataset import synthetic
+
+    def reader():
+        feats, labels = synthetic.class_clustered(n, dim, k, seed)
+        for i in range(n):
+            yield feats[i], int(labels[i])
+    return reader
+
+
+class TestSGDTrain:
+    def test_converges_and_reports_metrics(self):
+        paddle.init(use_tpu=False, seed=0)
+        cost, out, err = _mnist_like_net()
+        topo = paddle.Topology(cost)
+        params = paddle.create_parameters(topo)
+        opt = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.05)
+        trainer = paddle.SGD(cost=cost, parameters=params,
+                             update_equation=opt, extra_layers=[err])
+        costs, errors = [], []
+
+        def handler(e):
+            if isinstance(e, paddle.event.EndIteration):
+                costs.append(e.cost)
+                errors.append(e.metrics["error"])
+
+        reader = paddle.reader.batch(
+            paddle.reader.shuffle(_clustered_reader(512, 64, 10, 7), 512,
+                                  seed=1), 64)
+        trainer.train(reader, num_passes=6, event_handler=handler)
+        assert len(costs) == 48
+        first, last = np.mean(costs[:4]), np.mean(costs[-4:])
+        assert last < first * 0.5, f"did not converge: {first} -> {last}"
+        assert np.mean(errors[-4:]) < 0.2
+
+    def test_adam_and_test_eval(self):
+        paddle.init(use_tpu=False)
+        cost, out, err = _mnist_like_net()
+        params = paddle.create_parameters(paddle.Topology(cost))
+        trainer = paddle.SGD(cost=cost, parameters=params,
+                             update_equation=paddle.optimizer.Adam(
+                                 learning_rate=1e-2),
+                             extra_layers=[err])
+        reader = paddle.reader.batch(_clustered_reader(256, 64, 10, 3), 64)
+        trainer.train(reader, num_passes=4)
+        res = trainer.test(reader)
+        assert res.cost < 1.0
+        assert res.metrics["error"] < 0.3
+
+    def test_partial_batch_and_checkpoint(self, tmp_path):
+        paddle.init(use_tpu=False)
+        cost, out, err = _mnist_like_net()
+        params = paddle.create_parameters(paddle.Topology(cost))
+        trainer = paddle.SGD(cost=cost, parameters=params,
+                             update_equation=paddle.optimizer.Momentum(
+                                 learning_rate=0.01))
+        # 100 samples, batch 64 -> one partial batch of 36
+        reader = paddle.reader.batch(_clustered_reader(100, 64, 10, 5), 64)
+        trainer.train(reader, num_passes=1)
+        trainer.save_pass(str(tmp_path), 0)
+        assert (tmp_path / "pass-00000" / "params.tar").exists()
+        with open(tmp_path / "pass-00000" / "params.tar", "rb") as f:
+            loaded = paddle.Parameters.from_tar(f)
+        for name in params.names():
+            np.testing.assert_array_equal(params[name], loaded[name])
+
+    def test_infer(self):
+        paddle.init(use_tpu=False)
+        cost, out, err = _mnist_like_net()
+        params = paddle.create_parameters(paddle.Topology(cost))
+        data = [(np.random.RandomState(0).randn(64).astype(np.float32),)]
+        probs = paddle.infer(output_layer=out, parameters=params,
+                             input=data * 5,
+                             feeding={"pixel": 0})
+        assert probs.shape == (5, 10)
+        np.testing.assert_allclose(probs.sum(-1), np.ones(5), rtol=1e-4)
+
+    def test_regression_uci(self):
+        paddle.init(use_tpu=False)
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(13))
+        y = paddle.layer.data("y", paddle.data_type.dense_vector(1))
+        pred = paddle.layer.fc(x, size=1)
+        cost = paddle.layer.mse_cost(pred, y)
+        params = paddle.create_parameters(paddle.Topology(cost))
+        trainer = paddle.SGD(cost=cost, parameters=params,
+                             update_equation=paddle.optimizer.Momentum(
+                                 learning_rate=0.01, momentum=0.9))
+        from paddle_tpu.dataset import uci_housing
+        costs = []
+
+        def handler(e):
+            if isinstance(e, paddle.event.EndIteration):
+                costs.append(e.cost)
+
+        trainer.train(paddle.reader.batch(uci_housing.train(), 32,
+                                          drop_last=True),
+                      num_passes=12, event_handler=handler)
+        assert np.mean(costs[-3:]) < np.mean(costs[:3]) * 0.3
