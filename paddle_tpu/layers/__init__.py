@@ -256,11 +256,20 @@ img_conv_layer = img_conv
 
 def img_pool(input, pool_size: int, name=None, num_channels=None,
              pool_type=None, stride: int = 1, padding: int = 0,
-             **kw) -> LayerOutput:
+             pool_size_x=None, **kw) -> LayerOutput:
     return make_layer("pool", name, [input], pool_size=pool_size,
+                      pool_size_x=pool_size_x,
                       channels=num_channels, pool_type=pool_mod.to_name(
                           pool_type or "max"),
                       stride=stride, padding=padding)
+
+
+def global_img_pool(input, name=None, pool_type=None, **kw) -> LayerOutput:
+    """Global spatial pool (the GAP of ResNet/GoogleNet heads)."""
+    return make_layer("pool", name, [input], pool_size=input.meta.height,
+                      pool_size_x=input.meta.width,
+                      pool_type=pool_mod.to_name(pool_type or "average"),
+                      stride=1, padding=0)
 
 
 img_pool_layer = img_pool
@@ -410,6 +419,32 @@ def recurrent(input, name=None, reverse: bool = False, act=None,
 
 
 recurrent_layer = recurrent
+
+
+def gru_step(input, output_mem, size=None, name=None, act=None,
+             gate_act=None, bias_attr=None, param_attr=None, **kw) -> LayerOutput:
+    """Step-level GRU for recurrent_group decoders (gru_step_layer)."""
+    return make_layer("gru_step", name, [input, output_mem], size=size,
+                      act=act_mod.to_name(act or "tanh"),
+                      gate_act=act_mod.to_name(gate_act or "sigmoid"),
+                      bias_attr=bias_attr, param_attr=param_attr)
+
+
+gru_step_layer = gru_step
+
+
+def lstm_step(input, state, size=None, name=None, act=None, gate_act=None,
+              state_act=None, bias_attr=None, expose_state: bool = False,
+              **kw) -> LayerOutput:
+    """Step-level LSTM (lstm_step_layer): state is the prev-cell memory."""
+    return make_layer("lstm_step", name, [input, state], size=size,
+                      act=act_mod.to_name(act or "tanh"),
+                      gate_act=act_mod.to_name(gate_act or "sigmoid"),
+                      state_act=act_mod.to_name(state_act or "tanh"),
+                      bias_attr=bias_attr, expose_state=expose_state)
+
+
+lstm_step_layer = lstm_step
 
 
 # ---------------------------------------------------------------------------

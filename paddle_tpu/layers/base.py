@@ -214,6 +214,14 @@ class ConcatLayer:
     @staticmethod
     def build(name, cfg, input_metas):
         size = sum(m.size for m in input_metas)
+        m0 = input_metas[0]
+        # Image channel-concat (Inception): same spatial dims -> channels add.
+        if all(m.height and m.height == m0.height and m.width == m0.width
+               and m.channels for m in input_metas):
+            return LayerMeta(size=size,
+                             seq_level=max(m.seq_level for m in input_metas),
+                             height=m0.height, width=m0.width,
+                             channels=sum(m.channels for m in input_metas)), [], []
         return LayerMeta(size=size,
                          seq_level=max(m.seq_level for m in input_metas)), [], []
 

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from paddle_tpu.core.data_type import InputType
+from paddle_tpu.core.data_type import InputType, SeqType
 from paddle_tpu.core.registry import (LayerMeta, LayerOutput, make_layer,
                                       register_layer)
 from paddle_tpu.core.sequence import SequenceBatch
@@ -51,10 +51,9 @@ def build_beam_search(step, input, *, bos_id: int, eos_id: int,
     static_phs = []
     for i, si in enumerate(static_inputs):
         kind = "integer" if si.input.meta.is_integer else "dense"
+        seq_t = SeqType(si.input.meta.seq_level if si.is_seq else 0)
         ph = make_layer("data", f"@static@{gname}@{i}", [],
-                        input_type=InputType(si.input.meta.size, kind))
-        if si.is_seq:
-            ph.meta.seq_level = si.input.meta.seq_level
+                        input_type=InputType(si.input.meta.size, kind, seq_t))
         static_phs.append(ph)
 
     group_mod._build_ctx.stack.append(group)

@@ -88,11 +88,12 @@ class PoolLayer:
         m = input_metas[0]
         c = cfg.get("channels") or m.channels
         ih, iw = m.height, m.width
-        k = cfg["pool_size"]
+        ky = cfg["pool_size"]
+        kx = cfg.get("pool_size_x") or ky
         s = cfg.get("stride", 1)
         p = cfg.get("padding", 0)
-        oh = pool_ops.pool_out_size(ih, k, s, p)
-        ow = pool_ops.pool_out_size(iw, k, s, p)
+        oh = pool_ops.pool_out_size(ih, ky, s, p)
+        ow = pool_ops.pool_out_size(iw, kx, s, p)
         cfg["_ic"], cfg["_ih"], cfg["_iw"] = c, ih, iw
         return (LayerMeta(size=c * oh * ow, height=oh, width=ow, channels=c),
                 [], [])
@@ -100,13 +101,14 @@ class PoolLayer:
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
         x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
-        k = cfg["pool_size"]
+        ky = cfg["pool_size"]
+        kx = cfg.get("pool_size_x") or ky
         s = cfg.get("stride", 1)
         p = cfg.get("padding", 0)
         ptype = cfg.get("pool_type", "max")
         if ptype in ("max", "cudnn-max"):
-            return pool_ops.max_pool2d(x, k, s, p)
-        return pool_ops.avg_pool2d(x, k, s, p)
+            return pool_ops.max_pool2d(x, (ky, kx), s, p)
+        return pool_ops.avg_pool2d(x, (ky, kx), s, p)
 
 
 @register_layer("img_cmrnorm")

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from paddle_tpu.core.data_type import InputType
+from paddle_tpu.core.data_type import InputType, SeqType
 from paddle_tpu.core.registry import (ApplyContext, LayerMeta, LayerOutput,
                                       ParamSpec, make_layer, register_layer)
 from paddle_tpu.core.sequence import SequenceBatch
@@ -112,11 +112,12 @@ def recurrent_group(step, input, reverse: bool = False,
     static_phs = []
     for i, si in enumerate(static_inputs):
         kind = "integer" if si.input.meta.is_integer else "dense"
+        # a full sequence visible at each step (e.g. attention source):
+        # the seq level must live in the InputType so it survives the
+        # sub-topology JSON round-trip.
+        seq_t = SeqType(si.input.meta.seq_level if si.is_seq else 0)
         ph = make_layer("data", f"@static@{gname}@{i}", [],
-                        input_type=InputType(si.input.meta.size, kind))
-        if si.is_seq:
-            # a full sequence visible at each step (e.g. attention source)
-            ph.meta.seq_level = si.input.meta.seq_level
+                        input_type=InputType(si.input.meta.size, kind, seq_t))
         static_phs.append(ph)
 
     _build_ctx.stack.append(group)

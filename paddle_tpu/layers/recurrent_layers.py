@@ -115,3 +115,88 @@ class SimpleRecurrentLayer:
         return rnn_ops.rnn_scan(seq, w, bias,
                                 reverse=cfg.get("reverse", False),
                                 act=cfg.get("act", "tanh"))
+
+
+@register_layer("gru_step")
+class GruStepLayer:
+    """Step-level GRU for recurrent_group decoders (gru_step_layer,
+    gserver/layers/GruStepLayer.cpp). Inputs: [x3 (3h projection),
+    prev_state (h memory)]; owns the recurrent weight + gate bias."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        h = cfg.get("size") or input_metas[1].size
+        assert input_metas[0].size == 3 * h, \
+            f"gru_step {name}: input must be 3*size projection"
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        specs = [ParamSpec(wname, (h, 3 * h),
+                           a.initializer or initializers.smart_normal(0), a)]
+        cfg["_w_name"] = wname
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (3 * h,), initializers.zeros, battr))
+            cfg["_b_name"] = bname
+        return LayerMeta(size=h), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x3, h = inputs
+        w = params[cfg["_w_name"]]
+        bias = params.get(cfg.get("_b_name")) if cfg.get("_b_name") else None
+        return rnn_ops.gru_cell(x3, h, w, bias,
+                                act=cfg.get("act", "tanh"),
+                                gate_act=cfg.get("gate_act", "sigmoid"))
+
+
+@register_layer("lstm_step")
+class LstmStepLayer:
+    """Step-level LSTM (lstm_step_layer, gserver/layers/LstmStepLayer.cpp).
+
+    Reference semantics: inputs are [gate_input (4h), prev_cell (h)]; the
+    previous HIDDEN state is projected into gate_input by the caller (a
+    mixed/fc layer over the output memory), so this layer owns only the 3h
+    peephole "check" weights (LstmStepLayer.cpp:84-92 maps the bias
+    parameter onto checkIg/checkFg/checkOg). Output is h'; with
+    cfg["expose_state"] the output packs [h' | c'] so a cell memory can
+    link to it (get_output 'state' parity)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        # h always follows from the 4h gate projection; the state input may
+        # be h (cell only) or 2h (packed [h|c] from expose_state).
+        h = cfg.get("size") or input_metas[0].size // 4
+        assert input_metas[0].size == 4 * h, \
+            f"lstm_step {name}: input must be 4*size projection"
+        assert input_metas[1].size in (h, 2 * h), \
+            f"lstm_step {name}: state must be size h or 2h (packed [h|c])"
+        specs = []
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (3 * h,), initializers.zeros, battr))
+            cfg["_b_name"] = bname
+        cfg["_h"] = h
+        size = 2 * h if cfg.get("expose_state") else h
+        return LayerMeta(size=size), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x4, c_prev = inputs
+        hdim = cfg["_h"]
+        if c_prev.shape[-1] == 2 * hdim:
+            c_prev = c_prev[..., hdim:]
+        peep = params.get(cfg.get("_b_name")) if cfg.get("_b_name") else None
+        zero_w = jnp.zeros((hdim, 4 * hdim), x4.dtype)
+        h_new, c_new = rnn_ops.lstm_cell(
+            x4, jnp.zeros((x4.shape[0], hdim), x4.dtype), c_prev,
+            zero_w, None, peep,
+            act=cfg.get("act", "tanh"),
+            gate_act=cfg.get("gate_act", "sigmoid"),
+            state_act=cfg.get("state_act", "tanh"))
+        if cfg.get("expose_state"):
+            return jnp.concatenate([h_new, c_new], axis=-1)
+        return h_new

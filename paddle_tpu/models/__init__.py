@@ -1,3 +1,22 @@
-"""Model zoo — benchmark-parity network builders (populated per
-SURVEY.md §6: MNIST MLP, SmallNet/VGG/AlexNet/GoogleNet/ResNet CNNs,
-stacked-LSTM text classification, seq2seq NMT, Wide&Deep CTR, CRF tagger)."""
+"""Model zoo — benchmark-parity network builders (SURVEY.md §6,
+BASELINE.json configs): image CNNs (SmallNet/AlexNet/GoogleNet/VGG/ResNet),
+IMDB stacked-LSTM, attention seq2seq NMT, Wide&Deep CTR, CRF taggers.
+
+Every builder returns a ModelSpec (cost/output/error LayerOutputs) so the
+trainer, the bench harness, and __graft_entry__ drive them uniformly.
+"""
+
+from paddle_tpu.models.image import (ModelSpec, mnist_mlp, smallnet, alexnet,
+                                     vgg16, googlenet, resnet, resnet50)
+from paddle_tpu.models.text import (stacked_lstm_net, bidi_lstm_net,
+                                    convolution_net, ngram_lm)
+from paddle_tpu.models.seq2seq import nmt_attention, nmt_generator
+from paddle_tpu.models.recommender import wide_and_deep, movielens_regression
+from paddle_tpu.models.tagger import crf_tagger, rnn_crf_tagger
+
+__all__ = [
+    "ModelSpec", "mnist_mlp", "smallnet", "alexnet", "vgg16", "googlenet",
+    "resnet", "resnet50", "stacked_lstm_net", "bidi_lstm_net",
+    "convolution_net", "ngram_lm", "nmt_attention", "nmt_generator",
+    "wide_and_deep", "movielens_regression", "crf_tagger", "rnn_crf_tagger",
+]

@@ -1,0 +1,241 @@
+"""Image classification model zoo.
+
+Benchmark-parity builders (reference: benchmark/paddle/image/{alexnet,
+googlenet,smallnet_mnist_cifar}.py, plus the VGG group helper in
+trainer_config_helpers/networks.py:465) and the ResNet-50 north-star from
+BASELINE.json (no ResNet existed in the reference tree — this is the added
+flagship). All builders:
+
+  - take an image `data` layer named "image" (flat channel-major
+    [b, c*h*w], the paddle feed convention) and a `label` layer,
+  - return a ModelSpec with cost/output/error nodes so one helper drives
+    training, the bench harness, and the graft entry.
+
+TPU-first notes: convs run NHWC through lax.conv (MXU); batch-norm is fused
+by XLA; image tensors never round-trip to NCHW.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from paddle_tpu import activation as act
+from paddle_tpu import layers as layer
+from paddle_tpu import networks
+from paddle_tpu import pooling
+from paddle_tpu.core.data_type import dense_vector, integer_value
+from paddle_tpu.core.registry import LayerOutput
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """A built model: feed via .data/.label, train on .cost, eval .error."""
+    name: str
+    data: LayerOutput
+    label: LayerOutput
+    output: LayerOutput
+    cost: LayerOutput
+    error: Optional[LayerOutput] = None
+
+    @property
+    def extra_layers(self):
+        return [self.error] if self.error is not None else []
+
+
+def _image_inputs(height: int, width: int, channels: int, num_classes: int):
+    img = layer.data("image", dense_vector(height * width * channels),
+                     height=height, width=width)
+    lbl = layer.data("label", integer_value(num_classes))
+    return img, lbl
+
+
+def _close(name, img, out, lbl) -> ModelSpec:
+    cost = layer.classification_cost(out, lbl, name=f"{name}_cost")
+    err = layer.classification_error(out, lbl, name=f"{name}_error")
+    return ModelSpec(name=name, data=img, label=lbl, output=out, cost=cost,
+                     error=err)
+
+
+# ---------------------------------------------------------------------------
+
+
+def mnist_mlp(num_classes: int = 10) -> ModelSpec:
+    """784 -> 128 -> 64 -> softmax. v1_api_demo/mnist parity."""
+    img = layer.data("image", dense_vector(784))
+    lbl = layer.data("label", integer_value(num_classes))
+    h1 = layer.fc(img, size=128, act=act.Relu(), name="mlp_h1")
+    h2 = layer.fc(h1, size=64, act=act.Relu(), name="mlp_h2")
+    out = layer.fc(h2, size=num_classes, act=act.Softmax(), name="mlp_out")
+    return _close("mnist_mlp", img, out, lbl)
+
+
+def smallnet(height: int = 32, width: int = 32, channels: int = 3,
+             num_classes: int = 10) -> ModelSpec:
+    """CIFAR-quick net (benchmark/paddle/image/smallnet_mnist_cifar.py)."""
+    img, lbl = _image_inputs(height, width, channels, num_classes)
+    t = layer.img_conv(img, filter_size=5, num_filters=32, num_channels=channels,
+                       stride=1, padding=2, act=act.Relu(), name="sn_conv1")
+    t = layer.img_pool(t, pool_size=3, stride=2, padding=1, name="sn_pool1")
+    t = layer.img_conv(t, filter_size=5, num_filters=32, stride=1, padding=2,
+                       act=act.Relu(), name="sn_conv2")
+    t = layer.img_pool(t, pool_size=3, stride=2, padding=1,
+                       pool_type=pooling.Avg(), name="sn_pool2")
+    t = layer.img_conv(t, filter_size=3, num_filters=64, stride=1, padding=1,
+                       act=act.Relu(), name="sn_conv3")
+    t = layer.img_pool(t, pool_size=3, stride=2, padding=1,
+                       pool_type=pooling.Avg(), name="sn_pool3")
+    t = layer.fc(t, size=64, act=act.Relu(), name="sn_fc1")
+    out = layer.fc(t, size=num_classes, act=act.Softmax(), name="sn_out")
+    return _close("smallnet", img, out, lbl)
+
+
+def alexnet(height: int = 227, width: int = 227, channels: int = 3,
+            num_classes: int = 1000) -> ModelSpec:
+    """AlexNet (benchmark/paddle/image/alexnet.py — the headline bench)."""
+    img, lbl = _image_inputs(height, width, channels, num_classes)
+    t = layer.img_conv(img, filter_size=11, num_filters=96,
+                       num_channels=channels, stride=4, padding=1,
+                       act=act.Relu(), name="an_conv1")
+    t = layer.img_cmrnorm(t, size=5, scale=0.0001, power=0.75, name="an_norm1")
+    t = layer.img_pool(t, pool_size=3, stride=2, name="an_pool1")
+    t = layer.img_conv(t, filter_size=5, num_filters=256, stride=1, padding=2,
+                       act=act.Relu(), name="an_conv2")
+    t = layer.img_cmrnorm(t, size=5, scale=0.0001, power=0.75, name="an_norm2")
+    t = layer.img_pool(t, pool_size=3, stride=2, name="an_pool2")
+    t = layer.img_conv(t, filter_size=3, num_filters=384, stride=1, padding=1,
+                       act=act.Relu(), name="an_conv3")
+    t = layer.img_conv(t, filter_size=3, num_filters=384, stride=1, padding=1,
+                       act=act.Relu(), name="an_conv4")
+    t = layer.img_conv(t, filter_size=3, num_filters=256, stride=1, padding=1,
+                       act=act.Relu(), name="an_conv5")
+    t = layer.img_pool(t, pool_size=3, stride=2, name="an_pool5")
+    t = layer.fc(t, size=4096, act=act.Relu(), name="an_fc6")
+    t = layer.dropout(t, 0.5, name="an_drop6")
+    t = layer.fc(t, size=4096, act=act.Relu(), name="an_fc7")
+    t = layer.dropout(t, 0.5, name="an_drop7")
+    out = layer.fc(t, size=num_classes, act=act.Softmax(), name="an_out")
+    return _close("alexnet", img, out, lbl)
+
+
+def vgg16(height: int = 224, width: int = 224, channels: int = 3,
+          num_classes: int = 1000) -> ModelSpec:
+    img, lbl = _image_inputs(height, width, channels, num_classes)
+    out = networks.vgg_16_network(img, num_channels=channels,
+                                  num_classes=num_classes)
+    return _close("vgg16", img, out, lbl)
+
+
+# ---------------------------------------------------------------------------
+# GoogleNet (inception v1, benchmark/paddle/image/googlenet.py shapes)
+
+
+def _inception(name, input, f1, f3r, f3, f5r, f5, proj):
+    c1 = layer.img_conv(input, filter_size=1, num_filters=f1, act=act.Relu(),
+                        name=f"{name}_1x1")
+    c3r = layer.img_conv(input, filter_size=1, num_filters=f3r,
+                         act=act.Relu(), name=f"{name}_3x3r")
+    c3 = layer.img_conv(c3r, filter_size=3, num_filters=f3, padding=1,
+                        act=act.Relu(), name=f"{name}_3x3")
+    c5r = layer.img_conv(input, filter_size=1, num_filters=f5r,
+                         act=act.Relu(), name=f"{name}_5x5r")
+    c5 = layer.img_conv(c5r, filter_size=5, num_filters=f5, padding=2,
+                        act=act.Relu(), name=f"{name}_5x5")
+    mp = layer.img_pool(input, pool_size=3, stride=1, padding=1,
+                        name=f"{name}_maxpool")
+    cp = layer.img_conv(mp, filter_size=1, num_filters=proj, act=act.Relu(),
+                        name=f"{name}_proj")
+    return layer.concat([c1, c3, c5, cp], name=f"{name}_concat")
+
+
+def googlenet(height: int = 224, width: int = 224, channels: int = 3,
+              num_classes: int = 1000) -> ModelSpec:
+    img, lbl = _image_inputs(height, width, channels, num_classes)
+    t = layer.img_conv(img, filter_size=7, num_filters=64,
+                       num_channels=channels, stride=2, padding=3,
+                       act=act.Relu(), name="gn_conv1")
+    t = layer.img_pool(t, pool_size=3, stride=2, padding=1, name="gn_pool1")
+    t = layer.img_conv(t, filter_size=1, num_filters=64, act=act.Relu(),
+                       name="gn_conv2r")
+    t = layer.img_conv(t, filter_size=3, num_filters=192, padding=1,
+                       act=act.Relu(), name="gn_conv2")
+    t = layer.img_pool(t, pool_size=3, stride=2, padding=1, name="gn_pool2")
+    t = _inception("gn_i3a", t, 64, 96, 128, 16, 32, 32)
+    t = _inception("gn_i3b", t, 128, 128, 192, 32, 96, 64)
+    t = layer.img_pool(t, pool_size=3, stride=2, padding=1, name="gn_pool3")
+    t = _inception("gn_i4a", t, 192, 96, 208, 16, 48, 64)
+    t = _inception("gn_i4b", t, 160, 112, 224, 24, 64, 64)
+    t = _inception("gn_i4c", t, 128, 128, 256, 24, 64, 64)
+    t = _inception("gn_i4d", t, 112, 144, 288, 32, 64, 64)
+    t = _inception("gn_i4e", t, 256, 160, 320, 32, 128, 128)
+    t = layer.img_pool(t, pool_size=3, stride=2, padding=1, name="gn_pool4")
+    t = _inception("gn_i5a", t, 256, 160, 320, 32, 128, 128)
+    t = _inception("gn_i5b", t, 384, 192, 384, 48, 128, 128)
+    # global average pool
+    t = layer.global_img_pool(t, pool_type=pooling.Avg(), name="gn_gap")
+    t = layer.dropout(t, 0.4, name="gn_drop")
+    out = layer.fc(t, size=num_classes, act=act.Softmax(), name="gn_out")
+    return _close("googlenet", img, out, lbl)
+
+
+# ---------------------------------------------------------------------------
+# ResNet (v1.5-style: stride-2 in the 3x3 of the bottleneck) — the
+# BASELINE.json north-star model; no reference config exists, designed
+# TPU-first (NHWC, BN+ReLU fused by XLA, large MXU matmuls).
+
+_RESNET_BLOCKS = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def _conv_bn(name, x, k, nf, stride=1, padding=0, relu=True,
+             num_channels=None):
+    c = layer.img_conv(x, filter_size=k, num_filters=nf, stride=stride,
+                       padding=padding, bias_attr=False, act=None,
+                       num_channels=num_channels, name=f"{name}_conv")
+    return layer.batch_norm(c, act=act.Relu() if relu else None,
+                            name=f"{name}_bn")
+
+
+def _basic_block(name, x, nf, stride):
+    t = _conv_bn(f"{name}_a", x, 3, nf, stride=stride, padding=1)
+    t = _conv_bn(f"{name}_b", t, 3, nf, padding=1, relu=False)
+    if stride != 1 or x.meta.channels != nf:
+        x = _conv_bn(f"{name}_sc", x, 1, nf, stride=stride, relu=False)
+    return layer.addto([t, x], act=act.Relu(), name=f"{name}_add")
+
+
+def _bottleneck_block(name, x, nf, stride):
+    t = _conv_bn(f"{name}_a", x, 1, nf)
+    t = _conv_bn(f"{name}_b", t, 3, nf, stride=stride, padding=1)
+    t = _conv_bn(f"{name}_c", t, 1, nf * 4, relu=False)
+    if stride != 1 or x.meta.channels != nf * 4:
+        x = _conv_bn(f"{name}_sc", x, 1, nf * 4, stride=stride, relu=False)
+    return layer.addto([t, x], act=act.Relu(), name=f"{name}_add")
+
+
+def resnet(depth: int = 50, height: int = 224, width: int = 224,
+           channels: int = 3, num_classes: int = 1000) -> ModelSpec:
+    kind, reps = _RESNET_BLOCKS[depth]
+    block = _basic_block if kind == "basic" else _bottleneck_block
+    img, lbl = _image_inputs(height, width, channels, num_classes)
+    t = _conv_bn("rn_stem", img, 7, 64, stride=2, padding=3,
+                 num_channels=channels)
+    t = layer.img_pool(t, pool_size=3, stride=2, padding=1, name="rn_pool1")
+    nf = 64
+    for si, n in enumerate(reps):
+        for bi in range(n):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            t = block(f"rn_s{si}b{bi}", t, nf, stride)
+        nf *= 2
+    t = layer.global_img_pool(t, pool_type=pooling.Avg(), name="rn_gap")
+    out = layer.fc(t, size=num_classes, act=act.Softmax(), name="rn_out")
+    return _close(f"resnet{depth}", img, out, lbl)
+
+
+def resnet50(**kw) -> ModelSpec:
+    return resnet(50, **kw)

@@ -1,0 +1,55 @@
+"""CRF sequence tagging — v1_api_demo/sequence_tagging parity
+(BASELINE.json config #5; reference layers: CRFLayer/CRFDecoding,
+linear_chain_crf over a context-window + fc emission stack).
+
+TPU-first: the linear-chain forward algorithm is a lax.scan over time with
+batched [b, L, L] logsumexp transitions (layers/crf_layers.py); decoding is
+a Viterbi scan, all inside jit.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import activation as act
+from paddle_tpu import layers as layer
+from paddle_tpu.core.data_type import integer_value_sequence
+from paddle_tpu.models.image import ModelSpec
+
+
+def crf_tagger(vocab_size: int = 20000, num_labels: int = 45,
+               emb_size: int = 128, hidden_size: int = 256,
+               context_len: int = 5) -> ModelSpec:
+    words = layer.data("words", integer_value_sequence(vocab_size))
+    labels = layer.data("labels", integer_value_sequence(num_labels))
+    emb = layer.embedding(words, size=emb_size, name="crf_emb")
+    ctx = layer.context_projection(emb, context_len=context_len,
+                                   name="crf_ctx")
+    hidden = layer.fc(ctx, size=hidden_size, act=act.Tanh(), name="crf_h")
+    emission = layer.fc(hidden, size=num_labels, act=None,
+                        name="crf_emission")
+    cost = layer.crf(emission, labels, size=num_labels, name="crf_cost")
+    decoded = layer.crf_decoding(emission, size=num_labels, label=labels,
+                                 name="crf_decode")
+    spec = ModelSpec("crf_tagger", words, labels, emission, cost, None)
+    spec.decoded = decoded
+    return spec
+
+
+def rnn_crf_tagger(vocab_size: int = 20000, num_labels: int = 45,
+                   emb_size: int = 128, hidden_size: int = 128) -> ModelSpec:
+    """Bidirectional-GRU emissions under a CRF (sequence_tagging rnn_crf)."""
+    from paddle_tpu import networks
+    words = layer.data("words", integer_value_sequence(vocab_size))
+    labels = layer.data("labels", integer_value_sequence(num_labels))
+    emb = layer.embedding(words, size=emb_size, name="rcrf_emb")
+    fwd = networks.simple_gru(emb, size=hidden_size, name="rcrf_fw")
+    bwd = networks.simple_gru(emb, size=hidden_size, name="rcrf_bw",
+                              reverse=True)
+    merged = layer.concat([fwd, bwd], name="rcrf_concat")
+    emission = layer.fc(merged, size=num_labels, act=None,
+                        name="rcrf_emission")
+    cost = layer.crf(emission, labels, size=num_labels, name="rcrf_cost")
+    decoded = layer.crf_decoding(emission, size=num_labels, label=labels,
+                                 name="rcrf_decode")
+    spec = ModelSpec("rnn_crf_tagger", words, labels, emission, cost, None)
+    spec.decoded = decoded
+    return spec

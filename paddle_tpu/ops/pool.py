@@ -17,15 +17,29 @@ def _pair(v) -> Tuple[int, int]:
     return (int(v), int(v))
 
 
+def _ceil_pads(in_size: int, kernel: int, stride: int, padding: int):
+    """Caffe ceil-mode window arithmetic (reference PoolLayer /
+    config_parser pooling output size): out = ceil((in - k + 2p)/s) + 1,
+    clipped so the last window starts inside in+p; returns (out,
+    (left_pad, right_pad)) with the asymmetric right pad that makes
+    reduce_window produce exactly `out` windows."""
+    out = pool_out_size(in_size, kernel, stride, padding)
+    right = (out - 1) * stride + kernel - in_size - padding
+    return out, (padding, max(right, 0))
+
+
 def max_pool2d(x: jnp.ndarray, kernel, stride=None, padding=0) -> jnp.ndarray:
-    """x: [N,H,W,C]."""
+    """x: [N,H,W,C]. Ceil-mode (caffe) window arithmetic like the
+    reference's PoolLayer."""
     kh, kw = _pair(kernel)
     sh, sw = _pair(stride if stride is not None else kernel)
     ph, pw = _pair(padding)
+    _, pads_h = _ceil_pads(x.shape[1], kh, sh, ph)
+    _, pads_w = _ceil_pads(x.shape[2], kw, sw, pw)
     return lax.reduce_window(
         x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
         lax.max, (1, kh, kw, 1), (1, sh, sw, 1),
-        ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+        ((0, 0), pads_h, pads_w, (0, 0)))
 
 
 def avg_pool2d(x: jnp.ndarray, kernel, stride=None, padding=0,
@@ -33,14 +47,14 @@ def avg_pool2d(x: jnp.ndarray, kernel, stride=None, padding=0,
     kh, kw = _pair(kernel)
     sh, sw = _pair(stride if stride is not None else kernel)
     ph, pw = _pair(padding)
-    sums = lax.reduce_window(
-        x, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
-        ((0, 0), (ph, ph), (pw, pw), (0, 0)))
-    if exclude_padding and (ph or pw):
+    _, pads_h = _ceil_pads(x.shape[1], kh, sh, ph)
+    _, pads_w = _ceil_pads(x.shape[2], kw, sw, pw)
+    dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
+    pads = ((0, 0), pads_h, pads_w, (0, 0))
+    sums = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    if exclude_padding and any(p != (0, 0) for p in pads):
         ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
-        counts = lax.reduce_window(
-            ones, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
-            ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
         return sums / jnp.maximum(counts, 1.0)
     return sums / float(kh * kw)
 
@@ -51,10 +65,15 @@ def global_avg_pool(x: jnp.ndarray) -> jnp.ndarray:
 
 def pool_out_size(in_size: int, kernel: int, stride: int, padding: int,
                   ceil_mode: bool = True) -> int:
-    """config_parser.py cnn_output_size for pooling (paddle pools use ceil)."""
+    """config_parser.py pooling output size (caffe ceil mode + clip: the
+    last window must start inside in+p)."""
     if ceil_mode:
-        return int(np.ceil((in_size - kernel + 2 * padding) / stride)) + 1
-    return (in_size - kernel + 2 * padding) // stride + 1
+        out = int(np.ceil((in_size - kernel + 2 * padding) / stride)) + 1
+    else:
+        out = (in_size - kernel + 2 * padding) // stride + 1
+    if padding > 0 and (out - 1) * stride >= in_size + padding:
+        out -= 1
+    return out
 
 
 def maxout(x: jnp.ndarray, groups: int) -> jnp.ndarray:

@@ -1,0 +1,161 @@
+"""Model-zoo tests — every builder constructs, runs a forward pass, and the
+trainable ones take a full jitted train step (mirrors
+paddle/trainer/tests/test_Trainer over the benchmark configs)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models as M
+from paddle_tpu.core.data_type import SeqType
+
+
+def _random_sample(itype, rng, max_len=6):
+    if itype.seq_type == SeqType.SEQUENCE:
+        n = rng.randint(2, max_len)
+        if itype.kind == "integer":
+            return [int(v) for v in rng.randint(0, itype.dim, n)]
+        return [rng.randn(itype.dim).astype("float32") for _ in range(n)]
+    if itype.kind == "integer":
+        return int(rng.randint(0, itype.dim))
+    return rng.randn(itype.dim).astype("float32")
+
+
+def _make_reader(topo, rng, n=8):
+    types = [t for _, t in topo.data_type()]
+
+    def reader():
+        batch = [tuple(_random_sample(t, rng) for t in types)
+                 for _ in range(n)]
+        yield batch
+    return reader
+
+
+def _train_steps(spec, steps=2, opt=None, n=8):
+    topo = paddle.Topology(spec.cost)
+    params = paddle.create_parameters(topo)
+    trainer = paddle.SGD(
+        cost=spec.cost, parameters=params,
+        update_equation=opt or paddle.optimizer.Momentum(learning_rate=1e-3),
+        extra_layers=spec.extra_layers)
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    rng = np.random.RandomState(0)
+    for _ in range(steps):
+        trainer.train(_make_reader(trainer.topology, rng, n=n),
+                      num_passes=1, event_handler=handler)
+    assert all(np.isfinite(c) for c in costs), costs
+    return costs
+
+
+def _forward_only(spec, n=2):
+    topo = paddle.Topology(spec.cost)
+    params = topo.init_params()
+    state = topo.init_state()
+    from paddle_tpu.trainer.data_feeder import DataFeeder
+    rng = np.random.RandomState(0)
+    feeder = DataFeeder(topo.data_type())
+    types = [t for _, t in topo.data_type()]
+    batch = [tuple(_random_sample(t, rng) for t in types) for _ in range(n)]
+    feed = feeder(batch)
+    feed.pop("__batch_size__")
+    outs, _ = topo.forward(params, state, feed, mode="test")
+    v = outs[spec.cost.name]
+    assert np.all(np.isfinite(np.asarray(v))), spec.name
+    return outs
+
+
+class TestImageModels:
+    def test_mnist_mlp_trains(self):
+        costs = _train_steps(M.mnist_mlp(), steps=2)
+        assert len(costs) == 2
+
+    def test_smallnet_trains(self):
+        _train_steps(M.smallnet(height=16, width=16), steps=1)
+
+    def test_alexnet_forward(self):
+        _forward_only(M.alexnet(height=67, width=67, num_classes=10))
+
+    def test_vgg16_forward(self):
+        _forward_only(M.vgg16(height=32, width=32, num_classes=10))
+
+    def test_googlenet_forward(self):
+        _forward_only(M.googlenet(height=64, width=64, num_classes=10))
+
+    def test_resnet18_trains(self):
+        _train_steps(M.resnet(18, height=32, width=32, num_classes=10),
+                     steps=1, n=4)
+
+    def test_resnet50_builds(self):
+        spec = M.resnet50(num_classes=1000)
+        topo = paddle.Topology(spec.cost)
+        n_params = sum(int(np.prod(p.shape))
+                       for p in topo.param_specs.values())
+        # ResNet-50 has ~25.5M params
+        assert 24e6 < n_params < 27e6, n_params
+
+
+class TestTextModels:
+    def test_stacked_lstm_trains(self):
+        spec = M.stacked_lstm_net(vocab_size=100, emb_size=16,
+                                  hidden_size=16, lstm_num=2)
+        _train_steps(spec, steps=1)
+
+    def test_bidi_lstm_forward(self):
+        _forward_only(M.bidi_lstm_net(vocab_size=50, emb_size=8,
+                                      hidden_size=8))
+
+    def test_convolution_net_trains(self):
+        spec = M.convolution_net(vocab_size=100, emb_size=16, hidden_size=16)
+        _train_steps(spec, steps=1)
+
+    def test_ngram_lm_trains(self):
+        _train_steps(M.ngram_lm(vocab_size=50, emb_size=8, hidden_size=16),
+                     steps=1)
+
+
+class TestSeq2Seq:
+    def test_nmt_attention_trains(self):
+        spec = M.nmt_attention(src_vocab=40, trg_vocab=40, emb_size=8,
+                               enc_size=8, dec_size=8)
+        _train_steps(spec, steps=1, n=4)
+
+    def test_nmt_generator_builds_and_shares_params(self):
+        train_spec = M.nmt_attention(src_vocab=40, trg_vocab=40, emb_size=8,
+                                     enc_size=8, dec_size=8)
+        train_topo = paddle.Topology(train_spec.cost)
+        gen = M.nmt_generator(src_vocab=40, trg_vocab=40, emb_size=8,
+                              enc_size=8, dec_size=8, beam_size=2,
+                              max_length=5)
+        gen_topo = paddle.Topology(gen)
+        shared = set(train_topo.param_specs) & set(gen_topo.param_specs)
+        # every decoder/encoder weight must be shared by fixed name
+        assert "_dec_emb_w" in shared
+        assert "_dec_gru_w" in shared
+        assert "_enc_proj_w" in shared
+
+
+class TestRecommender:
+    def test_wide_and_deep_trains(self):
+        spec = M.wide_and_deep(sparse_dims=(50, 30), dense_dim=5,
+                               emb_size=8, hidden_sizes=(16, 8))
+        _train_steps(spec, steps=1)
+
+    def test_movielens_trains(self):
+        spec = M.movielens_regression(user_dim=20, movie_dim=30, emb_size=8)
+        _train_steps(spec, steps=1)
+
+
+class TestTagger:
+    def test_crf_tagger_trains(self):
+        spec = M.crf_tagger(vocab_size=50, num_labels=5, emb_size=8,
+                            hidden_size=8, context_len=3)
+        _train_steps(spec, steps=1, n=4)
+
+    def test_rnn_crf_tagger_forward(self):
+        _forward_only(M.rnn_crf_tagger(vocab_size=50, num_labels=5,
+                                       emb_size=8, hidden_size=8))
