@@ -21,26 +21,39 @@ from paddle_tpu.parallel.mesh import DP_AXIS
 
 
 def _feed_shardings(feed, mesh: Mesh):
-    """Batch-shard every feed leaf over dp (leading axis)."""
+    """Batch-shard every feed leaf over dp (leading axis); on meshes with
+    no dp axis (pure tensor-parallel) the feed stays replicated."""
+    spec = P(DP_AXIS) if DP_AXIS in mesh.shape else P()
+
     def leaf(x):
-        return NamedSharding(mesh, P(DP_AXIS))
+        return NamedSharding(mesh, spec)
     return jax.tree_util.tree_map(leaf, feed)
 
 
-def shard_train_step(step_fn: Callable, mesh: Mesh) -> Callable:
+def shard_train_step(step_fn: Callable, mesh: Mesh,
+                     param_shardings=None, opt_shardings=None) -> Callable:
     """Wrap a train step (params, opt_state, state, feed, rng, n_real) so the
-    feed is dp-sharded and params/opt state replicated."""
+    feed is dp-sharded over the mesh. Params/opt-state are replicated by
+    default; pass `param_shardings` (name -> NamedSharding, from
+    parallel.tensor_parallel) and matching `opt_shardings` for dp x mp runs
+    — XLA then partitions the matmuls over `mp` and all-reduces grads over
+    `dp`, replacing both MultiGradientMachine's ring and the pserver."""
     repl = NamedSharding(mesh, P())
-    dp = NamedSharding(mesh, P(DP_AXIS))
 
     def sharded(params, opt_state, state, feed, rng, n_real):
         feed = jax.lax.with_sharding_constraint(
             feed, _feed_shardings(feed, mesh))
         return step_fn(params, opt_state, state, feed, rng, n_real)
 
+    # out_shardings must pin the params/opt outputs to the SAME shardings as
+    # the inputs: otherwise XLA's propagated output shardings (e.g. a bias
+    # grad picking up mp from its matmul) poison the next call's args.
     return jax.jit(
         sharded,
-        in_shardings=(repl, repl, repl, None, repl, repl),
+        in_shardings=(param_shardings or repl, opt_shardings or repl,
+                      repl, None, repl, repl),
+        out_shardings=(param_shardings or repl, opt_shardings or repl,
+                       repl, repl, repl),
         donate_argnums=(0, 1, 2),
     )
 

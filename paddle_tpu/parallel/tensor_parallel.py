@@ -1,0 +1,111 @@
+"""Tensor/model-parallel parameter sharding.
+
+Replaces the reference's model-parallel machinery — ParallelNeuralNetwork's
+per-layer device pinning (ParallelNeuralNetwork.h:34-63, `--parallel_nn`)
+and the pserver's block-sharded parameter storage (ParameterServer2.h:78-95:
+parameters split into 64KB blocks scattered over servers) — with GSPMD
+sharding annotations: each parameter gets a PartitionSpec over the mesh's
+`mp` axis, XLA partitions the matmuls and inserts the collectives over ICI.
+
+Default rules (the scaling-book recipe for this layer vocabulary):
+  - embedding tables  (vocab, emb)   -> row-sharded  P("mp", None):
+    the sparse-remote-update capability (embedding rows living on pservers,
+    MultiGradientMachine.h:99-166) becomes rows-living-on-chips.
+  - fc/projection weights (in, out)  -> column-sharded P(None, "mp")
+    (output features split; XLA all-gathers activations only when needed).
+  - conv kernels (kh, kw, ic, oc)    -> P(None, None, None, "mp") when oc
+    divides; spatial conv stays local, channel reduce rides ICI.
+  - biases / gains / 1-D state      -> replicated.
+
+Use `default_rules()` for the defaults or pass custom `(regex, spec)`
+pairs to `spec_for`/`param_shardings`, which skip any param whose dims
+don't divide the axis (falling back to replication).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel.mesh import MP_AXIS
+
+
+Rule = Tuple[str, P]
+
+
+def default_rules() -> List[Rule]:
+    return [
+        (r".*emb.*\.w0$|.*emb.*_w$", P(MP_AXIS, None)),     # embedding rows
+        (r".*\.w\d+$|.*_w$", P(None, MP_AXIS)),             # fc columns
+        (r".*wbias$|.*_b$|.*moving_.*", P()),               # 1-D: replicate
+    ]
+
+
+def _spec_fits(shape: Sequence[int], spec: P, mesh: Mesh) -> bool:
+    """Every sharded dim must exist and divide the mesh axis size."""
+    if len(spec) > len(shape):
+        return False
+    for dim, axis in zip(shape, spec):
+        if axis is None:
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        n = int(np.prod([mesh.shape[a] for a in axes]))
+        if dim % n != 0:
+            return False
+    return True
+
+
+def spec_for(name: str, shape: Sequence[int], mesh: Mesh,
+             rules: Optional[Sequence[Rule]] = None) -> P:
+    """PartitionSpec for one parameter (first matching + fitting rule)."""
+    if MP_AXIS not in mesh.shape or mesh.shape[MP_AXIS] == 1:
+        return P()
+    ndim = len(shape)
+    for pat, spec in (rules or default_rules()):
+        if re.match(pat, name):
+            # conv kernels: shard the last (out-channel) dim instead of cols
+            if ndim == 4 and spec == P(None, MP_AXIS):
+                spec = P(None, None, None, MP_AXIS)
+            if len(spec) <= ndim and _spec_fits(shape, spec, mesh):
+                return spec
+            return P()
+    return P()
+
+
+def param_shardings(param_specs: Dict[str, "ParamSpec"], mesh: Mesh,
+                    rules: Optional[Sequence[Rule]] = None
+                    ) -> Dict[str, NamedSharding]:
+    """Name -> NamedSharding for a topology's parameter table."""
+    return {name: NamedSharding(mesh, spec_for(name, tuple(ps.shape), mesh,
+                                               rules))
+            for name, ps in param_specs.items()}
+
+
+def shard_params(params: Dict[str, jax.Array], mesh: Mesh,
+                 shardings: Dict[str, NamedSharding]) -> Dict[str, jax.Array]:
+    """Place a host/replicated param dict onto the mesh per the shardings."""
+    return {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+
+def opt_state_shardings(opt_state, param_shardings: Dict[str, NamedSharding],
+                        mesh: Mesh):
+    """Optimizer slots mirror their parameter's sharding (momentum/adam
+    moments have the param's shape); scalars replicate. This is the
+    pserver-parity move: optimizer state lives WITH the shard
+    (ParameterServer2 runs op_SGD on its local block)."""
+    repl = NamedSharding(mesh, P())
+
+    def assign(path, leaf):
+        if not hasattr(leaf, "shape") or leaf.ndim == 0:
+            return repl
+        for p in path:
+            key = getattr(p, "key", None)
+            if key in param_shardings:
+                return param_shardings[key]
+        return repl
+
+    return jax.tree_util.tree_map_with_path(assign, opt_state)

@@ -101,11 +101,24 @@ class SGD:
                 params, grads, opt_state, n_real.astype(jnp.float32))
             return new_params, new_opt_state, new_state, loss, metrics
 
-        jitted = jax.jit(step, donate_argnums=(0, 1, 2))
         if self.mesh is not None:
+            from paddle_tpu.parallel import tensor_parallel as tp
             from paddle_tpu.parallel.data_parallel import shard_train_step
-            return shard_train_step(step, self.mesh)
-        return jitted
+            from paddle_tpu.parallel.mesh import MP_AXIS
+            p_sh = o_sh = None
+            if MP_AXIS in self.mesh.shape and self.mesh.shape[MP_AXIS] > 1:
+                # shard over the LIVE param dict (may hold extra entries,
+                # e.g. a tar checkpoint from an older topology)
+                from jax.sharding import NamedSharding
+                p_sh = {
+                    name: NamedSharding(
+                        self.mesh,
+                        tp.spec_for(name, tuple(arr.shape), self.mesh))
+                    for name, arr in self.parameters.raw.items()}
+                o_sh = tp.opt_state_shardings(self.opt_state, p_sh,
+                                              self.mesh)
+            return shard_train_step(step, self.mesh, p_sh, o_sh)
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _build_test_step(self):
         def step(params, state, feed, n_real):
