@@ -1,6 +1,13 @@
 from paddle_tpu.parallel import mesh as mesh_mod
 from paddle_tpu.parallel.mesh import (create_mesh, data_parallel_mesh,
                                       DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS)
+from paddle_tpu.parallel import tensor_parallel
+from paddle_tpu.parallel import sequence_parallel
+from paddle_tpu.parallel import pipeline as pipeline_mod
+from paddle_tpu.parallel.sequence_parallel import (attention, ring_attention,
+                                                   ulysses_attention)
 
 __all__ = ["mesh_mod", "create_mesh", "data_parallel_mesh", "DP_AXIS",
-           "MP_AXIS", "PP_AXIS", "SP_AXIS"]
+           "MP_AXIS", "PP_AXIS", "SP_AXIS", "tensor_parallel",
+           "sequence_parallel", "pipeline_mod", "attention",
+           "ring_attention", "ulysses_attention"]

@@ -1,0 +1,17 @@
+"""shard_map compatibility: jax >= 0.8 exposes jax.shard_map with
+`check_vma`; older versions have jax.experimental.shard_map with
+`check_rep`. One shim so every call site works on both."""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+    _KW = "check_vma"
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check: bool = False):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_KW: check})

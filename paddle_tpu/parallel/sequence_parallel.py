@@ -1,0 +1,192 @@
+"""Sequence/context parallelism — ring attention over the mesh's `sp` axis.
+
+The reference (2017) had no sequence parallelism; its long-sequence story
+was ragged batching (Argument::sequenceStartPositions, SequenceToBatch).
+This module is the modern successor SURVEY.md §2.4/§7 calls for: sequences
+are sharded over chips on the time axis, and attention runs as a RING —
+each chip holds its Q block, while K/V blocks rotate around the `sp` axis
+via lax.ppermute; a running online-softmax (row max + normalizer) merges
+per-block partial results so the full [T, T] score matrix never
+materializes. Communication rides ICI neighbor-to-neighbor (the same
+pattern as MultiGradientMachine's grad ring, MultiGradientMachine.h:61-83,
+but over sequence blocks instead of gradient chunks).
+
+All code is jit/shard_map-compatible and differentiable (the backward pass
+is jax.grad through the scan + ppermute, which XLA reverses into the
+mirror ring).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.parallel._compat import shard_map
+
+from paddle_tpu.parallel.mesh import SP_AXIS
+
+
+def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+              mask: Optional[jnp.ndarray] = None,
+              scale: Optional[float] = None) -> jnp.ndarray:
+    """Plain scaled-dot-product attention, the single-chip reference.
+
+    q: [b, Tq, h, d]; k, v: [b, Tk, h, d]; mask: [b, Tq, Tk] additive-bool
+    (True = attend). Returns [b, Tq, h, d].
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        logits = jnp.where(mask[:, None, :, :], logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def _ring_attention_local(q, k, v, q_valid, kv_valid, axis_name, causal,
+                          q_offset, scale):
+    """Per-shard body. q: [b, Tq, h, d] (local block); k/v: [b, Tk, h, d]
+    (local block, will rotate). *_valid: [b, T*] bool masks for ragged
+    sequences. q_offset is the global start position of the local Q block
+    (for causal masking); K/V block positions follow from the rotation
+    source index.
+    """
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+
+    # running accumulators for online softmax
+    acc = jnp.zeros((b, tq, h, d), jnp.float32)
+    row_max = jnp.full((b, h, tq), -1e30, jnp.float32)
+    row_sum = jnp.zeros((b, h, tq), jnp.float32)
+
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = q_offset + jnp.arange(tq)                       # [tq] global
+
+    def body(carry, i):
+        acc, row_max, row_sum, k_blk, v_blk, kv_valid_blk = carry
+        # which shard's block are we holding? (blocks rotate backwards)
+        src = (me + i) % n
+        kv_pos = src * tk + jnp.arange(tk)                  # [tk] global
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            k_blk.astype(jnp.float32))
+        valid = kv_valid_blk[:, None, None, :]              # [b,1,1,tk]
+        if causal:
+            cmask = (q_pos[:, None] >= kv_pos[None, :])     # [tq,tk]
+            valid = jnp.logical_and(valid, cmask[None, None, :, :])
+        logits = jnp.where(valid, logits, -1e30)
+
+        blk_max = jnp.max(logits, axis=-1)                  # [b,h,tq]
+        new_max = jnp.maximum(row_max, blk_max)
+        correction = jnp.exp(row_max - new_max)
+        p = jnp.exp(logits - new_max[..., None])            # [b,h,tq,tk]
+        p = jnp.where(valid, p, 0.0)
+        blk_sum = jnp.sum(p, axis=-1)
+        new_sum = row_sum * correction + blk_sum
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p,
+                        v_blk.astype(jnp.float32))
+        new_acc = acc * correction.transpose(0, 2, 1)[..., None] + pv
+
+        # rotate kv to the next chip (neighbor ring over ICI); the last
+        # iteration's blocks are never read, so skip that hop
+        def rotate(blks):
+            perm = [(j, (j - 1) % n) for j in range(n)]
+            return tuple(lax.ppermute(x, axis_name, perm) for x in blks)
+
+        k_nxt, v_nxt, kv_valid_nxt = lax.cond(
+            i < n - 1, rotate, lambda blks: blks,
+            (k_blk, v_blk, kv_valid_blk))
+        return (new_acc, new_max, new_sum, k_nxt, v_nxt, kv_valid_nxt), None
+
+    init = (acc, row_max, row_sum, k, v, kv_valid)
+    (acc, row_max, row_sum, _, _, _), _ = lax.scan(
+        body, init, jnp.arange(n))
+    norm = jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
+    out = acc / norm
+    out = jnp.where(q_valid[:, :, None, None], out, 0.0)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, *,
+                   lengths: Optional[jnp.ndarray] = None,
+                   causal: bool = False,
+                   scale: Optional[float] = None,
+                   axis_name: str = SP_AXIS) -> jnp.ndarray:
+    """Context-parallel attention: time axis sharded over `axis_name`.
+
+    q/k/v: [b, T, h, d] GLOBAL arrays (jit will keep them sharded over sp);
+    lengths: [b] valid lengths for ragged batches. T must divide the sp
+    axis size. Differentiable; call inside or outside jit.
+    """
+    n = mesh.shape[axis_name]
+    b, t, h, d = q.shape
+    assert t % n == 0, f"seq len {t} must divide sp={n}"
+    tb = t // n
+    if lengths is None:
+        valid = jnp.ones((b, t), bool)
+    else:
+        valid = jnp.arange(t)[None, :] < lengths[:, None]
+
+    def local(q_blk, k_blk, v_blk, q_val, kv_val):
+        me = lax.axis_index(axis_name)
+        q_offset = me * tb
+        return _ring_attention_local(q_blk, k_blk, v_blk, q_val, kv_val,
+                                     axis_name, causal, q_offset, scale)
+
+    sp = P(None, axis_name, None, None)
+    spv = P(None, axis_name)
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(sp, sp, sp, spv, spv),
+                   out_specs=sp, check=False)
+    return fn(q, k, v, valid, valid)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, *,
+                      lengths: Optional[jnp.ndarray] = None,
+                      causal: bool = False,
+                      axis_name: str = SP_AXIS) -> jnp.ndarray:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style): resharding
+    [b, T/n, h, d] -> [b, T, h/n, d] via all_to_all so each chip computes
+    FULL attention for a HEAD slice, then reshards back. One all-to-all
+    each way over ICI instead of n ppermute hops — better when h >= n and
+    the sequence fits per-chip HBM."""
+    n = mesh.shape[axis_name]
+    b, t, h, d = q.shape
+    assert t % n == 0 and h % n == 0, (t, h, n)
+    if lengths is None:
+        valid = jnp.ones((b, t), bool)
+    else:
+        valid = jnp.arange(t)[None, :] < lengths[:, None]
+
+    def local(q_blk, k_blk, v_blk, val):
+        # [b, tb, h, d] -> all_to_all -> [b, t, h/n, d]
+        def reshard(x):
+            return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+        qg, kg, vg = reshard(q_blk), reshard(k_blk), reshard(v_blk)
+        val_g = lax.all_gather(val, axis_name, axis=1, tiled=True)
+        mask = val_g[:, None, :]                            # [b, 1, T]
+        mask = jnp.broadcast_to(mask, (b, t, t))
+        if causal:
+            cm = jnp.tril(jnp.ones((t, t), bool))
+            mask = jnp.logical_and(mask, cm[None])
+        out = attention(qg, kg, vg, mask)
+        # zero padded query rows (same contract as ring_attention)
+        out = jnp.where(val_g[:, :, None, None], out, 0.0)
+        # [b, t, h/n, d] -> back to [b, tb, h, d]
+        return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    sp = P(None, axis_name, None, None)
+    spv = P(None, axis_name)
+    fn = shard_map(local, mesh=mesh, in_specs=(sp, sp, sp, spv),
+                   out_specs=sp, check=False)
+    return fn(q, k, v, valid)
