@@ -32,6 +32,33 @@ BASELINES_MS = {
 }
 
 
+def _slope_time(step, carry, extra, iters, warmup):
+    """Update-inclusive ms/batch via slope timing: run N and 2N chained
+    steps (each chain ends in ONE device->host readback of the loss, the
+    only sync every transport honors) and take (T2N - TN)/N. The
+    difference cancels the constant sync/transport latency, which on a
+    tunneled TPU (~100 ms RTT) would otherwise dominate; the chain itself
+    serializes on-device because each step consumes the previous step's
+    params. Mirrors paddle --job=time (update time included)."""
+    feed, key, n_real = extra
+    p, o, s = carry
+
+    def chain(n):
+        nonlocal p, o, s
+        t0 = time.perf_counter()
+        for _ in range(n):
+            p, o, s, loss, _ = step(p, o, s, feed, key, n_real)
+        float(loss)
+        return (time.perf_counter() - t0) * 1000.0
+
+    for _ in range(warmup):
+        chain(1)
+    n = max(iters // 2, 2)
+    t1 = chain(n)
+    t2 = chain(2 * n)
+    return max((t2 - t1) / n, 1e-6)
+
+
 def _build(name):
     from paddle_tpu import models
     if name.startswith("alexnet"):
@@ -69,19 +96,7 @@ def bench_image(name: str, batch: int, iters: int = 20, warmup: int = 3):
     step = trainer._train_step
     p, o, s = trainer.parameters.raw, trainer.opt_state, \
         trainer.parameters.state
-    for _ in range(warmup):
-        p, o, s, loss, _ = step(p, o, s, feed, key, n_real)
-        float(loss)
-    # sync every step by fetching the scalar loss: paddle --job=time
-    # measures update-inclusive wall-clock per batch, and a device->host
-    # readback is the only sync that every transport honors
-    # (block_until_ready resolves early on tunneled platforms)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        p, o, s, loss, _ = step(p, o, s, feed, key, n_real)
-        float(loss)
-    dt = (time.perf_counter() - t0) / iters
-    return dt * 1000.0
+    return _slope_time(step, (p, o, s), (feed, key, n_real), iters, warmup)
 
 
 def bench_lstm(batch: int, hidden: int, seq_len: int = 100,
@@ -111,14 +126,7 @@ def bench_lstm(batch: int, hidden: int, seq_len: int = 100,
     step = trainer._train_step
     p, o, s = trainer.parameters.raw, trainer.opt_state, \
         trainer.parameters.state
-    for _ in range(warmup):
-        p, o, s, loss, _ = step(p, o, s, feed, key, n_real)
-        float(loss)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        p, o, s, loss, _ = step(p, o, s, feed, key, n_real)
-        float(loss)
-    return (time.perf_counter() - t0) / iters * 1000.0
+    return _slope_time(step, (p, o, s), (feed, key, n_real), iters, warmup)
 
 
 def main():

@@ -154,6 +154,17 @@ def lstm_scan(seq4: SequenceBatch, w_rec: jnp.ndarray,
     h_init = h0 if h0 is not None else jnp.zeros((b, h), dtype)
     c_init = c0 if c0 is not None else jnp.zeros((b, h), dtype)
 
+    # fused Pallas sequence kernel (hl_cuda_lstm.cu parity) when eligible
+    if (not reverse and h0 is None and c0 is None):
+        from paddle_tpu.ops import pallas_rnn
+        if pallas_rnn.pallas_ok(b, h, act, gate_act, state_act):
+            outs, hT, cT = pallas_rnn.lstm_sequence(
+                seq4.data, seq4.lengths, w_rec, bias, peep)
+            out_seq = seq4.with_data(outs.astype(dtype))
+            if return_state:
+                return out_seq, (hT.astype(dtype), cT.astype(dtype))
+            return out_seq
+
     def step(carry, x_t):
         hh, cc = carry
         h_new, c_new = lstm_cell(x_t, hh, cc, w_rec, bias, peep,
@@ -176,6 +187,18 @@ def gru_scan(seq3: SequenceBatch, w_rec: jnp.ndarray,
     b = seq3.data.shape[0]
     h = w_rec.shape[0]
     h_init = h0 if h0 is not None else jnp.zeros((b, h), seq3.data.dtype)
+
+    # fused Pallas sequence kernel (hl_gpu_gru.cuh parity) when eligible
+    if not reverse and h0 is None:
+        from paddle_tpu.ops import pallas_rnn
+        if pallas_rnn.pallas_ok(b, h, act, gate_act):
+            dtype = seq3.data.dtype
+            outs, hT = pallas_rnn.gru_sequence(
+                seq3.data, seq3.lengths, w_rec, bias)
+            out_seq = seq3.with_data(outs.astype(dtype))
+            if return_state:
+                return out_seq, hT.astype(dtype)
+            return out_seq
 
     def step(carry, x_t):
         h_new = gru_cell(x_t, carry, w_rec, bias, act, gate_act)

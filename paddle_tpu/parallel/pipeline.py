@@ -46,7 +46,7 @@ def pipeline(stage_fn: Callable, stage_params, x: jnp.ndarray, mesh: Mesh,
             f"stage_params leading axis {leaf.shape[0]} != pp={n}"
     b = x.shape[0]
     m = num_microbatches or n
-    assert b % m == 0, f"batch {b} must divide microbatches {m}"
+    assert b % m == 0, f"microbatches {m} must divide batch {b}"
     mb = b // m
     xm = x.reshape((m, mb) + x.shape[1:])
 

@@ -127,7 +127,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     n = mesh.shape[axis_name]
     b, t, h, d = q.shape
-    assert t % n == 0, f"seq len {t} must divide sp={n}"
+    assert t % n == 0, f"sp={n} must divide seq len {t}"
     tb = t // n
     if lengths is None:
         valid = jnp.ones((b, t), bool)

@@ -1,0 +1,106 @@
+"""Fused Pallas LSTM/GRU kernels vs the lax.scan reference (interpret mode
+on CPU — the CPU-as-fake-TPU discipline; on hardware the same kernels run
+compiled)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import pallas_rnn, recurrent
+
+
+def _seq(b=4, t=12, dim=24, seed=0, ragged=True):
+    rng = np.random.RandomState(seed)
+    data = jnp.asarray(rng.randn(b, t, dim).astype("float32") * 0.5)
+    lengths = jnp.asarray(rng.randint(3, t + 1, b) if ragged
+                          else np.full(b, t), jnp.int32)
+    return SequenceBatch(data, lengths)
+
+
+class TestPallasLSTM:
+    def test_matches_lax_scan(self):
+        h = 6
+        seq = _seq(dim=4 * h)
+        rng = np.random.RandomState(1)
+        w = jnp.asarray(rng.randn(h, 4 * h).astype("float32") * 0.3)
+        bias = jnp.asarray(rng.randn(4 * h).astype("float32") * 0.1)
+        peep = jnp.asarray(rng.randn(3 * h).astype("float32") * 0.1)
+        ref = recurrent.lstm_scan(seq, w, bias, peep)
+        out, hT, cT = pallas_rnn.lstm_sequence(
+            seq.data, seq.lengths, w, bias, peep, interpret=True)
+        np.testing.assert_allclose(np.asarray(ref.data), np.asarray(out),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_final_state_matches(self):
+        h = 6
+        seq = _seq(dim=4 * h, seed=2)
+        rng = np.random.RandomState(3)
+        w = jnp.asarray(rng.randn(h, 4 * h).astype("float32") * 0.3)
+        ref, (rhT, rcT) = recurrent.lstm_scan(seq, w, None, None,
+                                              return_state=True)
+        out, hT, cT = pallas_rnn.lstm_sequence(
+            seq.data, seq.lengths, w, None, None, interpret=True)
+        np.testing.assert_allclose(np.asarray(rhT), np.asarray(hT),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rcT), np.asarray(cT),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gradients_match(self):
+        h = 6
+        seq = _seq(dim=4 * h, seed=4)
+        rng = np.random.RandomState(5)
+        w = jnp.asarray(rng.randn(h, 4 * h).astype("float32") * 0.3)
+        bias = jnp.asarray(rng.randn(4 * h).astype("float32") * 0.1)
+
+        def loss_pallas(x, w, b):
+            out, _, _ = pallas_rnn.lstm_sequence(x, seq.lengths, w, b, None,
+                                                 interpret=True)
+            return jnp.sum(out ** 2)
+
+        def loss_ref(x, w, b):
+            ref = recurrent.lstm_scan(SequenceBatch(x, seq.lengths), w, b,
+                                      None)
+            return jnp.sum(ref.data ** 2)
+
+        gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(seq.data, w, bias)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(seq.data, w, bias)
+        for a, b_ in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestPallasGRU:
+    def test_matches_lax_scan(self):
+        h = 6
+        seq = _seq(dim=3 * h, seed=6)
+        rng = np.random.RandomState(7)
+        w = jnp.asarray(rng.randn(h, 3 * h).astype("float32") * 0.3)
+        bias = jnp.asarray(rng.randn(3 * h).astype("float32") * 0.1)
+        ref = recurrent.gru_scan(seq, w, bias)
+        out, hT = pallas_rnn.gru_sequence(seq.data, seq.lengths, w, bias,
+                                          interpret=True)
+        np.testing.assert_allclose(np.asarray(ref.data), np.asarray(out),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gradients_match(self):
+        h = 6
+        seq = _seq(dim=3 * h, seed=8)
+        rng = np.random.RandomState(9)
+        w = jnp.asarray(rng.randn(h, 3 * h).astype("float32") * 0.3)
+
+        def loss_pallas(x, w):
+            out, _ = pallas_rnn.gru_sequence(x, seq.lengths, w, None,
+                                             interpret=True)
+            return jnp.sum(out ** 2)
+
+        def loss_ref(x, w):
+            return jnp.sum(recurrent.gru_scan(
+                SequenceBatch(x, seq.lengths), w, None).data ** 2)
+
+        gp = jax.grad(loss_pallas, argnums=(0, 1))(seq.data, w)
+        gr = jax.grad(loss_ref, argnums=(0, 1))(seq.data, w)
+        for a, b_ in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-5)
