@@ -1,0 +1,325 @@
+"""Elastic training coordinator — go/master parity.
+
+The reference's Go master (go/master/service.go) partitions a RecordIO
+dataset into tasks, serves them to stateless trainers over RPC, re-queues
+tasks whose trainer died (per-task timeout, service.go:341), discards
+tasks that failed `failure_max` times (:313), snapshots its queue state so
+the master itself can restart (:166-230), and elects one trainer to save
+the model (:474). etcd provided discovery + the snapshot store.
+
+TPU-native build: the data plane is deterministic sharded readers, so the
+coordinator is a small control-plane service:
+
+  - Coordinator        — task queues todo/pending/done + snapshot/recover
+  - KVStore            — pluggable snapshot store (in-mem / file; the etcd
+                         equivalent without the dependency)
+  - CoordinatorServer  — stdlib XML-RPC wrapper so multiple trainer
+                         PROCESSES share one coordinator (net/rpc parity)
+  - task_reader        — client-side reader: pulls tasks, yields records,
+                         reports finish/failure (go/master/client.go
+                         NextRecord parity)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class Task:
+    task_id: int
+    chunks: List[Any]           # opaque chunk descriptors (paths, ranges…)
+    epoch: int = 0
+    num_failures: int = 0
+
+
+class KVStore:
+    """Snapshot store interface (the etcd stand-in)."""
+
+    def put(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+
+class InMemStore(KVStore):
+    """go/master/inmem_store.go parity."""
+
+    def __init__(self):
+        self._data: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key, value):
+        with self._lock:
+            self._data[key] = value
+
+    def get(self, key):
+        with self._lock:
+            return self._data.get(key)
+
+
+class FileStore(KVStore):
+    """Durable snapshot store on a shared filesystem (atomic rename)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key.replace("/", "_"))
+
+    def put(self, key, value):
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, self._path(key))
+
+    def get(self, key):
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+
+_SNAPSHOT_KEY = "coordinator/state"
+
+
+class Coordinator:
+    """Task dispatch with timeout re-queue and bounded failures.
+
+    Mirrors go/master/service.go taskQueues {todo, pending, done, failed}:
+    partition (:106), GetTask (:368), TaskFinished (:410), TaskFailed
+    (:448), checkTimeoutFunc (:341), snapshot (:207), recover (:166).
+    """
+
+    def __init__(self, chunks: Sequence[Any], chunks_per_task: int = 1,
+                 timeout_s: float = 60.0, failure_max: int = 3,
+                 store: Optional[KVStore] = None):
+        self.timeout_s = timeout_s
+        self.failure_max = failure_max
+        self.store = store or InMemStore()
+        self._lock = threading.Lock()
+        self._todo: List[Task] = []
+        self._pending: Dict[int, Dict[str, Any]] = {}   # id -> {task, deadline}
+        self._done: List[Task] = []
+        self._failed_dropped: List[Task] = []
+        self._epoch = 0
+        self._next_id = 0
+        self._chunks = list(chunks)
+        self._chunks_per_task = chunks_per_task
+        if not self._recover():
+            self._partition()
+            self._snapshot()
+
+    # ------------------------------------------------------------- queues
+    def _partition(self):
+        """service.go:106 — split chunk list into tasks."""
+        self._todo = []
+        cpt = self._chunks_per_task
+        for i in range(0, len(self._chunks), cpt):
+            self._todo.append(Task(self._next_id, self._chunks[i:i + cpt],
+                                   self._epoch))
+            self._next_id += 1
+
+    def get_task(self, epoch: Optional[int] = None
+                 ) -> Optional[Dict[str, Any]]:
+        """Next task (re-queueing timed-out pending tasks first). Returns
+        {task_id, chunks} or None when the queue is empty — pass the
+        `epoch` the caller is working on to also get None once that pass
+        has turned over (so per-pass readers terminate; the queue itself
+        refills every epoch like the Go master's turnover)."""
+        with self._lock:
+            self._requeue_timed_out()
+            if epoch is not None and self._epoch != epoch:
+                return None
+            if not self._todo:
+                return None
+            task = self._todo.pop(0)
+            self._pending[task.task_id] = {
+                "task": task, "deadline": time.time() + self.timeout_s}
+            self._snapshot()
+            return {"task_id": task.task_id, "chunks": task.chunks}
+
+    def task_finished(self, task_id: int) -> bool:
+        with self._lock:
+            ent = self._pending.pop(task_id, None)
+            if ent is None:
+                return False
+            self._done.append(ent["task"])
+            if not self._todo and not self._pending:
+                self._turn_epoch()
+            self._snapshot()
+            return True
+
+    def task_failed(self, task_id: int) -> bool:
+        """service.go:448 + processFailedTask:313 — re-queue with bounded
+        retries; after failure_max the task is dropped (bad data skipped,
+        training continues)."""
+        with self._lock:
+            ent = self._pending.pop(task_id, None)
+            if ent is None:
+                return False
+            task: Task = ent["task"]
+            task.num_failures += 1
+            if task.num_failures >= self.failure_max:
+                self._failed_dropped.append(task)
+            else:
+                self._todo.append(task)
+            if not self._todo and not self._pending:
+                self._turn_epoch()
+            self._snapshot()
+            return True
+
+    def _requeue_timed_out(self):
+        now = time.time()
+        for tid in list(self._pending):
+            if self._pending[tid]["deadline"] <= now:
+                ent = self._pending.pop(tid)
+                task = ent["task"]
+                task.num_failures += 1
+                if task.num_failures >= self.failure_max:
+                    self._failed_dropped.append(task)
+                else:
+                    self._todo.append(task)
+
+    def _turn_epoch(self):
+        """All tasks done: start the next pass (service.go:410 turns the
+        todo queue over from done)."""
+        self._epoch += 1
+        self._done = []
+        self._failed_dropped = []
+        self._partition()
+
+    # ------------------------------------------------------ pass tracking
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def num_dropped(self) -> int:
+        with self._lock:
+            return len(self._failed_dropped)
+
+    # --------------------------------------------------------- snapshots
+    def _snapshot(self):
+        """Gob-snapshot parity (service.go:207) — called under _lock."""
+        state = {
+            "epoch": self._epoch,
+            "next_id": self._next_id,
+            "todo": [dataclasses.asdict(t) for t in self._todo],
+            # pending tasks snapshot as todo: a recovered master must
+            # re-serve them (their trainers may have died with it)
+            "pending": [dataclasses.asdict(e["task"])
+                        for e in self._pending.values()],
+            "done": [dataclasses.asdict(t) for t in self._done],
+            "dropped": [dataclasses.asdict(t)
+                        for t in self._failed_dropped],
+            "chunks": self._chunks,
+            "chunks_per_task": self._chunks_per_task,
+        }
+        self.store.put(_SNAPSHOT_KEY, json.dumps(state).encode())
+
+    def _recover(self) -> bool:
+        """service.go:166 — restore queues from the store if present."""
+        blob = self.store.get(_SNAPSHOT_KEY)
+        if not blob:
+            return False
+        state = json.loads(blob.decode())
+        self._epoch = state["epoch"]
+        self._next_id = state["next_id"]
+        mk = lambda d: Task(**d)
+        self._todo = [mk(d) for d in state["todo"]] + \
+            [mk(d) for d in state["pending"]]
+        self._done = [mk(d) for d in state["done"]]
+        self._failed_dropped = [mk(d) for d in state["dropped"]]
+        self._chunks = state["chunks"]
+        self._chunks_per_task = state["chunks_per_task"]
+        self._pending = {}
+        return True
+
+    # ------------------------------------------------------- save election
+    _save_lock = threading.Lock()
+    _saving_for_epoch = -1
+
+    def request_save_model(self, epoch: int) -> bool:
+        """RequestSaveModel parity (service.go:474): exactly ONE caller per
+        epoch gets True and performs the save."""
+        with self._save_lock:
+            if self._saving_for_epoch >= epoch:
+                return False
+            self._saving_for_epoch = epoch
+            return True
+
+
+# ---------------------------------------------------------------------------
+# RPC wrapper (multi-process trainers; go net/rpc parity via stdlib)
+
+
+class CoordinatorServer:
+    """Expose a Coordinator over XML-RPC (threaded stdlib server)."""
+
+    def __init__(self, coordinator: Coordinator, host: str = "127.0.0.1",
+                 port: int = 0):
+        from xmlrpc.server import SimpleXMLRPCServer
+        self.coordinator = coordinator
+        self.server = SimpleXMLRPCServer((host, port), allow_none=True,
+                                         logRequests=False)
+        self.port = self.server.server_address[1]
+        for name in ("get_task", "task_finished", "task_failed",
+                     "request_save_model"):
+            self.server.register_function(getattr(coordinator, name), name)
+        self.server.register_function(lambda: coordinator.epoch, "epoch")
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def connect(host: str, port: int):
+    """Client proxy for a CoordinatorServer."""
+    from xmlrpc.client import ServerProxy
+    return ServerProxy(f"http://{host}:{port}", allow_none=True)
+
+
+# ---------------------------------------------------------------------------
+# client-side reader
+
+
+def task_reader(coordinator, chunk_reader: Callable[[Any], Any],
+                max_retries_idle: int = 0):
+    """Reader over coordinator-dispatched tasks (master client NextRecord
+    parity, go/master/client.go:232).
+
+    chunk_reader(chunk) -> iterable of records. Yields records; reports
+    task_finished after a task's chunks are exhausted and task_failed on a
+    reader exception (the task is then retried elsewhere, the bad task
+    bounded by failure_max)."""
+    def reader():
+        epoch0 = coordinator.epoch
+        while True:
+            t = coordinator.get_task(epoch0)
+            if t is None:
+                return                       # epoch drained
+            try:
+                for chunk in t["chunks"]:
+                    for rec in chunk_reader(chunk):
+                        yield rec
+            except Exception:
+                coordinator.task_failed(t["task_id"])
+                continue
+            coordinator.task_finished(t["task_id"])
+    return reader

@@ -187,6 +187,34 @@ class SGD:
                               {k: v / n for k, v in totals.items()})
 
     # ------------------------------------------------------------------
+    def save_checkpoint(self, manager, meta: Optional[Dict] = None) -> str:
+        """Full-state checkpoint (params + optimizer slots + layer state +
+        step counters) via a CheckpointManager — the Go-pserver
+        checkpoint-with-optimizer-state capability (go/pserver/
+        service.go:272, paddle/optimizer/serialization.h)."""
+        import numpy as _np
+        m = {"step_count": self._step_count,
+             "rng": _np.asarray(jax.random.key_data(self._rng)).tolist()}
+        m.update(meta or {})
+        return manager.save(self._step_count, self.parameters.raw,
+                            self.opt_state, self.parameters.state, m)
+
+    def restore_checkpoint(self, manager, step: Optional[int] = None) -> bool:
+        """Resume params/optimizer/state from the newest intact checkpoint
+        (LoadCheckpoint parity). Returns False if none exists."""
+        res = manager.restore(step)
+        if res is None:
+            return False
+        _, tree = res
+        self.parameters.replace(tree["params"])
+        self.parameters.state = tree["state"]
+        self.opt_state = tree["opt_state"]
+        self._step_count = int(tree["meta"].get("step_count", 0))
+        if "rng" in tree["meta"]:
+            self._rng = jax.random.wrap_key_data(
+                jnp.asarray(tree["meta"]["rng"], jnp.uint32))
+        return True
+
     def save_parameter_to_tar(self, f):
         self.parameters.to_tar(f)
 

@@ -1,0 +1,186 @@
+"""Elastic runtime tests — coordinator task dispatch/timeout/snapshot
+(go/master service_internal_test parity) and full-state checkpoint/resume
+(kill-a-host test of SURVEY.md §7 stage 8)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.trainer.checkpoint import CheckpointManager
+from paddle_tpu.trainer.coordinator import (Coordinator, CoordinatorServer,
+                                            FileStore, InMemStore, connect,
+                                            task_reader)
+
+
+class TestCoordinator:
+    def test_dispatch_and_finish_turns_epoch(self):
+        c = Coordinator(chunks=list(range(6)), chunks_per_task=2)
+        seen = []
+        for _ in range(3):
+            t = c.get_task()
+            seen.extend(t["chunks"])
+            assert c.task_finished(t["task_id"])
+        assert sorted(seen) == list(range(6))
+        assert c.epoch == 1                  # all done -> next pass
+        assert c.get_task() is not None      # epoch 1 re-serves tasks
+
+    def test_timeout_requeues(self):
+        c = Coordinator(chunks=[1, 2], chunks_per_task=1, timeout_s=0.05)
+        t1 = c.get_task()
+        t2 = c.get_task()
+        assert c.get_task() is None
+        time.sleep(0.08)                     # both time out
+        t3 = c.get_task()
+        assert t3 is not None                # re-served
+        assert t3["task_id"] in (t1["task_id"], t2["task_id"])
+
+    def test_failure_max_drops_task(self):
+        c = Coordinator(chunks=[1], chunks_per_task=1, failure_max=2)
+        t = c.get_task()
+        assert c.task_failed(t["task_id"])   # 1st failure: re-queued
+        t = c.get_task()
+        assert c.task_failed(t["task_id"])   # 2nd: dropped, epoch turns
+        assert c.epoch == 1
+
+    def test_snapshot_recover(self, tmp_path):
+        store = FileStore(str(tmp_path))
+        c1 = Coordinator(chunks=list(range(4)), chunks_per_task=1,
+                         store=store)
+        t = c1.get_task()                    # leaves one task pending
+        # master "crashes"; new master recovers from the store
+        c2 = Coordinator(chunks=[], store=store)
+        served = []
+        while True:
+            t2 = c2.get_task(c2.epoch if not served else epoch0)
+            if t2 is None:
+                break
+            if not served:
+                epoch0 = c2.epoch
+            served.append(t2["task_id"])
+            c2.task_finished(t2["task_id"])
+        # the pending task was re-served by the recovered master
+        assert t["task_id"] in served
+        assert len(served) == 4
+
+    def test_save_election(self):
+        c = Coordinator(chunks=[1])
+        grants = [c.request_save_model(0) for _ in range(5)]
+        assert grants.count(True) == 1
+        assert c.request_save_model(1) is True
+
+    def test_task_reader_skips_bad_chunk(self):
+        c = Coordinator(chunks=["a", "bad", "b"], chunks_per_task=1,
+                        failure_max=2)
+
+        def chunk_reader(chunk):
+            if chunk == "bad":
+                raise IOError("corrupt chunk")
+            yield from [f"{chunk}{i}" for i in range(2)]
+
+        recs = list(task_reader(c, chunk_reader)())
+        assert sorted(recs) == ["a0", "a1", "b0", "b1"]
+        assert c.num_dropped() in (0, 1)     # dropped or epoch turned
+
+    def test_rpc_server(self):
+        c = Coordinator(chunks=list(range(4)), chunks_per_task=2)
+        srv = CoordinatorServer(c).start()
+        try:
+            client = connect("127.0.0.1", srv.port)
+            t = client.get_task()
+            assert t is not None and len(t["chunks"]) == 2
+            assert client.task_finished(t["task_id"])
+            t2 = client.get_task()
+            assert client.task_failed(t2["task_id"])
+        finally:
+            srv.stop()
+
+
+def _trainer(seed=0):
+    from paddle_tpu.core import registry
+    registry.reset_name_counters()
+    paddle.init(use_tpu=False, seed=seed)
+    img = paddle.layer.data("x", paddle.data_type.dense_vector(16))
+    out = paddle.layer.fc(img, size=4, act=paddle.activation.Softmax(),
+                          name="out")
+    lbl = paddle.layer.data("y", paddle.data_type.integer_value(4))
+    cost = paddle.layer.classification_cost(out, lbl, name="cost")
+    params = paddle.create_parameters(paddle.Topology(cost))
+    return paddle.SGD(cost=cost, parameters=params,
+                      update_equation=paddle.optimizer.Adam(
+                          learning_rate=1e-2))
+
+
+def _reader(seed):
+    rng = np.random.RandomState(seed)
+    feats = rng.randn(32, 16).astype("float32")
+    labels = rng.randint(0, 4, 32)
+
+    def reader():
+        yield [(feats[i], int(labels[i])) for i in range(32)]
+    return reader
+
+
+class TestCheckpointResume:
+    def test_full_state_roundtrip(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        tr = _trainer()
+        tr.train(_reader(0), num_passes=2)
+        tr.save_checkpoint(mgr, meta={"pass": 2})
+
+        tr2 = _trainer()
+        assert tr2.restore_checkpoint(mgr)
+        for k, v in tr.parameters.raw.items():
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(tr2.parameters.raw[k]))
+        # optimizer slots (Adam moments) restored too
+        assert int(tr2.opt_state["step"]) == int(tr.opt_state["step"])
+
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        # uninterrupted: 4 passes
+        tr_full = _trainer()
+        tr_full.train(_reader(0), num_passes=4)
+
+        # interrupted: 2 passes, checkpoint, "crash", restore, 2 more
+        mgr = CheckpointManager(str(tmp_path))
+        tr_a = _trainer()
+        tr_a.train(_reader(0), num_passes=2)
+        tr_a.save_checkpoint(mgr)
+        tr_b = _trainer()
+        assert tr_b.restore_checkpoint(mgr)
+        tr_b.train(_reader(0), num_passes=2)
+
+        for k in tr_full.parameters.raw:
+            np.testing.assert_allclose(
+                np.asarray(tr_full.parameters.raw[k]),
+                np.asarray(tr_b.parameters.raw[k]), rtol=1e-5, atol=1e-6)
+
+    def test_async_write_and_corruption_fallback(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=3, async_write=True)
+        tr = _trainer()
+        tr.train(_reader(0), num_passes=1)
+        tr.save_checkpoint(mgr)
+        tr.train(_reader(0), num_passes=1)
+        tr.save_checkpoint(mgr)
+        mgr.wait()
+        steps = mgr.all_steps()
+        assert len(steps) == 2
+        # corrupt the newest -> restore falls back to the previous one
+        import os
+        newest = os.path.join(str(tmp_path), f"ckpt-{steps[-1]:010d}",
+                              "state.npz")
+        with open(newest, "wb") as f:
+            f.write(b"garbage")
+        assert mgr.latest_step() == steps[0]
+        tr2 = _trainer()
+        assert tr2.restore_checkpoint(mgr)
+
+    def test_keep_last_n(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        tr = _trainer()
+        for i in range(4):
+            tr.train(_reader(0), num_passes=1)
+            tr.save_checkpoint(mgr)
+        assert len(mgr.all_steps()) == 2
