@@ -35,6 +35,7 @@ from paddle_tpu.core import data_type
 from paddle_tpu import activation
 from paddle_tpu import attr
 from paddle_tpu import pooling
+from paddle_tpu import evaluator
 
 __all__ = [
     "init",

@@ -76,11 +76,18 @@ class Topology:
                 self.state_specs[ss.name] = ss
 
     # ------------------------------------------------------------------ init
-    def init_params(self, rng: Optional[jax.Array] = None) -> Dict[str, jax.Array]:
+    def init_params(self, rng: Optional[jax.Array] = None,
+                    only: Optional[Sequence[str]] = None
+                    ) -> Dict[str, jax.Array]:
+        """Initialize parameters. `only` restricts to a subset of names
+        (same per-name keys as a full init, so values are identical)."""
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        wanted = None if only is None else set(only)
         params = {}
         for i, (name, ps) in enumerate(sorted(self.param_specs.items())):
+            if wanted is not None and name not in wanted:
+                continue
             key = jax.random.fold_in(rng, i)
             params[name] = ps.initializer(key, tuple(ps.shape), ps.dtype)
         return params

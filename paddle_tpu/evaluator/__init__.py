@@ -1,0 +1,573 @@
+"""Evaluator framework — gserver/evaluators parity.
+
+The reference registers ~15 evaluator types (Evaluator.h:42, Evaluator.cpp
+REGISTER_EVALUATOR sites: classification_error, auc, precision_recall,
+pnpair, rankauc, sum, column_sum, chunk (ChunkEvaluator.cpp), ctc_edit
+_distance (CTCErrorEvaluator.cpp), maxid/maxframe/seqtext/value/gradient
+printers), evaluated per batch by the gradient machine and aggregated per
+pass into the event stream.
+
+TPU-first split: the per-sample hot math that belongs on device stays a
+metric layer inside the jitted step (classification_error); the streaming
+pass-level statistics (AUC buckets, chunk matching, edit distance, pair
+ordering) are HOST-side accumulators fed with fetched batch outputs —
+exactly where the reference ran them (always CPU), so they never poison
+the XLA step with dynamic shapes.
+
+API shape mirrors v2 (`paddle.evaluator.auc(input=..., label=...)`), but
+instances are passed explicitly to ``SGD(evaluators=[...])`` /
+``infer``-side helpers rather than hiding in graph-build global state —
+explicit wiring is the JAX idiom.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.core.registry import LayerOutput
+
+__all__ = [
+    "Evaluator", "auc", "classification_error", "precision_recall",
+    "chunk", "ctc_error", "pnpair", "rank_auc", "sum_evaluator",
+    "column_sum", "maxid_printer", "value_printer",
+]
+
+
+def _to_np(x):
+    """Fetch a step output to host. SequenceBatch -> (data, lengths)."""
+    from paddle_tpu.core.sequence import SequenceBatch
+    if isinstance(x, SequenceBatch):
+        return (np.asarray(x.data), np.asarray(x.lengths))
+    return np.asarray(x)
+
+
+def _rows(x, n_real: int):
+    """First n_real rows of an output (drop feed padding)."""
+    if isinstance(x, tuple):      # (data, lengths) from a SequenceBatch
+        return (x[0][:n_real], x[1][:n_real])
+    return x[:n_real]
+
+
+class Evaluator:
+    """Base: start() -> eval_batch(per batch) -> result() per pass."""
+
+    name: str = "evaluator"
+    #: LayerOutputs whose values this evaluator consumes each batch.
+    inputs: List[LayerOutput]
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def eval_batch(self, values: Sequence[Any], n_real: int) -> None:
+        """values: host arrays for self.inputs, in order."""
+        raise NotImplementedError
+
+    def result(self) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def __str__(self):
+        return " ".join(f"{k}={v:.6g}" for k, v in self.result().items())
+
+
+# ---------------------------------------------------------------------------
+# AUC (streaming, bucketed — AucEvaluator parity)
+
+
+class AucEvaluator(Evaluator):
+    """Streaming ROC AUC over score buckets (Evaluator.cpp AucEvaluator).
+
+    input: probability output — [b] / [b,1] score of the positive class,
+    or [b,2] softmax (column 1 taken). label: [b] in {0,1}.
+    """
+
+    def __init__(self, input: LayerOutput, label: LayerOutput,
+                 num_buckets: int = 1 << 12, name: str = "auc"):
+        self.name = name
+        self.inputs = [input, label]
+        self.num_buckets = num_buckets
+        self.start()
+
+    def start(self):
+        self._pos = np.zeros(self.num_buckets, np.int64)
+        self._neg = np.zeros(self.num_buckets, np.int64)
+
+    def eval_batch(self, values, n_real):
+        score, label = (_rows(v, n_real) for v in values)
+        score = np.asarray(score, np.float64)
+        if score.ndim == 2:
+            score = score[:, -1] if score.shape[1] <= 2 else score[:, 1]
+        label = np.asarray(label).reshape(-1).astype(np.int64)
+        idx = np.clip((score * self.num_buckets).astype(np.int64),
+                      0, self.num_buckets - 1)
+        np.add.at(self._pos, idx[label == 1], 1)
+        np.add.at(self._neg, idx[label != 1], 1)
+
+    def result(self):
+        P, N = self._pos.sum(), self._neg.sum()
+        if P == 0 or N == 0:
+            return {self.name: 0.0}
+        cum_neg_below = np.concatenate([[0], np.cumsum(self._neg)[:-1]])
+        correct = np.sum(self._pos * (cum_neg_below + 0.5 * self._neg))
+        return {self.name: float(correct / (P * N))}
+
+
+# ---------------------------------------------------------------------------
+# precision / recall / F1
+
+
+class PrecisionRecallEvaluator(Evaluator):
+    """Per-class TP/FP/FN counts (PrecisionRecallEvaluator parity).
+
+    input: [b, n_classes] probabilities (argmax taken) or [b] predicted
+    ids; label: [b] int class ids. With positive_label set, reports the
+    binary precision/recall/F1 of that class; otherwise macro-averaged.
+    """
+
+    def __init__(self, input: LayerOutput, label: LayerOutput,
+                 positive_label: Optional[int] = None,
+                 name: str = "precision_recall"):
+        self.name = name
+        self.inputs = [input, label]
+        self.positive_label = positive_label
+        self.start()
+
+    def start(self):
+        self._tp: Dict[int, int] = {}
+        self._fp: Dict[int, int] = {}
+        self._fn: Dict[int, int] = {}
+
+    def eval_batch(self, values, n_real):
+        pred, label = (_rows(v, n_real) for v in values)
+        pred = np.asarray(pred)
+        if pred.ndim == 2:
+            pred = pred.argmax(-1)
+        pred = pred.reshape(-1).astype(np.int64)
+        label = np.asarray(label).reshape(-1).astype(np.int64)
+        for c in np.unique(np.concatenate([pred, label])):
+            c = int(c)
+            self._tp[c] = self._tp.get(c, 0) + int(
+                np.sum((pred == c) & (label == c)))
+            self._fp[c] = self._fp.get(c, 0) + int(
+                np.sum((pred == c) & (label != c)))
+            self._fn[c] = self._fn.get(c, 0) + int(
+                np.sum((pred != c) & (label == c)))
+
+    @staticmethod
+    def _prf(tp, fp, fn):
+        p = tp / (tp + fp) if tp + fp else 0.0
+        r = tp / (tp + fn) if tp + fn else 0.0
+        f = 2 * p * r / (p + r) if p + r else 0.0
+        return p, r, f
+
+    def result(self):
+        if self.positive_label is not None:
+            c = self.positive_label
+            p, r, f = self._prf(self._tp.get(c, 0), self._fp.get(c, 0),
+                                self._fn.get(c, 0))
+        else:
+            classes = sorted(self._tp)
+            if not classes:
+                p = r = f = 0.0
+            else:
+                prf = [self._prf(self._tp[c], self._fp[c], self._fn[c])
+                       for c in classes]
+                p, r, f = (float(np.mean([x[i] for x in prf]))
+                           for i in range(3))
+        return {f"{self.name}_precision": p, f"{self.name}_recall": r,
+                f"{self.name}_f1": f}
+
+
+# ---------------------------------------------------------------------------
+# chunk F1 (NER — ChunkEvaluator.cpp parity)
+
+
+def extract_chunks(ids: np.ndarray, scheme: str, num_chunk_types: int):
+    """Decode (begin, end, type) chunks from a tag-id sequence.
+
+    Label encoding follows ChunkEvaluator.cpp: with T tag positions per
+    scheme (IOB:2 [B,I], IOE:2 [I,E], IOBES:4 [B,I,E,S], plain:1),
+    id = chunk_type * T + tag, and the single "other/O" id is
+    num_chunk_types * T.
+    """
+    tag_num = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    other = num_chunk_types * tag_num
+    chunks = []
+    start, ctype = None, None
+
+    def is_begin(tag, prev_tag, prev_type, typ):
+        if scheme == "plain":
+            return prev_type != typ or prev_tag is None
+        if scheme == "IOB":
+            return tag == 0 or prev_type != typ
+        if scheme == "IOE":
+            # begins when previous ended (prev tag E) or type changed
+            return prev_tag in (None, 1) or prev_type != typ
+        if scheme == "IOBES":
+            return tag in (0, 3) or prev_type != typ
+        raise ValueError(scheme)
+
+    prev_tag = prev_type = None
+    for i, lab in enumerate(np.asarray(ids).tolist()):
+        if lab == other or lab < 0 or lab > other:
+            if start is not None:
+                chunks.append((start, i - 1, ctype))
+            start = ctype = None
+            prev_tag = prev_type = None
+            continue
+        tag, typ = lab % tag_num, lab // tag_num
+        if is_begin(tag, prev_tag, prev_type, typ):
+            if start is not None:
+                chunks.append((start, i - 1, ctype))
+            start, ctype = i, typ
+        if scheme == "IOE" and tag == 1:       # E closes the chunk
+            chunks.append((start, i, ctype))
+            start = ctype = None
+        elif scheme == "IOBES" and tag in (2, 3):   # E / S close
+            chunks.append((start, i, ctype))
+            start = ctype = None
+        prev_tag, prev_type = tag, typ
+    if start is not None:
+        chunks.append((start, len(np.asarray(ids)) - 1, ctype))
+    return chunks
+
+
+class ChunkEvaluator(Evaluator):
+    """Chunk-level precision/recall/F1 for sequence tagging
+    (ChunkEvaluator.cpp — the CRF/NER metric).
+
+    input / label: SequenceBatch of tag ids ([b, T] + lengths), e.g. the
+    crf_decoding output vs the gold tags.
+    """
+
+    def __init__(self, input: LayerOutput, label: LayerOutput,
+                 chunk_scheme: str = "IOB", num_chunk_types: int = 1,
+                 name: str = "chunk"):
+        assert chunk_scheme in ("plain", "IOB", "IOE", "IOBES")
+        self.name = name
+        self.inputs = [input, label]
+        self.scheme = chunk_scheme
+        self.num_chunk_types = num_chunk_types
+        self.start()
+
+    def start(self):
+        self._correct = self._pred = self._gold = 0
+
+    def _seq_iter(self, v):
+        if isinstance(v, tuple):
+            data, lengths = v
+            for row, ln in zip(data, lengths):
+                yield row[: int(ln)]
+        else:                                   # dense [b, T]
+            for row in v:
+                yield row
+
+    def eval_batch(self, values, n_real):
+        pred, gold = (_rows(v, n_real) for v in values)
+        for p_row, g_row in zip(self._seq_iter(pred), self._seq_iter(gold)):
+            pc = set(extract_chunks(p_row, self.scheme, self.num_chunk_types))
+            gc = set(extract_chunks(g_row, self.scheme, self.num_chunk_types))
+            self._correct += len(pc & gc)
+            self._pred += len(pc)
+            self._gold += len(gc)
+
+    def result(self):
+        p = self._correct / self._pred if self._pred else 0.0
+        r = self._correct / self._gold if self._gold else 0.0
+        f = 2 * p * r / (p + r) if p + r else 0.0
+        return {f"{self.name}_precision": p, f"{self.name}_recall": r,
+                f"{self.name}_f1": f}
+
+
+# ---------------------------------------------------------------------------
+# CTC edit distance (CTCErrorEvaluator.cpp parity)
+
+
+def edit_distance(a: Sequence[int], b: Sequence[int]) -> int:
+    """Levenshtein distance (insert/delete/substitute, all cost 1)."""
+    a, b = list(a), list(b)
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (ca != cb))
+        prev = cur
+    return prev[-1]
+
+
+class CTCErrorEvaluator(Evaluator):
+    """Sequence error rate: edit_distance(best-path CTC decode, label) /
+    label length, averaged per pass (CTCErrorEvaluator.cpp).
+
+    input: SequenceBatch of per-frame class scores [b, T, C] (or already
+    -decoded id sequences [b, T]); label: SequenceBatch of target ids.
+    blank: id of the CTC blank — default 0, matching layer.ctc's default
+    (layers/crf_layers.py).
+    """
+
+    def __init__(self, input: LayerOutput, label: LayerOutput,
+                 blank: int = 0, name: str = "ctc_error"):
+        self.name = name
+        self.inputs = [input, label]
+        self.blank = blank
+        self.start()
+
+    def start(self):
+        self._dist = 0.0
+        self._len = 0
+
+    def _decode(self, frames):
+        """Best-path: argmax per frame, collapse repeats, drop blanks."""
+        ids = frames.argmax(-1) if frames.ndim == 2 else frames
+        out, prev = [], None
+        for t in ids.tolist():
+            if t != prev and t != self.blank:
+                out.append(t)
+            prev = t
+        return out
+
+    def eval_batch(self, values, n_real):
+        pred, gold = (_rows(v, n_real) for v in values)
+        pred_it = (row[: int(ln)] for row, ln in zip(*pred)) \
+            if isinstance(pred, tuple) else iter(pred)
+        gold_it = (row[: int(ln)] for row, ln in zip(*gold)) \
+            if isinstance(gold, tuple) else iter(gold)
+        for p_row, g_row in zip(pred_it, gold_it):
+            hyp = self._decode(np.asarray(p_row))
+            ref = np.asarray(g_row).reshape(-1).tolist()
+            self._dist += edit_distance(hyp, ref)
+            self._len += max(len(ref), 1)
+
+    def result(self):
+        return {self.name: self._dist / self._len if self._len else 0.0}
+
+
+# ---------------------------------------------------------------------------
+# pair ordering metrics (PnpairEvaluator / RankAucEvaluator parity)
+
+
+class PnpairEvaluator(Evaluator):
+    """Positive-negative pair ordering within query groups
+    (PnpairEvaluator: counts pairs where the higher-labelled sample also
+    scored higher; reports pos/neg ratio).
+
+    inputs: score [b], label [b] (graded relevance), query_id [b].
+    """
+
+    def __init__(self, input: LayerOutput, label: LayerOutput,
+                 query_id: LayerOutput, name: str = "pnpair"):
+        self.name = name
+        self.inputs = [input, label, query_id]
+        self.start()
+
+    def start(self):
+        self._pos = self._neg = self._tie = 0
+
+    def eval_batch(self, values, n_real):
+        score, label, qid = (np.asarray(_rows(v, n_real)).reshape(-1)
+                             for v in values)
+        for q in np.unique(qid):
+            m = qid == q
+            s, l = score[m], label[m]
+            ds = s[:, None] - s[None, :]
+            dl = l[:, None] - l[None, :]
+            upper = np.triu(np.ones_like(ds, bool), 1) & (dl != 0)
+            agree = np.sign(ds) == np.sign(dl)
+            self._pos += int(np.sum(upper & agree & (ds != 0)))
+            self._tie += int(np.sum(upper & (ds == 0)))
+            self._neg += int(np.sum(upper & ~agree & (ds != 0)))
+
+    def result(self):
+        return {f"{self.name}_pos": float(self._pos),
+                f"{self.name}_neg": float(self._neg),
+                f"{self.name}_ratio":
+                    self._pos / self._neg if self._neg else float(self._pos)}
+
+
+class RankAucEvaluator(Evaluator):
+    """Query-averaged pairwise AUC over graded labels (RankAucEvaluator):
+    fraction of correctly-ordered (non-tied) pairs, ties counted half."""
+
+    def __init__(self, input: LayerOutput, label: LayerOutput,
+                 query_id: LayerOutput, name: str = "rank_auc"):
+        self.name = name
+        self.inputs = [input, label, query_id]
+        self.start()
+
+    def start(self):
+        self._auc_sum = 0.0
+        self._n_queries = 0
+
+    def eval_batch(self, values, n_real):
+        score, label, qid = (np.asarray(_rows(v, n_real)).reshape(-1)
+                             for v in values)
+        for q in np.unique(qid):
+            m = qid == q
+            s, l = score[m], label[m]
+            ds = s[:, None] - s[None, :]
+            dl = l[:, None] - l[None, :]
+            valid = np.triu(np.ones_like(ds, bool), 1) & (dl != 0)
+            n = int(valid.sum())
+            if n == 0:
+                continue
+            agree = (np.sign(ds) == np.sign(dl)) & (ds != 0)
+            ties = ds == 0
+            self._auc_sum += (np.sum(valid & agree) +
+                              0.5 * np.sum(valid & ties)) / n
+            self._n_queries += 1
+
+    def result(self):
+        return {self.name: self._auc_sum / self._n_queries
+                if self._n_queries else 0.0}
+
+
+# ---------------------------------------------------------------------------
+# sums + printers
+
+
+class SumEvaluator(Evaluator):
+    """Pass-total of an output (SumEvaluator)."""
+
+    def __init__(self, input: LayerOutput, name: str = "sum"):
+        self.name = name
+        self.inputs = [input]
+        self.start()
+
+    def start(self):
+        self._sum = 0.0
+
+    def eval_batch(self, values, n_real):
+        v = _rows(values[0], n_real)
+        if isinstance(v, tuple):
+            data, lengths = v
+            t = np.arange(data.shape[1])[None, :] < lengths[:, None]
+            v = data * t.reshape(t.shape + (1,) * (data.ndim - 2))
+        self._sum += float(np.sum(v))
+
+    def result(self):
+        return {self.name: self._sum}
+
+
+class ColumnSumEvaluator(Evaluator):
+    """Pass-total of one column (ColumnSumEvaluator)."""
+
+    def __init__(self, input: LayerOutput, column: int = 0,
+                 name: str = "column_sum"):
+        self.name = name
+        self.inputs = [input]
+        self.column = column
+        self.start()
+
+    def start(self):
+        self._sum = 0.0
+
+    def eval_batch(self, values, n_real):
+        v = np.asarray(_rows(values[0], n_real))
+        self._sum += float(np.sum(v.reshape(v.shape[0], -1)[:, self.column]))
+
+    def result(self):
+        return {self.name: self._sum}
+
+
+class ClassificationErrorEvaluator(Evaluator):
+    """Host-side error rate (ClassificationErrorEvaluator; the device
+    metric layer `classification_error` is usually preferable)."""
+
+    def __init__(self, input: LayerOutput, label: LayerOutput,
+                 top_k: int = 1, name: str = "classification_error"):
+        self.name = name
+        self.inputs = [input, label]
+        self.top_k = top_k
+        self.start()
+
+    def start(self):
+        self._wrong = self._total = 0
+
+    def eval_batch(self, values, n_real):
+        probs, label = (_rows(v, n_real) for v in values)
+        probs = np.asarray(probs)
+        label = np.asarray(label).reshape(-1)
+        topk = np.argsort(-probs, axis=-1)[:, : self.top_k]
+        hit = (topk == label[:, None]).any(axis=1)
+        self._wrong += int(np.sum(~hit))
+        self._total += len(label)
+
+    def result(self):
+        return {self.name: self._wrong / self._total if self._total else 0.0}
+
+
+class PrinterEvaluator(Evaluator):
+    """Debug printer (ValuePrinter / MaxIdPrinter / SeqTextPrinter):
+    prints per batch, contributes no metrics."""
+
+    def __init__(self, input: LayerOutput, mode: str = "value",
+                 name: str = "printer", stream=None):
+        self.name = name
+        self.inputs = [input]
+        self.mode = mode
+        self.stream = stream
+
+    def start(self):
+        pass
+
+    def eval_batch(self, values, n_real):
+        import sys
+        v = _rows(values[0], n_real)
+        arr = v[0] if isinstance(v, tuple) else v
+        arr = np.asarray(arr)
+        if self.mode == "maxid" and arr.ndim >= 2:
+            arr = arr.argmax(-1)
+        print(f"[{self.name}] {arr}", file=self.stream or sys.stdout)
+
+    def result(self):
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# v2-style DSL constructors (trainer_config_helpers/evaluators.py names)
+
+
+def auc(input, label, **kw):
+    return AucEvaluator(input, label, **kw)
+
+
+def classification_error(input, label, **kw):
+    return ClassificationErrorEvaluator(input, label, **kw)
+
+
+def precision_recall(input, label, **kw):
+    return PrecisionRecallEvaluator(input, label, **kw)
+
+
+def chunk(input, label, **kw):
+    return ChunkEvaluator(input, label, **kw)
+
+
+def ctc_error(input, label, **kw):
+    return CTCErrorEvaluator(input, label, **kw)
+
+
+def pnpair(input, label, query_id, **kw):
+    return PnpairEvaluator(input, label, query_id, **kw)
+
+
+def rank_auc(input, label, query_id, **kw):
+    return RankAucEvaluator(input, label, query_id, **kw)
+
+
+def sum_evaluator(input, **kw):
+    return SumEvaluator(input, **kw)
+
+
+def column_sum(input, **kw):
+    return ColumnSumEvaluator(input, **kw)
+
+
+def maxid_printer(input, **kw):
+    return PrinterEvaluator(input, mode="maxid", **kw)
+
+
+def value_printer(input, **kw):
+    return PrinterEvaluator(input, mode="value", **kw)

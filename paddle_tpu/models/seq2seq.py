@@ -49,16 +49,15 @@ def _decoder_step_factory(dec_size: int, trg_vocab: int, name: str = "dec",
             encoded_sequence=enc_seq, encoded_proj=enc_proj,
             decoder_state=mem, name=f"{name}_attn",
             softmax_param_attr=ParamAttr(name=f"_{name}_attn_w"))
+        # Only the input projection feeds gru_step: the recurrent (h,3h)
+        # contribution is owned by GruStepLayer itself (reference decoder
+        # passes just the input projection — gru_unit, networks.py:1298).
         inputs = layer.fc(layer.concat([context, cur_emb],
                                        name=f"{name}_in_concat"),
                           size=dec_size * 3, act=None, bias_attr=False,
                           name=f"{name}_in_proj",
                           param_attr=ParamAttr(name=f"_{name}_inproj_w"))
-        state_proj = layer.fc(mem, size=dec_size * 3, act=None,
-                              bias_attr=False, name=f"{name}_state_proj",
-                              param_attr=ParamAttr(name=f"_{name}_sproj_w"))
-        gru_in = layer.addto([inputs, state_proj], name=f"{name}_gru_in")
-        nxt = layer.gru_step(gru_in, output_mem=mem, size=dec_size,
+        nxt = layer.gru_step(inputs, output_mem=mem, size=dec_size,
                              name=f"{name}_state",
                              param_attr=ParamAttr(name=f"_{name}_gru_w"),
                              bias_attr=ParamAttr(name=f"_{name}_gru_b"))

@@ -12,6 +12,7 @@ from __future__ import annotations
 from paddle_tpu import activation as act
 from paddle_tpu import layers as layer
 from paddle_tpu.core.data_type import integer_value_sequence
+from paddle_tpu.core.registry import ParamAttr
 from paddle_tpu.models.image import ModelSpec
 
 
@@ -26,9 +27,13 @@ def crf_tagger(vocab_size: int = 20000, num_labels: int = 45,
     hidden = layer.fc(ctx, size=hidden_size, act=act.Tanh(), name="crf_h")
     emission = layer.fc(hidden, size=num_labels, act=None,
                         name="crf_emission")
-    cost = layer.crf(emission, labels, size=num_labels, name="crf_cost")
-    decoded = layer.crf_decoding(emission, size=num_labels, label=labels,
-                                 name="crf_decode")
+    # decode shares the SAME transition parameter as the training CRF
+    # (reference: CRFDecodingLayer reuses the CRFLayer weight by name)
+    crf_w = ParamAttr(name="_crf_trans_w")
+    cost = layer.crf(emission, labels, size=num_labels, name="crf_cost",
+                     param_attr=crf_w)
+    decoded = layer.crf_decoding(emission, size=num_labels,
+                                 name="crf_decode", param_attr=crf_w)
     spec = ModelSpec("crf_tagger", words, labels, emission, cost, None)
     spec.decoded = decoded
     return spec
@@ -47,9 +52,11 @@ def rnn_crf_tagger(vocab_size: int = 20000, num_labels: int = 45,
     merged = layer.concat([fwd, bwd], name="rcrf_concat")
     emission = layer.fc(merged, size=num_labels, act=None,
                         name="rcrf_emission")
-    cost = layer.crf(emission, labels, size=num_labels, name="rcrf_cost")
-    decoded = layer.crf_decoding(emission, size=num_labels, label=labels,
-                                 name="rcrf_decode")
+    crf_w = ParamAttr(name="_rcrf_trans_w")
+    cost = layer.crf(emission, labels, size=num_labels, name="rcrf_cost",
+                     param_attr=crf_w)
+    decoded = layer.crf_decoding(emission, size=num_labels,
+                                 name="rcrf_decode", param_attr=crf_w)
     spec = ModelSpec("rnn_crf_tagger", words, labels, emission, cost, None)
     spec.decoded = decoded
     return spec

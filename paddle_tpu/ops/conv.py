@@ -38,6 +38,10 @@ def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0,
     dh, dw = _pair(dilation)
     cd = compute_dtype()
     out_dtype = x.dtype
+    # On the bf16 path we must NOT pass preferred_element_type: the conv
+    # VJP rule can't transpose mixed (bf16 operand, f32 cotangent) convs.
+    # The MXU accumulates bf16 passes in f32 internally either way.
+    pet = jnp.float32 if cd == jnp.float32 else None
     if cd != jnp.float32:
         x = x.astype(cd)
         w = w.astype(cd)
@@ -49,7 +53,7 @@ def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=groups,
         precision=_prec(),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=pet,
     )
     return y.astype(out_dtype)
 
@@ -62,6 +66,7 @@ def conv2d_transpose(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0) -> 
     kh, kw = w.shape[0], w.shape[1]
     cd = compute_dtype()
     out_dtype = x.dtype
+    pet = jnp.float32 if cd == jnp.float32 else None
     if cd != jnp.float32:
         x = x.astype(cd)
         w = w.astype(cd)
@@ -71,7 +76,7 @@ def conv2d_transpose(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0) -> 
         padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         precision=_prec(),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=pet,
     )
     return y.astype(out_dtype)
 
@@ -85,6 +90,7 @@ def conv3d(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0) -> jnp.ndarra
     pads = tuple((p, p) for p in padding)
     cd = compute_dtype()
     out_dtype = x.dtype
+    pet = jnp.float32 if cd == jnp.float32 else None
     if cd != jnp.float32:
         x = x.astype(cd)
         w = w.astype(cd)
@@ -92,7 +98,7 @@ def conv3d(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0) -> jnp.ndarra
         x, w, window_strides=tuple(stride), padding=pads,
         dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
         precision=_prec(),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=pet)
     return y.astype(out_dtype)
 
 

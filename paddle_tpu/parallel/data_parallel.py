@@ -48,12 +48,14 @@ def shard_train_step(step_fn: Callable, mesh: Mesh,
     # out_shardings must pin the params/opt outputs to the SAME shardings as
     # the inputs: otherwise XLA's propagated output shardings (e.g. a bias
     # grad picking up mp from its matmul) poison the next call's args.
+    # The 6th output (evaluator input values) is gathered to replicated so
+    # host-side evaluators see the full batch.
     return jax.jit(
         sharded,
         in_shardings=(param_shardings or repl, opt_shardings or repl,
                       repl, None, repl, repl),
         out_shardings=(param_shardings or repl, opt_shardings or repl,
-                       repl, repl, repl),
+                       repl, repl, repl, repl),
         donate_argnums=(0, 1, 2),
     )
 

@@ -106,6 +106,8 @@ class Coordinator:
         self.failure_max = failure_max
         self.store = store or InMemStore()
         self._lock = threading.Lock()
+        self._save_lock = threading.Lock()
+        self._saving_for_epoch = -1
         self._todo: List[Task] = []
         self._pending: Dict[int, Dict[str, Any]] = {}   # id -> {task, deadline}
         self._done: List[Task] = []
@@ -179,15 +181,28 @@ class Coordinator:
 
     def _requeue_timed_out(self):
         now = time.time()
+        mutated = False
         for tid in list(self._pending):
             if self._pending[tid]["deadline"] <= now:
                 ent = self._pending.pop(tid)
                 task = ent["task"]
                 task.num_failures += 1
+                mutated = True
                 if task.num_failures >= self.failure_max:
                     self._failed_dropped.append(task)
                 else:
                     self._todo.append(task)
+        # Mirror task_failed: if the last outstanding task died by timeout
+        # (its trainer crashed — the module's whole point) the pass must
+        # still turn over, or the queue drains forever (processFailedTask
+        # behavior, go/master/service.go:313).
+        if not self._todo and not self._pending and \
+                (self._done or self._failed_dropped):
+            self._turn_epoch()
+        if mutated:
+            # persist failure counts / turnover even if the caller's
+            # get_task then returns None (a restart must not reset them)
+            self._snapshot()
 
     def _turn_epoch(self):
         """All tasks done: start the next pass (service.go:410 turns the
@@ -245,9 +260,6 @@ class Coordinator:
         return True
 
     # ------------------------------------------------------- save election
-    _save_lock = threading.Lock()
-    _saving_for_epoch = -1
-
     def request_save_model(self, epoch: int) -> bool:
         """RequestSaveModel parity (service.go:474): exactly ONE caller per
         epoch gets True and performs the save."""
@@ -309,7 +321,10 @@ def task_reader(coordinator, chunk_reader: Callable[[Any], Any],
     reader exception (the task is then retried elsewhere, the bad task
     bounded by failure_max)."""
     def reader():
-        epoch0 = coordinator.epoch
+        # Over RPC (CoordinatorServer + connect) `epoch` is a registered
+        # function; in-process it is a property.  Support both.
+        e = coordinator.epoch
+        epoch0 = e() if callable(e) else e
         while True:
             t = coordinator.get_task(epoch0)
             if t is None:

@@ -40,11 +40,29 @@ class SGD:
 
     def __init__(self, cost, parameters: Parameters, update_equation,
                  extra_layers: Optional[Sequence[LayerOutput]] = None,
-                 is_local: bool = True, mesh=None, **kwargs):
+                 is_local: bool = True, mesh=None, evaluators=None,
+                 **kwargs):
         costs = cost if isinstance(cost, (list, tuple)) else [cost]
         self.costs = list(costs)
         self.extra_layers = list(extra_layers or [])
-        self.topology = Topology(self.costs, extra_outputs=self.extra_layers)
+        # Evaluator framework (gserver/evaluators parity): their input
+        # layers become extra topology outputs; per-batch values feed the
+        # host-side streaming accumulators (see paddle_tpu/evaluator).
+        self.evaluators = list(evaluators or [])
+        eval_inputs: List[LayerOutput] = []
+        seen = {c.name for c in self.costs} | \
+            {e.name for e in self.extra_layers}
+        for ev in self.evaluators:
+            for li in ev.inputs:
+                if li.name not in seen and hasattr(li, "parents"):
+                    # real graph nodes become extra outputs; name-only
+                    # references (data/feed layers) resolve from the feed
+                    seen.add(li.name)
+                    eval_inputs.append(li)
+        self._eval_out_names = sorted({li.name for ev in self.evaluators
+                                       for li in ev.inputs})
+        self.topology = Topology(
+            self.costs, extra_outputs=self.extra_layers + eval_inputs)
         self.parameters = parameters
         # ensure state entries exist (parameters.create fills them, but a
         # Parameters loaded from tar may lack new state keys)
@@ -52,6 +70,15 @@ class SGD:
             if name not in parameters.state:
                 parameters.state[name] = jnp.full(
                     tuple(spec.shape), spec.init_value, spec.dtype)
+        # likewise params: evaluator inputs may pull in layers (and their
+        # params) that the cost-only topology the user created params from
+        # never reached
+        missing = [n for n in self.topology.param_specs
+                   if n not in parameters.raw]
+        if missing:
+            fresh = self.topology.init_params(
+                jax.random.PRNGKey(global_config().seed), only=missing)
+            parameters.raw.update(fresh)
         self.optimizer = update_equation.bind(self.topology.param_specs)
         self.opt_state = self.optimizer.init_state(parameters.raw)
         self._rng = jax.random.PRNGKey(global_config().seed)
@@ -89,17 +116,21 @@ class SGD:
                 row_mask = (jnp.arange(v.shape[0]) < n_real).astype(v.dtype)
                 metrics[e.name] = jnp.sum(v * row_mask) / jnp.maximum(
                     n_real.astype(v.dtype), 1.0)
-        return total, (metrics, new_state)
+        # evaluator inputs: graph outputs, or raw feed entries (labels)
+        eval_outs = {n: (outs[n] if n in outs else feed[n])
+                     for n in self._eval_out_names}
+        return total, (metrics, new_state, eval_outs)
 
     def _build_train_step(self):
         def step(params, opt_state, state, feed, rng, n_real):
             grad_fn = jax.value_and_grad(
                 lambda p: self._loss_and_metrics(p, state, feed, rng, n_real,
                                                  "train"), has_aux=True)
-            (loss, (metrics, new_state)), grads = grad_fn(params)
+            (loss, (metrics, new_state, eval_outs)), grads = grad_fn(params)
             new_params, new_opt_state = self.optimizer.update(
                 params, grads, opt_state, n_real.astype(jnp.float32))
-            return new_params, new_opt_state, new_state, loss, metrics
+            return (new_params, new_opt_state, new_state, loss, metrics,
+                    eval_outs)
 
         if self.mesh is not None:
             from paddle_tpu.parallel import tensor_parallel as tp
@@ -122,9 +153,9 @@ class SGD:
 
     def _build_test_step(self):
         def step(params, state, feed, n_real):
-            loss, (metrics, _) = self._loss_and_metrics(
+            loss, (metrics, _, eval_outs) = self._loss_and_metrics(
                 params, state, feed, jax.random.PRNGKey(0), n_real, "test")
-            return loss, metrics
+            return loss, metrics, eval_outs
         return jax.jit(step)
 
     # ------------------------------------------------------------------
@@ -141,6 +172,8 @@ class SGD:
             event_handler(evt.BeginPass(pass_id))
             pass_metrics: Dict[str, float] = {}
             n_batches = 0
+            for ev in self.evaluators:
+                ev.start()
             for batch_id, data_batch in enumerate(reader()):
                 if num_batches_per_pass is not None and \
                         batch_id >= num_batches_per_pass:
@@ -151,7 +184,7 @@ class SGD:
                 self._rng, sub = jax.random.split(self._rng)
                 with stat_timer("train_step"):
                     (new_params, self.opt_state, new_state, loss,
-                     metrics) = self._train_step(
+                     metrics, eval_outs) = self._train_step(
                         self.parameters.raw, self.opt_state,
                         self.parameters.state, feed, sub, n_real)
                 self.parameters.replace(new_params)
@@ -161,9 +194,13 @@ class SGD:
                 for k, v in metrics_np.items():
                     pass_metrics[k] = pass_metrics.get(k, 0.0) + v
                 n_batches += 1
+                metrics_np.update(
+                    self._feed_evaluators(eval_outs, int(n_real)))
                 event_handler(evt.EndIteration(pass_id, batch_id,
                                                float(loss), metrics_np))
             avg = {k: v / max(n_batches, 1) for k, v in pass_metrics.items()}
+            for ev in self.evaluators:
+                avg.update(ev.result())
             event_handler(evt.EndPass(pass_id, avg, self.parameters))
 
     def test(self, reader, feeding=None) -> evt.TestResult:
@@ -173,18 +210,44 @@ class SGD:
         total_loss, n = 0.0, 0
         params = self.optimizer.test_params(self.parameters.raw,
                                             self.opt_state)
+        # test() may run mid-pass (from an EndIteration handler): save the
+        # evaluators' training accumulators and restore them afterwards so
+        # the train pass's metrics aren't corrupted by the test sweep.
+        import copy
+        saved = [{k: copy.deepcopy(v) for k, v in ev.__dict__.items()
+                  if k != "inputs"} for ev in self.evaluators]
+        for ev in self.evaluators:
+            ev.start()
         for data_batch in reader():
             feed = feeder(data_batch)
             n_real = jnp.asarray(feed.pop("__batch_size__"), jnp.int32)
-            loss, metrics = self._test_step(params, self.parameters.state,
-                                            feed, n_real)
+            loss, metrics, eval_outs = self._test_step(
+                params, self.parameters.state, feed, n_real)
             total_loss += float(loss)
             for k, v in metrics.items():
                 totals[k] = totals.get(k, 0.0) + float(v)
+            self._feed_evaluators(eval_outs, int(n_real))
             n += 1
         n = max(n, 1)
-        return evt.TestResult(total_loss / n,
-                              {k: v / n for k, v in totals.items()})
+        avg = {k: v / n for k, v in totals.items()}
+        for ev, st in zip(self.evaluators, saved):
+            avg.update(ev.result())
+            ev.__dict__.update(st)           # resume training accumulators
+        return evt.TestResult(total_loss / n, avg)
+
+    def _feed_evaluators(self, eval_outs, n_real: int) -> Dict[str, float]:
+        """Push fetched batch outputs through the host evaluators; returns
+        their running pass-so-far results (printed per log_period, the
+        reference's per-batch evaluator lines)."""
+        if not self.evaluators:
+            return {}
+        from paddle_tpu.evaluator import _to_np
+        host = {k: _to_np(v) for k, v in eval_outs.items()}
+        results: Dict[str, float] = {}
+        for ev in self.evaluators:
+            ev.eval_batch([host[li.name] for li in ev.inputs], n_real)
+            results.update(ev.result())
+        return results
 
     # ------------------------------------------------------------------
     def save_checkpoint(self, manager, meta: Optional[Dict] = None) -> str:
@@ -211,8 +274,10 @@ class SGD:
         self.opt_state = tree["opt_state"]
         self._step_count = int(tree["meta"].get("step_count", 0))
         if "rng" in tree["meta"]:
-            self._rng = jax.random.wrap_key_data(
-                jnp.asarray(tree["meta"]["rng"], jnp.uint32))
+            # Restore raw uint32 bits to keep the legacy key flavor the
+            # rest of the trainer uses — wrap_key_data would produce a
+            # typed key with a different aval and force a jit retrace.
+            self._rng = jnp.asarray(tree["meta"]["rng"], jnp.uint32)
         return True
 
     def save_parameter_to_tar(self, f):

@@ -37,6 +37,31 @@ class TestCoordinator:
         assert t3 is not None                # re-served
         assert t3["task_id"] in (t1["task_id"], t2["task_id"])
 
+    def test_timeout_drain_turns_epoch(self):
+        # Regression: the last outstanding task dying by TIMEOUT (trainer
+        # crash) must turn the pass over like task_failed does, or the
+        # queue drains forever.
+        c = Coordinator(chunks=[1], chunks_per_task=1, timeout_s=0.03,
+                        failure_max=1)
+        t = c.get_task()
+        assert t is not None
+        time.sleep(0.05)                     # times out -> dropped
+        t2 = c.get_task()                    # triggers requeue scan
+        assert c.epoch == 1                  # pass turned over
+        assert t2 is not None                # epoch-1 queue re-serves
+
+    def test_task_reader_over_rpc(self):
+        # task_reader must work against the RPC proxy, where `epoch` is a
+        # callable, not an attribute.
+        c = Coordinator(chunks=["a", "b"], chunks_per_task=1)
+        srv = CoordinatorServer(c).start()
+        try:
+            client = connect("127.0.0.1", srv.port)
+            recs = list(task_reader(client, lambda ch: [ch + "0"])())
+            assert sorted(recs) == ["a0", "b0"]
+        finally:
+            srv.stop()
+
     def test_failure_max_drops_task(self):
         c = Coordinator(chunks=[1], chunks_per_task=1, failure_max=2)
         t = c.get_task()
