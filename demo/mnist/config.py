@@ -1,0 +1,26 @@
+"""MNIST config for the CLI trainer (`paddle_tpu train --config=...`) —
+the trainer-config convention of the reference (config scripts executed by
+the trainer, TrainerMain.cpp:32 / config_parser.py)."""
+
+import paddle_tpu as paddle
+
+batch_size = 128
+
+img = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+h1 = paddle.layer.fc(img, size=128, act=paddle.activation.Relu())
+h2 = paddle.layer.fc(h1, size=64, act=paddle.activation.Relu())
+out = paddle.layer.fc(h2, size=10, act=paddle.activation.Softmax(),
+                      name="output")
+lbl = paddle.layer.data("label", paddle.data_type.integer_value(10))
+cost = paddle.layer.classification_cost(out, lbl, name="cost")
+extra_layers = [paddle.layer.classification_error(out, lbl, name="error")]
+
+optimizer = paddle.optimizer.Momentum(
+    learning_rate=0.1 / batch_size, momentum=0.9,
+    regularization=paddle.optimizer.L2Regularization(5e-4))
+
+train_reader = paddle.reader.batch(
+    paddle.reader.shuffle(paddle.dataset.mnist.train(), 8192, seed=1),
+    batch_size, drop_last=True)
+test_reader = paddle.reader.batch(paddle.dataset.mnist.test(), batch_size)
+num_passes = 3

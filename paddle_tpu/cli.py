@@ -1,0 +1,192 @@
+"""Command-line trainer — `paddle train` parity (TrainerMain.cpp:32-58).
+
+    paddle_tpu train --config=CONF [--job=train|time|test] [flags]
+
+CONF is either
+  * a Python config script (the reference's trainer-config convention,
+    config_parser.py executed user configs the same way): it must define
+    ``cost`` (a cost LayerOutput or list), and may define ``optimizer``,
+    ``train_reader`` / ``test_reader`` (callables yielding batches),
+    ``extra_layers``, ``evaluators``, ``num_passes``, ``batch_size``; or
+  * a serialized topology JSON (Topology.serialize / the ModelConfig
+    contract) — enough for --job=time (synthetic feeds) and, with
+    --init_model_path, --job=test over a config-provided reader.
+
+Jobs (Trainer::{train,test,time}, TrainerBenchmark.cpp --job=time):
+  train: SGD over train_reader, per-pass checkpoint under --save_dir.
+  test : load parameters, evaluate test_reader, print metrics.
+  time : timed fwd+bwd+update steps on synthetic data, one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import runpy
+import sys
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _load_config(path: str) -> Dict[str, Any]:
+    """Execute a .py config (namespace dict) or load a topology .json."""
+    if path.endswith(".py"):
+        ns = runpy.run_path(path)
+        if "cost" not in ns:
+            raise SystemExit(f"config {path!r} defines no `cost`")
+        return ns
+    with open(path) as f:
+        blob = f.read()
+    from paddle_tpu.core.topology import Topology
+    topo = Topology.deserialize(blob)
+    # outputs of a serialized topology are its cost nodes
+    return {"cost": list(topo.outputs)}
+
+
+def _build_trainer(ns: Dict[str, Any], init_model_path: Optional[str]):
+    import paddle_tpu as paddle
+    cost = ns["cost"]
+    topo = paddle.Topology(cost if isinstance(cost, (list, tuple)) else [cost],
+                           extra_outputs=list(ns.get("extra_layers") or []))
+    if init_model_path:
+        with open(init_model_path, "rb") as f:
+            parameters = paddle.Parameters.from_tar(f)
+    else:
+        parameters = paddle.create_parameters(topo)
+    optimizer = ns.get("optimizer") or paddle.optimizer.Momentum(
+        learning_rate=1e-3, momentum=0.9)
+    return paddle.SGD(cost=cost, parameters=parameters,
+                      update_equation=optimizer,
+                      extra_layers=ns.get("extra_layers"),
+                      evaluators=ns.get("evaluators"))
+
+
+def _synthetic_batch(trainer, batch_size: int):
+    """One synthetic batch matching the topology's data contract (the
+    --job=time mode needs shapes, not data)."""
+    from paddle_tpu.core.data_type import SeqType
+    rng = np.random.RandomState(0)
+    samples = []
+    for _ in range(batch_size):
+        row = []
+        for _, t in trainer.topology.data_type():
+            if t.seq_type != SeqType.NO_SEQUENCE:
+                n = 16
+                if t.kind == "integer":
+                    row.append([int(v) for v in rng.randint(0, t.dim, n)])
+                else:
+                    row.append([rng.randn(t.dim).astype("float32")
+                                for _ in range(n)])
+            elif t.kind == "integer":
+                row.append(int(rng.randint(0, t.dim)))
+            else:
+                row.append(rng.randn(t.dim).astype("float32"))
+        samples.append(tuple(row))
+    return samples
+
+
+def _job_time(trainer, batch_size: int, iters: int) -> int:
+    """TrainerBenchmark.cpp parity: timed train steps, update included."""
+    batch = _synthetic_batch(trainer, batch_size)
+
+    def reader():
+        while True:
+            yield batch
+
+    times = []
+    t_last = [None]
+
+    def handler(e):
+        import paddle_tpu as paddle
+        if isinstance(e, paddle.event.BeginIteration):
+            t_last[0] = time.perf_counter()
+        elif isinstance(e, paddle.event.EndIteration):
+            times.append(time.perf_counter() - t_last[0])
+
+    trainer.train(reader, num_passes=1, event_handler=handler,
+                  num_batches_per_pass=iters + 3)
+    steady = times[3:] or times              # drop compile warmup
+    ms = 1000.0 * float(np.mean(steady))
+    print(json.dumps({"metric": "train_ms_per_batch", "value": round(ms, 3),
+                      "unit": "ms/batch", "batch_size": batch_size,
+                      "iters": len(steady)}))
+    return 0
+
+
+def _job_train(trainer, ns, args) -> int:
+    import paddle_tpu as paddle
+    reader = ns.get("train_reader")
+    if reader is None:
+        raise SystemExit("--job=train needs a `train_reader` in the config")
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration) and \
+                e.batch_id % max(args.log_period, 1) == 0:
+            print(f"Pass {e.pass_id}, Batch {e.batch_id}, "
+                  f"Cost {e.cost:.6f}, {e.evaluator}")
+        elif isinstance(e, paddle.event.EndPass):
+            print(f"Pass {e.pass_id} done. {e.evaluator}")
+            if args.save_dir:
+                trainer.save_pass(args.save_dir, e.pass_id)
+
+    num_passes = args.num_passes or int(ns.get("num_passes", 1))
+    trainer.train(reader, num_passes=num_passes, event_handler=handler)
+    if ns.get("test_reader") is not None:
+        res = trainer.test(ns["test_reader"])
+        print(f"Test: cost={res.cost:.6f} {res.evaluator}")
+    return 0
+
+
+def _job_test(trainer, ns) -> int:
+    reader = ns.get("test_reader") or ns.get("train_reader")
+    if reader is None:
+        raise SystemExit("--job=test needs a `test_reader` in the config")
+    res = trainer.test(reader)
+    print(f"Test: cost={res.cost:.6f} {res.evaluator}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu",
+        description="TPU-native trainer CLI (paddle train parity)")
+    sub = ap.add_subparsers(dest="command", required=True)
+    tr = sub.add_parser("train", help="train / time / test a config")
+    tr.add_argument("--config", required=True,
+                    help=".py config script or serialized topology .json")
+    tr.add_argument("--job", default="train",
+                    choices=["train", "time", "test"])
+    tr.add_argument("--use_tpu", action="store_true", default=None)
+    tr.add_argument("--trainer_count", type=int, default=1)
+    tr.add_argument("--num_passes", type=int, default=None)
+    tr.add_argument("--batch_size", type=int, default=128,
+                    help="--job=time synthetic batch size")
+    tr.add_argument("--iters", type=int, default=20,
+                    help="--job=time timed steps")
+    tr.add_argument("--save_dir", default=None)
+    tr.add_argument("--init_model_path", default=None,
+                    help="params.tar to start from")
+    tr.add_argument("--log_period", type=int, default=100)
+    tr.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    tr.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    paddle.init(use_tpu=args.use_tpu, trainer_count=args.trainer_count,
+                seed=args.seed, compute_dtype=args.dtype,
+                log_period=args.log_period)
+    ns = _load_config(args.config)
+    trainer = _build_trainer(ns, args.init_model_path)
+    if args.job == "time":
+        return _job_time(trainer, args.batch_size, args.iters)
+    if args.job == "test":
+        return _job_test(trainer, ns)
+    return _job_train(trainer, ns, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

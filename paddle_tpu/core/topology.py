@@ -101,13 +101,18 @@ class Topology:
                 state: Dict[str, jax.Array],
                 feed: Dict[str, Any], *, mode: str = "train",
                 rng: Optional[jax.Array] = None,
-                output_names: Optional[Sequence[str]] = None):
+                output_names: Optional[Sequence[str]] = None,
+                sparse_sub: Optional[Dict[str, Any]] = None):
         """Pure forward pass.
 
         Returns (outputs_dict, new_state). `outputs_dict` maps layer name ->
         value for requested outputs (default: self.outputs).
+        `sparse_sub`: {param_name: (uids, rows)} prefetched row blocks —
+        embedding layers whose table appears here look ids up inside the
+        block so gradients stay row-sparse (SparseRowMatrix parity).
         """
         ctx = ApplyContext(mode, rng, state)
+        ctx.sparse_sub = sparse_sub
         values: Dict[str, Any] = {}
         wanted = set(output_names) if output_names is not None else \
             {o.name for o in self.outputs}
@@ -129,6 +134,30 @@ class Topology:
         new_state.update(ctx.state_updates)
         outs = {n: values[n] for n in wanted if n in values}
         return outs, new_state
+
+    # ----------------------------------------------------------- sparse path
+    def sparse_tables(self) -> Dict[str, str]:
+        """param_name -> ids data-layer name, for every embedding table
+        marked ParamAttr(sparse=True) whose ids come straight from a data
+        layer (the prefetchable set — MultiGradientMachine.h:99-166).
+        Sparse tables fed by computed ids fall back to dense gradients."""
+        out: Dict[str, str] = {}
+        dense_fallback = set()
+        for l in self.layers:
+            if l.type != "embedding":
+                continue
+            for ps in l.params:
+                if not getattr(ps.attr, "sparse", False):
+                    continue
+                if not (l.parents and l.parents[0].type == "data"):
+                    dense_fallback.add(ps.name)     # computed ids
+                elif ps.name in out and out[ps.name] != l.parents[0].name:
+                    dense_fallback.add(ps.name)     # shared across sources
+                else:
+                    out[ps.name] = l.parents[0].name
+        for n in dense_fallback:
+            out.pop(n, None)
+        return out
 
     # ------------------------------------------------------------ data layers
     def data_layers(self) -> Dict[str, LayerOutput]:

@@ -205,7 +205,8 @@ def extract_chunks(ids: np.ndarray, scheme: str, num_chunk_types: int):
             # begins when previous ended (prev tag E) or type changed
             return prev_tag in (None, 1) or prev_type != typ
         if scheme == "IOBES":
-            return tag in (0, 3) or prev_type != typ
+            # B/S begin; so does anything right after an E/S or a type flip
+            return tag in (0, 3) or prev_tag in (2, 3) or prev_type != typ
         raise ValueError(scheme)
 
     prev_tag = prev_type = None
@@ -222,10 +223,12 @@ def extract_chunks(ids: np.ndarray, scheme: str, num_chunk_types: int):
                 chunks.append((start, i - 1, ctype))
             start, ctype = i, typ
         if scheme == "IOE" and tag == 1:       # E closes the chunk
-            chunks.append((start, i, ctype))
+            chunks.append((start if start is not None else i, i,
+                           ctype if ctype is not None else typ))
             start = ctype = None
         elif scheme == "IOBES" and tag in (2, 3):   # E / S close
-            chunks.append((start, i, ctype))
+            chunks.append((start if start is not None else i, i,
+                           ctype if ctype is not None else typ))
             start = ctype = None
         prev_tag, prev_type = tag, typ
     if start is not None:
@@ -348,7 +351,41 @@ class CTCErrorEvaluator(Evaluator):
 # pair ordering metrics (PnpairEvaluator / RankAucEvaluator parity)
 
 
-class PnpairEvaluator(Evaluator):
+class _PassBufferedPairEvaluator(Evaluator):
+    """Base for pair-ordering metrics: buffers the whole pass (the
+    reference PnpairEvaluator does the same — query groups may span batch
+    boundaries, so per-batch counting would drop cross-batch pairs)."""
+
+    def __init__(self, input: LayerOutput, label: LayerOutput,
+                 query_id: LayerOutput, name: str):
+        self.name = name
+        self.inputs = [input, label, query_id]
+        self.start()
+
+    def start(self):
+        self._score: list = []
+        self._label: list = []
+        self._qid: list = []
+
+    def eval_batch(self, values, n_real):
+        score, label, qid = (np.asarray(_rows(v, n_real)).reshape(-1)
+                             for v in values)
+        self._score.append(score)
+        self._label.append(label)
+        self._qid.append(qid)
+
+    def _groups(self):
+        if not self._score:
+            return
+        score = np.concatenate(self._score)
+        label = np.concatenate(self._label)
+        qid = np.concatenate(self._qid)
+        for q in np.unique(qid):
+            m = qid == q
+            yield score[m], label[m]
+
+
+class PnpairEvaluator(_PassBufferedPairEvaluator):
     """Positive-negative pair ordering within query groups
     (PnpairEvaluator: counts pairs where the higher-labelled sample also
     scored higher; reports pos/neg ratio).
@@ -356,56 +393,32 @@ class PnpairEvaluator(Evaluator):
     inputs: score [b], label [b] (graded relevance), query_id [b].
     """
 
-    def __init__(self, input: LayerOutput, label: LayerOutput,
-                 query_id: LayerOutput, name: str = "pnpair"):
-        self.name = name
-        self.inputs = [input, label, query_id]
-        self.start()
+    def __init__(self, input, label, query_id, name: str = "pnpair"):
+        super().__init__(input, label, query_id, name)
 
-    def start(self):
-        self._pos = self._neg = self._tie = 0
-
-    def eval_batch(self, values, n_real):
-        score, label, qid = (np.asarray(_rows(v, n_real)).reshape(-1)
-                             for v in values)
-        for q in np.unique(qid):
-            m = qid == q
-            s, l = score[m], label[m]
+    def result(self):
+        pos = neg = 0
+        for s, l in self._groups():
             ds = s[:, None] - s[None, :]
             dl = l[:, None] - l[None, :]
             upper = np.triu(np.ones_like(ds, bool), 1) & (dl != 0)
             agree = np.sign(ds) == np.sign(dl)
-            self._pos += int(np.sum(upper & agree & (ds != 0)))
-            self._tie += int(np.sum(upper & (ds == 0)))
-            self._neg += int(np.sum(upper & ~agree & (ds != 0)))
-
-    def result(self):
-        return {f"{self.name}_pos": float(self._pos),
-                f"{self.name}_neg": float(self._neg),
-                f"{self.name}_ratio":
-                    self._pos / self._neg if self._neg else float(self._pos)}
+            pos += int(np.sum(upper & agree & (ds != 0)))
+            neg += int(np.sum(upper & ~agree & (ds != 0)))
+        return {f"{self.name}_pos": float(pos), f"{self.name}_neg": float(neg),
+                f"{self.name}_ratio": pos / neg if neg else float(pos)}
 
 
-class RankAucEvaluator(Evaluator):
+class RankAucEvaluator(_PassBufferedPairEvaluator):
     """Query-averaged pairwise AUC over graded labels (RankAucEvaluator):
     fraction of correctly-ordered (non-tied) pairs, ties counted half."""
 
-    def __init__(self, input: LayerOutput, label: LayerOutput,
-                 query_id: LayerOutput, name: str = "rank_auc"):
-        self.name = name
-        self.inputs = [input, label, query_id]
-        self.start()
+    def __init__(self, input, label, query_id, name: str = "rank_auc"):
+        super().__init__(input, label, query_id, name)
 
-    def start(self):
-        self._auc_sum = 0.0
-        self._n_queries = 0
-
-    def eval_batch(self, values, n_real):
-        score, label, qid = (np.asarray(_rows(v, n_real)).reshape(-1)
-                             for v in values)
-        for q in np.unique(qid):
-            m = qid == q
-            s, l = score[m], label[m]
+    def result(self):
+        auc_sum, n_queries = 0.0, 0
+        for s, l in self._groups():
             ds = s[:, None] - s[None, :]
             dl = l[:, None] - l[None, :]
             valid = np.triu(np.ones_like(ds, bool), 1) & (dl != 0)
@@ -413,14 +426,10 @@ class RankAucEvaluator(Evaluator):
             if n == 0:
                 continue
             agree = (np.sign(ds) == np.sign(dl)) & (ds != 0)
-            ties = ds == 0
-            self._auc_sum += (np.sum(valid & agree) +
-                              0.5 * np.sum(valid & ties)) / n
-            self._n_queries += 1
-
-    def result(self):
-        return {self.name: self._auc_sum / self._n_queries
-                if self._n_queries else 0.0}
+            auc_sum += (np.sum(valid & agree) +
+                        0.5 * np.sum(valid & (ds == 0))) / n
+            n_queries += 1
+        return {self.name: auc_sum / n_queries if n_queries else 0.0}
 
 
 # ---------------------------------------------------------------------------

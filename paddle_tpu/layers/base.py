@@ -148,10 +148,20 @@ class EmbeddingLayer:
 
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
-        table = params[cfg["_w_name"]]
+        pname = cfg["_w_name"]
+        table = params[pname]
         val = inputs[0]
         ids = _payload(val)
-        out = emb_ops.embedding_lookup(table, ids, pad_id=cfg.get("pad_id", -1))
+        sub = getattr(ctx, "sparse_sub", None)
+        if sub and pname in sub:
+            # row-sparse path: look up inside the prefetched row block so
+            # gradients flow to the [k, emb] rows, not the whole table
+            uids, rows = sub[pname]
+            out = emb_ops.row_sub_lookup(uids, rows, ids, table.shape[0],
+                                         pad_id=cfg.get("pad_id", -1))
+        else:
+            out = emb_ops.embedding_lookup(table, ids,
+                                           pad_id=cfg.get("pad_id", -1))
         if isinstance(val, SequenceBatch):
             return val.with_data(out)
         return out

@@ -2,11 +2,16 @@
 
 Reference: TableProjection (gserver/layers/TableProjection.cpp) +
 SparseRowCpuMatrix row-sparse gradients (math/SparseRowMatrix.h) + the
-sparse-remote prefetch path (MultiGradientMachine.h:99-166). On TPU a lookup
-is a gather XLA vectorizes; row-sparse gradients are unnecessary for
-correctness (dense grads) but the trainer supports sharding big tables over
-the mesh 'model' axis (parallel/sharding.py) which is the pserver-block
-equivalent.
+sparse-remote prefetch path (MultiGradientMachine.h:99-166,
+SparsePrefetchRowCpuMatrix, RemoteParameterUpdater.h:265).
+
+TPU-native row-sparse path: the train step PRE-GATHERS the batch's touched
+rows (`touched_rows` — the prefetch), the forward looks ids up inside that
+small row block (`row_sub_lookup`), autodiff produces gradients for the
+row block only (never a dense [vocab, emb] buffer), and the optimizer
+scatter-updates just those rows and their slots. Tables additionally shard
+rows over the mesh `mp` axis (parallel/tensor_parallel.py) — the
+pserver-block-sharding equivalent.
 """
 
 from __future__ import annotations
@@ -19,6 +24,37 @@ def embedding_lookup(table: jnp.ndarray, ids: jnp.ndarray,
     """table: [vocab, d]; ids: [...] int -> [..., d]. ids == pad_id yields 0."""
     safe = jnp.clip(ids, 0, table.shape[0] - 1).astype(jnp.int32)
     out = jnp.take(table, safe, axis=0)
+    if pad_id is not None:
+        out = out * (ids != pad_id)[..., None].astype(out.dtype)
+    return out
+
+
+def touched_ids(ids: jnp.ndarray, vocab: int) -> jnp.ndarray:
+    """The batch's unique ids, static-shaped: [k = ids.size] sorted, padded
+    with the out-of-range sentinel `vocab` (stays sorted; scatters back
+    with mode=drop). This IS the prefetch contract row_sub_lookup's binary
+    search relies on — keep train-step and lookup on this one helper."""
+    flat = jnp.clip(ids.reshape(-1), 0, vocab - 1).astype(jnp.int32)
+    return jnp.unique(flat, size=flat.size, fill_value=vocab)
+
+
+def touched_rows(table: jnp.ndarray, ids: jnp.ndarray):
+    """Prefetch: (uids, rows) for the unique ids of a batch."""
+    vocab = table.shape[0]
+    uids = touched_ids(ids, vocab)
+    rows = jnp.take(table, jnp.clip(uids, 0, vocab - 1), axis=0)
+    return uids, rows
+
+
+def row_sub_lookup(uids: jnp.ndarray, rows: jnp.ndarray, ids: jnp.ndarray,
+                   vocab: int, pad_id: int = -1) -> jnp.ndarray:
+    """Lookup through a prefetched row block: every (valid) id of the batch
+    is guaranteed to be in `uids` (it came from the same batch), located by
+    binary search since uids is sorted."""
+    safe = jnp.clip(ids, 0, vocab - 1).astype(jnp.int32)
+    pos = jnp.searchsorted(uids, safe)
+    pos = jnp.clip(pos, 0, rows.shape[0] - 1)
+    out = jnp.take(rows, pos, axis=0)
     if pad_id is not None:
         out = out * (ids != pad_id)[..., None].astype(out.dtype)
     return out

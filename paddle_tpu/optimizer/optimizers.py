@@ -70,9 +70,15 @@ class Optimizer:
         self.model_average = model_average
         self.param_attrs: Dict[str, Any] = {}
 
-    def bind(self, param_specs: Dict[str, Any]) -> "Optimizer":
-        """Attach per-parameter attrs from Topology.param_specs."""
+    def bind(self, param_specs: Dict[str, Any],
+             sparse_params=None) -> "Optimizer":
+        """Attach per-parameter attrs from Topology.param_specs.
+        sparse_params: names that actually take the row-sparse path (the
+        trainer's topology.sparse_tables() — sparse-attr params that fall
+        back to dense gradients must NOT get a row clock, or the dense
+        update would change the opt-state pytree structure)."""
         self.param_attrs = {name: ps.attr for name, ps in param_specs.items()}
+        self.sparse_params = set(sparse_params or ())
         return self
 
     # ---- subclass hooks --------------------------------------------------
@@ -82,44 +88,75 @@ class Optimizer:
     def _apply(self, p, g, slot, lr, step) -> Tuple[jnp.ndarray, Dict]:
         raise NotImplementedError
 
+    def _catch_up(self, p_rows, slot_rows, dt):
+        """Row-sparse catch-up for dt-1 missed (zero-gradient) steps since
+        the row was last touched (SparseMomentumParameterOptimizer's t0
+        machinery, FirstOrderOptimizer.h:60-117). Default: rows freeze
+        while untouched (exact for SGD/AdaGrad; the lazy convention for
+        the rest)."""
+        return p_rows, slot_rows
+
     # ---- public API ------------------------------------------------------
     def init_state(self, params: Dict[str, jnp.ndarray]) -> Dict[str, Any]:
         state = {"step": jnp.zeros((), jnp.int32),
                  "num_samples": jnp.zeros((), jnp.float32),
                  "slots": {k: self._init_slot(v) for k, v in params.items()}}
+        # per-row last-touched step for row-sparse tables (t0 vectors)
+        for k, v in params.items():
+            if k in getattr(self, "sparse_params", ()):
+                state["slots"][k]["_t"] = jnp.zeros((v.shape[0],), jnp.int32)
         if self.model_average is not None:
             state["avg"] = {k: v for k, v in params.items()}
         return state
 
+    def _adjust_grad(self, k, p, g):
+        """Clipping + L1/L2 (elementwise, so valid on full params or row
+        slices alike). Returns (g, lr_scale)."""
+        attr = self.param_attrs.get(k)
+        clip = attr.gradient_clipping_threshold if (
+            attr and attr.gradient_clipping_threshold) else self.clip
+        if clip:
+            g = jnp.clip(g, -clip, clip)
+        l2 = attr.l2_rate if (attr and attr.l2_rate is not None) else self.l2
+        l1 = attr.l1_rate if (attr and attr.l1_rate is not None) else self.l1
+        if l2:
+            g = g + l2 * p
+        if l1:
+            g = g + l1 * jnp.sign(p)
+        return g, (attr.learning_rate if attr else 1.0)
+
     def update(self, params: Dict[str, jnp.ndarray],
                grads: Dict[str, jnp.ndarray], state: Dict[str, Any],
-               batch_size) -> Tuple[Dict[str, jnp.ndarray], Dict[str, Any]]:
+               batch_size, sparse_rows: Optional[Dict[str, Any]] = None
+               ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, Any]]:
+        """sparse_rows: {param_name: (uids, grad_rows, p_rows, slot_rows)}
+        row-sparse gradients plus the caught-up prefetched rows (from
+        sparse_prefetch) for embedding tables — only those rows (and their
+        slots) are touched, so update cost scales with the batch's unique
+        ids, not the vocab (SparseRowMatrix / sparse_update parity). Such
+        params need no entry in `grads`."""
+        sparse_rows = sparse_rows or {}
         step = state["step"] + 1
         num_samples = state["num_samples"] + batch_size
         base_lr = self.schedule(num_samples)
         new_params, new_slots = {}, {}
         for k in params:
-            p, g = params[k], grads[k]
+            p = params[k]
             attr = self.param_attrs.get(k)
-            if attr is not None and attr.is_static:
+            if (attr is not None and attr.is_static) or \
+                    (k not in grads and k not in sparse_rows):
                 new_params[k] = p
                 new_slots[k] = state["slots"][k]
                 continue
+            if k in sparse_rows:
+                new_params[k], new_slots[k] = self._update_rows(
+                    k, p, sparse_rows[k], state["slots"][k], base_lr, step)
+                continue
             # gradient clipping (per-param threshold overrides global);
             # reference: GradientClippingOptimizer clips by absolute value
-            clip = attr.gradient_clipping_threshold if (
-                attr and attr.gradient_clipping_threshold) else self.clip
-            if clip:
-                g = jnp.clip(g, -clip, clip)
-            # L2/L1 regularization as grad decay (OptimizerWithRegularizer)
-            l2 = attr.l2_rate if (attr and attr.l2_rate is not None) else self.l2
-            l1 = attr.l1_rate if (attr and attr.l1_rate is not None) else self.l1
-            if l2:
-                g = g + l2 * p
-            if l1:
-                g = g + l1 * jnp.sign(p)
-            lr = base_lr * (attr.learning_rate if attr else 1.0)
-            np_, ns = self._apply(p, g, state["slots"][k], lr, step)
+            g, lr_scale = self._adjust_grad(k, p, grads[k])
+            np_, ns = self._apply(p, g, state["slots"][k], base_lr * lr_scale,
+                                  step)
             new_params[k] = np_
             new_slots[k] = ns
         new_state = {"step": step, "num_samples": num_samples,
@@ -135,11 +172,62 @@ class Optimizer:
                 for k in new_params}
         return new_params, new_state
 
+    def sparse_prefetch(self, k, p, slot, uids, next_step):
+        """Prefetch the touched rows of a sparse table WITH catch-up: the
+        returned p_rows are the values a dense run would hold at this step
+        (untouched rows drift under momentum-style rules — the reference
+        solved the same problem with the SparseMomentum alpha/beta/tau
+        basis, FirstOrderOptimizer.h:60-117). The forward pass must use
+        these rows, and update() receives them back so the plain rule
+        applies."""
+        vocab = p.shape[0]
+        safe = jnp.clip(uids, 0, vocab - 1)
+        p_rows = jnp.take(p, safe, axis=0)
+        slot_rows = {kk: jnp.take(v, safe, axis=0)
+                     for kk, v in slot.items() if kk != "_t"}
+        if "_t" in slot:
+            dt = next_step - jnp.take(slot["_t"], safe)
+            p_rows, slot_rows = self._catch_up(p_rows, slot_rows, dt)
+        return p_rows, slot_rows
+
+    def _update_rows(self, k, p, sparse_entry, slot, base_lr, step):
+        """Row-sparse update: apply the dense rule on the (caught-up)
+        prefetched row block and scatter rows + slots back. uids carry an
+        out-of-range sentinel for padding — scatter mode='drop' ignores
+        those."""
+        uids, g_rows, p_rows, slot_rows = sparse_entry
+        g_rows, lr_scale = self._adjust_grad(k, p_rows, g_rows)
+        np_rows, ns_rows = self._apply(p_rows, g_rows, slot_rows,
+                                       base_lr * lr_scale, step)
+        new_p = p.at[uids].set(np_rows, mode="drop")
+        new_slot = {kk: slot[kk].at[uids].set(ns_rows[kk], mode="drop")
+                    for kk in ns_rows}
+        if "_t" in slot:
+            new_slot["_t"] = slot["_t"].at[uids].set(step, mode="drop")
+        return new_p, new_slot
+
+    def materialize_sparse(self, params, state):
+        """Catch every row of sparse tables up to the current step (stale
+        untouched rows drift under momentum-style rules; their true value
+        materializes on fetch). One dense pass per table — for eval /
+        export, not the train loop."""
+        out = dict(params)
+        step = state["step"]
+        for k, slot in state["slots"].items():
+            if "_t" not in slot or k not in params:
+                continue
+            dt = step - slot["_t"] + 1
+            rows = {kk: v for kk, v in slot.items() if kk != "_t"}
+            p_rows, _ = self._catch_up(params[k], rows, dt)
+            out[k] = p_rows
+        return out
+
     def test_params(self, params, state):
-        """Parameters to evaluate with (model-averaged if enabled)."""
+        """Parameters to evaluate with (model-averaged if enabled,
+        sparse tables materialized)."""
         if self.model_average is not None and "avg" in state:
             return state["avg"]
-        return params
+        return self.materialize_sparse(params, state)
 
 
 class Momentum(Optimizer):
@@ -160,6 +248,22 @@ class Momentum(Optimizer):
             return p - lr * g, slot
         m = slot["mom"] * self.momentum - lr * g
         return p + m, {"mom": m}
+
+    def _catch_up(self, p_rows, slot_rows, dt):
+        """Exact sparse-momentum catch-up: dt-1 zero-grad steps each do
+        m *= mu; p += m, so p gains m0*(mu + ... + mu^(dt-1)) and m decays
+        by mu^(dt-1) (the reference's alpha/beta/tau closed form,
+        FirstOrderOptimizer.h:60-117). Result: sparse == dense exactly."""
+        if not self.momentum:
+            return p_rows, slot_rows
+        mu = self.momentum
+        e = (dt - 1).astype(jnp.float32)
+        e = e[:, None] if p_rows.ndim > 1 else e
+        m = slot_rows["mom"]
+        if mu >= 1.0:                      # geometric sum degenerates to e
+            return p_rows + m * e, {"mom": m}
+        geo = mu * (1.0 - jnp.power(mu, e)) / (1.0 - mu)
+        return p_rows + m * geo, {"mom": m * jnp.power(mu, e)}
 
 
 SGD = Momentum
@@ -184,6 +288,15 @@ class Adam(Optimizer):
         mhat = m / (1 - jnp.power(self.b1, t))
         vhat = v / (1 - jnp.power(self.b2, t))
         return p - lr * mhat / (jnp.sqrt(vhat) + self.eps), {"m": m, "v": v}
+
+    def _catch_up(self, p_rows, slot_rows, dt):
+        """Lazy-Adam: moments decay for the dt-1 missed zero-grad steps on
+        touch; the missed (tiny) parameter nudges are skipped — the
+        standard lazy-Adam semantics for sparse tables."""
+        e = (dt - 1).astype(jnp.float32)
+        e = e[:, None] if p_rows.ndim > 1 else e
+        return p_rows, {"m": slot_rows["m"] * jnp.power(self.b1, e),
+                        "v": slot_rows["v"] * jnp.power(self.b2, e)}
 
 
 class Adamax(Optimizer):

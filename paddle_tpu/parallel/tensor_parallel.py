@@ -105,7 +105,12 @@ def opt_state_shardings(opt_state, param_shardings: Dict[str, NamedSharding],
         for p in path:
             key = getattr(p, "key", None)
             if key in param_shardings:
-                return param_shardings[key]
+                sh = param_shardings[key]
+                if len(sh.spec) <= leaf.ndim:
+                    return sh
+                # lower-rank slot (e.g. the per-row "_t" clock [vocab] of a
+                # row-sharded [vocab, emb] table): keep the leading axes
+                return NamedSharding(mesh, P(*sh.spec[: leaf.ndim]))
         return repl
 
     return jax.tree_util.tree_map_with_path(assign, opt_state)

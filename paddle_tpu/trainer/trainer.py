@@ -79,7 +79,9 @@ class SGD:
             fresh = self.topology.init_params(
                 jax.random.PRNGKey(global_config().seed), only=missing)
             parameters.raw.update(fresh)
-        self.optimizer = update_equation.bind(self.topology.param_specs)
+        self.optimizer = update_equation.bind(
+            self.topology.param_specs,
+            sparse_params=self.topology.sparse_tables().keys())
         self.opt_state = self.optimizer.init_state(parameters.raw)
         self._rng = jax.random.PRNGKey(global_config().seed)
         self._step_count = 0
@@ -88,9 +90,10 @@ class SGD:
         self._test_step = self._build_test_step()
 
     # ------------------------------------------------------------------
-    def _loss_and_metrics(self, params, state, feed, rng, n_real, mode):
+    def _loss_and_metrics(self, params, state, feed, rng, n_real, mode,
+                          sparse_sub=None):
         outs, new_state = self.topology.forward(
-            params, state, feed, mode=mode, rng=rng)
+            params, state, feed, mode=mode, rng=rng, sparse_sub=sparse_sub)
         b = None
         total = 0.0
         metrics = {}
@@ -122,7 +125,55 @@ class SGD:
         return total, (metrics, new_state, eval_outs)
 
     def _build_train_step(self):
+        # Row-sparse tables (ParamAttr(sparse=True) embeddings fed by data
+        # layers): prefetch their touched rows, differentiate w.r.t. the
+        # row block only, scatter-update rows + slots. The dense
+        # [vocab, emb] gradient never materializes (SparseRowMatrix /
+        # prefetch parity, MultiGradientMachine.h:99-166).
+        sparse_map = self.topology.sparse_tables()
+
         def step(params, opt_state, state, feed, rng, n_real):
+            if sparse_map:
+                from paddle_tpu.core.sequence import SequenceBatch
+                from paddle_tpu.ops import embedding as emb_ops
+                next_step = opt_state["step"] + 1
+                uids_map, rows0, slot_rows_map = {}, {}, {}
+                for pname, src in sparse_map.items():
+                    v = feed[src]
+                    ids = v.data if isinstance(v, SequenceBatch) else v
+                    vocab = params[pname].shape[0]
+                    uids = emb_ops.touched_ids(ids, vocab)
+                    # prefetch WITH optimizer catch-up so the forward sees
+                    # the values a dense run would hold at this step
+                    p_rows, s_rows = self.optimizer.sparse_prefetch(
+                        pname, params[pname], opt_state["slots"][pname],
+                        uids, next_step)
+                    uids_map[pname] = uids
+                    rows0[pname] = p_rows
+                    slot_rows_map[pname] = s_rows
+                dense = {k: v for k, v in params.items()
+                         if k not in sparse_map}
+
+                def loss_fn(dp, rows):
+                    full = dict(dp)
+                    for k in sparse_map:
+                        full[k] = params[k]
+                    sub = {k: (uids_map[k], rows[k]) for k in rows}
+                    return self._loss_and_metrics(full, state, feed, rng,
+                                                  n_real, "train",
+                                                  sparse_sub=sub)
+
+                grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                             has_aux=True)
+                ((loss, (metrics, new_state, eval_outs)),
+                 (g_dense, g_rows)) = grad_fn(dense, rows0)
+                sparse_rows = {k: (uids_map[k], g_rows[k], rows0[k],
+                                   slot_rows_map[k]) for k in g_rows}
+                new_params, new_opt_state = self.optimizer.update(
+                    params, g_dense, opt_state, n_real.astype(jnp.float32),
+                    sparse_rows=sparse_rows)
+                return (new_params, new_opt_state, new_state, loss, metrics,
+                        eval_outs)
             grad_fn = jax.value_and_grad(
                 lambda p: self._loss_and_metrics(p, state, feed, rng, n_real,
                                                  "train"), has_aux=True)

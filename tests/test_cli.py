@@ -1,0 +1,70 @@
+"""CLI trainer tests — `paddle train --config --job=train|time|test`
+parity (TrainerMain.cpp:32-58, TrainerBenchmark.cpp)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = os.path.join(REPO, "demo", "mnist", "config.py")
+
+
+def _run_cli(args, timeout=600):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.cli"] + args,
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+class TestCLI:
+    def test_job_time_prints_json(self, tmp_path):
+        r = _run_cli(["train", "--config", CONFIG, "--job", "time",
+                      "--batch_size", "32", "--iters", "4"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        line = [l for l in r.stdout.splitlines()
+                if l.startswith("{")][-1]
+        rec = json.loads(line)
+        assert rec["metric"] == "train_ms_per_batch"
+        assert rec["value"] > 0
+
+    def test_job_train_saves_and_test_restores(self, tmp_path):
+        save = str(tmp_path / "out")
+        r = _run_cli(["train", "--config", CONFIG, "--job", "train",
+                      "--num_passes", "1", "--save_dir", save,
+                      "--log_period", "16"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "Pass 0 done" in r.stdout
+        tar = os.path.join(save, "pass-00000", "params.tar")
+        assert os.path.exists(tar)
+
+        r2 = _run_cli(["train", "--config", CONFIG, "--job", "test",
+                       "--init_model_path", tar])
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        assert "Test: cost=" in r2.stdout
+
+    def test_job_time_from_serialized_topology(self, tmp_path):
+        # the JSON topology contract has a consumer outside the tests now
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import paddle_tpu as paddle
+        from paddle_tpu.core import registry
+        registry.reset_name_counters()
+        img = paddle.layer.data("x", paddle.data_type.dense_vector(16))
+        out = paddle.layer.fc(img, size=4,
+                              act=paddle.activation.Softmax())
+        lbl = paddle.layer.data("y", paddle.data_type.integer_value(4))
+        cost = paddle.layer.classification_cost(out, lbl, name="cost")
+        blob = paddle.Topology(cost).serialize()
+        p = tmp_path / "model.json"
+        p.write_text(blob)
+        r = _run_cli(["train", "--config", str(p), "--job", "time",
+                      "--batch_size", "16", "--iters", "3"])
+        assert r.returncode == 0, r.stderr[-2000:]
+        rec = json.loads([l for l in r.stdout.splitlines()
+                          if l.startswith("{")][-1])
+        assert rec["value"] > 0

@@ -93,6 +93,15 @@ class TestChunk:
         ids = np.array([0, 1, 2, 4, 3])
         assert E.extract_chunks(ids, "IOBES", 1) == [(0, 2, 0), (4, 4, 0)]
 
+    def test_extract_chunks_iobes_e_after_e(self):
+        # malformed-but-common model output: E right after E starts a new
+        # chunk (ChunkEvaluator begin-of-chunk rule); no (None,...) tuples
+        chunks = E.extract_chunks(np.array([0, 2, 2]), "IOBES", 1)
+        assert chunks == [(0, 1, 0), (2, 2, 0)]
+        # trailing I after E is an (unclosed) chunk, not dropped
+        chunks = E.extract_chunks(np.array([0, 2, 1]), "IOBES", 1)
+        assert chunks == [(0, 1, 0), (2, 2, 0)]
+
     def test_f1(self):
         ev = E.chunk(_FakeLayer("p"), _FakeLayer("l"),
                      chunk_scheme="IOB", num_chunk_types=2)

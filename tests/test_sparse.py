@@ -1,0 +1,178 @@
+"""Row-sparse embedding path tests (SparseRowMatrix / prefetch parity —
+math/SparseRowMatrix.h, MultiGradientMachine.h:99-166).
+
+The contract: tables marked ParamAttr(sparse=True) train through a
+prefetched row block — gradients and optimizer updates touch only the
+batch's unique ids. Momentum carries an EXACT catch-up (sparse == dense
+bit-for-tolerance); Adam is lazy (moments decay on touch)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.registry import ParamAttr
+from paddle_tpu.ops import embedding as emb_ops
+
+
+class TestRowOps:
+    def test_touched_rows_unique_sorted_sentinel(self):
+        table = jnp.arange(20.0).reshape(10, 2)
+        ids = jnp.array([[3, 7], [3, 1]])
+        uids, rows = emb_ops.touched_rows(table, ids)
+        assert uids.shape == (4,)                       # static: ids.size
+        np.testing.assert_array_equal(np.asarray(uids), [1, 3, 7, 10])
+        np.testing.assert_array_equal(np.asarray(rows[:3]),
+                                      np.asarray(table)[[1, 3, 7]])
+
+    def test_row_sub_lookup_matches_dense(self):
+        rng = np.random.RandomState(0)
+        table = jnp.asarray(rng.randn(50, 8).astype("float32"))
+        ids = jnp.asarray(rng.randint(0, 50, (4, 6)).astype("int32"))
+        uids, rows = emb_ops.touched_rows(table, ids)
+        got = emb_ops.row_sub_lookup(uids, rows, ids, 50)
+        want = emb_ops.embedding_lookup(table, ids)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    def test_row_sub_lookup_grad_is_row_shaped(self):
+        table = jnp.ones((100, 4))
+        ids = jnp.array([2, 2, 5])
+        uids, rows = emb_ops.touched_rows(table, ids)
+
+        def loss(r):
+            return jnp.sum(emb_ops.row_sub_lookup(uids, r, ids, 100) ** 2)
+
+        g = jax.grad(loss)(rows)
+        assert g.shape == rows.shape                    # [k, emb], not [V, emb]
+        # duplicated id 2 accumulates both occurrences on its single row
+        pos2 = int(np.searchsorted(np.asarray(uids), 2))
+        np.testing.assert_allclose(np.asarray(g[pos2]), 4.0)
+
+
+def _emb_model(vocab, emb, sparse):
+    ids = paddle.layer.data("ids", paddle.data_type.integer_value(vocab))
+    lbl = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    e = paddle.layer.embedding(
+        ids, size=emb, name="tbl",
+        param_attr=ParamAttr(name="_tbl_w", sparse=sparse))
+    out = paddle.layer.fc(e, size=2, act=paddle.activation.Softmax(),
+                          name="out")
+    cost = paddle.layer.classification_cost(out, lbl, name="cost")
+    return cost
+
+
+def _run(sparse, opt_fn, batches, vocab=32, emb=4, seed=7):
+    from paddle_tpu.core import registry
+    registry.reset_name_counters()
+    paddle.init(seed=seed)
+    cost = _emb_model(vocab, emb, sparse)
+    params = paddle.create_parameters(paddle.Topology(cost))
+    tr = paddle.SGD(cost=cost, parameters=params, update_equation=opt_fn())
+
+    def reader():
+        for ids, ys in batches:
+            yield [(int(i), int(y)) for i, y in zip(ids, ys)]
+
+    tr.train(reader, num_passes=1)
+    return tr
+
+
+class TestSparseDenseEquivalence:
+    def _batches(self, n=6, b=8, vocab=32):
+        rng = np.random.RandomState(3)
+        # skewed ids so many rows go untouched for several steps
+        return [(rng.randint(0, vocab // 2, b) * 2, rng.randint(0, 2, b))
+                for _ in range(n)]
+
+    def test_momentum_exact_match(self):
+        batches = self._batches()
+        mk = lambda: paddle.optimizer.Momentum(learning_rate=0.1,
+                                               momentum=0.9)
+        tr_d = _run(False, mk, batches)
+        tr_s = _run(True, mk, batches)
+        # untouched sparse rows are stale until fetched: compare the
+        # MATERIALIZED view (what eval/export reads) against dense
+        d = tr_d.optimizer.test_params(tr_d.parameters.raw, tr_d.opt_state)
+        s = tr_s.optimizer.test_params(tr_s.parameters.raw, tr_s.opt_state)
+        for k in d:
+            np.testing.assert_allclose(np.asarray(d[k]), np.asarray(s[k]),
+                                       rtol=1e-5, atol=1e-6, err_msg=k)
+
+    def test_sgd_exact_match(self):
+        batches = self._batches()
+        mk = lambda: paddle.optimizer.Momentum(learning_rate=0.1)
+        tr_d = _run(False, mk, batches)
+        tr_s = _run(True, mk, batches)
+        for k in tr_d.parameters.raw:
+            np.testing.assert_allclose(
+                np.asarray(tr_d.parameters.raw[k]),
+                np.asarray(tr_s.parameters.raw[k]), rtol=1e-5, atol=1e-6)
+
+    def test_adagrad_exact_match(self):
+        batches = self._batches()
+        mk = lambda: paddle.optimizer.AdaGrad(learning_rate=0.1)
+        tr_d = _run(False, mk, batches)
+        tr_s = _run(True, mk, batches)
+        for k in tr_d.parameters.raw:
+            np.testing.assert_allclose(
+                np.asarray(tr_d.parameters.raw[k]),
+                np.asarray(tr_s.parameters.raw[k]), rtol=1e-5, atol=1e-6)
+
+    def test_adam_untouched_rows_frozen(self):
+        vocab = 32
+        # only even ids ever touched
+        batches = [(np.arange(8) * 2, np.ones(8, np.int64))
+                   for _ in range(4)]
+        mk = lambda: paddle.optimizer.Adam(learning_rate=0.05)
+        tr_s = _run(True, mk, batches, vocab=vocab)
+        table0 = paddle.Topology(_emb_model(vocab, 4, True))  # fresh init
+        # untouched (odd) rows: value and moments unchanged from init
+        tbl = np.asarray(tr_s.parameters.raw["_tbl_w"])
+        slots = tr_s.opt_state["slots"]["_tbl_w"]
+        m = np.asarray(slots["m"])
+        odd = np.arange(1, vocab, 2)
+        np.testing.assert_array_equal(m[odd], 0.0)
+        t_row = np.asarray(slots["_t"])
+        assert (t_row[odd] == 0).all()
+        assert (t_row[np.arange(0, 16, 2)] > 0).all()
+
+
+class TestWideDeepE2E:
+    def test_trains_and_touches_only_batch_rows(self):
+        from paddle_tpu import models as M
+        spec = M.wide_and_deep(sparse_dims=(200, 200, 50), dense_dim=4,
+                               emb_size=8, hidden_sizes=(16,))
+        params = paddle.create_parameters(paddle.Topology(spec.cost))
+        tr = paddle.SGD(cost=spec.cost, parameters=params,
+                        update_equation=paddle.optimizer.Adam(
+                            learning_rate=5e-3))
+        rng = np.random.RandomState(0)
+        used = set()
+
+        def reader():
+            batch = []
+            for _ in range(32):
+                ids = [int(rng.randint(20)) for _ in range(3)]  # ids < 20
+                used.update(ids)
+                batch.append((*ids, rng.randn(4).astype("float32"),
+                              int(ids[0] % 2)))
+            yield batch
+
+        losses = []
+        tr.train(reader, num_passes=20,
+                 event_handler=lambda e: losses.append(e.cost)
+                 if isinstance(e, paddle.event.EndIteration) else None)
+        assert np.mean(losses[-5:]) < np.mean(losses[:5])
+        # rows >= 20 never appeared: Adam moments there must be zero
+        m = np.asarray(tr.opt_state["slots"]["_wd_emb0_w"]["m"])
+        assert np.abs(m[20:]).max() == 0.0
+        assert np.abs(m[:20]).max() > 0.0
+
+
+class TestBigVocabSharded:
+    def test_1m_row_table_dpxmp(self):
+        """VERDICT exit criterion: a 1M-row sharded sparse table trains a
+        step over the dp x mp mesh."""
+        import __graft_entry__ as g
+        g.dryrun_sparse_multichip(8)
