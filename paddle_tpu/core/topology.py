@@ -102,7 +102,9 @@ class Topology:
                 feed: Dict[str, Any], *, mode: str = "train",
                 rng: Optional[jax.Array] = None,
                 output_names: Optional[Sequence[str]] = None,
-                sparse_sub: Optional[Dict[str, Any]] = None):
+                sparse_sub: Optional[Dict[str, Any]] = None,
+                injected: Optional[Dict[str, Any]] = None,
+                skip: Sequence[str] = (), mesh=None):
         """Pure forward pass.
 
         Returns (outputs_dict, new_state). `outputs_dict` maps layer name ->
@@ -110,13 +112,20 @@ class Topology:
         `sparse_sub`: {param_name: (uids, rows)} prefetched row blocks —
         embedding layers whose table appears here look ids up inside the
         block so gradients stay row-sparse (SparseRowMatrix parity).
+        `injected`/`skip`: pre-computed values (e.g. the pipelined body's
+        boundary activation) and layer names NOT to execute here — a
+        skipped, un-injected value consumed downstream raises KeyError.
         """
         ctx = ApplyContext(mode, rng, state)
         ctx.sparse_sub = sparse_sub
-        values: Dict[str, Any] = {}
+        ctx.mesh = mesh     # layers may pick sp/mp-aware code paths
+        values: Dict[str, Any] = dict(injected or {})
+        skip_set = set(skip)
         wanted = set(output_names) if output_names is not None else \
             {o.name for o in self.outputs}
         for layer in self.layers:
+            if layer.name in values or layer.name in skip_set:
+                continue
             impl = get_layer_impl(layer.type)
             if layer.type == "data":
                 if layer.name not in feed:

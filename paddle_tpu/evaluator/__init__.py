@@ -354,7 +354,11 @@ class CTCErrorEvaluator(Evaluator):
 class _PassBufferedPairEvaluator(Evaluator):
     """Base for pair-ordering metrics: buffers the whole pass (the
     reference PnpairEvaluator does the same — query groups may span batch
-    boundaries, so per-batch counting would drop cross-batch pairs)."""
+    boundaries, so per-batch counting would drop cross-batch pairs).
+    `expensive_result` tells the trainer to compute result() only at pass
+    end, not per batch (it redoes the full pairwise pass)."""
+
+    expensive_result = True
 
     def __init__(self, input: LayerOutput, label: LayerOutput,
                  query_id: LayerOutput, name: str):

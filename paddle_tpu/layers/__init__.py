@@ -26,6 +26,9 @@ from paddle_tpu.layers import group as _group          # noqa: F401
 from paddle_tpu.layers.group import (recurrent_group, memory, beam_search,
                                      StaticInput, GeneratedInput)
 from paddle_tpu.layers import crf_layers as _crf       # noqa: F401
+from paddle_tpu.layers import attention_layers as _attn  # noqa: F401
+from paddle_tpu.layers.attention_layers import (dot_product_attention,
+                                                multi_head_attention)
 
 
 def _listify(x):

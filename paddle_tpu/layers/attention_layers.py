@@ -1,0 +1,87 @@
+"""Multi-head dot-product attention layer with transparent sequence
+parallelism.
+
+The 2017 reference's attention story was additive attention built from
+mixed/projection primitives (simple_attention, networks.py:1298) — kept in
+paddle_tpu.networks. This layer is the modern head-split dot-product form,
+and the user-facing handle for the context-parallel machinery: when the
+trainer's mesh has an `sp` axis (>1), attention runs as a RING over ICI
+(parallel/sequence_parallel.py ring_attention — K/V blocks rotate via
+ppermute under an online softmax), otherwise as plain fused attention.
+The switch is invisible to the model definition: same layer, same params,
+sp is purely a mesh decision — SURVEY §2.4's sequence-parallel row.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import LayerMeta, make_layer, register_layer
+from paddle_tpu.core.sequence import SequenceBatch
+
+
+def _split_heads(x: jnp.ndarray, h: int) -> jnp.ndarray:
+    b, t, d = x.shape
+    return x.reshape(b, t, h, d // h)
+
+
+def _merge_heads(x: jnp.ndarray) -> jnp.ndarray:
+    b, t, h, dh = x.shape
+    return x.reshape(b, t, h * dh)
+
+
+@register_layer("dot_product_attention")
+class DotProductAttentionLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        q, k, v = input_metas
+        assert q.seq_level >= 1 and k.seq_level >= 1 and v.seq_level >= 1, \
+            "attention inputs must be sequences"
+        assert q.size == k.size, "query/key feature sizes must match"
+        h = cfg.get("num_heads", 1)
+        assert q.size % h == 0 and v.size % h == 0, \
+            f"num_heads={h} must divide q/v sizes ({q.size}, {v.size})"
+        return LayerMeta(size=v.size, seq_level=1), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        from paddle_tpu.parallel import sequence_parallel as sp_ops
+        from paddle_tpu.parallel.mesh import SP_AXIS
+        qs, ks, vs = inputs
+        h = cfg.get("num_heads", 1)
+        causal = cfg.get("causal", False)
+        q = _split_heads(qs.data, h)
+        k = _split_heads(ks.data, h)
+        v = _split_heads(vs.data, h)
+        mesh = getattr(ctx, "mesh", None)
+        if mesh is not None and SP_AXIS in mesh.shape and \
+                mesh.shape[SP_AXIS] > 1:
+            out = sp_ops.ring_attention(q, k, v, mesh, lengths=ks.lengths,
+                                        causal=causal)
+        else:
+            b, tq = q.shape[0], q.shape[1]
+            tk = k.shape[1]
+            kv_valid = (jnp.arange(tk)[None, :] <
+                        ks.lengths[:, None])            # [b, Tk]
+            mask = jnp.broadcast_to(kv_valid[:, None, :], (b, tq, tk))
+            if causal:
+                tri = jnp.tril(jnp.ones((tq, tk), bool))
+                mask = mask & tri[None]
+            out = sp_ops.attention(q, k, v, mask=mask)
+        return qs.with_data(_merge_heads(out))
+
+
+def dot_product_attention(query, key=None, value=None, num_heads: int = 1,
+                          causal: bool = False, name=None, **kw):
+    """Multi-head scaled-dot-product attention over sequences.
+
+    query/key/value: sequence layers [b, T, d] (key/value default to
+    query — self-attention). Runs ring attention over the mesh `sp` axis
+    when one exists; plain attention otherwise."""
+    key = key if key is not None else query
+    value = value if value is not None else key
+    return make_layer("dot_product_attention", name, [query, key, value],
+                      num_heads=num_heads, causal=causal)
+
+
+multi_head_attention = dot_product_attention

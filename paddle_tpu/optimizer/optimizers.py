@@ -93,7 +93,10 @@ class Optimizer:
         the row was last touched (SparseMomentumParameterOptimizer's t0
         machinery, FirstOrderOptimizer.h:60-117). Default: rows freeze
         while untouched (exact for SGD/AdaGrad; the lazy convention for
-        the rest)."""
+        the rest). NOTE: L1/L2 regularization on sparse tables is lazy
+        too — decay applies on touch only, not per missed step (the usual
+        sparse-table convention; keep weight decay off embeddings if you
+        need dense-run parity)."""
         return p_rows, slot_rows
 
     # ---- public API ------------------------------------------------------
@@ -157,6 +160,12 @@ class Optimizer:
             g, lr_scale = self._adjust_grad(k, p, grads[k])
             np_, ns = self._apply(p, g, state["slots"][k], base_lr * lr_scale,
                                   step)
+            if "_t" in state["slots"][k]:
+                # a sparse-clocked param dense-updated (e.g. under a
+                # pipelined step): every row was touched — keep the clock
+                # in the pytree and current
+                ns = dict(ns)
+                ns["_t"] = jnp.full_like(state["slots"][k]["_t"], step)
             new_params[k] = np_
             new_slots[k] = ns
         new_state = {"step": step, "num_samples": num_samples,

@@ -88,6 +88,83 @@ def pipeline(stage_fn: Callable, stage_params, x: jnp.ndarray, mesh: Mesh,
     return out.reshape((b,) + out.shape[2:])
 
 
+def topology_stages(topology, stage_names):
+    """Build the pipeline pieces for a Topology-defined model.
+
+    stage_names: list (one entry per pp rank) of lists of layer names —
+    the explicit stage map, the TPU-native form of ParallelNeuralNetwork's
+    per-layer `deviceId` pinning (ParallelNeuralNetwork.h:34, config
+    `device=` attribute). Constraints (asserted): stages must be
+    structurally identical (same layer types + param shapes — GPipe over
+    a repeated block), each stage a linear chain whose first layer feeds
+    from the previous stage's last (stage 0 from a data layer), and
+    stateless (no batch-norm stats inside the body).
+
+    Returns (stage_fn, stack_params, body_names, x_src, body_end):
+      stage_fn(slot_params, x) — replays stage 0's layers with
+        substituted params (all stages share its structure);
+      stack_params(params) — {stage0 param name: [n_stages, ...] stack};
+      body_names — every pipelined layer (to skip in the tail forward);
+      x_src — the data layer feeding the pipeline;
+      body_end — the final stage's last layer name (inject its value).
+    """
+    from paddle_tpu.core.registry import ApplyContext, get_layer_impl
+
+    by_name = topology.by_name
+    n = len(stage_names)
+    sigs = []
+    for si, st in enumerate(stage_names):
+        sig = []
+        for li, nm in enumerate(st):
+            l = by_name[nm]
+            assert not l.states, \
+                f"stateful layer {nm!r} unsupported inside a pipeline stage"
+            assert l.type != "dropout", \
+                f"dropout ({nm!r}) unsupported inside a pipeline stage — " \
+                "the stage context has no per-step rng (put dropout in " \
+                "the tail, or between body and head)"
+            assert len(l.parents) == 1, \
+                f"pipeline stages must be linear chains; {nm!r} has " \
+                f"{len(l.parents)} inputs"
+            expect = st[li - 1] if li > 0 else (
+                stage_names[si - 1][-1] if si > 0 else None)
+            if expect is not None:
+                assert l.parents[0].name == expect, \
+                    f"{nm!r} must consume {expect!r}, got " \
+                    f"{l.parents[0].name!r}"
+            sig.append((l.type,
+                        tuple(tuple(ps.shape) for ps in l.params)))
+        sigs.append(tuple(sig))
+    assert all(s == sigs[0] for s in sigs), \
+        "pipeline stages must be structurally identical"
+    first = by_name[stage_names[0][0]]
+    assert first.parents[0].type == "data", \
+        "the pipeline body must start right after a data layer"
+    x_src = first.parents[0].name
+
+    name_matrix = [[ps.name for nm in st for ps in by_name[nm].params]
+                   for st in stage_names]
+    slot_names = name_matrix[0]
+    stage0 = [by_name[nm] for nm in stage_names[0]]
+
+    def stage_fn(slot_params, x):
+        ctx = ApplyContext("train", None, {})
+        prev = x
+        for l in stage0:
+            impl = get_layer_impl(l.type)
+            lp = {ps.name: slot_params[ps.name] for ps in l.params}
+            prev = impl["apply"](ctx, l.name, l.config, lp, [prev])
+        return prev
+
+    def stack_params(params):
+        return {slot_names[j]: jnp.stack(
+            [params[name_matrix[i][j]] for i in range(n)])
+            for j in range(len(slot_names))}
+
+    body_names = [nm for st in stage_names for nm in st]
+    return stage_fn, stack_params, body_names, x_src, stage_names[-1][-1]
+
+
 def pipeline_loss(stage_fn: Callable, loss_fn: Callable):
     """Compose pipeline + loss into one differentiable objective:
     loss_fn(y, *args) applied to the pipeline output (e.g. softmax CE on

@@ -311,24 +311,48 @@ def connect(host: str, port: int):
 # client-side reader
 
 
+def coordinator_epoch(coordinator) -> int:
+    """Current epoch of an in-process Coordinator (property) or an RPC
+    proxy (registered function)."""
+    e = coordinator.epoch
+    return e() if callable(e) else e
+
+
 def task_reader(coordinator, chunk_reader: Callable[[Any], Any],
-                max_retries_idle: int = 0):
+                idle_timeout: float = 600.0, poll_interval: float = 0.2):
     """Reader over coordinator-dispatched tasks (master client NextRecord
     parity, go/master/client.go:232).
 
     chunk_reader(chunk) -> iterable of records. Yields records; reports
     task_finished after a task's chunks are exhausted and task_failed on a
     reader exception (the task is then retried elsewhere, the bad task
-    bounded by failure_max)."""
+    bounded by failure_max).
+
+    An empty queue whose epoch has NOT turned means other trainers still
+    hold pending tasks (one may have died — its task re-queues on
+    timeout): like the Go client, poll until the pass completes or
+    `idle_timeout` seconds pass with nothing to do (raise it when peer
+    trainers may legitimately hold a task longer than that)."""
     def reader():
-        # Over RPC (CoordinatorServer + connect) `epoch` is a registered
-        # function; in-process it is a property.  Support both.
-        e = coordinator.epoch
-        epoch0 = e() if callable(e) else e
+        epoch0 = coordinator_epoch(coordinator)
+        idle = 0.0
         while True:
             t = coordinator.get_task(epoch0)
             if t is None:
-                return                       # epoch drained
+                if coordinator_epoch(coordinator) != epoch0:
+                    return                   # pass completed
+                if idle >= idle_timeout:
+                    import warnings
+                    warnings.warn(
+                        f"task_reader: no task served for {idle:.0f}s and "
+                        f"epoch {epoch0} never completed — giving up "
+                        "(a peer may hold a straggler task; raise "
+                        "idle_timeout if that is legitimate)")
+                    return
+                time.sleep(poll_interval)
+                idle += poll_interval
+                continue
+            idle = 0.0
             try:
                 for chunk in t["chunks"]:
                     for rec in chunk_reader(chunk):

@@ -41,7 +41,7 @@ class SGD:
     def __init__(self, cost, parameters: Parameters, update_equation,
                  extra_layers: Optional[Sequence[LayerOutput]] = None,
                  is_local: bool = True, mesh=None, evaluators=None,
-                 **kwargs):
+                 pipeline_stages=None, **kwargs):
         costs = cost if isinstance(cost, (list, tuple)) else [cost]
         self.costs = list(costs)
         self.extra_layers = list(extra_layers or [])
@@ -86,14 +86,19 @@ class SGD:
         self._rng = jax.random.PRNGKey(global_config().seed)
         self._step_count = 0
         self.mesh = mesh
+        # explicit stage map for pipeline parallelism over the mesh `pp`
+        # axis (ParallelNeuralNetwork deviceId-pinning parity):
+        # [[stage0 layer names], [stage1 ...], ...]
+        self.pipeline_stages = pipeline_stages
         self._train_step = self._build_train_step()
         self._test_step = self._build_test_step()
 
     # ------------------------------------------------------------------
     def _loss_and_metrics(self, params, state, feed, rng, n_real, mode,
-                          sparse_sub=None):
+                          sparse_sub=None, injected=None, skip=()):
         outs, new_state = self.topology.forward(
-            params, state, feed, mode=mode, rng=rng, sparse_sub=sparse_sub)
+            params, state, feed, mode=mode, rng=rng, sparse_sub=sparse_sub,
+            injected=injected, skip=skip, mesh=self.mesh)
         b = None
         total = 0.0
         metrics = {}
@@ -131,6 +136,11 @@ class SGD:
         # [vocab, emb] gradient never materializes (SparseRowMatrix /
         # prefetch parity, MultiGradientMachine.h:99-166).
         sparse_map = self.topology.sparse_tables()
+
+        from paddle_tpu.parallel.mesh import PP_AXIS
+        if self.mesh is not None and PP_AXIS in self.mesh.shape and \
+                self.mesh.shape[PP_AXIS] > 1:
+            return self._build_pipelined_train_step()
 
         def step(params, opt_state, state, feed, rng, n_real):
             if sparse_map:
@@ -202,6 +212,38 @@ class SGD:
             return shard_train_step(step, self.mesh, p_sh, o_sh)
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def _build_pipelined_train_step(self):
+        """Train step with the model body GPipe-pipelined over the mesh
+        `pp` axis (ParallelNeuralNetwork parity — see
+        parallel/pipeline.py). The tail (costs, metrics) runs replicated
+        on the boundary activation."""
+        from paddle_tpu.parallel.data_parallel import shard_train_step
+        from paddle_tpu.parallel.pipeline import pipeline, topology_stages
+        assert self.pipeline_stages, \
+            "a pp mesh needs SGD(..., pipeline_stages=[[layer names]...])"
+        mesh = self.mesh
+        from paddle_tpu.parallel.mesh import PP_AXIS
+        assert len(self.pipeline_stages) == mesh.shape[PP_AXIS], \
+            "pipeline_stages must have one entry per pp rank"
+        (stage_fn, stack_params, body_names, x_src,
+         body_end) = topology_stages(self.topology, self.pipeline_stages)
+
+        def step(params, opt_state, state, feed, rng, n_real):
+            def loss_fn(p):
+                y = pipeline(stage_fn, stack_params(p), feed[x_src], mesh)
+                return self._loss_and_metrics(
+                    p, state, feed, rng, n_real, "train",
+                    injected={body_end: y}, skip=body_names)
+
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (loss, (metrics, new_state, eval_outs)), grads = grad_fn(params)
+            new_params, new_opt_state = self.optimizer.update(
+                params, grads, opt_state, n_real.astype(jnp.float32))
+            return (new_params, new_opt_state, new_state, loss, metrics,
+                    eval_outs)
+
+        return shard_train_step(step, mesh)
+
     def _build_test_step(self):
         def step(params, state, feed, n_real):
             loss, (metrics, _, eval_outs) = self._loss_and_metrics(
@@ -210,49 +252,106 @@ class SGD:
         return jax.jit(step)
 
     # ------------------------------------------------------------------
-    def train(self, reader, num_passes: int = 1,
+    def train(self, reader=None, num_passes: int = 1,
               event_handler: Optional[Callable] = None, feeding=None,
-              num_batches_per_pass: Optional[int] = None):
+              num_batches_per_pass: Optional[int] = None,
+              coordinator=None, chunk_reader=None, batch_size: int = 0,
+              checkpoint_manager=None, checkpoint_period: int = 0,
+              idle_timeout: float = 600.0):
         """reader: callable yielding BATCHES (lists of sample tuples), i.e.
-        the output of paddle_tpu.reader.batch(...)."""
+        the output of paddle_tpu.reader.batch(...).
+
+        Elastic mode (the Go-master cloud-training path, go/master/
+        service.go + NewRemoteParameterUpdater): pass `coordinator` (a
+        Coordinator or a connect() RPC proxy) + `chunk_reader` instead of
+        `reader` — data then flows through coordinator-dispatched tasks
+        (timeout-requeued if this trainer dies), `num_passes` counts
+        coordinator epochs, and with `checkpoint_manager` the trainer
+        auto-restores the newest full-state checkpoint on entry and saves
+        every `checkpoint_period` batches + each pass end, so a SIGKILLed
+        trainer resumes within the pass it died in."""
         from paddle_tpu.trainer.data_feeder import DataFeeder
         if event_handler is None:
             event_handler = _default_event_handler
         feeder = DataFeeder(self.topology.data_type(), feeding)
-        for pass_id in range(num_passes):
-            event_handler(evt.BeginPass(pass_id))
-            pass_metrics: Dict[str, float] = {}
-            n_batches = 0
-            for ev in self.evaluators:
-                ev.start()
-            for batch_id, data_batch in enumerate(reader()):
-                if num_batches_per_pass is not None and \
-                        batch_id >= num_batches_per_pass:
+
+        if coordinator is not None:
+            from paddle_tpu.reader import batch as batch_reader
+            from paddle_tpu.trainer.coordinator import (coordinator_epoch,
+                                                        task_reader)
+            assert chunk_reader is not None, \
+                "coordinator mode needs chunk_reader(chunk) -> records"
+            rdr = task_reader(coordinator, chunk_reader,
+                              idle_timeout=idle_timeout)
+            if batch_size:
+                rdr = batch_reader(rdr, batch_size)
+            if checkpoint_manager is not None:
+                self.restore_checkpoint(checkpoint_manager)
+
+            while coordinator_epoch(coordinator) < num_passes:
+                pass_id = coordinator_epoch(coordinator)
+                self._run_pass(pass_id, rdr, feeder, event_handler,
+                               num_batches_per_pass, checkpoint_manager,
+                               checkpoint_period)
+                if checkpoint_manager is not None:
+                    self.save_checkpoint(checkpoint_manager)
+                if coordinator_epoch(coordinator) == pass_id:
+                    # the reader gave up without the epoch turning (every
+                    # task dropped, or idle_timeout hit) — surfaced by
+                    # task_reader's warning; don't spin
+                    import warnings
+                    warnings.warn(
+                        f"elastic training stopped at epoch {pass_id} of "
+                        f"{num_passes}: the pass never completed")
                     break
-                event_handler(evt.BeginIteration(pass_id, batch_id))
-                feed = feeder(data_batch)
-                n_real = jnp.asarray(feed.pop("__batch_size__"), jnp.int32)
-                self._rng, sub = jax.random.split(self._rng)
-                with stat_timer("train_step"):
-                    (new_params, self.opt_state, new_state, loss,
-                     metrics, eval_outs) = self._train_step(
-                        self.parameters.raw, self.opt_state,
-                        self.parameters.state, feed, sub, n_real)
-                self.parameters.replace(new_params)
-                self.parameters.state = new_state
-                self._step_count += 1
-                metrics_np = {k: float(v) for k, v in metrics.items()}
-                for k, v in metrics_np.items():
-                    pass_metrics[k] = pass_metrics.get(k, 0.0) + v
-                n_batches += 1
-                metrics_np.update(
-                    self._feed_evaluators(eval_outs, int(n_real)))
-                event_handler(evt.EndIteration(pass_id, batch_id,
-                                               float(loss), metrics_np))
-            avg = {k: v / max(n_batches, 1) for k, v in pass_metrics.items()}
-            for ev in self.evaluators:
-                avg.update(ev.result())
-            event_handler(evt.EndPass(pass_id, avg, self.parameters))
+            return
+
+        for pass_id in range(num_passes):
+            self._run_pass(pass_id, reader, feeder, event_handler,
+                           num_batches_per_pass, checkpoint_manager,
+                           checkpoint_period)
+            if checkpoint_manager is not None:
+                self.save_checkpoint(checkpoint_manager)
+
+    def _run_pass(self, pass_id, reader, feeder, event_handler,
+                  num_batches_per_pass, checkpoint_manager=None,
+                  checkpoint_period: int = 0):
+        event_handler(evt.BeginPass(pass_id))
+        pass_metrics: Dict[str, float] = {}
+        n_batches = 0
+        for ev in self.evaluators:
+            ev.start()
+        for batch_id, data_batch in enumerate(reader()):
+            if num_batches_per_pass is not None and \
+                    batch_id >= num_batches_per_pass:
+                break
+            event_handler(evt.BeginIteration(pass_id, batch_id))
+            feed = feeder(data_batch)
+            n_real = jnp.asarray(feed.pop("__batch_size__"), jnp.int32)
+            self._rng, sub = jax.random.split(self._rng)
+            with stat_timer("train_step"):
+                (new_params, self.opt_state, new_state, loss,
+                 metrics, eval_outs) = self._train_step(
+                    self.parameters.raw, self.opt_state,
+                    self.parameters.state, feed, sub, n_real)
+            self.parameters.replace(new_params)
+            self.parameters.state = new_state
+            self._step_count += 1
+            metrics_np = {k: float(v) for k, v in metrics.items()}
+            for k, v in metrics_np.items():
+                pass_metrics[k] = pass_metrics.get(k, 0.0) + v
+            n_batches += 1
+            metrics_np.update(
+                self._feed_evaluators(eval_outs, int(n_real)))
+            event_handler(evt.EndIteration(pass_id, batch_id,
+                                           float(loss), metrics_np))
+            if checkpoint_manager is not None and checkpoint_period and \
+                    self._step_count % checkpoint_period == 0:
+                self.save_checkpoint(checkpoint_manager)
+        avg = {k: v / max(n_batches, 1) for k, v in pass_metrics.items()}
+        for ev in self.evaluators:
+            avg.update(ev.result())
+        event_handler(evt.EndPass(pass_id, avg, self.parameters))
 
     def test(self, reader, feeding=None) -> evt.TestResult:
         from paddle_tpu.trainer.data_feeder import DataFeeder
@@ -297,7 +396,8 @@ class SGD:
         results: Dict[str, float] = {}
         for ev in self.evaluators:
             ev.eval_batch([host[li.name] for li in ev.inputs], n_real)
-            results.update(ev.result())
+            if not getattr(ev, "expensive_result", False):
+                results.update(ev.result())   # running pass-so-far display
         return results
 
     # ------------------------------------------------------------------

