@@ -1,0 +1,117 @@
+/* C inference ABI for paddle_tpu.
+ *
+ * Mirrors paddle/capi/gradient_machine.h:36-88:
+ *   paddle_gradient_machine_create_for_inference_with_parameters
+ *     -> paddle_tpu_create (merged topology+params artifact)
+ *   paddle_gradient_machine_create_shared_param
+ *     -> paddle_tpu_create_shared (weight-sharing clone)
+ *   paddle_gradient_machine_forward -> paddle_tpu_forward
+ *   paddle_gradient_machine_destroy -> paddle_tpu_destroy
+ *
+ * The compute core is Python/JAX; this shim embeds CPython and routes
+ * every call through paddle_tpu.capi_host. Thread-safe: the GIL is
+ * released after init and re-acquired per call, so multiple C threads
+ * may serve concurrently over shared weights (serialized by the GIL at
+ * dispatch; the XLA execution itself releases it).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+static PyThreadState *g_main_state = NULL;
+
+static PyObject *host(void) {
+    return PyImport_ImportModule("paddle_tpu.capi_host");
+}
+
+int paddle_tpu_init(void) {
+    if (g_main_state != NULL) return 0;
+    Py_InitializeEx(0);
+    /* import once up front so later calls are cheap and early-fail */
+    PyObject *m = host();
+    if (m == NULL) {
+        PyErr_Print();
+        return -1;
+    }
+    Py_DECREF(m);
+    g_main_state = PyEval_SaveThread();
+    return 0;
+}
+
+static long call_long(const char *fn_name, PyObject *args) {
+    long out = -1;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *m = host();
+    if (m != NULL) {
+        PyObject *fn = PyObject_GetAttrString(m, fn_name);
+        if (fn != NULL) {
+            PyObject *res = PyObject_CallObject(fn, args);
+            if (res != NULL) {
+                out = PyLong_AsLong(res);
+                Py_DECREF(res);
+            }
+            Py_DECREF(fn);
+        }
+        Py_DECREF(m);
+    }
+    if (PyErr_Occurred()) PyErr_Print();
+    Py_XDECREF(args);
+    PyGILState_Release(g);
+    return out;
+}
+
+long paddle_tpu_create(const char *model_path) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue("(s)", model_path);
+    PyGILState_Release(g);
+    return call_long("create", args);
+}
+
+long paddle_tpu_create_shared(long handle) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue("(l)", handle);
+    PyGILState_Release(g);
+    return call_long("create_shared", args);
+}
+
+/* Writes batch*out_dim floats into out (capacity out_cap floats).
+ * Returns out_dim per sample, or -1 on error / insufficient capacity. */
+int paddle_tpu_forward(long handle, const float *in, int batch, int dim,
+                       float *out, int out_cap) {
+    int out_dim = -1;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *m = host();
+    if (m != NULL) {
+        PyObject *fn = PyObject_GetAttrString(m, "forward");
+        if (fn != NULL) {
+            PyObject *res = PyObject_CallFunction(
+                fn, "ly#ii", handle, (const char *)in,
+                (Py_ssize_t)(batch * dim * sizeof(float)), batch, dim);
+            if (res != NULL) {
+                PyObject *bytes_obj = PyTuple_GetItem(res, 0);
+                long od = PyLong_AsLong(PyTuple_GetItem(res, 1));
+                char *buf = NULL;
+                Py_ssize_t n = 0;
+                if (PyBytes_AsStringAndSize(bytes_obj, &buf, &n) == 0 &&
+                    n <= (Py_ssize_t)(out_cap * sizeof(float))) {
+                    memcpy(out, buf, n);
+                    out_dim = (int)od;
+                }
+                Py_DECREF(res);
+            }
+            Py_DECREF(fn);
+        }
+        Py_DECREF(m);
+    }
+    if (PyErr_Occurred()) PyErr_Print();
+    PyGILState_Release(g);
+    return out_dim;
+}
+
+void paddle_tpu_destroy(long handle) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue("(l)", handle);
+    PyGILState_Release(g);
+    call_long("destroy", args);
+}

@@ -1,0 +1,75 @@
+"""MNIST convergence artifact — the BASELINE.json north-star run.
+
+Trains the classic MLP to convergence, measures wall-clock and test
+accuracy, and writes CONVERGENCE.json. The artifact records the data
+provenance: `"data": "real"` when the cached MNIST idx files exist under
+DATA_HOME/mnist (this container has no network egress, so CI runs record
+the synthetic-fallback number until the cache is provisioned; target on
+real data: >=98% test accuracy).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import paddle_tpu as paddle
+from paddle_tpu.dataset import common, mnist
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num_passes", type=int, default=10)
+    ap.add_argument("--batch_size", type=int, default=128)
+    ap.add_argument("--out", default="CONVERGENCE.json")
+    args = ap.parse_args()
+
+    paddle.init(seed=42)
+    real = common.has_cached("mnist", "train-images-idx3-ubyte.gz") or \
+        common.has_cached("mnist", "train-images-idx3-ubyte")
+
+    img = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+    h1 = paddle.layer.fc(img, size=128, act=paddle.activation.Relu())
+    h2 = paddle.layer.fc(h1, size=64, act=paddle.activation.Relu())
+    out = paddle.layer.fc(h2, size=10, act=paddle.activation.Softmax())
+    lbl = paddle.layer.data("label", paddle.data_type.integer_value(10))
+    cost = paddle.layer.classification_cost(out, lbl)
+    err = paddle.layer.classification_error(out, lbl, name="error")
+
+    params = paddle.create_parameters(paddle.Topology(cost))
+    trainer = paddle.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(
+            learning_rate=0.1 / args.batch_size, momentum=0.9,
+            regularization=paddle.optimizer.L2Regularization(5e-4)),
+        extra_layers=[err])
+
+    reader = paddle.reader.batch(
+        paddle.reader.shuffle(mnist.train(), 8192, seed=1),
+        args.batch_size, drop_last=True)
+    t0 = time.perf_counter()
+    trainer.train(reader, num_passes=args.num_passes,
+                  event_handler=lambda e: None)
+    wall = time.perf_counter() - t0
+    res = trainer.test(paddle.reader.batch(mnist.test(), args.batch_size))
+    acc = 1.0 - res.metrics.get("error", 1.0)
+
+    artifact = {
+        "benchmark": "mnist_convergence",
+        "data": "real" if real else "synthetic-fallback",
+        "num_passes": args.num_passes,
+        "batch_size": args.batch_size,
+        "test_accuracy": round(float(acc), 4),
+        "test_cost": round(float(res.cost), 5),
+        "wall_clock_s": round(wall, 2),
+        "target": "real-data test_accuracy >= 0.98",
+        "met": bool(real and acc >= 0.98),
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps(artifact))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Python host for the C inference ABI.
+
+The reference's C API (paddle/capi/gradient_machine.h:36-88) exposed
+create-for-inference(+merged parameters), shared-weight clones for
+multi-threaded serving, forward, and destroy over its C++ core. Our core
+is Python/JAX, so the C shim (capi/paddle_tpu_capi.c) embeds CPython and
+dispatches to this module; handles are plain ints so the C side never
+touches object lifetimes.
+
+Functions (C symbol -> here):
+  paddle_tpu_create               -> create(model_path)
+  paddle_tpu_create_shared        -> create_shared(handle)   # shared weights
+  paddle_tpu_forward              -> forward(handle, bytes, batch, dim)
+  paddle_tpu_destroy              -> destroy(handle)
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict
+
+import numpy as np
+
+_handles: Dict[int, object] = {}
+_next_id = itertools.count(1)
+
+
+def create(model_path: str) -> int:
+    """Load a save_inference_model artifact; returns a handle id.
+    (`paddle_gradient_machine_create_for_inference_with_parameters`.)"""
+    from paddle_tpu.trainer.inference import load_inference_model
+    h = next(_next_id)
+    _handles[h] = load_inference_model(model_path)
+    return h
+
+
+def create_shared(handle: int) -> int:
+    """A second engine sharing the SAME weight arrays (multi-instance
+    serving — `paddle_gradient_machine_create_shared_param`,
+    capi/gradient_machine.h:88). Device buffers are immutable and shared;
+    only the handle differs."""
+    from paddle_tpu.trainer.inference import Inference
+    src = _handles[handle]
+    h = next(_next_id)
+    _handles[h] = Inference(parameters=src.parameters,
+                            topology=src.topology)
+    return h
+
+
+def forward(handle: int, data: bytes, batch: int, dim: int):
+    """Dense forward: `data` is batch*dim float32s; returns
+    (out_bytes, out_dim) with out_bytes = batch*out_dim float32s.
+    (`paddle_gradient_machine_forward`.)"""
+    inf = _handles[handle]
+    x = np.frombuffer(data, dtype=np.float32,
+                      count=batch * dim).reshape(batch, dim)
+    samples = [(x[i],) for i in range(batch)]
+    probs = inf.infer(samples)
+    probs = np.asarray(probs, dtype=np.float32)
+    probs = probs.reshape(batch, -1)
+    return probs.tobytes(), int(probs.shape[1])
+
+
+def destroy(handle: int) -> int:
+    _handles.pop(handle, None)
+    return 0

@@ -1,5 +1,8 @@
 from paddle_tpu.dataset import mnist, cifar, uci_housing, imdb, imikolov
+from paddle_tpu.dataset import (conll05, flowers, movielens, mq2007,
+                                sentiment, voc2012, wmt14)
 from paddle_tpu.dataset import synthetic, common
 
-__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov", "synthetic",
-           "common"]
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov", "conll05",
+           "flowers", "movielens", "mq2007", "sentiment", "voc2012",
+           "wmt14", "synthetic", "common"]

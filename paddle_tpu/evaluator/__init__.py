@@ -306,12 +306,13 @@ class CTCErrorEvaluator(Evaluator):
 
     input: SequenceBatch of per-frame class scores [b, T, C] (or already
     -decoded id sequences [b, T]); label: SequenceBatch of target ids.
-    blank: id of the CTC blank — default 0, matching layer.ctc's default
-    (layers/crf_layers.py).
+    blank: id of the CTC blank — default None = the LAST class for score
+    inputs, matching layer.ctc (LinearChainCTC.cpp:86 blank=numClasses-1);
+    pass it explicitly for pre-decoded id inputs or warp_ctc models.
     """
 
     def __init__(self, input: LayerOutput, label: LayerOutput,
-                 blank: int = 0, name: str = "ctc_error"):
+                 blank: Optional[int] = None, name: str = "ctc_error"):
         self.name = name
         self.inputs = [input, label]
         self.blank = blank
@@ -323,10 +324,16 @@ class CTCErrorEvaluator(Evaluator):
 
     def _decode(self, frames):
         """Best-path: argmax per frame, collapse repeats, drop blanks."""
-        ids = frames.argmax(-1) if frames.ndim == 2 else frames
+        blank = self.blank
+        if frames.ndim == 2:
+            ids = frames.argmax(-1)
+            if blank is None:
+                blank = frames.shape[-1] - 1      # layer.ctc convention
+        else:
+            ids = frames                           # pre-decoded: no blank
         out, prev = [], None
         for t in ids.tolist():
-            if t != prev and t != self.blank:
+            if t != prev and t != blank:
                 out.append(t)
             prev = t
         return out

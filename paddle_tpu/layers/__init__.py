@@ -24,7 +24,8 @@ from paddle_tpu.layers import cost_layers as _cost     # noqa: F401
 from paddle_tpu.layers import recurrent_layers as _rec  # noqa: F401
 from paddle_tpu.layers import group as _group          # noqa: F401
 from paddle_tpu.layers.group import (recurrent_group, memory, beam_search,
-                                     StaticInput, GeneratedInput)
+                                     get_output, StaticInput,
+                                     GeneratedInput)
 from paddle_tpu.layers import crf_layers as _crf       # noqa: F401
 from paddle_tpu.layers import attention_layers as _attn  # noqa: F401
 from paddle_tpu.layers.attention_layers import (dot_product_attention,

@@ -30,8 +30,50 @@ from paddle_tpu.layers import group as group_mod
 _NEG = -1e9
 
 
+@jax.tree_util.register_pytree_node_class
+class BeamResult(SequenceBatch):
+    """Beam-search output: the best path as a SequenceBatch (data/lengths
+    — downstream layers see a normal sequence) PLUS all
+    num_results_per_sample paths with scores (SequenceGenerator /
+    Path-with-logProb parity, RecurrentGradientMachine.h:186-309):
+
+      all_data:    [b, N, L] token ids per returned path
+      all_lengths: [b, N]    valid lengths (incl. the EOS position)
+      scores:      [b, N]    accumulated log-probabilities, best first
+    """
+
+    def __init__(self, data, lengths, all_data, all_lengths, scores):
+        super().__init__(data, lengths)
+        self.all_data = all_data
+        self.all_lengths = all_lengths
+        self.scores = scores
+
+    def tree_flatten(self):
+        return ((self.data, self.lengths, self.all_data, self.all_lengths,
+                 self.scores), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def to_list(self):
+        """[[(score, [ids...]), ...] per sample] — the SWIG
+        SequenceGenerator's generateSequence return shape."""
+        import numpy as np
+        out = []
+        ad = np.asarray(self.all_data)
+        al = np.asarray(self.all_lengths)
+        sc = np.asarray(self.scores)
+        for b in range(ad.shape[0]):
+            out.append([(float(sc[b, n]),
+                         [int(v) for v in ad[b, n, : al[b, n]]])
+                        for n in range(ad.shape[1])])
+        return out
+
+
 def build_beam_search(step, input, *, bos_id: int, eos_id: int,
                       beam_size: int, max_length: int,
+                      num_results_per_sample: int = 1,
                       name: Optional[str] = None) -> LayerOutput:
     from paddle_tpu.core.registry import _auto_name
     from paddle_tpu.core.topology import Topology
@@ -81,6 +123,7 @@ def build_beam_search(step, input, *, bos_id: int, eos_id: int,
         vocab=out.meta.size,
         bos_id=bos_id, eos_id=eos_id, beam_size=beam_size,
         max_length=max_length,
+        num_results_per_sample=min(num_results_per_sample, beam_size),
         sub_topology=sub_topo.serialize(),
     )
     node.params = list(sub_topo.param_specs.values())
@@ -213,12 +256,16 @@ class BeamSearchLayer:
         (tokens, scores, finished, _, hist), _ = lax.scan(
             step_t, carry0, jnp.arange(L))
 
-        # pick best beam per sample; sequence length = position of eos + 1
-        best = jnp.argmax(scores, axis=1)                      # [b]
-        best_seq = jnp.take_along_axis(
-            hist, best[:, None, None], axis=1)[:, 0, :]        # [b, L]
-        is_eos = best_seq == eos
-        has_eos = jnp.any(is_eos, axis=1)
-        first_eos = jnp.argmax(is_eos, axis=1)
-        lengths = jnp.where(has_eos, first_eos + 1, L).astype(jnp.int32)
-        return SequenceBatch(best_seq, lengths)
+        # rank beams per sample; keep num_results_per_sample paths with
+        # their scores (SequenceGenerator semantics — Path::logProb,
+        # RecurrentGradientMachine.h:186)
+        N = cfg.get("num_results_per_sample", 1)
+        top_scores, order = lax.top_k(scores, N)               # [b, N]
+        top_seqs = jnp.take_along_axis(
+            hist, order[:, :, None].astype(jnp.int32), axis=1)  # [b, N, L]
+        is_eos = top_seqs == eos
+        has_eos = jnp.any(is_eos, axis=2)
+        first_eos = jnp.argmax(is_eos, axis=2)
+        top_lens = jnp.where(has_eos, first_eos + 1, L).astype(jnp.int32)
+        return BeamResult(top_seqs[:, 0, :], top_lens[:, 0],
+                          top_seqs, top_lens, top_scores)

@@ -163,16 +163,24 @@ class CTCLayer:
         seq: SequenceBatch = inputs[0]       # [b, T, n] probs or logits
         labels: SequenceBatch = inputs[1]    # [b, U] int
         logits = seq.data
-        if not cfg.get("from_logits", True):
+        # reference ctc_layer consumes SOFTMAX output (CTCLayer::forward
+        # works on normalized probs), so the default converts probs ->
+        # log-space; warp_ctc passes from_logits=True (raw activations,
+        # warp-ctc softmaxes internally — here optax does).
+        if not cfg.get("from_logits", False):
             logits = jnp.log(jnp.maximum(logits, 1e-10))
         logit_pad = 1.0 - seq.mask()
         lab = labels.data if isinstance(labels, SequenceBatch) else labels
         lab_pad = 1.0 - labels.mask() if isinstance(labels, SequenceBatch) \
             else jnp.zeros_like(lab, jnp.float32)
-        # optax blank convention: blank id = 0 by default; paddle uses
-        # size-1 as blank for warp_ctc and 0.. hmm, reference CTCLayer uses
-        # last index as blank (norm_by_times etc.); optax supports blank_id.
-        blank = cfg.get("blank", 0)
+        # Blank convention (resolved against the reference):
+        # LinearChainCTC.cpp:86 pins blank = numClasses-1 (the LAST id) —
+        # `ctc` therefore defaults to last; WarpCTCLayer.cpp:33 reads a
+        # configurable blank from config (proto default 0) — `warp_ctc`
+        # passes blank=0 unless overridden. optax takes blank_id directly.
+        blank = cfg.get("blank")
+        if blank is None:
+            blank = logits.shape[-1] - 1
         return optax.ctc_loss(logits, logit_pad, lab.astype(jnp.int32),
                               lab_pad, blank_id=blank)
 
@@ -194,7 +202,9 @@ def crf_decoding(input, size=None, label=None, param_attr=None, name=None, **kw)
 crf_decoding_layer = crf_decoding
 
 
-def ctc(input, label, size=None, blank=0, name=None, **kw):
+def ctc(input, label, size=None, blank=None, name=None, **kw):
+    """CTC cost; blank defaults to the LAST class id (LinearChainCTC.cpp:86
+    convention)."""
     return make_layer("ctc", name, [input, label], size=size, blank=blank)
 
 
@@ -202,6 +212,7 @@ ctc_layer = ctc
 
 
 def warp_ctc(input, label, size=None, blank=0, name=None, **kw):
-    """warp_ctc parity — same XLA CTC under the hood."""
+    """warp_ctc parity — same XLA CTC under the hood; blank configurable,
+    default 0 (WarpCTCLayer.cpp:33 / ModelConfig blank default)."""
     return make_layer("ctc", name, [input, label], size=size, blank=blank,
                       from_logits=True)

@@ -152,6 +152,7 @@ def recurrent_group(step, input, reverse: bool = False,
         static_names=[p.name for p in static_phs],
         static_is_seq=[s.is_seq for s in static_inputs],
         out_name=step_outputs[0].name,
+        out_names=[o.name for o in step_outputs],
         sub_topology=sub_topo.serialize(),
     )
     # attach hoisted params and rebuild meta
@@ -225,7 +226,7 @@ class RecurrentGroupLayer:
 
         mem_feed_names = [m["feed_name"] for m in cfg["memories"]]
         link_names = [m["link_name"] for m in cfg["memories"]]
-        out_name = cfg["out_name"]
+        out_names = cfg.get("out_names") or [cfg["out_name"]]
 
         def body(carry, inp):
             t, x_t = inp
@@ -236,12 +237,10 @@ class RecurrentGroupLayer:
                 feed[fname] = mv
             outs, _ = sub.forward(params, {}, feed, mode=ctx.mode,
                                   rng=ctx.rng_for(f"{name}@{0}"),
-                                  output_names=[out_name] + link_names)
+                                  output_names=list(out_names) + link_names)
             new_mems = tuple(
                 outs[ln].data if isinstance(outs[ln], SequenceBatch)
                 else outs[ln] for ln in link_names)
-            out_t = outs[out_name]
-            out_t = out_t.data if isinstance(out_t, SequenceBatch) else out_t
             valid = t < lengths
 
             def freeze(n, o):
@@ -250,36 +249,93 @@ class RecurrentGroupLayer:
 
             merged = tuple(jax.tree_util.tree_map(freeze, n, o)
                            for n, o in zip(new_mems, carry))
-            vo = valid.reshape((-1,) + (1,) * (out_t.ndim - 1))
-            return merged, jnp.where(vo, out_t, jnp.zeros_like(out_t))
+            outs_t = []
+            for on in out_names:
+                ot = outs[on]
+                ot = ot.data if isinstance(ot, SequenceBatch) else ot
+                vo = valid.reshape((-1,) + (1,) * (ot.ndim - 1))
+                outs_t.append(jnp.where(vo, ot, jnp.zeros_like(ot)))
+            return merged, tuple(outs_t)
 
         tidx = jnp.arange(T, dtype=jnp.int32)
-        _, outs = lax.scan(body, tuple(mems), (tidx, xs))
-        outs = jnp.moveaxis(outs, 0, 1)
-        if reverse:
-            idx = jnp.clip(lengths[:, None] - 1 -
-                           jnp.arange(T, dtype=jnp.int32)[None, :], 0, T - 1)
-            outs = jnp.take_along_axis(
-                outs, idx.reshape(idx.shape + (1,) * (outs.ndim - 2)), axis=1) \
-                if outs.ndim > 2 else jnp.take_along_axis(outs, idx, axis=1)
-            m = (jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None])
-            outs = jnp.where(m.reshape(m.shape + (1,) * (outs.ndim - 2)),
-                             outs, jnp.zeros_like(outs))
-        return SequenceBatch(outs, lengths)
+        _, outs_all = lax.scan(body, tuple(mems), (tidx, xs))
+
+        def finalize(outs):
+            outs = jnp.moveaxis(outs, 0, 1)
+            if reverse:
+                idx = jnp.clip(lengths[:, None] - 1 -
+                               jnp.arange(T, dtype=jnp.int32)[None, :], 0,
+                               T - 1)
+                outs = jnp.take_along_axis(
+                    outs, idx.reshape(idx.shape + (1,) * (outs.ndim - 2)),
+                    axis=1) if outs.ndim > 2 else \
+                    jnp.take_along_axis(outs, idx, axis=1)
+                m = (jnp.arange(T, dtype=jnp.int32)[None, :] <
+                     lengths[:, None])
+                outs = jnp.where(
+                    m.reshape(m.shape + (1,) * (outs.ndim - 2)), outs,
+                    jnp.zeros_like(outs))
+            return SequenceBatch(outs, lengths)
+
+        results = [finalize(o) for o in outs_all]
+        # non-primary step outputs are retrievable via layer.get_output
+        # (GetOutputLayer reads them off the apply context)
+        aux = getattr(ctx, "aux_outputs", None)
+        if aux is None:
+            aux = ctx.aux_outputs = {}
+        for on, val in zip(out_names, results):
+            aux[(name, on)] = val
+        return results[0]
 
 
 def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int,
-                max_length: int = 100, name: Optional[str] = None, **kw):
+                max_length: int = 100, num_results_per_sample: int = 1,
+                name: Optional[str] = None, **kw):
     """Generation-time beam search (reference beam_search:4101 +
-    RecurrentGradientMachine::generateSequence). Implemented in
-    layers/beam.py; wired here for API parity."""
+    RecurrentGradientMachine::generateSequence). Returns a BeamResult:
+    best path as a SequenceBatch plus num_results_per_sample paths with
+    scores. Implemented in layers/beam.py; wired here for API parity."""
     from paddle_tpu.layers.beam import build_beam_search
     return build_beam_search(step, input, bos_id=bos_id, eos_id=eos_id,
                              beam_size=beam_size, max_length=max_length,
+                             num_results_per_sample=num_results_per_sample,
                              name=name)
 
 
-def get_output(input: LayerOutput, arg_name: str, **kw) -> LayerOutput:
-    """get_output_layer parity: select a non-default output of a group.
-    With single-output groups this is the identity."""
-    return input
+@register_layer("get_output")
+class GetOutputLayer:
+    """get_output_layer parity (GetOutputLayer.cpp): select a non-default
+    output of a recurrent_group whose step returned several layers."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=cfg["size"], seq_level=1,
+                         is_integer=cfg.get("is_integer", False)), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        aux = getattr(ctx, "aux_outputs", {})
+        key = (cfg["group_name"], cfg["arg_name"])
+        if key not in aux:
+            raise KeyError(
+                f"get_output: group {cfg['group_name']!r} produced no "
+                f"output {cfg['arg_name']!r} this pass")
+        return aux[key]
+
+
+def get_output(input: LayerOutput, arg_name: str, name=None,
+               **kw) -> LayerOutput:
+    """Select step-output `arg_name` from a multi-output recurrent_group
+    (reference get_output_layer, trainer_config_helpers/layers.py)."""
+    if arg_name == input.config.get("out_name"):
+        return input                          # the primary output
+    sub = input.config.get("_obj_sub_topo")
+    assert sub is not None and arg_name in sub.by_name, \
+        f"get_output: {arg_name!r} is not an output of {input.name!r}"
+    assert arg_name in (input.config.get("out_names") or ()), \
+        f"get_output: step did not RETURN {arg_name!r}; return it from " \
+        "the step function to expose it"
+    m = sub.by_name[arg_name].meta
+    return make_layer("get_output", name, [input], arg_name=arg_name,
+                      group_name=input.name, size=m.size,
+                      is_integer=m.is_integer)

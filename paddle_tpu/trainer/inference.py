@@ -22,12 +22,15 @@ from paddle_tpu.trainer.parameters import Parameters
 
 
 class Inference:
-    def __init__(self, output_layer, parameters: Parameters):
-        outputs = output_layer if isinstance(output_layer, (list, tuple)) \
-            else [output_layer]
-        self.topology = Topology(list(outputs))
+    def __init__(self, output_layer=None, parameters: Parameters = None,
+                 topology: Optional[Topology] = None):
+        if topology is None:
+            outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+                else [output_layer]
+            topology = Topology(list(outputs))
+        self.topology = topology
         self.parameters = parameters
-        self.output_names = [o.name for o in outputs]
+        self.output_names = [o.name for o in topology.outputs]
 
         def fwd(params, state, feed):
             outs, _ = self.topology.forward(params, state, feed, mode="test")
@@ -64,3 +67,46 @@ def infer(output_layer, parameters: Parameters, input, field="value",
     """paddle.infer parity."""
     return Inference(output_layer, parameters).infer(
         input, field=field, feeding=feeding, batch_size=batch_size)
+
+
+# ---------------------------------------------------------------------------
+# merged inference artifact (MergeModel + capi `_with_parameters` parity)
+
+
+def save_inference_model(path: str, output_layer,
+                         parameters: Parameters) -> str:
+    """ONE deployable file: serialized topology + every parameter — the
+    MergeModel artifact (paddle/trainer/MergeModel.cpp) the C API loads
+    with `paddle_gradient_machine_create_for_inference_with_parameters`
+    (capi/gradient_machine.h:52)."""
+    import io
+    import tarfile
+
+    outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+        else [output_layer]
+    topo = Topology(list(outputs))
+    with tarfile.open(path, "w") as tf:
+        blob = topo.serialize().encode()
+        info = tarfile.TarInfo("topology.json")
+        info.size = len(blob)
+        tf.addfile(info, io.BytesIO(blob))
+        buf = io.BytesIO()
+        parameters.to_tar(buf)
+        b = buf.getvalue()
+        info = tarfile.TarInfo("params.tar")
+        info.size = len(b)
+        tf.addfile(info, io.BytesIO(b))
+    return path
+
+
+def load_inference_model(path: str) -> Inference:
+    """Load a save_inference_model artifact into a ready Inference."""
+    import io
+    import tarfile
+
+    with tarfile.open(path, "r") as tf:
+        blob = tf.extractfile("topology.json").read()
+        pbytes = tf.extractfile("params.tar").read()
+    topo = Topology.deserialize(blob)
+    params = Parameters.from_tar(io.BytesIO(pbytes))
+    return Inference(parameters=params, topology=topo)

@@ -1,0 +1,103 @@
+"""C inference ABI tests — capi/gradient_machine.h:36-88 parity.
+
+Builds the real .so (embedding CPython), saves a merged MNIST model with
+save_inference_model, and runs the C example program against it; its
+output must match the in-process Python inference bit-for-tolerance."""
+
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import registry
+from paddle_tpu.trainer.inference import (load_inference_model,
+                                          save_inference_model)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _train_small_mnist():
+    registry.reset_name_counters()
+    paddle.init(seed=3)
+    img = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+    h = paddle.layer.fc(img, size=32, act=paddle.activation.Relu())
+    out = paddle.layer.fc(h, size=10, act=paddle.activation.Softmax(),
+                          name="output")
+    lbl = paddle.layer.data("label", paddle.data_type.integer_value(10))
+    cost = paddle.layer.classification_cost(out, lbl, name="cost")
+    params = paddle.create_parameters(paddle.Topology(cost))
+    tr = paddle.SGD(cost=cost, parameters=params,
+                    update_equation=paddle.optimizer.Momentum(
+                        learning_rate=0.01, momentum=0.9))
+    reader = paddle.reader.batch(paddle.dataset.mnist.train(), 64,
+                                 drop_last=True)
+    tr.train(reader, num_passes=1, num_batches_per_pass=8,
+             event_handler=lambda e: None)
+    return out, tr.parameters
+
+
+class TestMergedArtifact:
+    def test_save_load_roundtrip(self, tmp_path):
+        out, params = _train_small_mnist()
+        path = str(tmp_path / "model.tar")
+        save_inference_model(path, out, params)
+        inf = load_inference_model(path)
+        x = np.linspace(0, 1, 784).astype("float32")
+        want = paddle.infer(output_layer=out, parameters=params,
+                            input=[(x,)])
+        got = inf.infer([(x,)])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+class TestCABI:
+    def _build(self, tmp_path):
+        cc = shutil.which("gcc") or shutil.which("cc")
+        if cc is None:
+            pytest.skip("no C compiler")
+        inc = sysconfig.get_path("include")
+        libdir = sysconfig.get_config_var("LIBDIR")
+        ver = sysconfig.get_config_var("LDVERSION")
+        lib = str(tmp_path / "libpaddle_tpu_capi.so")
+        exe = str(tmp_path / "dense_infer")
+        subprocess.run(
+            [cc, "-shared", "-fPIC", os.path.join(REPO, "capi",
+                                                  "paddle_tpu_capi.c"),
+             f"-I{inc}", f"-L{libdir}", f"-lpython{ver}",
+             f"-Wl,-rpath,{libdir}", "-o", lib], check=True)
+        subprocess.run(
+            [cc, os.path.join(REPO, "capi", "examples", "dense_infer.c"),
+             f"-L{tmp_path}", "-lpaddle_tpu_capi",
+             f"-Wl,-rpath,{tmp_path}", f"-Wl,-rpath,{libdir}", "-o", exe],
+            check=True)
+        return exe
+
+    def test_c_program_runs_mnist_inference(self, tmp_path):
+        exe = self._build(tmp_path)
+        out, params = _train_small_mnist()
+        model = str(tmp_path / "model.tar")
+        save_inference_model(model, out, params)
+
+        site = sysconfig.get_path("purelib")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [REPO, site, env.get("PYTHONPATH", "")])
+        env["JAX_PLATFORMS"] = "cpu"
+        r = subprocess.run([exe, model, "784"], capture_output=True,
+                           text=True, timeout=600, env=env)
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+        lines = [l for l in r.stdout.splitlines() if l.strip()]
+        assert lines[0] == "out_dim=10"
+        assert "shared_ok" in lines[-1]
+
+        # parse row0 and compare against in-process inference
+        row0 = np.array([float(v) for v in
+                         lines[1].split(":")[1].split()])
+        x = (0.001 * (np.arange(784) % 1000)).astype("float32")
+        want = paddle.infer(output_layer=out, parameters=params,
+                            input=[(x,)])[0]
+        np.testing.assert_allclose(row0, want, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,157 @@
+"""Generation parity tests (VERDICT #8): beam search returning top-k
+paths + scores (SequenceGenerator semantics), a real get_output over
+multi-output recurrent groups, and a golden-value CTC test pinning the
+blank convention against LinearChainCTC.cpp:86 (blank = last class)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import registry
+from paddle_tpu.core.registry import ParamAttr
+from paddle_tpu.core.sequence import SequenceBatch
+
+
+class TestBeamTopK:
+    def _generator(self):
+        """Markov-chain generator: next-token probs depend only on the
+        previous token, via a hand-set embedding table of logits."""
+        registry.reset_name_counters()
+        paddle.init(seed=0)
+        src = paddle.layer.data("src",
+                                paddle.data_type.dense_vector(2))
+
+        def step(cur_ids, _static):
+            logits = paddle.layer.embedding(
+                cur_ids, size=4, name="gen_logits",
+                param_attr=ParamAttr(name="_gen_M"))
+            return paddle.layer.fc(
+                logits, size=4, act=paddle.activation.Softmax(),
+                bias_attr=False, name="gen_probs",
+                param_attr=ParamAttr(name="_gen_eye", is_static=True))
+
+        return src, paddle.layer.beam_search(
+            step=step,
+            input=[paddle.layer.GeneratedInput(size=4, embedding_name="_gen_M",
+                                               embedding_size=4),
+                   paddle.layer.StaticInput(src, is_seq=False)],
+            bos_id=0, eos_id=3, beam_size=2, max_length=3,
+            num_results_per_sample=2, name="gen_beam")
+
+    def test_paths_and_scores_match_hand_search(self):
+        src, beam = self._generator()
+        topo = paddle.Topology(beam)
+        params = paddle.create_parameters(topo)
+        tiny = 1e-9
+        M = np.log(np.array([
+            [0.1, 0.6, 0.3, tiny],     # from BOS(0): 1:.6  2:.3
+            [tiny, 0.1, 0.2, 0.7],     # after 1: EOS .7
+            [tiny, 0.8, 0.1, 0.1],     # after 2: 1:.8
+            [0.25, 0.25, 0.25, 0.25],  # after EOS (unused)
+        ], np.float64)).astype("float32")
+        params.raw["_gen_M"] = M
+        params.raw["_gen_eye"] = np.eye(4, dtype="float32")
+
+        feed = {"src": np.zeros((1, 2), "float32")}
+        outs, _ = topo.forward(params.raw, {}, feed, mode="test")
+        res = outs["gen_beam"]
+        paths = res.to_list()[0]           # [(score, ids), ...] best first
+        # hand search (beam 2): best [1,3]=log(.6*.7); 2nd [2,1,3]=log(.3*.8*.7)
+        assert paths[0][1] == [1, 3]
+        assert paths[0][0] == pytest.approx(np.log(0.42), abs=2e-3)
+        assert paths[1][1] == [2, 1, 3]
+        assert paths[1][0] == pytest.approx(np.log(0.168), abs=2e-3)
+        # primary SequenceBatch view = the best path
+        np.testing.assert_array_equal(np.asarray(res.data)[0, :2], [1, 3])
+        assert int(res.lengths[0]) == 2
+
+
+class TestGetOutput:
+    def test_selects_secondary_step_output(self):
+        registry.reset_name_counters()
+        paddle.init(seed=0)
+        seq = paddle.layer.data(
+            "s", paddle.data_type.dense_vector_sequence(8))
+
+        def step(x):
+            mem = paddle.layer.memory(name="go_h", size=8)
+            h = paddle.layer.addto([x, mem], name="go_h")
+            d = paddle.layer.addto([h, h], name="go_double")
+            return h, d
+
+        grp = paddle.layer.recurrent_group(step=step, input=[seq],
+                                           name="go_grp")
+        second = paddle.layer.get_output(grp, "go_double")
+        topo = paddle.Topology([grp, second])
+        params = paddle.create_parameters(topo)
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 5, 8).astype("float32")
+        lens = np.array([5, 3], np.int32)
+        feed = {"s": SequenceBatch(x, lens)}
+        outs, _ = topo.forward(params.raw, {}, feed, mode="test")
+        h = np.asarray(outs["go_grp"].data)
+        d = np.asarray(outs[second.name].data)
+        np.testing.assert_allclose(d, 2.0 * h, rtol=1e-6)
+
+    def test_primary_name_is_identity(self):
+        registry.reset_name_counters()
+        seq = paddle.layer.data(
+            "s", paddle.data_type.dense_vector_sequence(4))
+
+        def step(x):
+            mem = paddle.layer.memory(name="gi_h", size=4)
+            return paddle.layer.addto([x, mem], name="gi_h")
+
+        grp = paddle.layer.recurrent_group(step=step, input=[seq],
+                                           name="gi_grp")
+        assert paddle.layer.get_output(grp, "gi_h") is grp
+
+
+class TestCTCGolden:
+    def test_blank_is_last_class(self):
+        """T=2 frames, vocab {0, 1, blank=2}, label [0]:
+        P = p1(0)p2(0) + p1(0)p2(b) + p1(b)p2(0) — the three alignments of
+        the LinearChainCTC lattice; NLL must match exactly."""
+        registry.reset_name_counters()
+        paddle.init(seed=0)
+        probs_in = paddle.layer.data(
+            "p", paddle.data_type.dense_vector_sequence(3))
+        lbl = paddle.layer.data(
+            "l", paddle.data_type.integer_value_sequence(2))
+        cost = paddle.layer.ctc(probs_in, lbl, size=3, name="ctc_cost")
+        topo = paddle.Topology(cost)
+        params = paddle.create_parameters(topo)
+
+        p1 = np.array([0.6, 0.3, 0.1])
+        p2 = np.array([0.5, 0.2, 0.3])
+        # ctc consumes SOFTMAX probabilities (CTCLayer convention)
+        probs = np.stack([p1, p2])[None].astype("float32")
+        feed = {"p": SequenceBatch(probs, np.array([2], np.int32)),
+                "l": SequenceBatch(np.array([[0]], np.int32),
+                                   np.array([1], np.int32))}
+        outs, _ = topo.forward(params.raw, {}, feed, mode="test")
+        nll = float(np.asarray(outs["ctc_cost"]).reshape(-1)[0])
+        want = -np.log(p1[0] * p2[0] + p1[0] * p2[2] + p1[2] * p2[0])
+        assert nll == pytest.approx(want, abs=1e-4)
+
+    def test_warp_ctc_blank_zero(self):
+        """warp_ctc keeps the configurable blank (default 0,
+        WarpCTCLayer.cpp:33): same lattice with blank at id 0."""
+        registry.reset_name_counters()
+        probs_in = paddle.layer.data(
+            "p", paddle.data_type.dense_vector_sequence(3))
+        lbl = paddle.layer.data(
+            "l", paddle.data_type.integer_value_sequence(2))
+        cost = paddle.layer.warp_ctc(probs_in, lbl, size=3, name="wctc")
+        topo = paddle.Topology(cost)
+        params = paddle.create_parameters(topo)
+        p1 = np.array([0.1, 0.6, 0.3])     # blank=0
+        p2 = np.array([0.3, 0.5, 0.2])
+        logits = np.log(np.stack([p1, p2]))[None].astype("float32")
+        feed = {"p": SequenceBatch(logits, np.array([2], np.int32)),
+                "l": SequenceBatch(np.array([[1]], np.int32),
+                                   np.array([1], np.int32))}
+        outs, _ = topo.forward(params.raw, {}, feed, mode="test")
+        nll = float(np.asarray(outs["wctc"]).reshape(-1)[0])
+        want = -np.log(p1[1] * p2[1] + p1[1] * p2[0] + p1[0] * p2[1])
+        assert nll == pytest.approx(want, abs=1e-4)
