@@ -38,12 +38,11 @@ def create_shared(handle: int) -> int:
     """A second engine sharing the SAME weight arrays (multi-instance
     serving — `paddle_gradient_machine_create_shared_param`,
     capi/gradient_machine.h:88). Device buffers are immutable and shared;
-    only the handle differs."""
-    from paddle_tpu.trainer.inference import Inference
+    only the handle differs — the source's jitted forward (and its compiled
+    executable cache) is reused so clones don't recompile."""
     src = _handles[handle]
     h = next(_next_id)
-    _handles[h] = Inference(parameters=src.parameters,
-                            topology=src.topology)
+    _handles[h] = src
     return h
 
 

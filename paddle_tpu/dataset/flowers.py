@@ -18,7 +18,12 @@ N_CLASSES = 102
 DEFAULT_SIZE = 32     # synthetic fallback resolution (3*32*32 features)
 
 
+_real_cache = {}
+
+
 def _real(split):
+    if split in _real_cache:
+        return _real_cache[split]
     p = os.path.join(common.DATA_HOME, "flowers", f"{split}.npz")
     if not os.path.exists(p):
         return None
@@ -26,7 +31,9 @@ def _real(split):
     imgs = blob["images"].astype(np.float32)
     if imgs.max() > 1.5:
         imgs = imgs / 255.0
-    return imgs.reshape(len(imgs), -1), blob["labels"].astype(np.int64)
+    out = (imgs.reshape(len(imgs), -1), blob["labels"].astype(np.int64))
+    _real_cache[split] = out
+    return out
 
 
 def _synthetic(split, n, seed, size=DEFAULT_SIZE):

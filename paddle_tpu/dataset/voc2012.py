@@ -19,7 +19,12 @@ VOID = 255
 DEFAULT_SIZE = 32
 
 
+_real_cache = {}
+
+
 def _real(split):
+    if split in _real_cache:
+        return _real_cache[split]
     p = os.path.join(common.DATA_HOME, "voc2012", f"{split}.npz")
     if not os.path.exists(p):
         return None
@@ -27,8 +32,10 @@ def _real(split):
     imgs = blob["images"].astype(np.float32)
     if imgs.max() > 1.5:
         imgs = imgs / 255.0
-    return (imgs.reshape(len(imgs), -1),
-            blob["masks"].astype(np.int32).reshape(len(imgs), -1))
+    out = (imgs.reshape(len(imgs), -1),
+           blob["masks"].astype(np.int32).reshape(len(imgs), -1))
+    _real_cache[split] = out
+    return out
 
 
 def _synthetic(n, seed, size=DEFAULT_SIZE):

@@ -28,6 +28,8 @@ from paddle_tpu.layers.group import (recurrent_group, memory, beam_search,
                                      GeneratedInput)
 from paddle_tpu.layers import crf_layers as _crf       # noqa: F401
 from paddle_tpu.layers import attention_layers as _attn  # noqa: F401
+from paddle_tpu.layers import misc_layers as _misc     # noqa: F401
+from paddle_tpu.layers import detection_layers as _det  # noqa: F401
 from paddle_tpu.layers.attention_layers import (dot_product_attention,
                                                 multi_head_attention)
 
@@ -540,3 +542,190 @@ def classification_error(input, label, name=None, **kw) -> LayerOutput:
 # crf / ctc re-exported from crf_layers
 from paddle_tpu.layers.crf_layers import (crf, crf_decoding, ctc,
                                           warp_ctc)  # noqa: E402,F401
+
+
+# ---------------------------------------------------------------------------
+# id / sampling / generation helpers
+# (reference layers.py maxid_layer:3989, sampling_id_layer:4859,
+#  eos_layer:4062, multiplex_layer:6123)
+
+
+def max_id(input, name=None, beam_size: int = 1, **kw) -> LayerOutput:
+    return make_layer("maxid", name, [input], beam_size=beam_size)
+
+
+maxid = max_id
+
+
+def sampling_id(input, name=None, **kw) -> LayerOutput:
+    return make_layer("sampling_id", name, [input])
+
+
+def eos(input, eos_id: int, name=None, **kw) -> LayerOutput:
+    return make_layer("eos_id", name, [input], eos_id=eos_id)
+
+
+def multiplex(input, name=None, **kw) -> LayerOutput:
+    return make_layer("multiplex", name, _listify(input))
+
+
+# ---------------------------------------------------------------------------
+# elementwise / feature utilities
+# (clip_layer:6566, scale_shift_layer:6849, power_layer:2046,
+#  rotate_layer:2167, featmap_expand FeatureMapExpandLayer.cpp,
+#  data_norm DataNormLayer.cpp, selective_fc_layer:4776,
+#  row_conv_layer:6197)
+
+
+def clip(input, min: float, max: float, name=None, **kw) -> LayerOutput:
+    return make_layer("clip", name, [input], min=min, max=max)
+
+
+def scale_shift(input, name=None, param_attr=None, bias_attr=None,
+                **kw) -> LayerOutput:
+    return make_layer("scale_shift", name, [input], param_attr=param_attr,
+                      bias_attr=bias_attr)
+
+
+def power(input, weight, name=None, **kw) -> LayerOutput:
+    return make_layer("power", name, [weight, input])
+
+
+def rotate(input, height=None, width=None, name=None, **kw) -> LayerOutput:
+    return make_layer("rotate", name, [input], height=height, width=width)
+
+
+def featmap_expand(input, num_filters: int, as_row_vector: bool = True,
+                   name=None, **kw) -> LayerOutput:
+    return make_layer("featmap_expand", name, [input],
+                      num_filters=num_filters, as_row_vector=as_row_vector)
+
+
+def data_norm(input, data_norm_strategy: str = "z-score", name=None,
+              param_attr=None, **kw) -> LayerOutput:
+    return make_layer("data_norm", name, [input],
+                      data_norm_strategy=data_norm_strategy,
+                      param_attr=param_attr)
+
+
+def selective_fc(input, size: int, select=None, act=None, name=None,
+                 param_attr=None, bias_attr=None, **kw) -> LayerOutput:
+    inputs = _listify(input) + ([select] if select is not None else [])
+    return make_layer("selective_fc", name, inputs, size=size,
+                      act=act_mod.to_name(act), param_attr=param_attr,
+                      bias_attr=bias_attr)
+
+
+def row_conv(input, context_len: int, act=None, name=None, param_attr=None,
+             **kw) -> LayerOutput:
+    return make_layer("row_conv", name, [input], context_len=context_len,
+                      act=act_mod.to_name(act), param_attr=param_attr)
+
+
+def print_layer(input, format=None, name=None, **kw) -> LayerOutput:
+    return make_layer("print", name, [input],
+                      **({"format": format} if format else {}))
+
+
+# ---------------------------------------------------------------------------
+# sequence selection (sub_seq SubSequenceLayer.cpp,
+#  kmax_seq_score_layer:6667, sub_nested_seq_layer:6520)
+
+
+def sub_seq(input, offsets, sizes, name=None, **kw) -> LayerOutput:
+    return make_layer("subseq", name, [input, offsets, sizes])
+
+
+def kmax_seq_score(input, beam_size: int = 1, name=None, **kw) -> LayerOutput:
+    return make_layer("kmax_seq_score", name, [input], beam_size=beam_size)
+
+
+def sub_nested_seq(input, selected_indices, name=None, **kw) -> LayerOutput:
+    return make_layer("sub_nested_seq", name, [input, selected_indices])
+
+
+# ---------------------------------------------------------------------------
+# 3D conv/pool (Conv3DLayer.cpp, DeConv3DLayer.cpp, Pool3DLayer.cpp)
+
+
+def img_conv3d(input, filter_size, num_filters: int, input_depth: int,
+               name=None, num_channels=None, act=None, stride=1, padding=0,
+               trans: bool = False, param_attr=None, bias_attr=None,
+               input_height=None, input_width=None, **kw) -> LayerOutput:
+    layer_type = "deconv3d" if trans else "conv3d"
+    return make_layer(layer_type, name, [input], filter_size=filter_size,
+                      num_filters=num_filters, input_depth=input_depth,
+                      channels=num_channels, act=act_mod.to_name(act),
+                      stride=stride, padding=padding, param_attr=param_attr,
+                      bias_attr=bias_attr, input_height=input_height,
+                      input_width=input_width)
+
+
+def img_pool3d(input, pool_size, input_depth: int, name=None,
+               num_channels=None, pool_type=None, stride=1, padding=0,
+               input_height=None, input_width=None, **kw) -> LayerOutput:
+    return make_layer("pool3d", name, [input], pool_size=pool_size,
+                      input_depth=input_depth, channels=num_channels,
+                      pool_type=pool_mod.to_name(pool_type) if pool_type
+                      else "max",
+                      stride=stride, padding=padding,
+                      input_height=input_height, input_width=input_width)
+
+
+def mdlstm(input, name=None, directions=None, act=None, gate_act=None,
+           param_attr=None, bias_attr=None, **kw) -> LayerOutput:
+    return make_layer("mdlstm", name, [input],
+                      directions=directions or [True, True],
+                      act=act_mod.to_name(act) if act else "tanh",
+                      gate_act=act_mod.to_name(gate_act) if gate_act
+                      else "sigmoid",
+                      param_attr=param_attr, bias_attr=bias_attr)
+
+
+# ---------------------------------------------------------------------------
+# SSD detection (priorbox_layer:1095, multibox_loss_layer:1141,
+#  detection_output_layer:1214, cross_channel_norm_layer:1294)
+
+
+def priorbox(input, image, aspect_ratio, variance, min_size, max_size=None,
+             name=None, **kw) -> LayerOutput:
+    return make_layer("priorbox", name, [input, image],
+                      aspect_ratio=list(aspect_ratio),
+                      variance=list(variance), min_size=list(min_size),
+                      max_size=list(max_size or []))
+
+
+def cross_channel_norm(input, name=None, param_attr=None, **kw) -> LayerOutput:
+    return make_layer("cross_channel_norm", name, [input],
+                      param_attr=param_attr)
+
+
+def multibox_loss(input_loc, input_conf, priorbox, label, num_classes: int,
+                  overlap_threshold: float = 0.5, neg_pos_ratio: float = 3.0,
+                  neg_overlap: float = 0.5, background_id: int = 0,
+                  name=None, **kw) -> LayerOutput:
+    locs = _listify(input_loc)
+    confs = _listify(input_conf)
+    assert len(locs) == len(confs)
+    return make_layer("multibox_loss", name,
+                      [priorbox, label] + locs + confs,
+                      input_num=len(locs), num_classes=num_classes,
+                      overlap_threshold=overlap_threshold,
+                      neg_pos_ratio=neg_pos_ratio, neg_overlap=neg_overlap,
+                      background_id=background_id)
+
+
+def detection_output(input_loc, input_conf, priorbox, num_classes: int,
+                     nms_threshold: float = 0.45, nms_top_k: int = 400,
+                     keep_top_k: int = 200,
+                     confidence_threshold: float = 0.01,
+                     background_id: int = 0, name=None, **kw) -> LayerOutput:
+    locs = _listify(input_loc)
+    confs = _listify(input_conf)
+    assert len(locs) == len(confs)
+    return make_layer("detection_output", name, [priorbox] + locs + confs,
+                      input_num=len(locs), num_classes=num_classes,
+                      nms_threshold=nms_threshold, nms_top_k=nms_top_k,
+                      keep_top_k=keep_top_k,
+                      confidence_threshold=confidence_threshold,
+                      background_id=background_id)

@@ -257,3 +257,135 @@ class BlockExpandLayer:
         data = patches.reshape(b, oh * ow, d)
         lengths = jnp.full((b,), oh * ow, jnp.int32)
         return SequenceBatch(data, lengths)
+
+
+def ensure_ndhwc(x: jnp.ndarray, c: int, d: int, h: int, w: int) -> jnp.ndarray:
+    """Accept [b, c*d*h*w] flat channel-major or already-NDHWC."""
+    if x.ndim == 5:
+        return x
+    b = x.shape[0]
+    return x.reshape(b, c, d, h, w).transpose(0, 2, 3, 4, 1)
+
+
+from paddle_tpu.ops.pool import _triple  # noqa: E402 — shared int->3-tuple
+
+
+@register_layer("conv3d")
+class Conv3DLayer:
+    """Volumetric convolution (gserver/layers/Conv3DLayer.cpp); shape math
+    from config_parser.py's depth-extended cnn_output_size. Input is
+    [b, c*d*h*w] flat channel-major (paddle layout) or NDHWC."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        ic = cfg.get("channels") or m.channels
+        idp = cfg["input_depth"]
+        ih = cfg.get("input_height") or m.height or \
+            int(round((m.size // (ic * idp)) ** 0.5))
+        iw = cfg.get("input_width") or m.width or (m.size // (ic * idp * ih))
+        oc = cfg["num_filters"]
+        kd, kh, kw = _triple(cfg["filter_size"])
+        sd, sh, sw = _triple(cfg.get("stride", 1))
+        pd, ph, pw = _triple(cfg.get("padding", 0))
+        od = conv_ops.conv_out_size(idp, kd, sd, pd)
+        oh = conv_ops.conv_out_size(ih, kh, sh, ph)
+        ow = conv_ops.conv_out_size(iw, kw, sw, pw)
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        specs = [ParamSpec(wname, (kd, kh, kw, ic, oc),
+                           a.initializer or initializers.msra((0, 1, 2, 3)), a)]
+        cfg["_w_name"] = wname
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (oc,), initializers.zeros, battr))
+            cfg["_bias_name"] = bname
+        cfg["_in"] = (ic, idp, ih, iw)
+        cfg["_out"] = (oc, od, oh, ow)
+        return (LayerMeta(size=oc * od * oh * ow, height=oh, width=ow,
+                          channels=oc), specs, [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_ndhwc(inputs[0], *cfg["_in"])
+        y = conv_ops.conv3d(x, params[cfg["_w_name"]],
+                            stride=cfg.get("stride", 1),
+                            padding=cfg.get("padding", 0))
+        if cfg.get("_bias_name"):
+            y = y + params[cfg["_bias_name"]]
+        return act_ops.get(cfg.get("act", "linear"))(y)
+
+
+@register_layer("deconv3d")
+class DeConv3DLayer:
+    """Volumetric transposed convolution (DeConv3DLayer.cpp)."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        ic = cfg.get("channels") or m.channels
+        idp = cfg["input_depth"]
+        ih = cfg.get("input_height") or m.height
+        iw = cfg.get("input_width") or m.width
+        oc = cfg["num_filters"]
+        kd, kh, kw = _triple(cfg["filter_size"])
+        sd, sh, sw = _triple(cfg.get("stride", 1))
+        pd, ph, pw = _triple(cfg.get("padding", 0))
+        od = (idp - 1) * sd - 2 * pd + kd
+        oh = (ih - 1) * sh - 2 * ph + kh
+        ow = (iw - 1) * sw - 2 * pw + kw
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        specs = [ParamSpec(wname, (kd, kh, kw, ic, oc),
+                           a.initializer or initializers.msra((0, 1, 2, 3)), a)]
+        cfg["_w_name"] = wname
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (oc,), initializers.zeros, battr))
+            cfg["_bias_name"] = bname
+        cfg["_in"] = (ic, idp, ih, iw)
+        return (LayerMeta(size=oc * od * oh * ow, height=oh, width=ow,
+                          channels=oc), specs, [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_ndhwc(inputs[0], *cfg["_in"])
+        y = conv_ops.conv3d_transpose(x, params[cfg["_w_name"]],
+                                      stride=cfg.get("stride", 1),
+                                      padding=cfg.get("padding", 0))
+        if cfg.get("_bias_name"):
+            y = y + params[cfg["_bias_name"]]
+        return act_ops.get(cfg.get("act", "linear"))(y)
+
+
+@register_layer("pool3d")
+class Pool3DLayer:
+    """Volumetric pooling (Pool3DLayer.cpp)."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        c = cfg.get("channels") or m.channels
+        idp = cfg["input_depth"]
+        ih = cfg.get("input_height") or m.height
+        iw = cfg.get("input_width") or m.width
+        kd, kh, kw = _triple(cfg["pool_size"])
+        sd, sh, sw = _triple(cfg.get("stride", 1))
+        pd, ph, pw = _triple(cfg.get("padding", 0))
+        od = pool_ops.pool_out_size(idp, kd, sd, pd)
+        oh = pool_ops.pool_out_size(ih, kh, sh, ph)
+        ow = pool_ops.pool_out_size(iw, kw, sw, pw)
+        cfg["_in"] = (c, idp, ih, iw)
+        return (LayerMeta(size=c * od * oh * ow, height=oh, width=ow,
+                          channels=c), [], [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_ndhwc(inputs[0], *cfg["_in"])
+        k = _triple(cfg["pool_size"])
+        s = _triple(cfg.get("stride", 1))
+        p = _triple(cfg.get("padding", 0))
+        if cfg.get("pool_type", "max") in ("max", "cudnn-max"):
+            return pool_ops.max_pool3d(x, k, s, p)
+        return pool_ops.avg_pool3d(x, k, s, p)

@@ -1,0 +1,235 @@
+"""SSD detection layers: priorbox, multibox loss, detection output, norm.
+
+Reference: paddle/gserver/layers/{PriorBox.cpp, MultiBoxLossLayer.cpp,
+DetectionOutputLayer.cpp, CrossChannelNormLayer.cpp}; DSL wrappers
+trainer_config_helpers/layers.py:1095-1330 (priorbox_layer,
+multibox_loss_layer, detection_output_layer, cross_channel_norm_layer).
+
+Layout notes: conv loc/conf heads arrive as NHWC images; the reference
+permutes NCHW->NHWC before flattening (DetectionOutputLayer.cpp
+appendWithPermute), so our natural NHWC flatten produces the same
+prior-major ordering. Detection output is a fixed [b, keep_top_k, 7]
+tensor of (image_id, label, score, xmin, ymin, xmax, ymax) with label -1
+on padded rows — the static-shape stand-in for the reference's variable
+row count.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers
+from paddle_tpu.core.registry import (LayerMeta, ParamAttr, ParamSpec,
+                                      register_layer)
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layers.conv_layers import ensure_nhwc
+from paddle_tpu.ops import detection as det_ops
+
+
+@register_layer("priorbox")
+class PriorBoxLayer:
+    """Generates SSD anchors for one feature map (PriorBox.cpp:34-106)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m, img = input_metas
+        n_ratio_boxes = sum(2 for r in cfg["aspect_ratio"]
+                            if abs(r - 1.0) >= 1e-6)
+        n_priors = (len(cfg["min_size"]) * (1 + len(cfg.get("max_size", [])))
+                    + n_ratio_boxes)
+        cfg["_n_priors"] = n_priors
+        cfg["_lh"], cfg["_lw"] = m.height, m.width
+        cfg["_ih"], cfg["_iw"] = img.height, img.width
+        size = m.height * m.width * n_priors * 8
+        return LayerMeta(size=size), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        pb = det_ops.prior_boxes(
+            cfg["_lh"], cfg["_lw"], cfg["_ih"], cfg["_iw"],
+            cfg["min_size"], cfg.get("max_size", []),
+            cfg["aspect_ratio"], cfg["variance"])
+        x = inputs[0].data if isinstance(inputs[0], SequenceBatch) else inputs[0]
+        b = x.shape[0]
+        return jnp.broadcast_to(pb.reshape(1, -1), (b, pb.size))
+
+
+@register_layer("cross_channel_norm")
+class CrossChannelNormLayer:
+    """Per-position L2 norm across channels with a learned per-channel scale
+    (CrossChannelNormLayer.cpp — SSD's conv4_3 L2 normalization)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        a = ParamAttr.of(cfg.get("param_attr"))
+        pname = a.name or f"_{name}.w0"
+        cfg["_w_name"] = pname
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = m.channels, m.height, m.width
+        specs = [ParamSpec(pname, (m.channels,),
+                           a.initializer or initializers.constant(20.0), a)]
+        return (LayerMeta(size=m.size, height=m.height, width=m.width,
+                          channels=m.channels), specs, [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        scale = params[cfg["_w_name"]]
+        norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True) + 1e-10)
+        return x / norm * scale
+
+
+def _gather_heads(cfg, inputs, start, n, per_box, shapes_key):
+    """Flatten n NHWC head outputs into [b, total_priors, per_box]."""
+    parts = []
+    for i in range(n):
+        x = inputs[start + i]
+        x = x.data if isinstance(x, SequenceBatch) else x
+        shp = cfg[shapes_key][i]
+        x = ensure_nhwc(x, *shp)           # [b, h, w, np*per_box]
+        parts.append(x.reshape(x.shape[0], -1, per_box))
+    return jnp.concatenate(parts, axis=1)
+
+
+def _priors_from_input(val):
+    pb = val.data if isinstance(val, SequenceBatch) else val
+    return pb[0].reshape(-1, 8)            # identical across the batch
+
+
+@register_layer("multibox_loss")
+class MultiBoxLossLayer:
+    """SSD training loss: prior/gt matching, smooth-L1 loc loss, softmax conf
+    loss with hard negative mining (MultiBoxLossLayer.cpp).
+
+    Inputs: [priorbox, label, loc..., conf...] where label is a SequenceBatch
+    of per-image gt rows (label_id, xmin, ymin, xmax, ymax, [difficult]).
+    Output: [b, 1] per-image normalized loss.
+    """
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        n = cfg["input_num"]
+        cfg["_loc_shapes"] = [(m.channels, m.height, m.width)
+                              for m in input_metas[2:2 + n]]
+        cfg["_conf_shapes"] = [(m.channels, m.height, m.width)
+                               for m in input_metas[2 + n:2 + 2 * n]]
+        return LayerMeta(size=1), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        n = cfg["input_num"]
+        num_classes = cfg["num_classes"]
+        bg = cfg.get("background_id", 0)
+        priors = _priors_from_input(inputs[0])           # [P, 8]
+        label: SequenceBatch = inputs[1]
+        loc = _gather_heads(cfg, inputs, 2, n, 4, "_loc_shapes")   # [b, P, 4]
+        conf = _gather_heads(cfg, inputs, 2 + n, n, num_classes,
+                             "_conf_shapes")
+        P = priors.shape[0]
+
+        gt_boxes = label.data[..., 1:5]                  # [b, G, 4]
+        gt_labels = label.data[..., 0].astype(jnp.int32)
+        gt_valid = label.bool_mask()                     # [b, G]
+
+        def per_image(loc_i, conf_i, boxes_i, labels_i, valid_i):
+            midx, miou = det_ops.match_priors(
+                priors, boxes_i, valid_i,
+                overlap_threshold=cfg.get("overlap_threshold", 0.5))
+            pos = midx >= 0
+            n_pos = jnp.sum(pos)
+            safe = jnp.clip(midx, 0)
+            # localization: smooth-L1 on matched priors
+            targets = det_ops.encode_boxes(boxes_i[safe], priors)
+            loc_loss = jnp.sum(
+                jnp.where(pos[:, None],
+                          det_ops.smooth_l1(loc_i - targets), 0.0))
+            # confidence: softmax CE; positives use matched label,
+            # negatives (hard-mined) use background
+            tgt_cls = jnp.where(pos, labels_i[safe], bg)
+            logp = jax.nn.log_softmax(conf_i, axis=-1)
+            ce = -jnp.take_along_axis(logp, tgt_cls[:, None], axis=-1)[:, 0]
+            neg_cand = (~pos) & (miou < cfg.get("neg_overlap", 0.5))
+            n_neg = jnp.minimum(
+                (cfg.get("neg_pos_ratio", 3.0) * n_pos).astype(jnp.int32),
+                jnp.sum(neg_cand))
+            neg_score = jnp.where(neg_cand, ce, -jnp.inf)
+            order = jnp.argsort(-neg_score)
+            rank = jnp.zeros((P,), jnp.int32).at[order].set(
+                jnp.arange(P, dtype=jnp.int32))
+            neg_sel = neg_cand & (rank < n_neg)
+            conf_loss = jnp.sum(jnp.where(pos | neg_sel, ce, 0.0))
+            denom = jnp.maximum(n_pos.astype(loc_loss.dtype), 1.0)
+            return (loc_loss + conf_loss) / denom
+
+        losses = jax.vmap(per_image)(loc, conf, gt_boxes, gt_labels, gt_valid)
+        return losses[:, None]
+
+
+@register_layer("detection_output")
+class DetectionOutputLayer:
+    """Decode + per-class NMS + keep-top-k (DetectionOutputLayer.cpp).
+
+    Inputs: [priorbox, loc..., conf...]. Output [b, keep_top_k * 7] rows of
+    (image_id, label, score, xmin, ymin, xmax, ymax); label -1 pads.
+    """
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        n = cfg["input_num"]
+        cfg["_loc_shapes"] = [(m.channels, m.height, m.width)
+                              for m in input_metas[1:1 + n]]
+        cfg["_conf_shapes"] = [(m.channels, m.height, m.width)
+                               for m in input_metas[1 + n:1 + 2 * n]]
+        return LayerMeta(size=cfg.get("keep_top_k", 200) * 7), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        n = cfg["input_num"]
+        num_classes = cfg["num_classes"]
+        bg = cfg.get("background_id", 0)
+        keep_top_k = cfg.get("keep_top_k", 200)
+        nms_top_k = cfg.get("nms_top_k", 400)
+        priors = _priors_from_input(inputs[0])
+        loc = _gather_heads(cfg, inputs, 1, n, 4, "_loc_shapes")
+        conf = _gather_heads(cfg, inputs, 1 + n, n, num_classes,
+                             "_conf_shapes")
+        probs = jax.nn.softmax(conf, axis=-1)            # [b, P, C]
+
+        def per_image(loc_i, probs_i):
+            decoded = det_ops.decode_boxes(loc_i, priors)   # [P, 4]
+            rows = []
+            for c in range(num_classes):
+                if c == bg:
+                    continue
+                boxes_c, scores_c, keep_c = det_ops.nms(
+                    decoded, probs_i[:, c],
+                    iou_threshold=cfg.get("nms_threshold", 0.45),
+                    score_threshold=cfg.get("confidence_threshold", 0.01),
+                    top_k=nms_top_k)
+                lab = jnp.where(keep_c, float(c), -1.0)
+                rows.append(jnp.concatenate(
+                    [lab[:, None], scores_c[:, None], boxes_c], axis=1))
+            allr = jnp.concatenate(rows, axis=0)            # [(C-1)*K, 6]
+            k = min(keep_top_k, allr.shape[0])
+            top_scores, order = jax.lax.top_k(allr[:, 1], k)
+            sel = allr[order]
+            sel = jnp.where(top_scores[:, None] > 0, sel,
+                            jnp.concatenate([jnp.full((k, 1), -1.0),
+                                             jnp.zeros((k, 5))], axis=1))
+            if k < keep_top_k:
+                pad = jnp.concatenate(
+                    [jnp.full((keep_top_k - k, 1), -1.0),
+                     jnp.zeros((keep_top_k - k, 5))], axis=1)
+                sel = jnp.concatenate([sel, pad], axis=0)
+            return sel
+
+        out = jax.vmap(per_image)(loc, probs)               # [b, K, 6]
+        b = out.shape[0]
+        img_id = jnp.broadcast_to(
+            jnp.arange(b, dtype=out.dtype)[:, None, None],
+            (b, keep_top_k, 1))
+        out = jnp.concatenate([img_id, out], axis=-1)       # [b, K, 7]
+        return out.reshape(b, keep_top_k * 7)

@@ -1,0 +1,353 @@
+"""Assorted reference-parity layers: id/sampling helpers, selective FC,
+row convolution, data normalization, multiplex, elementwise utilities.
+
+Reference: paddle/gserver/layers/{MaxIdLayer.cpp, SamplingIdLayer.cpp,
+EosIdCheckLayer.cpp, MultiplexLayer.cpp, SelectiveFullyConnectedLayer.cpp,
+RowConvLayer.cpp, DataNormLayer.cpp (.h:41 NormalizationStrategy),
+ClipLayer.cpp, ScaleShiftLayer.cpp, PowerLayer.cpp,
+FeatureMapExpandLayer.cpp, RotateLayer.cpp, PrintLayer.cpp}; DSL wrappers
+trainer_config_helpers/layers.py (maxid_layer:3989, sampling_id_layer:4859,
+eos_layer:4062, selective_fc_layer:4776, row_conv_layer:6197,
+multiplex_layer:6123, clip_layer:6566, scale_shift_layer:6849,
+power_layer:2046, rotate_layer:2167).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers
+from paddle_tpu.core.registry import (LayerMeta, ParamAttr, ParamSpec,
+                                      default_weight_init, register_layer)
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layers.base import _map_seq, _payload
+from paddle_tpu.layers.conv_layers import ensure_nhwc
+from paddle_tpu.ops import activations as act_ops
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import linear as linear_ops
+
+
+@register_layer("maxid")
+class MaxIdLayer:
+    """Argmax id per row (MaxIdLayer.cpp; beam_size top ids when asked)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=cfg.get("beam_size", 1), seq_level=m.seq_level,
+                         is_integer=True), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        k = cfg.get("beam_size", 1)
+
+        def top(x):
+            if k == 1:
+                return jnp.argmax(x, axis=-1).astype(jnp.int32)[..., None]
+            _, idx = jax.lax.top_k(x, k)
+            return idx.astype(jnp.int32)
+
+        return _map_seq(top, inputs[0])
+
+
+@register_layer("sampling_id")
+class SamplingIdLayer:
+    """Sample one id from each row's distribution (SamplingIdLayer.cpp,
+    MultinomialSampler.cpp). In eval mode falls back to argmax so test
+    passes stay deterministic."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=1, seq_level=m.seq_level, is_integer=True), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        def sample(x):
+            logits = jnp.log(jnp.clip(x, 1e-20))
+            if not ctx.is_train:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)[..., None]
+            flat = logits.reshape(-1, logits.shape[-1])
+            ids = jax.random.categorical(ctx.rng_for(name), flat)
+            return ids.reshape(logits.shape[:-1] + (1,)).astype(jnp.int32)
+
+        return _map_seq(sample, inputs[0])
+
+
+@register_layer("eos_id")
+class EosIdCheckLayer:
+    """1.0 where the input id equals eos_id (EosIdCheckLayer.cpp)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=1, seq_level=m.seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        eos = cfg["eos_id"]
+
+        def check(ids):
+            ids = ids if ids.ndim and ids.shape[-1] == 1 else ids[..., None]
+            return (ids == eos).astype(jnp.float32)
+
+        return _map_seq(check, inputs[0])
+
+
+@register_layer("multiplex")
+class MultiplexLayer:
+    """Row-wise select among k value inputs by an id input
+    (MultiplexLayer.cpp: input 0 is ids, inputs 1..k are candidates)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        size = input_metas[1].size
+        for m in input_metas[2:]:
+            assert m.size == size, "multiplex candidates must agree in size"
+        return LayerMeta(size=size), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        ids = _payload(inputs[0]).reshape(-1).astype(jnp.int32)
+        stacked = jnp.stack([_payload(v) for v in inputs[1:]], axis=0)
+        return stacked[ids, jnp.arange(stacked.shape[1])]
+
+
+@register_layer("clip")
+class ClipLayer:
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=m.seq_level, height=m.height,
+                         width=m.width, channels=m.channels), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        lo, hi = cfg["min"], cfg["max"]
+        return _map_seq(lambda x: jnp.clip(x, lo, hi), inputs[0])
+
+
+@register_layer("scale_shift")
+class ScaleShiftLayer:
+    """y = w * x + b with scalar learned w (and optional scalar b)
+    (ScaleShiftLayer.cpp)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        specs = [ParamSpec(wname, (1,), a.initializer or initializers.ones, a)]
+        cfg["_w_name"] = wname
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (1,), initializers.zeros, battr))
+            cfg["_bias_name"] = bname
+        return LayerMeta(size=m.size, seq_level=m.seq_level), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        w = params[cfg["_w_name"]]
+        b = params.get(cfg.get("_bias_name"), jnp.zeros((1,))) \
+            if cfg.get("_bias_name") else 0.0
+        return _map_seq(lambda x: w * x + b, inputs[0])
+
+
+@register_layer("power")
+class PowerLayer:
+    """y = v ** w with per-row scalar exponent input 0 (PowerLayer.cpp)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=input_metas[1].size,
+                         seq_level=input_metas[1].seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        w = _payload(inputs[0])
+        v = inputs[1]
+        ref = v if isinstance(v, SequenceBatch) else None
+        out = jnp.power(jnp.clip(_payload(v), 1e-20), w)
+        return ref.with_data(out) if ref is not None else out
+
+
+@register_layer("featmap_expand")
+class FeatureMapExpandLayer:
+    """Tile a [b, d] input across num_filters channels -> [b, num_filters*d]
+    (FeatureMapExpandLayer.cpp; as_row_vector matches the reference flag)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        nf = cfg["num_filters"]
+        return LayerMeta(size=m.size * nf, seq_level=m.seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        nf = cfg["num_filters"]
+        as_row = cfg.get("as_row_vector", True)
+
+        def expand(x):
+            if as_row:
+                return jnp.tile(x, (1,) * (x.ndim - 1) + (nf,))
+            return jnp.repeat(x, nf, axis=-1)
+
+        return _map_seq(expand, inputs[0])
+
+
+@register_layer("rotate")
+class RotateLayer:
+    """Rotate a CHW feature map 90 degrees counter-clockwise
+    (RotateLayer.cpp; used by trans_layer's spatial sibling)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        h = cfg.get("height") or m.height
+        w = cfg.get("width") or m.width
+        c = m.channels or (m.size // max(h * w, 1))
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = c, h, w
+        return LayerMeta(size=m.size, height=w, width=h, channels=c), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        return jnp.rot90(x, k=1, axes=(1, 2))
+
+
+@register_layer("data_norm")
+class DataNormLayer:
+    """Feature normalization from precomputed stats (DataNormLayer.h:41
+    strategies: z-score, min-max, decimal-scaling). The stats live in one
+    non-trainable [5, size] parameter with rows (min, max, mean, std,
+    decimal_scale), loaded rather than learned — matching the reference's
+    externally-computed stats parameter."""
+
+    STRATS = {"z-score": 0, "min-max": 1, "decimal-scaling": 2}
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        a = ParamAttr.of(cfg.get("param_attr"))
+        a.is_static = True
+        pname = a.name or f"_{name}.w0"
+        cfg["_w_name"] = pname
+
+        def stats_init(key, shape, dtype=jnp.float32):
+            base = jnp.zeros(shape, dtype)
+            return base.at[1].set(1.0).at[3].set(1.0).at[4].set(1.0)
+
+        specs = [ParamSpec(pname, (5, m.size), stats_init, a)]
+        return LayerMeta(size=m.size, seq_level=m.seq_level), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        stats = params[cfg["_w_name"]]
+        mn, mx, mean, std, dscale = (stats[i] for i in range(5))
+        strat = cfg.get("data_norm_strategy", "z-score")
+
+        def norm(x):
+            if strat == "min-max":
+                return (x - mn) / jnp.maximum(mx - mn, 1e-8)
+            if strat == "decimal-scaling":
+                return x / jnp.maximum(dscale, 1e-8)
+            return (x - mean) / jnp.maximum(std, 1e-8)
+
+        return _map_seq(norm, inputs[0])
+
+
+@register_layer("selective_fc")
+class SelectiveFCLayer:
+    """FC computed only on selected output columns
+    (SelectiveFullyConnectedLayer.cpp). The selection arrives as a dense
+    0/1 mask [b, size] (the reference's sparse selection matrix densified —
+    on the MXU a masked full matmul beats a gather for the typical
+    size/selection ratios). With no selection input it degrades to plain fc,
+    matching the reference's full-output mode."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        size = cfg["size"]
+        m = input_metas[0]
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        # weight is stored transposed [size, in] as the reference does
+        # (selective rows = output columns)
+        specs = [ParamSpec(wname, (size, m.size),
+                           default_weight_init(a, (1,)), a)]
+        cfg["_w_name"] = wname
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (size,), initializers.zeros, battr))
+            cfg["_bias_name"] = bname
+        cfg["_has_select"] = len(input_metas) > 1
+        return LayerMeta(size=size, seq_level=m.seq_level), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        w = params[cfg["_w_name"]]
+        b = params.get(cfg.get("_bias_name")) if cfg.get("_bias_name") else None
+        x = inputs[0]
+        sel = _payload(inputs[1]) if cfg.get("_has_select") else None
+
+        def run(v):
+            y = linear_ops.matmul(v, w.T)
+            if b is not None:
+                y = y + b
+            y = act_ops.get(cfg.get("act", "linear"))(y)
+            if sel is not None:
+                y = y * sel.astype(y.dtype)
+            return y
+
+        return _map_seq(run, x)
+
+
+@register_layer("row_conv")
+class RowConvLayer:
+    """Lookahead row convolution over a sequence (RowConvLayer.cpp:27-91,
+    DeepSpeech2): out[t] = sum_{i<ctx} in[t+i] * w[i], per-channel weights
+    [context, d]. Future context = context - 1 steps (RowConvLayer.h:40)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        ctxlen = cfg["context_len"]
+        a = ParamAttr.of(cfg.get("param_attr"))
+        pname = a.name or f"_{name}.w0"
+        cfg["_w_name"] = pname
+        specs = [ParamSpec(pname, (ctxlen, m.size),
+                           default_weight_init(a, (0,)), a)]
+        return LayerMeta(size=m.size, seq_level=1), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq: SequenceBatch = inputs[0]
+        w = params[cfg["_w_name"]]
+        out = conv_ops.row_conv(seq.masked_data(), w)
+        act = cfg.get("act", "linear")
+        return seq.with_data(act_ops.get(act)(out))
+
+
+@register_layer("print")
+class PrintLayer:
+    """Identity layer that prints its input during execution
+    (PrintLayer.cpp / ValuePrinter) via jax.debug.print — works under jit."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=m.seq_level, height=m.height,
+                         width=m.width, channels=m.channels,
+                         is_integer=m.is_integer), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        val = inputs[0]
+        fmt = cfg.get("format", name + ": {x}")
+        jax.debug.print(fmt, x=_payload(val))
+        return val

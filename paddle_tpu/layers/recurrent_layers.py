@@ -200,3 +200,48 @@ class LstmStepLayer:
         if cfg.get("expose_state"):
             return jnp.concatenate([h_new, c_new], axis=-1)
         return h_new
+
+
+@register_layer("mdlstm")
+class MDLstmLayer:
+    """2-D multi-directional LSTM over an image (MDLstmLayer.cpp).
+
+    Input: an image whose channel count is 5*size (the pre-projected gate
+    input, as lstmemory expects 4*size — reference layout
+    numBlocks*(3+numDims), numDims=2). Owns the shared recurrent weight
+    [size, 5*size] and the (5+2*2)*size bias (gates + peepholes).
+    directions: [bool, bool] — False reverses the walk along (height,
+    width), matching config.directions."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        assert m.channels and m.channels % 5 == 0, \
+            f"mdlstm {name}: input channels must be 5*size"
+        h = m.channels // 5
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        specs = [ParamSpec(wname, (h, 5 * h),
+                           a.initializer or initializers.smart_normal(0), a)]
+        cfg["_w_name"] = wname
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (9 * h,), initializers.zeros, battr))
+            cfg["_b_name"] = bname
+        cfg["_in"] = (m.channels, m.height, m.width)
+        return (LayerMeta(size=h * m.height * m.width, height=m.height,
+                          width=m.width, channels=h), specs, [])
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        from paddle_tpu.layers.conv_layers import ensure_nhwc
+        x = ensure_nhwc(inputs[0], *cfg["_in"])
+        w = params[cfg["_w_name"]]
+        bias = params.get(cfg.get("_b_name")) if cfg.get("_b_name") else None
+        dirs = cfg.get("directions", [True, True])
+        return rnn_ops.mdlstm_2d(
+            x, w, bias, act=cfg.get("act", "tanh"),
+            gate_act=cfg.get("gate_act", "sigmoid"),
+            reverse_h=not dirs[0], reverse_w=not dirs[1])

@@ -8,6 +8,7 @@ first_seq, expand_layer, seq_concat_layer, ...
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import LayerMeta, register_layer
@@ -156,3 +157,102 @@ class ContextProjectionLayer:
         cstart = cfg.get("context_start", -(clen // 2))
         pad = params.get(cfg.get("_pad_name")) if cfg.get("_pad_name") else None
         return seq_ops.context_projection(inputs[0], clen, cstart, pad)
+
+
+@register_layer("subseq")
+class SubSeqLayer:
+    """SubSequenceLayer: per-row slice given offset and size id inputs
+    (gserver/layers/SubSequenceLayer.cpp; DSL sub_seq_layer)."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=m.seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq, offsets, sizes = inputs
+        off = _first_col(offsets)
+        sz = _first_col(sizes)
+        return seq_ops.seq_slice(seq, off, off + sz)
+
+
+def _first_col(v):
+    x = v.data if isinstance(v, SequenceBatch) else v
+    x = x.reshape(x.shape[0], -1)
+    return x[:, 0].astype(jnp.int32)
+
+
+@register_layer("kmax_seq_score")
+class KmaxSeqScoreLayer:
+    """Top-k positions of per-step scores within each sequence
+    (KmaxSeqScoreLayer.cpp; DSL kmax_seq_score_layer:6667). Output [b, k]
+    int32 position ids, -1 padded past the sequence length — feeds
+    sub_nested_seq selection in beam decoding stacks."""
+    @staticmethod
+    def build(name, cfg, input_metas):
+        return LayerMeta(size=cfg.get("beam_size", 1), is_integer=True), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq: SequenceBatch = inputs[0]
+        k = cfg.get("beam_size", 1)
+        scores = seq.data.reshape(seq.batch_size, seq.max_len)
+        scores = jnp.where(seq.bool_mask(), scores, -jnp.inf)
+        vals, idx = jax.lax.top_k(scores, min(k, scores.shape[1]))
+        idx = jnp.where(jnp.isfinite(vals), idx, -1).astype(jnp.int32)
+        if idx.shape[1] < k:
+            idx = jnp.pad(idx, ((0, 0), (0, k - idx.shape[1])),
+                          constant_values=-1)
+        return idx
+
+
+@register_layer("sub_nested_seq")
+class SubNestedSeqLayer:
+    """Select subsequences of a nested sequence by index
+    (SubNestedSequenceLayer.cpp:36-60; DSL sub_nested_seq_layer:6520).
+
+    Input 0: nested SequenceBatch; input 1: selected segment indices
+    [b, k] int32 (-1 = unused slot). Output: nested SequenceBatch holding
+    only the selected subsequences, renumbered 0..k'-1 and packed to the
+    front of the time axis.
+    """
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=2), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        seq: SequenceBatch = inputs[0]
+        assert seq.is_nested, "sub_nested_seq needs a nested sequence input"
+        sel = inputs[1]
+        sel = (sel.data if isinstance(sel, SequenceBatch) else sel)
+        sel = sel.reshape(sel.shape[0], -1).astype(jnp.int32)   # [b, k]
+        T = seq.max_len
+
+        def per_row(data, segs, sel_row):
+            k = sel_row.shape[0]
+            # new segment index of each input position (-1 = dropped)
+            eq = (segs[None, :] == sel_row[:, None]) & \
+                (sel_row[:, None] >= 0) & (segs[None, :] >= 0)   # [k, T]
+            nj = jnp.where(jnp.any(eq, axis=0),
+                           jnp.argmax(eq, axis=0), -1)           # [T]
+            seg_len = jnp.sum(eq, axis=1)                        # [k]
+            offset = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32),
+                 jnp.cumsum(seg_len)[:-1].astype(jnp.int32)])
+            # rank within the source segment: segments are contiguous, so
+            # rank = t - first position of that segment
+            first = jnp.argmax(eq, axis=1).astype(jnp.int32)     # [k]
+            t_idx = jnp.arange(T, dtype=jnp.int32)
+            rank = t_idx - first[jnp.clip(nj, 0)]
+            newpos = jnp.where(nj >= 0, offset[jnp.clip(nj, 0)] + rank, T)
+            out = jnp.zeros_like(data).at[newpos].set(data, mode="drop")
+            out_segs = jnp.full((T,), -1, jnp.int32).at[newpos].set(
+                nj, mode="drop")
+            return out, out_segs, jnp.sum(seg_len).astype(jnp.int32), \
+                jnp.sum(sel_row >= 0).astype(jnp.int32)
+
+        data, segs, lengths, nsegs = jax.vmap(per_row)(
+            seq.data, seq.segment_ids, sel)
+        return SequenceBatch(data, lengths, segs, nsegs)

@@ -139,3 +139,28 @@ def row_conv(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=d)
     return y[:, :, 0, :]
+
+
+def conv3d_transpose(x: jnp.ndarray, w: jnp.ndarray, *, stride=1,
+                     padding=0) -> jnp.ndarray:
+    """x: [N,D,H,W,C], w: [kd,kh,kw,IC,OC] (DeConv3DLayer) — same
+    fractionally-strided form as conv2d_transpose above."""
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+    if isinstance(padding, int):
+        padding = (padding,) * 3
+    k = w.shape[:3]
+    pads = tuple((k[i] - 1 - padding[i], k[i] - 1 - padding[i])
+                 for i in range(3))
+    cd = compute_dtype()
+    out_dtype = x.dtype
+    pet = jnp.float32 if cd == jnp.float32 else None
+    if cd != jnp.float32:
+        x = x.astype(cd)
+        w = w.astype(cd)
+    y = lax.conv_transpose(
+        x, w, strides=tuple(stride), padding=pads,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        precision=_prec(),
+        preferred_element_type=pet)
+    return y.astype(out_dtype)

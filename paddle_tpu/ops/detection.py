@@ -1,0 +1,190 @@
+"""SSD-style detection ops: prior boxes, bbox encode/decode, IoU, NMS.
+
+Reference: paddle/gserver/layers/PriorBox.cpp (forward:34-106, init:19-33),
+paddle/gserver/layers/DetectionUtil.cpp (decodeBBox, encodeBBoxWithVar,
+matchBBox semantics inside MultiBoxLossLayer), DetectionOutputLayer.cpp.
+
+TPU design: the reference builds dynamic per-class vectors on the CPU and
+runs greedy NMS over them; here everything is fixed-shape and vectorized so
+the whole detection head stays on-device under jit. NMS is a static-length
+greedy pass (`lax.fori_loop` over a top-k candidate list with an O(N^2)
+IoU suppression matrix) — padded slots carry score 0 / label -1.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def prior_boxes(layer_h: int, layer_w: int, image_h: int, image_w: int,
+                min_sizes: Sequence[float], max_sizes: Sequence[float],
+                aspect_ratios: Sequence[float], variance: Sequence[float],
+                clip: bool = True) -> jnp.ndarray:
+    """Generate SSD prior boxes for one feature map.
+
+    Returns [layer_h * layer_w * num_priors, 8] — each row is
+    (xmin, ymin, xmax, ymax, var0, var1, var2, var3), normalized to [0, 1],
+    matching the reference's interleaved box/variance layout
+    (PriorBox.cpp:49-67: 4 coords then 4 variances per prior).
+
+    Prior order per cell mirrors the reference loop: one box per min_size
+    (aspect 1), then one sqrt(min*max) box per max_size, then one box per
+    flipped aspect ratio (r and 1/r) at the last min_size.
+    """
+    assert len(variance) == 4
+    step_w = image_w / layer_w
+    step_h = image_h / layer_h
+
+    # per-cell (w, h) box shapes in pixels, in reference emission order
+    shapes = []
+    for s in min_sizes:
+        shapes.append((s, s))
+    for s in min_sizes:
+        for m in max_sizes:
+            d = math.sqrt(s * m)
+            shapes.append((d, d))
+    base = min_sizes[-1]
+    for r in aspect_ratios:
+        if abs(r - 1.0) < 1e-6:
+            continue
+        for ar in (r, 1.0 / r):
+            shapes.append((base * math.sqrt(ar), base / math.sqrt(ar)))
+    shapes = jnp.asarray(shapes, jnp.float32)          # [np, 2]
+    n_priors = shapes.shape[0]
+
+    cx = (jnp.arange(layer_w, dtype=jnp.float32) + 0.5) * step_w
+    cy = (jnp.arange(layer_h, dtype=jnp.float32) + 0.5) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)                    # [h, w]
+    cxg = cxg[..., None]                               # [h, w, 1]
+    cyg = cyg[..., None]
+    bw = shapes[None, None, :, 0]                      # [1, 1, np]
+    bh = shapes[None, None, :, 1]
+    xmin = (cxg - bw / 2.0) / image_w
+    ymin = (cyg - bh / 2.0) / image_h
+    xmax = (cxg + bw / 2.0) / image_w
+    ymax = (cyg + bh / 2.0) / image_h
+    boxes = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)  # [h, w, np, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           boxes.shape)
+    out = jnp.concatenate([boxes, var], axis=-1)       # [h, w, np, 8]
+    return out.reshape(layer_h * layer_w * n_priors, 8)
+
+
+def _center_form(boxes: jnp.ndarray):
+    """(xmin,ymin,xmax,ymax) -> (cx, cy, w, h)."""
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    cx = (boxes[..., 0] + boxes[..., 2]) * 0.5
+    cy = (boxes[..., 1] + boxes[..., 3]) * 0.5
+    return cx, cy, w, h
+
+
+def decode_boxes(loc: jnp.ndarray, priors: jnp.ndarray) -> jnp.ndarray:
+    """Decode predicted offsets against priors (DetectionUtil decodeBBox).
+
+    loc:    [..., P, 4] predicted (dx, dy, dw, dh)
+    priors: [P, 8] boxes + variances from prior_boxes
+    returns [..., P, 4] corner-form boxes.
+    """
+    pcx, pcy, pw, ph = _center_form(priors[..., :4])
+    var = priors[..., 4:]
+    cx = var[..., 0] * loc[..., 0] * pw + pcx
+    cy = var[..., 1] * loc[..., 1] * ph + pcy
+    w = jnp.exp(jnp.clip(var[..., 2] * loc[..., 2], -10.0, 10.0)) * pw
+    h = jnp.exp(jnp.clip(var[..., 3] * loc[..., 3], -10.0, 10.0)) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+def encode_boxes(gt: jnp.ndarray, priors: jnp.ndarray) -> jnp.ndarray:
+    """Encode ground-truth corner boxes into regression targets (inverse of
+    decode_boxes; DetectionUtil encodeBBoxWithVar)."""
+    pcx, pcy, pw, ph = _center_form(priors[..., :4])
+    var = priors[..., 4:]
+    gcx, gcy, gw, gh = _center_form(gt)
+    eps = 1e-8
+    dx = (gcx - pcx) / jnp.maximum(pw, eps) / var[..., 0]
+    dy = (gcy - pcy) / jnp.maximum(ph, eps) / var[..., 1]
+    dw = jnp.log(jnp.maximum(gw, eps) / jnp.maximum(pw, eps)) / var[..., 2]
+    dh = jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ph, eps)) / var[..., 3]
+    return jnp.stack([dx, dy, dw, dh], axis=-1)
+
+
+def iou_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU. a: [N, 4], b: [M, 4] corner boxes -> [N, M]."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0.0) * jnp.clip(a[:, 3] - a[:, 1], 0.0)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0.0) * jnp.clip(b[:, 3] - b[:, 1], 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+
+
+def match_priors(priors: jnp.ndarray, gt_boxes: jnp.ndarray,
+                 gt_valid: jnp.ndarray,
+                 overlap_threshold: float = 0.5
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Match priors to ground truth (MultiBoxLossLayer matchBBox semantics).
+
+    Two-phase: (1) per-prior argmax matching when IoU > overlap_threshold,
+    (2) bipartite override — every valid gt claims its best prior so no gt
+    goes unmatched. Returns (match_idx [P] int32, -1 = unmatched;
+    match_iou [P] float32).
+    """
+    P = priors.shape[0]
+    iou = iou_matrix(priors[:, :4], gt_boxes)            # [P, G]
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)  # [P]
+    best_iou = jnp.max(iou, axis=1)
+    match_idx = jnp.where(best_iou > overlap_threshold, best_gt, -1)
+    # bipartite: gt g claims prior argmax_p iou[p, g]; invalid gt slots are
+    # routed to index P so the drop-mode scatter ignores them entirely
+    best_prior = jnp.argmax(iou, axis=0).astype(jnp.int32)  # [G]
+    g_ids = jnp.arange(gt_boxes.shape[0], dtype=jnp.int32)
+    scatter_idx = jnp.where(gt_valid, best_prior, P)
+    claimed = jnp.full((P,), -1, jnp.int32).at[scatter_idx].set(
+        g_ids, mode="drop")
+    match_idx = jnp.where(claimed >= 0, claimed, match_idx)
+    match_iou = jnp.where(
+        claimed >= 0,
+        iou[jnp.arange(P), jnp.clip(claimed, 0)],
+        best_iou)
+    return match_idx, match_iou
+
+
+def nms(boxes: jnp.ndarray, scores: jnp.ndarray, *,
+        iou_threshold: float = 0.45, score_threshold: float = 0.01,
+        top_k: int = 400) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Greedy NMS with static shapes (DetectionUtil applyNMSFast).
+
+    boxes [N, 4], scores [N] -> (boxes [K, 4], scores [K], keep_mask [K])
+    where K = min(top_k, N); suppressed/padded slots have score 0.
+    """
+    k = min(top_k, boxes.shape[0])
+    scores = jnp.where(scores >= score_threshold, scores, 0.0)
+    top_scores, order = lax.top_k(scores, k)
+    cand = boxes[order]                                   # [K, 4]
+    iou = iou_matrix(cand, cand)                          # [K, K]
+    valid = top_scores > 0.0
+
+    def body(i, keep):
+        sup = jnp.any((iou[i] > iou_threshold) & keep &
+                      (jnp.arange(k) < i))
+        return keep.at[i].set(valid[i] & ~sup)
+
+    keep = lax.fori_loop(0, k, body, jnp.zeros((k,), bool))
+    return cand, jnp.where(keep, top_scores, 0.0), keep
+
+
+def smooth_l1(x: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise smooth-L1 (huber with delta=1), as SSD's loc loss uses."""
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5)

@@ -114,3 +114,35 @@ def spatial_pyramid_pool(x: jnp.ndarray, pyramid_height: int,
                 else:
                     outs.append(jnp.mean(region, axis=(1, 2)))
     return jnp.concatenate(outs, axis=-1)
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def max_pool3d(x: jnp.ndarray, kernel, stride=None, padding=0) -> jnp.ndarray:
+    """x: [N,D,H,W,C] (Pool3DLayer); same ceil-mode arithmetic as 2D."""
+    kd, kh, kw = _triple(kernel)
+    sd, sh, sw = _triple(stride if stride is not None else kernel)
+    pd, ph, pw = _triple(padding)
+    _, pads_d = _ceil_pads(x.shape[1], kd, sd, pd)
+    _, pads_h = _ceil_pads(x.shape[2], kh, sh, ph)
+    _, pads_w = _ceil_pads(x.shape[3], kw, sw, pw)
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, kd, kh, kw, 1), (1, sd, sh, sw, 1),
+        ((0, 0), pads_d, pads_h, pads_w, (0, 0)))
+
+
+def avg_pool3d(x: jnp.ndarray, kernel, stride=None, padding=0) -> jnp.ndarray:
+    kd, kh, kw = _triple(kernel)
+    sd, sh, sw = _triple(stride if stride is not None else kernel)
+    pd, ph, pw = _triple(padding)
+    _, pads_d = _ceil_pads(x.shape[1], kd, sd, pd)
+    _, pads_h = _ceil_pads(x.shape[2], kh, sh, ph)
+    _, pads_w = _ceil_pads(x.shape[3], kw, sw, pw)
+    dims, strides = (1, kd, kh, kw, 1), (1, sd, sh, sw, 1)
+    pads = ((0, 0), pads_d, pads_h, pads_w, (0, 0))
+    sums = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    ones = jnp.ones(x.shape[:4] + (1,), x.dtype)
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+    return sums / jnp.maximum(counts, 1.0)

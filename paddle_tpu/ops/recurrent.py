@@ -224,3 +224,73 @@ def rnn_scan(seq: SequenceBatch, w_rec: jnp.ndarray,
 
     _, outs = _masked_scan(step, h_init, seq, reverse)
     return seq.with_data(outs)
+
+
+def mdlstm_2d(x: jnp.ndarray, w: jnp.ndarray, bias: Optional[jnp.ndarray],
+              *, act: str = "tanh", gate_act: str = "sigmoid",
+              reverse_h: bool = False, reverse_w: bool = False) -> jnp.ndarray:
+    """2-D multi-dimensional LSTM over an image grid (MDLstmLayer.cpp).
+
+    x:  [b, H, W, 5*h] pre-projected gate input — layout (in, ig, fg_y,
+        fg_x, og), matching the reference's numBlocks*(3+numDims) with
+        numDims=2 (MDLstmLayer.cpp:226-234).
+    w:  [h, 5*h] recurrent weight, shared across both predecessor
+        directions as the reference's single weight parameter is.
+    bias: [9*h] = 5h gate bias + peephole (ig, fg_y, fg_x, og) each h
+        (MDLstmLayer.cpp:230-232: numBlocks*(5+2*numDims)).
+
+    Each cell (i, j) sees h/c from (i-1, j) and (i, j-1). Implemented as a
+    scan over rows whose body scans over columns — XLA compiles the doubly
+    nested scan once; the H*W sequential chain is inherent to the
+    recurrence (the reference walks the same chain cell by cell via
+    CoordIterator). reverse_h/reverse_w flip the walk direction per axis,
+    giving the 4 scan directions a multi-directional stack needs.
+    """
+    b, H, W, d5 = x.shape
+    h = d5 // 5
+    fa = activations.get(act)
+    ga = activations.get(gate_act)
+    if bias is None:
+        gate_b = jnp.zeros((5 * h,), x.dtype)
+        peep = jnp.zeros((4 * h,), x.dtype)
+    else:
+        gate_b, peep = bias[:5 * h], bias[5 * h:]
+    p_ig, p_fy, p_fx, p_og = (peep[i * h:(i + 1) * h] for i in range(4))
+
+    if reverse_h:
+        x = x[:, ::-1]
+    if reverse_w:
+        x = x[:, :, ::-1]
+
+    def cell(pre, h_up, c_up, h_left, c_left):
+        pre = pre + matmul(h_up + h_left, w) + gate_b
+        a_in = fa(pre[..., :h])
+        ig = ga(pre[..., h:2 * h] + p_ig * (c_up + c_left))
+        fy = ga(pre[..., 2 * h:3 * h] + p_fy * c_up)
+        fx = ga(pre[..., 3 * h:4 * h] + p_fx * c_left)
+        c = ig * a_in + fy * c_up + fx * c_left
+        og = ga(pre[..., 4 * h:] + p_og * c)
+        return og * fa(c), c
+
+    def col_step(carry, inp):
+        h_left, c_left = carry
+        pre_j, h_up_j, c_up_j = inp
+        h_new, c_new = cell(pre_j, h_up_j, c_up_j, h_left, c_left)
+        return (h_new, c_new), (h_new, c_new)
+
+    def row_step(carry, pre_row):
+        h_up_row, c_up_row = carry            # [W, b, h] each
+        zero = jnp.zeros((b, h), x.dtype)
+        _, (h_row, c_row) = lax.scan(
+            col_step, (zero, zero), (pre_row, h_up_row, c_up_row))
+        return (h_row, c_row), h_row
+
+    pre = jnp.moveaxis(x, 0, 2)               # [H, W, b, 5h]
+    zero_row = jnp.zeros((W, b, h), x.dtype)
+    _, out = lax.scan(row_step, (zero_row, zero_row), pre)
+    out = jnp.moveaxis(out, 2, 0)             # [b, H, W, h]
+    if reverse_h:
+        out = out[:, ::-1]
+    if reverse_w:
+        out = out[:, :, ::-1]
+    return out

@@ -1,0 +1,434 @@
+"""Forward-semantics tests for the round-2 layer zoo additions: id/sampling
+helpers, multiplex, selective_fc, row_conv, data_norm, elementwise utils,
+sequence selection (sub_seq / kmax_seq_score / sub_nested_seq), 3D conv/pool,
+MDLstm, and the SSD detection family.
+
+Gradient coverage for these types comes from the generated matrix in
+test_layers_grad.py; here we pin VALUES against hand-computed expectations,
+the way test_LayerGrad.cpp's sibling unit tests (test_KmaxSeqScore.cpp,
+test_CrossEntropyOverBeamGrad.cpp, test_PriorBox.cpp, test_DetectionOutput.cpp)
+do in the reference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.sequence import pack_nested_sequences, pack_sequences
+from paddle_tpu.core.topology import Topology
+from paddle_tpu.ops import detection as det_ops
+
+
+def run(out, feed, mode="test", seed=0):
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(seed))
+    outs, _ = topo.forward(params, topo.init_state(), feed, mode=mode,
+                           rng=jax.random.PRNGKey(seed + 1))
+    return outs[out.name], params
+
+
+class TestIdLayers:
+    def test_maxid(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+        out = paddle.layer.max_id(x)
+        v = np.array([[0.1, 0.9, 0.0, 0.2], [0.5, 0.1, 0.3, 0.7]], np.float32)
+        got, _ = run(out, {"x": jnp.asarray(v)})
+        np.testing.assert_array_equal(np.asarray(got)[:, 0], [1, 3])
+
+    def test_maxid_beam(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+        out = paddle.layer.max_id(x, beam_size=2)
+        v = np.array([[0.1, 0.9, 0.0, 0.2]], np.float32)
+        got, _ = run(out, {"x": jnp.asarray(v)})
+        np.testing.assert_array_equal(np.asarray(got)[0], [1, 3])
+
+    def test_sampling_id_valid_and_deterministic_in_test(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(5))
+        out = paddle.layer.sampling_id(x)
+        probs = np.full((3, 5), 0.01, np.float32)
+        probs[:, 2] = 0.96
+        got, _ = run(out, {"x": jnp.asarray(probs)})
+        np.testing.assert_array_equal(np.asarray(got)[:, 0], [2, 2, 2])
+
+    def test_sampling_id_train_mode_samples(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(3))
+        out = paddle.layer.sampling_id(x)
+        probs = np.tile(np.array([[0.2, 0.5, 0.3]], np.float32), (64, 1))
+        got, _ = run(out, {"x": jnp.asarray(probs)}, mode="train")
+        ids = np.asarray(got)[:, 0]
+        assert set(np.unique(ids)) <= {0, 1, 2}
+        assert len(np.unique(ids)) > 1     # actually stochastic
+
+    def test_eos(self):
+        x = paddle.layer.data("x", paddle.data_type.integer_value(10))
+        out = paddle.layer.eos(x, eos_id=7)
+        got, _ = run(out, {"x": jnp.asarray([3, 7, 7, 1])})
+        np.testing.assert_array_equal(np.asarray(got)[:, 0], [0, 1, 1, 0])
+
+    def test_multiplex(self):
+        idx = paddle.layer.data("idx", paddle.data_type.integer_value(2))
+        a = paddle.layer.data("a", paddle.data_type.dense_vector(3))
+        b = paddle.layer.data("b", paddle.data_type.dense_vector(3))
+        out = paddle.layer.multiplex([idx, a, b])
+        av = np.arange(6, dtype=np.float32).reshape(2, 3)
+        bv = -np.arange(6, dtype=np.float32).reshape(2, 3)
+        got, _ = run(out, {"idx": jnp.asarray([1, 0]),
+                           "a": jnp.asarray(av), "b": jnp.asarray(bv)})
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.stack([bv[0], av[1]]))
+
+
+class TestElementwiseUtils:
+    def test_clip(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(3))
+        out = paddle.layer.clip(x, min=-1.0, max=1.0)
+        got, _ = run(out, {"x": jnp.asarray([[-5.0, 0.5, 3.0]])})
+        np.testing.assert_allclose(np.asarray(got), [[-1.0, 0.5, 1.0]])
+
+    def test_scale_shift_initial_identity(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(3))
+        out = paddle.layer.scale_shift(x)
+        v = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        got, _ = run(out, {"x": jnp.asarray(v)})
+        np.testing.assert_allclose(np.asarray(got), v, rtol=1e-6)
+
+    def test_power(self):
+        w = paddle.layer.data("w", paddle.data_type.dense_vector(1))
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(2))
+        out = paddle.layer.power(x, w)
+        got, _ = run(out, {"w": jnp.asarray([[2.0]]),
+                           "x": jnp.asarray([[3.0, 4.0]])})
+        np.testing.assert_allclose(np.asarray(got), [[9.0, 16.0]], rtol=1e-5)
+
+    def test_featmap_expand(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(2))
+        out = paddle.layer.featmap_expand(x, num_filters=3)
+        assert out.meta.size == 6
+        got, _ = run(out, {"x": jnp.asarray([[1.0, 2.0]])})
+        np.testing.assert_allclose(np.asarray(got),
+                                   [[1.0, 2.0, 1.0, 2.0, 1.0, 2.0]])
+
+    def test_rotate(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(6),
+                              height=2, width=3)
+        out = paddle.layer.rotate(x)
+        assert (out.meta.height, out.meta.width) == (3, 2)
+        img = np.arange(6, dtype=np.float32).reshape(1, 6)  # [1, c*h*w], c=1
+        got, _ = run(out, {"x": jnp.asarray(img)})
+        # chw [[0,1,2],[3,4,5]] rotated 90 ccw -> [[2,5],[1,4],[0,3]]
+        np.testing.assert_allclose(np.asarray(got).reshape(3, 2),
+                                   [[2, 5], [1, 4], [0, 3]])
+
+    def test_data_norm_zscore_default_identity(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(3))
+        out = paddle.layer.data_norm(x)
+        v = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+        got, _ = run(out, {"x": jnp.asarray(v)})
+        np.testing.assert_allclose(np.asarray(got), v, rtol=1e-6)
+
+    def test_data_norm_minmax(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(2))
+        out = paddle.layer.data_norm(x, data_norm_strategy="min-max")
+        got, _ = run(out, {"x": jnp.asarray([[0.25, 0.5]])})
+        np.testing.assert_allclose(np.asarray(got), [[0.25, 0.5]], rtol=1e-6)
+
+
+class TestSelectiveFCAndRowConv:
+    def test_selective_fc_mask(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+        sel = paddle.layer.data("sel", paddle.data_type.dense_vector(6))
+        out = paddle.layer.selective_fc(x, size=6, select=sel)
+        xv = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+        mask = np.zeros((3, 6), np.float32)
+        mask[:, [1, 4]] = 1.0
+        got, params = run(out, {"x": jnp.asarray(xv), "sel": jnp.asarray(mask)})
+        got = np.asarray(got)
+        assert np.all(got[:, [0, 2, 3, 5]] == 0.0)
+        # selected columns equal the plain fc value
+        w = np.asarray(params[f"_{out.name}.w0"])
+        b = np.asarray(params[f"_{out.name}.wbias"])
+        full = xv @ w.T + b
+        np.testing.assert_allclose(got[:, [1, 4]], full[:, [1, 4]], rtol=1e-5)
+
+    def test_selective_fc_no_select_is_fc(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+        out = paddle.layer.selective_fc(x, size=5)
+        xv = np.random.RandomState(3).randn(2, 4).astype(np.float32)
+        got, params = run(out, {"x": jnp.asarray(xv)})
+        w = np.asarray(params[f"_{out.name}.w0"])
+        b = np.asarray(params[f"_{out.name}.wbias"])
+        np.testing.assert_allclose(np.asarray(got), xv @ w.T + b, rtol=1e-5)
+
+    def test_row_conv_lookahead(self):
+        s = paddle.layer.data("s", paddle.data_type.dense_vector_sequence(3))
+        out = paddle.layer.row_conv(s, context_len=2)
+        rows = [np.eye(3, dtype=np.float32)[:2] * 0 + np.array(
+            [[1, 0, 0], [0, 1, 0]], np.float32)]
+        seq = pack_sequences([np.array([[1., 1, 1], [2, 2, 2], [4, 4, 4]],
+                                       np.float32)])
+        topo = Topology(out)
+        params = dict(topo.init_params(jax.random.PRNGKey(0)))
+        pname = f"_{out.name}.w0"
+        params[pname] = jnp.asarray(np.stack(
+            [np.ones(3, np.float32), 0.5 * np.ones(3, np.float32)]))
+        outs, _ = topo.forward(params, topo.init_state(), {"s": seq},
+                               mode="test", rng=jax.random.PRNGKey(1))
+        got = np.asarray(outs[out.name].data[0])
+        # out[t] = x[t] + 0.5*x[t+1]; last step sees zero future (masked)
+        np.testing.assert_allclose(got[0], [2.0, 2.0, 2.0])
+        np.testing.assert_allclose(got[1], [4.0, 4.0, 4.0])
+        np.testing.assert_allclose(got[2], [4.0, 4.0, 4.0])
+
+
+class TestSequenceSelection:
+    def test_sub_seq(self):
+        s = paddle.layer.data("s", paddle.data_type.dense_vector_sequence(2))
+        off = paddle.layer.data("off", paddle.data_type.integer_value(10))
+        sz = paddle.layer.data("sz", paddle.data_type.integer_value(10))
+        out = paddle.layer.sub_seq(s, off, sz)
+        seq = pack_sequences([np.arange(10, dtype=np.float32).reshape(5, 2)])
+        got, _ = run(out, {"s": seq, "off": jnp.asarray([1]),
+                           "sz": jnp.asarray([2])})
+        assert int(got.lengths[0]) == 2
+        np.testing.assert_allclose(np.asarray(got.data[0, :2]),
+                                   [[2, 3], [4, 5]])
+
+    def test_kmax_seq_score(self):
+        s = paddle.layer.data("s", paddle.data_type.dense_vector_sequence(1))
+        out = paddle.layer.kmax_seq_score(s, beam_size=3)
+        seq = pack_sequences([np.array([[0.1], [0.9], [0.5], [0.7]],
+                                       np.float32),
+                              np.array([[0.3], [0.2]], np.float32)])
+        got, _ = run(out, {"s": seq})
+        got = np.asarray(got)
+        np.testing.assert_array_equal(got[0], [1, 3, 2])
+        np.testing.assert_array_equal(got[1], [0, 1, -1])  # padded past len
+
+    def test_sub_nested_seq(self):
+        s = paddle.layer.data(
+            "s", paddle.data_type.dense_vector_sub_sequence(1))
+        idx = paddle.layer.data("idx", paddle.data_type.integer_value(4))
+        out = paddle.layer.sub_nested_seq(s, idx)
+        nested = pack_nested_sequences(
+            [[np.array([[1.], [2.]]), np.array([[3.]]),
+              np.array([[4.], [5.], [6.]])],
+             [np.array([[7.]]), np.array([[8.], [9.]])]])
+        sel = jnp.asarray([[2, 0], [1, -1]], jnp.int32)
+        got, _ = run(out, {"s": nested, "idx": sel})
+        # row 0: segment 2 (4,5,6) then segment 0 (1,2)
+        np.testing.assert_allclose(
+            np.asarray(got.data[0, :5, 0]), [4, 5, 6, 1, 2])
+        np.testing.assert_array_equal(
+            np.asarray(got.segment_ids[0, :5]), [0, 0, 0, 1, 1])
+        assert int(got.lengths[0]) == 5 and int(got.num_segments[0]) == 2
+        # row 1: segment 1 (8,9) only
+        np.testing.assert_allclose(np.asarray(got.data[1, :2, 0]), [8, 9])
+        assert int(got.lengths[1]) == 2 and int(got.num_segments[1]) == 1
+
+
+class TestConv3D:
+    def test_conv3d_shape(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(2 * 4 * 4 * 4))
+        out = paddle.layer.img_conv3d(x, filter_size=3, num_filters=5,
+                                      input_depth=4, num_channels=2,
+                                      input_height=4, input_width=4,
+                                      padding=1)
+        assert out.meta.size == 5 * 4 * 4 * 4
+        v = np.random.RandomState(0).randn(2, 2 * 64).astype(np.float32)
+        got, _ = run(out, {"x": jnp.asarray(v)})
+        assert got.shape == (2, 4, 4, 4, 5)
+
+    def test_deconv3d_shape(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(3 * 2 * 2 * 2))
+        out = paddle.layer.img_conv3d(x, filter_size=2, num_filters=4,
+                                      input_depth=2, num_channels=3,
+                                      input_height=2, input_width=2,
+                                      stride=2, trans=True)
+        assert out.meta.size == 4 * 4 * 4 * 4
+        v = np.random.RandomState(0).randn(1, 24).astype(np.float32)
+        got, _ = run(out, {"x": jnp.asarray(v)})
+        assert got.shape == (1, 4, 4, 4, 4)
+
+    def test_pool3d_values(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+        out = paddle.layer.img_pool3d(x, pool_size=2, input_depth=2,
+                                      num_channels=1, input_height=2,
+                                      input_width=2, stride=2)
+        v = np.arange(8, dtype=np.float32).reshape(1, 8)
+        got, _ = run(out, {"x": jnp.asarray(v)})
+        assert float(np.asarray(got).ravel()[0]) == 7.0
+
+
+class TestMDLstm:
+    def test_mdlstm_shape_and_finite(self):
+        h, H, W = 3, 4, 5
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(5 * h * H * W),
+                              height=H, width=W)
+        out = paddle.layer.mdlstm(x)
+        assert out.meta.channels == h
+        v = np.random.RandomState(0).randn(2, 5 * h * H * W).astype(np.float32)
+        got, _ = run(out, {"x": jnp.asarray(v)})
+        assert got.shape == (2, H, W, h)
+        assert np.all(np.isfinite(np.asarray(got)))
+
+    def test_mdlstm_matches_manual_cell_chain(self):
+        # 1x1 grid degenerates to a single LSTM cell with zero recurrence
+        h = 2
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(5 * h),
+                              height=1, width=1)
+        out = paddle.layer.mdlstm(x)
+        v = np.random.RandomState(1).randn(1, 5 * h).astype(np.float32)
+        got, _ = run(out, {"x": jnp.asarray(v)})
+        pre = v.reshape(5, h)
+        a_in = np.tanh(pre[0])
+        sig = lambda z: 1 / (1 + np.exp(-z))
+        c = sig(pre[1]) * a_in
+        expect = sig(pre[4]) * np.tanh(c)
+        np.testing.assert_allclose(np.asarray(got).reshape(h), expect,
+                                   rtol=1e-5)
+
+    def test_mdlstm_direction_flip_changes_output(self):
+        h, H, W = 2, 3, 3
+        xv = np.random.RandomState(2).randn(1, 5 * h * H * W).astype(np.float32)
+
+        def build(directions):
+            x = paddle.layer.data(
+                "x", paddle.data_type.dense_vector(5 * h * H * W),
+                height=H, width=W)
+            out = paddle.layer.mdlstm(x, directions=directions)
+            topo = Topology(out)
+            params = topo.init_params(jax.random.PRNGKey(5))
+            outs, _ = topo.forward(params, topo.init_state(),
+                                   {"x": jnp.asarray(xv)}, mode="test",
+                                   rng=jax.random.PRNGKey(0))
+            return np.asarray(outs[out.name])
+
+        fwd = build([True, True])
+        rev = build([False, False])
+        assert not np.allclose(fwd, rev)
+
+
+class TestDetection:
+    def _priors(self):
+        return det_ops.prior_boxes(2, 2, 8, 8, [2.0], [4.0], [2.0],
+                                   [0.1, 0.1, 0.2, 0.2])
+
+    def test_prior_boxes_values(self):
+        pb = np.asarray(self._priors())
+        # 2x2 cells x (1 min + 1 max + 2 ratio) priors x 8
+        assert pb.shape == (2 * 2 * 4, 8)
+        # first prior of cell (0,0): center (2,2), box 2x2 -> [1,1,3,3]/8
+        np.testing.assert_allclose(pb[0, :4],
+                                   [1 / 8, 1 / 8, 3 / 8, 3 / 8], rtol=1e-6)
+        np.testing.assert_allclose(pb[0, 4:], [0.1, 0.1, 0.2, 0.2])
+        # second: sqrt(2*4) box
+        d = np.sqrt(8.0)
+        np.testing.assert_allclose(
+            pb[1, :4], [(2 - d / 2) / 8, (2 - d / 2) / 8,
+                        (2 + d / 2) / 8, (2 + d / 2) / 8], rtol=1e-6)
+        assert pb[:, :4].min() >= 0.0 and pb[:, :4].max() <= 1.0
+
+    def test_encode_decode_roundtrip(self):
+        priors = self._priors()
+        rng = np.random.RandomState(0)
+        gt = np.sort(rng.rand(priors.shape[0], 4).astype(np.float32), axis=1)
+        enc = det_ops.encode_boxes(jnp.asarray(gt), priors)
+        dec = det_ops.decode_boxes(enc, priors)
+        np.testing.assert_allclose(np.asarray(dec), gt, atol=1e-4)
+
+    def test_nms_suppresses_overlaps(self):
+        boxes = jnp.asarray([[0., 0., 1., 1.],
+                             [0.02, 0.02, 1.02, 1.02],   # heavy overlap
+                             [2., 2., 3., 3.]])
+        scores = jnp.asarray([0.9, 0.8, 0.7])
+        _, kept_scores, keep = det_ops.nms(boxes, scores, iou_threshold=0.5,
+                                           top_k=3)
+        assert bool(keep[0]) and not bool(keep[1]) and bool(keep[2])
+
+    def test_match_priors_bipartite(self):
+        priors = jnp.asarray([[0., 0., .5, .5, .1, .1, .2, .2],
+                              [.5, .5, 1., 1., .1, .1, .2, .2]])
+        gt = jnp.asarray([[0.05, 0.05, 0.45, 0.45]])
+        midx, _ = det_ops.match_priors(priors, gt, jnp.asarray([True]))
+        assert int(midx[0]) == 0 and int(midx[1]) == -1
+
+    def test_match_priors_ignores_padded_gt(self):
+        # padded gt slots must not clobber a valid gt's bipartite claim
+        priors = jnp.asarray([[0., 0., .4, .4, .1, .1, .2, .2],
+                              [.6, .6, 1., 1., .1, .1, .2, .2]])
+        gt = jnp.asarray([[0., 0., .2, .2], [0., 0., 0., 0.]])
+        midx, _ = det_ops.match_priors(priors, gt,
+                                       jnp.asarray([True, False]))
+        assert int(midx[0]) == 0 and int(midx[1]) == -1
+
+    def test_cross_channel_norm(self):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(2 * 2 * 2),
+                              height=2, width=2)
+        out = paddle.layer.cross_channel_norm(x)
+        v = np.random.RandomState(0).randn(1, 8).astype(np.float32)
+        got, _ = run(out, {"x": jnp.asarray(v)})
+        got = np.asarray(got)
+        # default scale 20 -> per-position channel norm == 20
+        norms = np.linalg.norm(got, axis=-1)
+        np.testing.assert_allclose(norms, 20.0, rtol=1e-4)
+
+    def _ssd_head(self, with_label):
+        C = 3
+        feat = paddle.layer.data("feat", paddle.data_type.dense_vector(
+            4 * 2 * 2), height=2, width=2)   # 4 ch, 2x2
+        img = paddle.layer.data("img", paddle.data_type.dense_vector(
+            3 * 8 * 8), height=8, width=8)
+        pb = paddle.layer.priorbox(feat, img, aspect_ratio=[2.0],
+                                   variance=[0.1, 0.1, 0.2, 0.2],
+                                   min_size=[2.0], max_size=[4.0])
+        n_priors = 4
+        loc = paddle.layer.img_conv(feat, filter_size=1,
+                                    num_filters=n_priors * 4, padding=0)
+        conf = paddle.layer.img_conv(feat, filter_size=1,
+                                     num_filters=n_priors * C, padding=0)
+        feed = {
+            "feat": jnp.asarray(np.random.RandomState(0).randn(
+                2, 16).astype(np.float32)),
+            "img": jnp.asarray(np.zeros((2, 192), np.float32)),
+        }
+        if with_label:
+            lbl = paddle.layer.data(
+                "label", paddle.data_type.dense_vector_sequence(6))
+            feed["label"] = pack_sequences(
+                [np.array([[1, .1, .1, .4, .4, 0],
+                           [2, .5, .5, .9, .9, 0]], np.float32),
+                 np.array([[1, .2, .2, .6, .6, 0]], np.float32)])
+            out = paddle.layer.multibox_loss(loc, conf, pb, lbl,
+                                             num_classes=C)
+        else:
+            out = paddle.layer.detection_output(loc, conf, pb, num_classes=C,
+                                                keep_top_k=10, nms_top_k=16)
+        return out, feed
+
+    def test_multibox_loss_finite_positive(self):
+        out, feed = self._ssd_head(with_label=True)
+        got, _ = run(out, feed, mode="train")
+        got = np.asarray(got)
+        assert got.shape == (2, 1)
+        assert np.all(np.isfinite(got)) and np.all(got > 0)
+
+    def test_detection_output_shape_and_labels(self):
+        out, feed = self._ssd_head(with_label=False)
+        got, _ = run(out, feed)
+        got = np.asarray(got).reshape(2, 10, 7)
+        # image ids stamped, labels in {-1, 1, 2}, boxes finite
+        np.testing.assert_array_equal(got[0, :, 0], 0.0)
+        np.testing.assert_array_equal(got[1, :, 0], 1.0)
+        assert set(np.unique(got[..., 1])) <= {-1.0, 1.0, 2.0}
+        assert np.all(np.isfinite(got))
+
+
+class TestPrintLayer:
+    def test_print_is_identity(self, capfd):
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(2))
+        out = paddle.layer.print_layer(x)
+        v = np.array([[1.0, 2.0]], np.float32)
+        got, _ = run(out, {"x": jnp.asarray(v)})
+        np.testing.assert_allclose(np.asarray(got), v)
