@@ -1,0 +1,57 @@
+"""Quick-start text classification — v1_api_demo/quick_start parity.
+
+IMDB sentiment with the text-CNN config (trainer_config.cnn.py shape),
+reporting classification error plus AUC via the evaluator framework.
+Falls back to the deterministic synthetic corpus when no cached IMDB data
+is present (paddle_tpu/dataset/common.py).
+"""
+
+import argparse
+import sys
+
+import paddle_tpu as paddle
+from paddle_tpu import evaluator
+from paddle_tpu.models.text import convolution_net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--use_tpu", action="store_true", default=None)
+    ap.add_argument("--num_passes", type=int, default=3)
+    ap.add_argument("--batch_size", type=int, default=64)
+    args = ap.parse_args()
+
+    paddle.init(use_tpu=args.use_tpu, seed=7)
+
+    vocab = len(paddle.dataset.imdb.word_dict())
+    model = convolution_net(vocab_size=vocab, emb_size=64, hidden_size=64)
+    parameters = paddle.create_parameters(paddle.Topology(model.cost))
+    optimizer = paddle.optimizer.Adam(learning_rate=1e-3)
+    auc = evaluator.auc(model.output, model.label, name="auc")
+    trainer = paddle.SGD(cost=model.cost, parameters=parameters,
+                         update_equation=optimizer,
+                         extra_layers=model.extra_layers,
+                         evaluators=[auc])
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration) and e.batch_id % 25 == 0:
+            print(f"pass {e.pass_id} batch {e.batch_id} cost {e.cost:.4f} "
+                  f"{e.evaluator}")
+        if isinstance(e, paddle.event.EndPass):
+            print(f"== pass {e.pass_id}: {e.evaluator}")
+
+    reader = paddle.reader.batch(
+        paddle.reader.shuffle(paddle.dataset.imdb.train(), 2048, seed=1),
+        args.batch_size, drop_last=True)
+    trainer.train(reader, num_passes=args.num_passes, event_handler=handler,
+                  feeding={"word": 0, "label": 1})
+
+    result = trainer.test(
+        paddle.reader.batch(paddle.dataset.imdb.test(), args.batch_size),
+        feeding={"word": 0, "label": 1})
+    print(f"test: cost {result.cost:.4f} {result.evaluator}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

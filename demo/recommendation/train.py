@@ -1,0 +1,64 @@
+"""Recommendation — demo/recommendation parity.
+
+MovieLens rating regression with user/movie embedding towers and a
+cos_sim head scaled to [0, 5] (models/recommender.movielens_regression).
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.dataset import movielens
+from paddle_tpu.models.recommender import movielens_regression
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--use_tpu", action="store_true", default=None)
+    ap.add_argument("--num_passes", type=int, default=2)
+    ap.add_argument("--batch_size", type=int, default=64)
+    args = ap.parse_args()
+
+    paddle.init(use_tpu=args.use_tpu, seed=13)
+
+    model = movielens_regression(user_dim=movielens.max_user_id() + 1,
+                                 movie_dim=movielens.max_movie_id() + 1,
+                                 emb_size=32)
+    parameters = paddle.create_parameters(paddle.Topology(model.cost))
+    optimizer = paddle.optimizer.Adam(learning_rate=2e-3)
+    trainer = paddle.SGD(cost=model.cost, parameters=parameters,
+                         update_equation=optimizer)
+
+    def to_sample(r):
+        # movielens rows: (uid, gender, age, job, mid, categories, title,
+        # rating) -> (user_id, movie_id, [rating])
+        def reader():
+            for row in r():
+                yield row[0], row[4], np.asarray([row[7]], np.float32)
+        return reader
+
+    feeding = {"user_id": 0, "movie_id": 1, "score": 2}
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration) and e.batch_id % 25 == 0:
+            print(f"pass {e.pass_id} batch {e.batch_id} cost {e.cost:.4f}")
+        if isinstance(e, paddle.event.EndPass):
+            print(f"== pass {e.pass_id} done")
+
+    reader = paddle.reader.batch(
+        paddle.reader.shuffle(to_sample(movielens.train()), 4096, seed=2),
+        args.batch_size, drop_last=True)
+    trainer.train(reader, num_passes=args.num_passes, event_handler=handler,
+                  feeding=feeding)
+
+    result = trainer.test(
+        paddle.reader.batch(to_sample(movielens.test()), args.batch_size),
+        feeding=feeding)
+    print(f"test mse cost {result.cost:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

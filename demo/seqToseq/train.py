@@ -1,0 +1,71 @@
+"""Neural machine translation — demo/seqToseq parity.
+
+WMT-14 fr->en with the attention encoder-decoder (models/seq2seq), then
+beam-search generation sharing the trained weights (SequenceGenerator
+semantics: top-k paths with scores per source sentence).
+"""
+
+import argparse
+import sys
+
+import paddle_tpu as paddle
+from paddle_tpu.models.seq2seq import nmt_attention, nmt_generator
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--use_tpu", action="store_true", default=None)
+    ap.add_argument("--num_passes", type=int, default=2)
+    ap.add_argument("--batch_size", type=int, default=16)
+    ap.add_argument("--dict_size", type=int, default=1000)
+    ap.add_argument("--beam_size", type=int, default=3)
+    args = ap.parse_args()
+
+    paddle.init(use_tpu=args.use_tpu, seed=5)
+
+    model = nmt_attention(src_vocab=args.dict_size, trg_vocab=args.dict_size,
+                          emb_size=64, enc_size=64, dec_size=64)
+    parameters = paddle.create_parameters(paddle.Topology(model.cost))
+    optimizer = paddle.optimizer.Adam(learning_rate=1e-3)
+    trainer = paddle.SGD(cost=model.cost, parameters=parameters,
+                         update_equation=optimizer,
+                         extra_layers=model.extra_layers)
+
+    feeding = {"source_words": 0, "target_words": 1, "target_next_words": 2}
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration) and e.batch_id % 20 == 0:
+            print(f"pass {e.pass_id} batch {e.batch_id} cost {e.cost:.4f}")
+        if isinstance(e, paddle.event.EndPass):
+            print(f"== pass {e.pass_id}: {e.evaluator}")
+
+    reader = paddle.reader.batch(
+        paddle.reader.shuffle(
+            paddle.dataset.wmt14.train(dict_size=args.dict_size), 1024,
+            seed=9),
+        args.batch_size, drop_last=True)
+    trainer.train(reader, num_passes=args.num_passes, event_handler=handler,
+                  feeding=feeding)
+
+    # --- generation: same parameters drive the beam-search graph
+    beam = nmt_generator(src_vocab=args.dict_size, trg_vocab=args.dict_size,
+                         emb_size=64, enc_size=64, dec_size=64,
+                         beam_size=args.beam_size, max_length=12)
+    gen_topo = paddle.Topology(beam)
+    from paddle_tpu.trainer.data_feeder import DataFeeder
+    feeder = DataFeeder(gen_topo.data_type(), {"source_words": 0})
+    samples = [s for _, s in zip(range(3),
+                                 paddle.dataset.wmt14.test(args.dict_size)())]
+    feed = feeder([(s[0],) for s in samples])
+    feed.pop("__batch_size__", None)
+    outs, _ = gen_topo.forward(parameters.raw, {}, feed, mode="test")
+    res = outs[beam.name]
+    for i, paths in enumerate(res.to_list()):
+        print(f"source {i}:")
+        for score, ids in paths:
+            print(f"  [{score:8.3f}] {' '.join(str(t) for t in ids)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

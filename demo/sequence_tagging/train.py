@@ -1,0 +1,62 @@
+"""Sequence tagging (SRL-style) — demo/sequence_tagging parity.
+
+CoNLL-05 labels with a bidirectional-GRU + CRF tagger, decoded with the
+shared transition matrix and scored with the chunk evaluator (NER-style
+F1 — ChunkEvaluator.cpp semantics).
+"""
+
+import argparse
+import sys
+
+import paddle_tpu as paddle
+from paddle_tpu import evaluator
+from paddle_tpu.dataset import conll05
+from paddle_tpu.models.tagger import rnn_crf_tagger
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--use_tpu", action="store_true", default=None)
+    ap.add_argument("--num_passes", type=int, default=2)
+    ap.add_argument("--batch_size", type=int, default=16)
+    args = ap.parse_args()
+
+    paddle.init(use_tpu=args.use_tpu, seed=11)
+
+    model = rnn_crf_tagger(vocab_size=conll05.word_dict_len(),
+                           num_labels=conll05.label_dict_len(),
+                           emb_size=64, hidden_size=128)
+    parameters = paddle.create_parameters(paddle.Topology(model.cost))
+    optimizer = paddle.optimizer.Adam(learning_rate=2e-3)
+    # chunk-F1 over the decoded path, IOB with the conll05 label layout
+    chunk = evaluator.chunk(model.decoded, model.label, chunk_scheme="IOB",
+                            num_chunk_types=(conll05.label_dict_len() - 2) // 2,
+                            name="chunk_f1")
+    trainer = paddle.SGD(cost=model.cost, parameters=parameters,
+                         update_equation=optimizer, evaluators=[chunk])
+
+    # conll05 rows: (word, pred, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+    # mark, label) — the tagger uses the word and label columns
+    feeding = {"words": 0, "labels": 8}
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration) and e.batch_id % 20 == 0:
+            print(f"pass {e.pass_id} batch {e.batch_id} cost {e.cost:.4f}")
+        if isinstance(e, paddle.event.EndPass):
+            print(f"== pass {e.pass_id}: {e.evaluator}")
+
+    reader = paddle.reader.batch(
+        paddle.reader.shuffle(conll05.test(), 1024, seed=3),
+        args.batch_size, drop_last=True)
+    trainer.train(reader, num_passes=args.num_passes, event_handler=handler,
+                  feeding=feeding)
+
+    result = trainer.test(paddle.reader.batch(conll05.test(),
+                                              args.batch_size),
+                          feeding=feeding)
+    print(f"test: cost {result.cost:.4f} {result.evaluator}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,8 @@ class GlobalConfig:
     log_period: int = 100
     # Reference: seed flag for deterministic runs
     seed: int = 0
+    # FPE-trap equivalent (TrainerMain.cpp:49): raise at the first NaN.
+    debug_nans: bool = False
     initialized: bool = False
 
 
@@ -38,14 +40,24 @@ _g = GlobalConfig()
 
 def init(use_tpu: Optional[bool] = None, use_gpu: Optional[bool] = None,
          trainer_count: int = 1, seed: int = 0, compute_dtype: str = "float32",
-         log_period: int = 100, **kwargs) -> GlobalConfig:
+         log_period: int = 100, debug_nans: bool = False,
+         **kwargs) -> GlobalConfig:
     """Initialize the framework. Mirrors paddle.v2.init(use_gpu=..., trainer_count=...).
 
     `use_gpu` is accepted for source compatibility with v2 scripts and treated
     as a request for the accelerator backend (i.e. the TPU here).
+
+    `debug_nans=True` is the FPE-trap discipline of the reference trainer
+    (TrainerMain.cpp:49 feenableexcept(FE_INVALID|FE_DIVBYZERO|FE_OVERFLOW)):
+    XLA re-runs any computation that produced a NaN un-jitted and raises at
+    the exact primitive (jax_debug_nans), so a diverging run fails loudly at
+    the source instead of training on garbage.
     """
     import jax
 
+    # set AND clear: a later init(debug_nans=False) must un-latch the flag
+    jax.config.update("jax_debug_nans", bool(debug_nans))
+    _g.debug_nans = debug_nans
     if use_tpu is None:
         use_tpu = bool(use_gpu) if use_gpu is not None else None
     if use_tpu is None:

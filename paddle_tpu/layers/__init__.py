@@ -25,7 +25,7 @@ from paddle_tpu.layers import recurrent_layers as _rec  # noqa: F401
 from paddle_tpu.layers import group as _group          # noqa: F401
 from paddle_tpu.layers.group import (recurrent_group, memory, beam_search,
                                      get_output, StaticInput,
-                                     GeneratedInput)
+                                     GeneratedInput, SubsequenceInput)
 from paddle_tpu.layers import crf_layers as _crf       # noqa: F401
 from paddle_tpu.layers import attention_layers as _attn  # noqa: F401
 from paddle_tpu.layers import misc_layers as _misc     # noqa: F401

@@ -39,6 +39,21 @@ class StaticInput:
         self.is_seq = is_seq
 
 
+class SubsequenceInput:
+    """Nested in-link: the group iterates over SUBSEQUENCES — at outer step
+    t the step function receives the t-th subsequence of each sample as a
+    level-1 sequence (reference SubsequenceInput,
+    trainer_config_helpers/layers.py + RecurrentGradientMachine.h:32's
+    hasSubseq in-frame path). max_segments / max_sub_len bound the dense
+    per-subsequence view (default: the input's max_len, always safe)."""
+
+    def __init__(self, input: LayerOutput, max_segments: Optional[int] = None,
+                 max_sub_len: Optional[int] = None):
+        self.input = input
+        self.max_segments = max_segments
+        self.max_sub_len = max_sub_len
+
+
 class GeneratedInput:
     """Generation-mode input: the step consumes its own previous prediction
     (reference GeneratedInput for beam_search). Used by layers/beam.py."""
@@ -96,18 +111,27 @@ def recurrent_group(step, input, reverse: bool = False,
 
     gname = name or _auto_name("recurrent_group")
     inputs = input if isinstance(input, (list, tuple)) else [input]
+    sub_inputs = [i for i in inputs if isinstance(i, SubsequenceInput)]
     seq_inputs = [i for i in inputs if isinstance(i, LayerOutput)]
     static_inputs = [i for i in inputs if isinstance(i, StaticInput)]
+    nested = bool(sub_inputs)
+    if nested:
+        assert not seq_inputs, \
+            "recurrent_group: mix of SubsequenceInput and plain sequence " \
+            "in-links is not supported — wrap all of them"
+        seq_inputs = [s.input for s in sub_inputs]
     assert seq_inputs, "recurrent_group needs at least one sequence input"
 
-    # Build step placeholders (seq inputs with one seq level peeled off).
+    # Build step placeholders: plain groups peel one seq level off; nested
+    # groups hand the step a level-1 subsequence per outer step.
     group = {"name": gname, "memories": [], "boot_layers": []}
     placeholders = []
     for i, si in enumerate(seq_inputs):
         ph = make_layer(
             "data", f"@in@{gname}@{i}", [],
             input_type=InputType(si.meta.size,
-                                 "integer" if si.meta.is_integer else "dense"))
+                                 "integer" if si.meta.is_integer else "dense",
+                                 SeqType(1) if nested else SeqType(0)))
         placeholders.append(ph)
     static_phs = []
     for i, si in enumerate(static_inputs):
@@ -147,6 +171,9 @@ def recurrent_group(step, input, reverse: bool = False,
         "recurrent_group", gname, outer_inputs,
         n_seq=len(seq_inputs), n_static=len(static_inputs),
         reverse=reverse,
+        nested=nested,
+        max_segments=(sub_inputs[0].max_segments if nested else None),
+        max_sub_len=(sub_inputs[0].max_sub_len if nested else None),
         memories=group["memories"],
         step_in_names=[p.name for p in placeholders],
         static_names=[p.name for p in static_phs],
@@ -157,8 +184,10 @@ def recurrent_group(step, input, reverse: bool = False,
     )
     # attach hoisted params and rebuild meta
     node.params = list(sub_topo.param_specs.values())
-    node.meta = LayerMeta(size=step_outputs[0].meta.size, seq_level=1,
-                          is_integer=step_outputs[0].meta.is_integer)
+    out0 = step_outputs[0].meta
+    out_level = (out0.seq_level + 1) if nested else 1
+    node.meta = LayerMeta(size=out0.size, seq_level=out_level,
+                          is_integer=out0.is_integer)
     node.config["_obj_sub_topo"] = sub_topo
     return node
 
@@ -176,12 +205,15 @@ class RecurrentGroupLayer:
             cfg["_obj_sub_topo"] = sub
         out_meta = sub.by_name[cfg["out_name"]].meta
         params = list(sub.param_specs.values())
-        meta = LayerMeta(size=out_meta.size, seq_level=1,
+        out_level = (out_meta.seq_level + 1) if cfg.get("nested") else 1
+        meta = LayerMeta(size=out_meta.size, seq_level=out_level,
                          is_integer=out_meta.is_integer)
         return meta, params, []
 
     @staticmethod
     def apply(ctx: ApplyContext, name, cfg, params, inputs):
+        if cfg.get("nested"):
+            return _apply_nested_group(ctx, name, cfg, params, inputs)
         sub = cfg["_obj_sub_topo"]
         n_seq = cfg["n_seq"]
         n_static = cfg["n_static"]
@@ -286,6 +318,135 @@ class RecurrentGroupLayer:
         for on, val in zip(out_names, results):
             aux[(name, on)] = val
         return results[0]
+
+
+def _apply_nested_group(ctx: ApplyContext, name, cfg, params, inputs):
+    """Level-2 unroll: outer scan over subsequences, each outer step runs
+    the sub-topology on a level-1 SequenceBatch view of the t-th
+    subsequence (RecurrentGradientMachine.h:32 hasSubseq path — the
+    reference rebuilds in-frames per outer step via createInFrameInfo; here
+    it is one nested_to_padded scatter + a lax.scan over the segment axis).
+    """
+    from paddle_tpu.ops import sequence_ops as seq_ops
+
+    sub = cfg["_obj_sub_topo"]
+    n_seq = cfg["n_seq"]
+    n_static = cfg["n_static"]
+    seqs: List[SequenceBatch] = list(inputs[:n_seq])
+    statics = list(inputs[n_seq:n_seq + n_static])
+    boots = list(inputs[n_seq + n_static:])
+    ref = seqs[0]
+    assert ref.is_nested, \
+        f"recurrent_group {name}: SubsequenceInput needs a nested sequence"
+    b = ref.batch_size
+    T = ref.max_len
+    S = int(cfg.get("max_segments") or T)
+    Lm = int(cfg.get("max_sub_len") or T)
+    n_seg = ref.num_segments
+    reverse = cfg.get("reverse", False)
+
+    def rev_segments(data, ilen):
+        """Per-row flip of the segment axis: step i sees segment
+        n_seg-1-i, giving the backward walk over subsequences."""
+        idx = jnp.clip(n_seg[:, None] - 1 -
+                       jnp.arange(S, dtype=jnp.int32)[None, :], 0, S - 1)
+        d = jnp.take_along_axis(
+            data, idx.reshape(idx.shape + (1,) * (data.ndim - 2)), axis=1)
+        l = jnp.take_along_axis(ilen, idx, axis=1)
+        keep = jnp.arange(S, dtype=jnp.int32)[None, :] < n_seg[:, None]
+        return (jnp.where(keep.reshape(keep.shape + (1,) * (d.ndim - 2)),
+                          d, jnp.zeros_like(d)),
+                jnp.where(keep, l, 0))
+
+    views = [seq_ops.nested_to_padded(s, S, Lm) for s in seqs]
+    if reverse:
+        views = [rev_segments(d, l) for d, l in views]
+
+    # memory init (same as the flat path)
+    mems = []
+    boot_i = 0
+    for m in cfg["memories"]:
+        if m["has_boot_layer"]:
+            bv = boots[boot_i]
+            boot_i += 1
+            mems.append(bv.data if isinstance(bv, SequenceBatch) else bv)
+        elif m["boot_const_id"] is not None:
+            mems.append(jnp.full((b,), m["boot_const_id"], jnp.int32))
+        else:
+            mems.append(jnp.zeros((b, m["size"]), jnp.float32))
+
+    static_feed = dict(zip(cfg["static_names"], statics))
+    mem_feed_names = [m["feed_name"] for m in cfg["memories"]]
+    link_names = [m["link_name"] for m in cfg["memories"]]
+    out_names = cfg.get("out_names") or [cfg["out_name"]]
+    out_is_seq = {
+        on: sub.by_name[on].meta.seq_level >= 1 for on in out_names}
+
+    def to_mem(v):
+        if isinstance(v, SequenceBatch):
+            return seq_ops.last_instance(v)
+        return v
+
+    def body(carry, inp):
+        s_idx, per_in = inp
+        feed = dict(static_feed)
+        for ph_name, (dat, ilen) in zip(cfg["step_in_names"], per_in):
+            feed[ph_name] = SequenceBatch(dat, ilen)
+        for fname, mv in zip(mem_feed_names, carry):
+            feed[fname] = mv
+        outs, _ = sub.forward(params, {}, feed, mode=ctx.mode,
+                              rng=ctx.rng_for(f"{name}@nested"),
+                              output_names=list(out_names) + link_names)
+        valid = s_idx < n_seg
+
+        def freeze(nv, ov):
+            v = valid.reshape((-1,) + (1,) * (nv.ndim - 1))
+            return jnp.where(v, nv, ov)
+
+        new_mems = tuple(
+            jax.tree_util.tree_map(freeze, to_mem(outs[ln]), ov)
+            for ln, ov in zip(link_names, carry))
+        outs_t = []
+        for on in out_names:
+            ov = outs[on]
+            if isinstance(ov, SequenceBatch):
+                od = jnp.where(
+                    valid.reshape((-1,) + (1,) * (ov.data.ndim - 1)),
+                    ov.data, jnp.zeros_like(ov.data))
+                ol = jnp.where(valid, ov.lengths, 0)
+                outs_t.append((od, ol))
+            else:
+                vo = valid.reshape((-1,) + (1,) * (ov.ndim - 1))
+                outs_t.append((jnp.where(vo, ov, jnp.zeros_like(ov)), None))
+        return new_mems, tuple(outs_t)
+
+    s_idx = jnp.arange(S, dtype=jnp.int32)
+    xs = tuple((jnp.moveaxis(dat, 0, 1), jnp.moveaxis(ilen, 0, 1))
+               for dat, ilen in views)          # [S, b, L, d], [S, b]
+    _, outs_all = lax.scan(body, tuple(mems), (s_idx, xs))
+
+    results = []
+    for on, (od, ol) in zip(out_names, outs_all):
+        if out_is_seq[on]:
+            # [S, b, L, d] -> nested SequenceBatch over the original T axis
+            data = jnp.moveaxis(od, 0, 1)       # [b, S, L, d]
+            ilen = jnp.moveaxis(ol, 0, 1)       # [b, S]
+            if reverse:
+                data, ilen = rev_segments(data, ilen)
+            results.append(seq_ops.padded_to_nested(data, ilen, n_seg, T))
+        else:
+            out = jnp.moveaxis(od, 0, 1)        # [b, S, d]
+            if reverse:
+                out, _ = rev_segments(out,
+                                      jnp.zeros(out.shape[:2], jnp.int32))
+            results.append(SequenceBatch(out, n_seg))
+
+    aux = getattr(ctx, "aux_outputs", None)
+    if aux is None:
+        aux = ctx.aux_outputs = {}
+    for on, val in zip(out_names, results):
+        aux[(name, on)] = val
+    return results[0]
 
 
 def beam_search(step, input, bos_id: int, eos_id: int, beam_size: int,

@@ -87,12 +87,15 @@ class EosIdCheckLayer:
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
         eos = cfg["eos_id"]
-
-        def check(ids):
-            ids = ids if ids.ndim and ids.shape[-1] == 1 else ids[..., None]
-            return (ids == eos).astype(jnp.float32)
-
-        return _map_seq(check, inputs[0])
+        val = inputs[0]
+        ids = _payload(val)
+        # id payloads are [b] / [b, T] (or already [.., 1] from maxid) —
+        # always emit a trailing size-1 feature axis
+        base_rank = 2 if isinstance(val, SequenceBatch) else 1
+        if ids.ndim == base_rank:
+            ids = ids[..., None]
+        out = (ids == eos).astype(jnp.float32)
+        return val.with_data(out) if isinstance(val, SequenceBatch) else out
 
 
 @register_layer("multiplex")
@@ -170,7 +173,7 @@ class PowerLayer:
         w = _payload(inputs[0])
         v = inputs[1]
         ref = v if isinstance(v, SequenceBatch) else None
-        out = jnp.power(jnp.clip(_payload(v), 1e-20), w)
+        out = jnp.power(_payload(v), w)   # direct pow, as the reference
         return ref.with_data(out) if ref is not None else out
 
 

@@ -180,3 +180,68 @@ def sub_seq_pool(seq: SequenceBatch, pool_type: str = "average",
     else:
         raise ValueError(pool_type)
     return SequenceBatch(pooled, seq.num_segments)
+
+
+def nested_to_padded(seq: SequenceBatch, max_segments=None, max_sub_len=None):
+    """Nested ragged layout -> dense per-subsequence view.
+
+    [b, T, d] + segment_ids -> (data [b, S, L, d], inner_len [b, S]) where
+    S/L default to T (bounded by it). This is the RecurrentGradientMachine
+    createInFrameInfo reorganization (RecurrentGradientMachine.cpp) done as
+    one static-shape scatter instead of per-sample index vectors.
+    """
+    assert seq.is_nested, "nested_to_padded needs segment_ids"
+    T = seq.max_len
+    S = int(max_segments or T)
+    Lm = int(max_sub_len or T)
+    d_shape = seq.data.shape[2:]
+
+    def per_row(data, segs):
+        t_idx = jnp.arange(T, dtype=jnp.int32)
+        valid = (segs >= 0) & (segs < S)
+        seg_safe = jnp.clip(segs, 0, S - 1)
+        # first position of each segment (segments are contiguous, ascending)
+        eq = seg_safe[None, :] == jnp.arange(S, dtype=jnp.int32)[:, None]
+        eq = eq & valid[None, :]
+        first = jnp.argmax(eq, axis=1).astype(jnp.int32)      # [S]
+        # count only positions that fit the [S, Lm] view — lengths must
+        # agree with the (possibly truncated) data
+        inner_len = jnp.minimum(jnp.sum(eq, axis=1), Lm).astype(jnp.int32)
+        rank = t_idx - first[seg_safe]
+        flat_pos = jnp.where(valid & (rank < Lm),
+                             seg_safe * Lm + rank, S * Lm)
+        buf = jnp.zeros((S * Lm,) + d_shape, seq.data.dtype)
+        buf = buf.at[flat_pos].set(data, mode="drop")
+        return buf.reshape((S, Lm) + d_shape), inner_len
+
+    return jax.vmap(per_row)(seq.data, seq.segment_ids)
+
+
+def padded_to_nested(data: jnp.ndarray, inner_len: jnp.ndarray,
+                     n_segments: jnp.ndarray, out_len: int) -> SequenceBatch:
+    """Inverse of nested_to_padded: [b, S, L, d] + [b, S] -> nested
+    SequenceBatch with max_len out_len."""
+    b, S, Lm = data.shape[:3]
+    d_shape = data.shape[3:]
+
+    def per_row(dat, ilen, nseg):
+        s_ids = jnp.arange(S, dtype=jnp.int32)
+        ilen = jnp.where(s_ids < nseg, ilen, 0)
+        offset = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(ilen)[:-1].astype(jnp.int32)])
+        l_idx = jnp.arange(Lm, dtype=jnp.int32)[None, :]
+        pos = offset[:, None] + l_idx                          # [S, L]
+        keep = (l_idx < ilen[:, None]) & (s_ids[:, None] < nseg)
+        pos = jnp.where(keep, pos, out_len)
+        buf = jnp.zeros((out_len,) + d_shape, data.dtype)
+        buf = buf.at[pos.reshape(-1)].set(
+            dat.reshape((S * Lm,) + d_shape), mode="drop")
+        seg_buf = jnp.full((out_len,), -1, jnp.int32).at[
+            pos.reshape(-1)].set(
+            jnp.broadcast_to(s_ids[:, None], (S, Lm)).reshape(-1),
+            mode="drop")
+        return buf, seg_buf, jnp.sum(ilen).astype(jnp.int32)
+
+    out, segs, lengths = jax.vmap(per_row)(data, inner_len, n_segments)
+    return SequenceBatch(out, lengths, segs, n_segments)

@@ -11,3 +11,6 @@ __all__ = ["mesh_mod", "create_mesh", "data_parallel_mesh", "DP_AXIS",
            "MP_AXIS", "PP_AXIS", "SP_AXIS", "tensor_parallel",
            "sequence_parallel", "pipeline_mod", "attention",
            "ring_attention", "ulysses_attention"]
+from paddle_tpu.parallel.multihost import (init_distributed,  # noqa: F401
+                                           process_reader, global_batch,
+                                           is_coordinator)

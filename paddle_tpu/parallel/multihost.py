@@ -1,0 +1,98 @@
+"""Multi-host runtime glue — the cluster entry point.
+
+Reference: the v1 cluster launcher wires trainer_id / num_gradient_servers
+/ pserver endpoints through flags (Flags.cpp:55-60, TrainerMain.cpp:32-58,
+RemoteParameterUpdater's pserver hand-off); the Go runtime
+(go/cmd/pserver, master) discovers peers via etcd.
+
+TPU-native design: `jax.distributed.initialize` forms the process group
+(coordinator address = the etcd/pserver-endpoint equivalent); after it
+returns, jax.devices() spans EVERY host and the same single-jit
+dp/mp/pp/sp program from parallel/ runs unchanged — XLA routes
+collectives over ICI within a slice and DCN across hosts. The only
+per-process code is data: each process feeds its own shard
+(process_reader) and jax.make_array_from_process_local_data assembles the
+global batch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from paddle_tpu import config as config_mod
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids: Optional[Sequence[int]] = None,
+                     **kw) -> Tuple[int, int]:
+    """Join (or form) the multi-host process group.
+
+    Mirrors `paddle train --trainer_id=i --num_gradient_servers=n
+    --pservers=host:port,...` (Flags.cpp:55-60): coordinator_address plays
+    the pserver-endpoint/etcd role. No-args works under TPU cluster
+    schedulers that set the environment (GKE/Borg metadata), matching the
+    reference's cloud auto-discovery. Returns (process_index,
+    process_count) and records them in the global config.
+    """
+    if jax.process_count() == 1 and (coordinator_address or num_processes):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids, **kw)
+    g = config_mod.global_config()
+    g.process_index = jax.process_index()
+    g.process_count = jax.process_count()
+    return g.process_index, g.process_count
+
+
+def process_reader(reader: Callable, process_index: Optional[int] = None,
+                   process_count: Optional[int] = None) -> Callable:
+    """Deal a global reader's samples round-robin to this process.
+
+    The per-process half of multi-host data parallelism: every process
+    runs the same reader pipeline but keeps samples where
+    `i % process_count == process_index` — the deterministic equivalent of
+    the reference's per-trainer file-list split
+    (cluster_train/conf.py trainer splits + master task dispatch).
+    """
+    g = config_mod.global_config()
+    pi = g.process_index if process_index is None else process_index
+    pc = g.process_count if process_count is None else process_count
+
+    def sharded():
+        for i, sample in enumerate(reader()):
+            if i % pc == pi:
+                yield sample
+
+    return sharded
+
+
+def global_batch(local_batch, mesh, spec) -> jax.Array:
+    """Assemble a globally-sharded array from each process's local shard.
+
+    local_batch: this process's rows (numpy). mesh/spec: the global
+    dp-sharding the train step expects. Single-process: a plain
+    device_put. Multi-process: jax.make_array_from_process_local_data
+    builds the global jax.Array without gathering — the
+    ParameterServer-free replacement for distributing the global batch.
+    """
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec) if not hasattr(spec, "mesh") \
+        else spec
+    local_batch = np.asarray(local_batch)
+    if jax.process_count() == 1:
+        return jax.device_put(local_batch, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_batch)
+
+
+def is_coordinator() -> bool:
+    """True on the process that should write checkpoints / logs (the
+    reference's trainer_id==0 convention)."""
+    return jax.process_index() == 0

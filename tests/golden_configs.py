@@ -1,0 +1,318 @@
+"""Canonical model configs for the golden-topology regression corpus.
+
+Reference: python/paddle/trainer_config_helpers/tests/configs/ — each
+config file is parsed and its protostr committed
+(configs/protostr/*.protostr); CI diffs freshly-generated output against
+the golden copy so any silent DSL/shape-inference drift fails loudly.
+Here the serialized JSON topology (core/topology.py serialize) plays the
+protostr role.
+
+Every builder returns the FINAL output node of a small canonical network.
+Keep builders deterministic: fixed names, no randomness.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+L = paddle.layer
+A = paddle.activation
+P = paddle.pooling
+D = paddle.data_type
+
+
+def simple_fc():
+    x = L.data("x", D.dense_vector(100))
+    h = L.fc(x, size=64, act=A.Tanh(), name="hidden")
+    out = L.fc(h, size=10, act=A.Softmax(), name="output")
+    lbl = L.data("label", D.integer_value(10))
+    return L.classification_cost(out, lbl, name="cost")
+
+
+def img_layers():
+    im = L.data("image", D.dense_vector(3 * 16 * 16), height=16, width=16)
+    c = L.img_conv(im, filter_size=3, num_filters=8, padding=1,
+                   act=A.Relu(), name="conv1")
+    bn = L.batch_norm(c, act=A.Relu(), name="bn1")
+    p = L.img_pool(bn, pool_size=2, stride=2, name="pool1")
+    n = L.img_cmrnorm(p, size=5, name="norm1")
+    return L.fc(n, size=10, act=A.Softmax(), name="output")
+
+
+def img_trans_layers():
+    im = L.data("image", D.dense_vector(2 * 8 * 8), height=8, width=8)
+    c = L.img_conv(im, filter_size=3, num_filters=4, padding=1, name="convt",
+                   trans=True)
+    padded = L.pad(c, pad_c=[0, 1], pad_h=[1, 1], pad_w=[1, 1], name="pad1")
+    cropped = L.crop(padded, shape=[4, 8, 8], offset=[0, 1, 1], name="crop1")
+    r = L.rotate(cropped, name="rot1")
+    return L.bilinear_interp(r, out_size_x=16, out_size_y=16, name="bi1")
+
+
+def util_layers():
+    a = L.data("a", D.dense_vector(10))
+    b = L.data("b", D.dense_vector(10))
+    add = L.addto([a, b], act=A.Relu(), bias_attr=False, name="add")
+    cat = L.concat([a, b], name="cat")
+    dm = L.dotmul(a, b, name="dm")
+    w = L.data("w", D.dense_vector(1))
+    interp = L.interpolation([a, b], w, name="interp")
+    cs = L.cos_sim(a, b, name="cs")
+    si = L.slope_intercept(add, slope=2.0, intercept=1.0, name="si")
+    return L.concat([cat, dm, interp, cs, si], name="all")
+
+
+def projections():
+    x = L.data("x", D.dense_vector(20))
+    ids = L.data("ids", D.integer_value(100))
+    m = L.mixed(input=[
+        L.full_matrix_projection(x, size=16),
+        L.table_projection(ids, size=16),
+        L.trans_full_matrix_projection(
+            L.fc(x, size=16, name="pre"), size=16),
+    ], act=A.Tanh(), name="mix")
+    s = L.scaling_projection(m)
+    d = L.dotmul_projection(s)
+    return L.slice_projection(d, 2, 10)
+
+
+def seq_ops_suite():
+    s = L.data("s", D.dense_vector_sequence(8))
+    pooled = L.pooling(s, pooling_type=P.Max(), name="pmax")
+    last = L.last_seq(s, name="last")
+    first = L.first_seq(s, name="first")
+    ex = L.expand(last, s, name="ex")
+    cat = L.seq_concat(s, ex, name="scat")
+    rs = L.seq_reshape(s, reshape_size=4, name="rs")
+    rev = L.seq_reverse(s, name="rev")
+    p2 = L.pooling(rev, pooling_type=P.Avg(), name="pavg")
+    return L.concat([pooled, last, first, p2,
+                     L.last_seq(cat), L.last_seq(rs)], name="out")
+
+
+def simple_rnn():
+    ids = L.data("word", D.integer_value_sequence(1000))
+    emb = L.embedding(ids, size=32, name="emb")
+    rnn = L.recurrent(L.fc(emb, size=32, name="proj"), name="rnn")
+    return L.fc(L.last_seq(rnn), size=2, act=A.Softmax(), name="output")
+
+
+def simple_lstm_net():
+    ids = L.data("word", D.integer_value_sequence(1000))
+    emb = L.embedding(ids, size=32, name="emb")
+    lstm = L.lstmemory(L.fc(emb, size=128, name="proj"), name="lstm")
+    return L.fc(L.pooling(lstm, pooling_type=P.Max()), size=2,
+                act=A.Softmax(), name="output")
+
+
+def bidirectional_gru():
+    ids = L.data("word", D.integer_value_sequence(500))
+    emb = L.embedding(ids, size=16, name="emb")
+    fwd = L.grumemory(L.fc(emb, size=48, name="pf"), name="gru_fwd")
+    bwd = L.grumemory(L.fc(emb, size=48, name="pb"), reverse=True,
+                      name="gru_bwd")
+    return L.fc(L.concat([L.last_seq(fwd), L.first_seq(bwd)]), size=4,
+                act=A.Softmax(), name="output")
+
+
+def rnn_group():
+    s = L.data("s", D.dense_vector_sequence(16))
+
+    def step(x):
+        mem = L.memory(name="h", size=16)
+        return L.fc([x, mem], size=16, act=A.Tanh(), name="h")
+
+    g = L.recurrent_group(step=step, input=s, name="rg")
+    return L.last_seq(g, name="out")
+
+
+def nested_rnn_group():
+    ns = L.data("ns", D.dense_vector_sub_sequence(8))
+
+    def outer(sub):
+        mem = L.memory(name="oh", size=8)
+        pooled = L.pooling(sub, pooling_type=P.Avg())
+        return L.fc([pooled, mem], size=8, act=A.Tanh(), name="oh")
+
+    g = L.recurrent_group(step=outer, input=L.SubsequenceInput(ns),
+                          name="nrg")
+    return L.last_seq(g, name="out")
+
+
+def attention_net():
+    src = L.data("src", D.dense_vector_sequence(32))
+    q = L.data("q", D.dense_vector_sequence(32))
+    att = L.dot_product_attention(q, src, src, num_heads=4, name="att")
+    return L.fc(L.last_seq(att), size=8, name="output")
+
+
+def cost_suite():
+    x = L.data("x", D.dense_vector(16))
+    out4 = L.fc(x, size=4, act=A.Softmax(), name="p4")
+    lbl = L.data("label", D.integer_value(4))
+    dense_lbl = L.data("dl", D.dense_vector(4))
+    c1 = L.cross_entropy_cost(out4, lbl, name="ce")
+    c2 = L.square_error_cost(out4, dense_lbl, name="mse")
+    c3 = L.huber_regression_cost(out4, dense_lbl, name="huber")
+    c4 = L.smooth_l1_cost(out4, dense_lbl, name="sl1")
+    c5 = L.multi_binary_label_cross_entropy_cost(
+        L.fc(x, size=4, act=A.Sigmoid(), name="p4b"), dense_lbl, name="mbce")
+    return L.addto([c1, c2, c3, c4, c5], name="total")
+
+
+def rank_costs():
+    a = L.data("a", D.dense_vector(8))
+    b = L.data("b", D.dense_vector(8))
+    sa = L.fc(a, size=1, name="sa")
+    sb = L.fc(b, size=1, name="sb")
+    lbl = L.data("label", D.dense_vector(1))
+    return L.rank_cost(sa, sb, lbl, name="rank")
+
+
+def crf_tagger():
+    s = L.data("s", D.dense_vector_sequence(16))
+    emit = L.fc(s, size=8, name="emission")
+    lbl = L.data("label", D.integer_value_sequence(8))
+    return L.crf(emit, lbl, size=8, name="crf_cost")
+
+
+def ctc_net():
+    s = L.data("s", D.dense_vector_sequence(16))
+    probs = L.fc(s, size=10, act=A.Softmax(), name="probs")
+    lbl = L.data("label", D.integer_value_sequence(10))
+    return L.ctc(probs, lbl, size=10, name="ctc_cost")
+
+
+def nce_hsigmoid():
+    x = L.data("x", D.dense_vector(16))
+    lbl = L.data("label", D.integer_value(32))
+    n = L.nce(L.fc(x, size=8, name="h1"), lbl, num_classes=32,
+              num_neg_samples=5, name="nce_cost")
+    h = L.hsigmoid(L.fc(x, size=8, name="h2"), lbl, num_classes=32,
+                   name="hs_cost")
+    return L.addto([n, h], name="total")
+
+
+def detection_net():
+    feat = L.data("feat", D.dense_vector(8 * 4 * 4), height=4, width=4)
+    img = L.data("img", D.dense_vector(3 * 32 * 32), height=32, width=32)
+    norm = L.cross_channel_norm(feat, name="ccn")
+    pb = L.priorbox(norm, img, aspect_ratio=[2.0],
+                    variance=[0.1, 0.1, 0.2, 0.2], min_size=[8.0],
+                    max_size=[16.0], name="pb")
+    loc = L.img_conv(norm, filter_size=3, num_filters=4 * 4, padding=1,
+                     name="loc")
+    conf = L.img_conv(norm, filter_size=3, num_filters=4 * 21, padding=1,
+                      name="conf")
+    return L.detection_output(loc, conf, pb, num_classes=21, name="det")
+
+
+def multibox_net():
+    feat = L.data("feat", D.dense_vector(8 * 4 * 4), height=4, width=4)
+    img = L.data("img", D.dense_vector(3 * 32 * 32), height=32, width=32)
+    pb = L.priorbox(feat, img, aspect_ratio=[2.0],
+                    variance=[0.1, 0.1, 0.2, 0.2], min_size=[8.0],
+                    name="pb")
+    loc = L.img_conv(feat, filter_size=3, num_filters=3 * 4, padding=1,
+                     name="loc")
+    conf = L.img_conv(feat, filter_size=3, num_filters=3 * 21, padding=1,
+                      name="conf")
+    gt = L.data("gt", D.dense_vector_sequence(6))
+    return L.multibox_loss(loc, conf, pb, gt, num_classes=21, name="mbloss")
+
+
+def conv3d_net():
+    v = L.data("v", D.dense_vector(2 * 8 * 8 * 8))
+    c = L.img_conv3d(v, filter_size=3, num_filters=4, input_depth=8,
+                     num_channels=2, input_height=8, input_width=8,
+                     padding=1, act=A.Relu(), name="c3d")
+    p = L.img_pool3d(c, pool_size=2, input_depth=8, num_channels=4,
+                     input_height=8, input_width=8, stride=2, name="p3d")
+    return L.fc(p, size=10, act=A.Softmax(), name="output")
+
+
+def mdlstm_ocr():
+    im = L.data("im", D.dense_vector(1 * 8 * 8), height=8, width=8)
+    proj = L.img_conv(im, filter_size=1, num_filters=5 * 4, name="gates")
+    md = L.mdlstm(proj, name="md")
+    be = L.block_expand(md, block_x=1, block_y=8, stride_x=1, stride_y=8,
+                        name="cols")
+    return L.fc(be, size=11, act=A.Softmax(), name="probs")
+
+
+def misc_utils():
+    x = L.data("x", D.dense_vector(12))
+    c = L.clip(x, min=-5.0, max=5.0, name="clip1")
+    ss = L.scale_shift(c, name="ss1")
+    dn = L.data_norm(ss, name="dn1")
+    fe = L.featmap_expand(dn, num_filters=2, name="fe1")
+    sn = L.sum_to_one_norm(L.fc(fe, size=6, act=A.Sigmoid(), name="h")
+                           , name="sn1")
+    w = L.data("w", D.dense_vector(1))
+    return L.power(sn, w, name="pow1")
+
+
+def selection_layers():
+    x = L.data("x", D.dense_vector(16))
+    sel = L.data("sel", D.dense_vector(32))
+    sfc = L.selective_fc(x, size=32, select=sel, act=A.Tanh(), name="sfc")
+    idx = L.data("idx", D.integer_value(2))
+    a = L.fc(x, size=8, name="ca")
+    b = L.fc(x, size=8, name="cb")
+    mx = L.multiplex([idx, a, b], name="mx")
+    return L.concat([L.fc(sfc, size=8, name="down"), mx], name="out")
+
+
+def generation_helpers():
+    s = L.data("s", D.dense_vector_sequence(16))
+    scores = L.fc(s, size=1, name="score")
+    km = L.kmax_seq_score(scores, beam_size=3, name="km")
+    probs = L.fc(L.last_seq(s), size=10, act=A.Softmax(), name="probs")
+    mid = L.max_id(probs, name="mid")
+    e = L.eos(mid, eos_id=9, name="e")
+    return [km, e]
+
+
+def deep_speech_row_conv():
+    s = L.data("audio", D.dense_vector_sequence(64))
+    h = L.fc(s, size=64, act=A.Relu(), name="h1")
+    rc = L.row_conv(h, context_len=4, act=A.Relu(), name="rc")
+    return L.fc(rc, size=29, act=A.Softmax(), name="probs")
+
+
+def word_embedding_ngram():
+    ws = [L.data(f"w{i}", D.integer_value(1000)) for i in range(4)]
+    shared = paddle.attr.ParamAttr(name="shared_emb")
+    embs = [L.embedding(w, size=16, param_attr=shared) for w in ws]
+    h = L.fc(L.concat(embs, name="ctx"), size=32, act=A.Tanh(), name="h")
+    return L.fc(h, size=1000, act=A.Softmax(), name="next_word")
+
+
+CONFIGS = {
+    "simple_fc": simple_fc,
+    "img_layers": img_layers,
+    "img_trans_layers": img_trans_layers,
+    "util_layers": util_layers,
+    "projections": projections,
+    "seq_ops_suite": seq_ops_suite,
+    "simple_rnn": simple_rnn,
+    "simple_lstm_net": simple_lstm_net,
+    "bidirectional_gru": bidirectional_gru,
+    "rnn_group": rnn_group,
+    "nested_rnn_group": nested_rnn_group,
+    "attention_net": attention_net,
+    "cost_suite": cost_suite,
+    "rank_costs": rank_costs,
+    "crf_tagger": crf_tagger,
+    "ctc_net": ctc_net,
+    "nce_hsigmoid": nce_hsigmoid,
+    "detection_net": detection_net,
+    "multibox_net": multibox_net,
+    "conv3d_net": conv3d_net,
+    "mdlstm_ocr": mdlstm_ocr,
+    "misc_utils": misc_utils,
+    "selection_layers": selection_layers,
+    "generation_helpers": generation_helpers,
+    "deep_speech_row_conv": deep_speech_row_conv,
+    "word_embedding_ngram": word_embedding_ngram,
+}

@@ -1,0 +1,63 @@
+"""Golden-topology regression corpus.
+
+Reference: trainer_config_helpers/tests/configs/ + protostr/ — every DSL
+config's serialized form is committed, and CI diffs a fresh parse against
+it (generate_protostr.sh / run_tests.sh). Any change to shape inference,
+auto-naming, parameter layout, or serialization shows up as a diff here
+and must be intentional (regenerate with UPDATE_GOLDEN=1).
+
+    UPDATE_GOLDEN=1 python -m pytest tests/test_golden_topology.py
+
+Besides the byte diff, each config must deserialize back and rebuild the
+same serialized form (round-trip closure).
+"""
+
+import json
+import os
+import pathlib
+
+import pytest
+
+from paddle_tpu.core.topology import Topology
+from tests.golden_configs import CONFIGS
+
+GOLDEN_DIR = pathlib.Path(__file__).parent / "golden"
+
+
+def build_serialized(name: str) -> str:
+    out = CONFIGS[name]()
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    return Topology(outs).serialize()
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_golden_topology(name):
+    blob = build_serialized(name)
+    path = GOLDEN_DIR / f"{name}.json"
+    if os.environ.get("UPDATE_GOLDEN"):
+        GOLDEN_DIR.mkdir(exist_ok=True)
+        path.write_text(blob)
+    assert path.exists(), (
+        f"no golden topology for {name!r}; run with UPDATE_GOLDEN=1 to "
+        "create it")
+    golden = path.read_text()
+    if blob != golden:
+        # structured diff makes the failure actionable
+        a = json.loads(golden)
+        b = json.loads(blob)
+        ga = {l["name"]: l for l in a["layers"]}
+        gb = {l["name"]: l for l in b["layers"]}
+        only_a = sorted(set(ga) - set(gb))
+        only_b = sorted(set(gb) - set(ga))
+        changed = [n for n in ga if n in gb and ga[n] != gb[n]]
+        pytest.fail(
+            f"topology drift for {name!r}: removed={only_a} added={only_b} "
+            f"changed={changed[:10]} — if intentional, regenerate with "
+            "UPDATE_GOLDEN=1")
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_golden_roundtrip(name):
+    blob = build_serialized(name)
+    topo = Topology.deserialize(blob)
+    assert topo.serialize() == blob

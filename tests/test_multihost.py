@@ -1,0 +1,43 @@
+"""Multi-host glue (parallel/multihost.py) — single-process semantics of
+the jax.distributed path (Flags.cpp:55-60 trainer_id/num_gradient_servers
+equivalent). Real multi-process formation needs multiple hosts; here we
+pin the process-local contracts the cluster path builds on."""
+
+import jax
+import numpy as np
+
+from paddle_tpu import config as cfg
+from paddle_tpu.parallel import (global_batch, init_distributed,
+                                 is_coordinator, process_reader)
+from paddle_tpu.parallel.mesh import batch_sharding, data_parallel_mesh
+
+
+def test_init_distributed_single_process_noop():
+    pi, pc = init_distributed()
+    assert (pi, pc) == (0, 1)
+    assert cfg.global_config().process_index == 0
+    assert cfg.global_config().process_count == 1
+    assert is_coordinator()
+
+
+def test_process_reader_deals_round_robin():
+    def reader():
+        yield from range(10)
+
+    r0 = list(process_reader(reader, process_index=0, process_count=3)())
+    r1 = list(process_reader(reader, process_index=1, process_count=3)())
+    r2 = list(process_reader(reader, process_index=2, process_count=3)())
+    assert r0 == [0, 3, 6, 9]
+    assert r1 == [1, 4, 7]
+    assert r2 == [2, 5, 8]
+    assert sorted(r0 + r1 + r2) == list(range(10))
+
+
+def test_global_batch_shards_over_mesh():
+    mesh = data_parallel_mesh(8)
+    sharding = batch_sharding(mesh)
+    x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    arr = global_batch(x, mesh, sharding.spec)
+    assert arr.shape == (16, 3)
+    assert len(arr.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(arr), x)

@@ -1,0 +1,207 @@
+"""Nested recurrent_group (SubsequenceInput) — the level-2 unroll of
+RecurrentGradientMachine (RecurrentGradientMachine.h:32 hasSubseq path,
+gserver/tests/test_RecurrentGradientMachine.cpp's hierarchical configs).
+
+The outer group iterates over subsequences; each outer step hands the step
+function a level-1 SequenceBatch. Covers: per-subsequence reduction to a
+level-1 output, per-position inner-sequence outputs re-flattened to the
+nested layout, memory carried across subsequences, and gradients through
+the whole two-level unroll.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.sequence import SequenceBatch, pack_nested_sequences
+from paddle_tpu.core.topology import Topology
+from paddle_tpu.ops import sequence_ops as seq_ops
+from tests.grad_check import check_topology_grads
+
+L = paddle.layer
+
+
+def nested_feed(d=3):
+    rows = [[np.arange(2 * d, dtype=np.float32).reshape(2, d),
+             10 + np.arange(3 * d, dtype=np.float32).reshape(3, d)],
+            [20 + np.arange(1 * d, dtype=np.float32).reshape(1, d),
+             30 + np.arange(2 * d, dtype=np.float32).reshape(2, d),
+             40 + np.arange(2 * d, dtype=np.float32).reshape(2, d)]]
+    return rows, pack_nested_sequences(rows)
+
+
+class TestNestedRestructure:
+    def test_nested_to_padded_roundtrip(self):
+        rows, seq = nested_feed()
+        data, ilen = seq_ops.nested_to_padded(seq)
+        # row 0: segments of length 2 and 3
+        np.testing.assert_array_equal(np.asarray(ilen[0])[:3], [2, 3, 0])
+        np.testing.assert_allclose(np.asarray(data[0, 0, :2]), rows[0][0])
+        np.testing.assert_allclose(np.asarray(data[0, 1, :3]), rows[0][1])
+        back = seq_ops.padded_to_nested(data, ilen, seq.num_segments,
+                                        seq.max_len)
+        np.testing.assert_allclose(np.asarray(back.data),
+                                   np.asarray(seq.data))
+        np.testing.assert_array_equal(np.asarray(back.segment_ids),
+                                      np.asarray(seq.segment_ids))
+        np.testing.assert_array_equal(np.asarray(back.lengths),
+                                      np.asarray(seq.lengths))
+
+
+def run(out, feed, mode="test"):
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    outs, _ = topo.forward(params, topo.init_state(), feed, mode=mode,
+                           rng=jax.random.PRNGKey(1))
+    return outs[out.name], params
+
+
+class TestNestedGroup:
+    def test_subsequence_pooling_step(self):
+        """step reduces each subsequence -> level-1 sequence of vectors."""
+        rows, seq = nested_feed()
+        ns = L.data("ns", paddle.data_type.dense_vector_sub_sequence(3))
+
+        def step(sub):
+            return L.pooling(sub, pooling_type=paddle.pooling.Avg())
+
+        g = L.recurrent_group(step=step, input=L.SubsequenceInput(ns))
+        assert g.meta.seq_level == 1
+        got, _ = run(g, {"ns": seq})
+        assert isinstance(got, SequenceBatch) and not got.is_nested
+        np.testing.assert_array_equal(np.asarray(got.lengths), [2, 3])
+        np.testing.assert_allclose(np.asarray(got.data[0, 0]),
+                                   rows[0][0].mean(0), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(got.data[1, 2]),
+                                   rows[1][2].mean(0), rtol=1e-6)
+
+    def test_inner_seq_output_stays_nested(self):
+        """step returns a per-position output -> nested output, same
+        raggedness as the input."""
+        rows, seq = nested_feed()
+        ns = L.data("ns", paddle.data_type.dense_vector_sub_sequence(3))
+
+        def step(sub):
+            return L.fc(sub, size=4, act=paddle.activation.Tanh())
+
+        g = L.recurrent_group(step=step, input=L.SubsequenceInput(ns))
+        assert g.meta.seq_level == 2
+        got, _ = run(g, {"ns": seq})
+        assert got.is_nested
+        np.testing.assert_array_equal(np.asarray(got.lengths),
+                                      np.asarray(seq.lengths))
+        np.testing.assert_array_equal(np.asarray(got.segment_ids),
+                                      np.asarray(seq.segment_ids))
+
+    def test_memory_across_subsequences(self):
+        """A memory linked across outer steps accumulates subsequence
+        summaries — the hierarchical-RNN pattern."""
+        rows, seq = nested_feed()
+        ns = L.data("ns", paddle.data_type.dense_vector_sub_sequence(3))
+
+        def step(sub):
+            mem = L.memory(name="acc", size=3)
+            pooled = L.pooling(sub, pooling_type=paddle.pooling.Sum())
+            return L.addto([pooled, mem], name="acc")
+
+        g = L.recurrent_group(step=step, input=L.SubsequenceInput(ns))
+        got, _ = run(g, {"ns": seq})
+        # outer step s output = sum of pooled sums up to s
+        np.testing.assert_allclose(np.asarray(got.data[0, 0]),
+                                   rows[0][0].sum(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(got.data[0, 1]),
+                                   rows[0][0].sum(0) + rows[0][1].sum(0),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(got.data[1, 2]),
+            rows[1][0].sum(0) + rows[1][1].sum(0) + rows[1][2].sum(0),
+            rtol=1e-5)
+
+    def test_inner_recurrent_group_two_level(self):
+        """Full two-level unroll: an inner recurrent_group inside the outer
+        step (the configuration test_RecurrentGradientMachine exercises)."""
+        rows, seq = nested_feed()
+        ns = L.data("ns", paddle.data_type.dense_vector_sub_sequence(3))
+
+        def inner_step(x):
+            m = L.memory(name="ih", size=4)
+            return L.fc([x, m], size=4, act=paddle.activation.Tanh(),
+                        name="ih")
+
+        def outer_step(sub):
+            h = L.recurrent_group(step=inner_step, input=sub,
+                                  name="inner_rg")
+            return L.last_seq(h)
+
+        g = L.recurrent_group(step=outer_step, input=L.SubsequenceInput(ns),
+                              name="outer_rg")
+        got, _ = run(g, {"ns": seq})
+        assert isinstance(got, SequenceBatch)
+        np.testing.assert_array_equal(np.asarray(got.lengths), [2, 3])
+        assert np.all(np.isfinite(np.asarray(got.data)))
+
+    def test_reverse_walks_subsequences_backward(self):
+        rows, seq = nested_feed()
+        ns = L.data("ns", paddle.data_type.dense_vector_sub_sequence(3))
+
+        def step(sub):
+            mem = L.memory(name="racc", size=3)
+            pooled = L.pooling(sub, pooling_type=paddle.pooling.Sum())
+            return L.addto([pooled, mem], name="racc")
+
+        g = L.recurrent_group(step=step, input=L.SubsequenceInput(ns),
+                              reverse=True)
+        got, _ = run(g, {"ns": seq})
+        # reverse: step 0 sees the LAST subsequence; outputs are delivered
+        # back in forward segment order, so segment 0 carries the full sum
+        np.testing.assert_allclose(np.asarray(got.data[0, 0]),
+                                   rows[0][0].sum(0) + rows[0][1].sum(0),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(got.data[0, 1]),
+                                   rows[0][1].sum(0), rtol=1e-5)
+
+    def test_bounded_view_truncates_consistently(self):
+        # max_segments / max_sub_len clip data AND lengths together
+        rows = [[np.ones((2, 2), np.float32), 2 * np.ones((1, 2), np.float32),
+                 3 * np.ones((2, 2), np.float32)]]
+        seq = pack_nested_sequences(rows)
+        data, ilen = seq_ops.nested_to_padded(seq, max_segments=2,
+                                              max_sub_len=1)
+        np.testing.assert_array_equal(np.asarray(ilen[0])[:2], [1, 1])
+        assert np.all(np.asarray(ilen) <= 1)
+
+    def test_nested_group_gradients(self, rng):
+        rows = [[rng.randn(2, 3).astype(np.float32),
+                 rng.randn(3, 3).astype(np.float32)],
+                [rng.randn(2, 3).astype(np.float32)]]
+        seq = pack_nested_sequences(rows)
+        ns = L.data("ns", paddle.data_type.dense_vector_sub_sequence(3))
+
+        def step(sub):
+            mem = L.memory(name="h2", size=4)
+            pooled = L.pooling(L.fc(sub, size=4,
+                                    act=paddle.activation.Tanh()),
+                               pooling_type=paddle.pooling.Avg())
+            return L.fc([pooled, mem], size=4,
+                        act=paddle.activation.Tanh(), name="h2")
+
+        g = L.recurrent_group(step=step, input=L.SubsequenceInput(ns))
+        cost = L.sum_cost(L.last_seq(g))
+        check_topology_grads(Topology(cost), {"ns": seq}, n_coords=4)
+
+    def test_serialization_roundtrip(self):
+        rows, seq = nested_feed()
+        ns = L.data("ns", paddle.data_type.dense_vector_sub_sequence(3))
+
+        def step(sub):
+            return L.pooling(sub, pooling_type=paddle.pooling.Avg())
+
+        g = L.recurrent_group(step=step, input=L.SubsequenceInput(ns))
+        topo = Topology(g)
+        topo2 = Topology.deserialize(topo.serialize())
+        params = topo2.init_params(jax.random.PRNGKey(0))
+        outs, _ = topo2.forward(params, topo2.init_state(), {"ns": seq},
+                                mode="test", rng=jax.random.PRNGKey(1))
+        got = outs[g.name]
+        np.testing.assert_array_equal(np.asarray(got.lengths), [2, 3])

@@ -1,0 +1,103 @@
+"""PyDataProvider2 compat shim (reader/provider.py) —
+python/paddle/trainer/PyDataProvider2.py:365 protocol on the v2 reader path."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.reader.provider import (CacheType, DataProvider,
+                                        define_py_data_sources2, provider,
+                                        provider_reader)
+
+
+@provider(input_types=[paddle.data_type.dense_vector(4),
+                       paddle.data_type.integer_value(3)],
+          should_shuffle=False, cache=CacheType.CACHE_PASS_IN_MEM)
+def sample_process(settings, filename):
+    base = int(filename.rsplit("-", 1)[-1])
+    for i in range(3):
+        yield np.full(4, base + i, np.float32), (base + i) % 3
+
+
+@provider(input_types=[paddle.data_type.integer_value_sequence(10)],
+          should_shuffle=False,
+          init_hook=lambda settings, **kw: setattr(
+              settings, "offset", kw.get("offset", 0)))
+def seq_process(settings, filename):
+    yield [settings.offset, settings.offset + 1]
+
+
+def test_provider_decorator_returns_data_provider():
+    assert isinstance(sample_process, DataProvider)
+    assert sample_process.cache == CacheType.CACHE_PASS_IN_MEM
+
+
+def test_provider_reader_yields_all_files():
+    reader = provider_reader(sample_process, ["f-0", "f-10"])
+    got = list(reader())
+    assert len(got) == 6
+    assert got[0][1] == 0 and got[3][1] == 10 % 3
+    np.testing.assert_allclose(got[4][0], np.full(4, 11.0))
+
+
+def test_provider_cache_pass_in_mem():
+    calls = []
+
+    @provider(input_types=[paddle.data_type.dense_vector(2)],
+              should_shuffle=False, cache=CacheType.CACHE_PASS_IN_MEM)
+    def p(settings, filename):
+        calls.append(filename)
+        yield np.zeros(2, np.float32)
+
+    reader = provider_reader(p, ["only"])
+    list(reader())
+    list(reader())          # second pass must hit the cache
+    assert calls == ["only"]
+
+
+def test_init_hook_and_args():
+    reader = provider_reader(seq_process, ["x"], offset=5)
+    first_sample = list(reader())[0]
+    assert list(first_sample) == [5, 6]
+
+
+def test_file_list_from_text_file(tmp_path):
+    lst = tmp_path / "train.list"
+    lst.write_text("f-1\nf-2\n")
+    reader = provider_reader(sample_process, str(lst))
+    assert len(list(reader())) == 6
+
+
+def test_define_py_data_sources2(tmp_path):
+    import sys
+    import types
+    mod = types.ModuleType("fake_provider_mod")
+    mod.process = sample_process
+    sys.modules["fake_provider_mod"] = mod
+    try:
+        srcs = define_py_data_sources2(["f-0"], ["f-3"],
+                                       "fake_provider_mod", "process")
+        assert len(list(srcs["train"]())) == 3
+        assert list(srcs["test"]())[0][1] == 0
+    finally:
+        del sys.modules["fake_provider_mod"]
+
+
+def test_trains_through_sgd():
+    """End-to-end: a v1 provider feeds SGD.train via the adapter."""
+    reader = provider_reader(sample_process, ["f-0", "f-10"])
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+    out = paddle.layer.fc(x, size=3, act=paddle.activation.Softmax())
+    lbl = paddle.layer.data("label", paddle.data_type.integer_value(3))
+    cost = paddle.layer.classification_cost(out, lbl)
+    params = paddle.create_parameters(paddle.Topology(cost))
+    trainer = paddle.SGD(cost=cost, parameters=params,
+                         update_equation=paddle.optimizer.Adam(
+                             learning_rate=1e-2))
+    seen = []
+    trainer.train(paddle.reader.batch(reader, 3),
+                  num_passes=1,
+                  event_handler=lambda e: seen.append(e))
+    assert any(isinstance(e, paddle.event.EndPass) for e in seen)

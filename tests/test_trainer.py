@@ -119,3 +119,20 @@ class TestSGDTrain:
                                           drop_last=True),
                       num_passes=12, event_handler=handler)
         assert np.mean(costs[-3:]) < np.mean(costs[:3]) * 0.3
+
+
+def test_debug_nans_flag_raises_at_source():
+    """config.init(debug_nans=True) = the FPE-trap discipline
+    (TrainerMain.cpp:49): NaN-producing math raises instead of propagating."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+    from paddle_tpu import config as cfg
+    cfg.init(debug_nans=True)
+    try:
+        assert cfg.global_config().debug_nans
+        with _pytest.raises(FloatingPointError):
+            jnp.log(jnp.zeros(())) * 0.0  # -inf * 0 -> nan, must trap
+    finally:
+        jax.config.update("jax_debug_nans", False)
+        cfg.init(debug_nans=False)
