@@ -12,18 +12,33 @@ from typing import Optional
 from paddle_tpu.core.registry import ParamAttr
 
 
+class HookAttribute:
+    """Parameter update hook (attrs.py:59 HookAttribute → C++
+    ParameterUpdaterHook.cpp). Supported: type='pruning' with
+    sparsity_ratio — a static mask from the initial weight magnitudes
+    applied after every update (StaticPruningHook)."""
+
+    def __init__(self, type: str, sparsity_ratio: Optional[float] = None):
+        assert type in ("pruning",), f"unsupported hook type {type!r}"
+        self.type = type
+        self.sparsity_ratio = 0.6 if sparsity_ratio is None else \
+            float(sparsity_ratio)
+        if self.type == "pruning":
+            assert 0.0 <= self.sparsity_ratio <= 1.0
+
+
 def Param(name: Optional[str] = None, learning_rate: float = 1.0,
           l1_rate: Optional[float] = None, l2_rate: Optional[float] = None,
           initial_std: Optional[float] = None, initial_mean: float = 0.0,
           is_static: bool = False, sparse_update: bool = False,
           gradient_clipping_threshold: Optional[float] = None,
-          initializer=None, **kwargs) -> ParamAttr:
+          initializer=None, update_hooks=None, **kwargs) -> ParamAttr:
     return ParamAttr(name=name, learning_rate=learning_rate,
                      l1_rate=l1_rate, l2_rate=l2_rate,
                      initial_std=initial_std, initial_mean=initial_mean,
                      is_static=is_static, sparse=sparse_update,
                      gradient_clipping_threshold=gradient_clipping_threshold,
-                     initializer=initializer)
+                     initializer=initializer, update_hooks=update_hooks)
 
 
 ParameterAttribute = Param

@@ -39,6 +39,9 @@ class ParamAttr:
     initial_std: Optional[float] = None
     initial_mean: float = 0.0
     gradient_clipping_threshold: Optional[float] = None
+    # ParameterUpdaterHook (ParameterUpdaterHook.cpp StaticPruningHook):
+    # e.g. HookAttribute("pruning", sparsity_ratio=0.6)
+    update_hooks: Optional[Any] = None
 
     @staticmethod
     def of(x) -> "ParamAttr":

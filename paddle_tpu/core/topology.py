@@ -232,12 +232,20 @@ def _jsonify(obj):
         return {"__seq_type__": obj.value}
     if isinstance(obj, ParamAttr):
         # initializer callables are init-time only; dropped in serialization
-        return {"__param_attr__": {
+        d = {
             "name": obj.name, "learning_rate": obj.learning_rate,
             "l1_rate": obj.l1_rate, "l2_rate": obj.l2_rate,
             "is_static": obj.is_static, "sparse": obj.sparse,
             "initial_std": obj.initial_std, "initial_mean": obj.initial_mean,
-            "gradient_clipping_threshold": obj.gradient_clipping_threshold}}
+            "gradient_clipping_threshold": obj.gradient_clipping_threshold}
+        hooks = obj.update_hooks
+        if hooks is not None:
+            d["update_hooks"] = [
+                {"type": h.type,
+                 "sparsity_ratio": getattr(h, "sparsity_ratio", None)}
+                for h in (hooks if isinstance(hooks, (list, tuple))
+                          else [hooks])]
+        return {"__param_attr__": d}
     return obj
 
 
@@ -251,7 +259,13 @@ def _unjsonify(obj):
         if "__seq_type__" in obj:
             return SeqType(obj["__seq_type__"])
         if "__param_attr__" in obj:
-            return ParamAttr(**obj["__param_attr__"])
+            d = dict(obj["__param_attr__"])
+            if d.get("update_hooks"):
+                from paddle_tpu.attr import HookAttribute
+                d["update_hooks"] = [
+                    HookAttribute(h["type"], h.get("sparsity_ratio"))
+                    for h in d["update_hooks"]]
+            return ParamAttr(**d)
         return {k: _unjsonify(v) for k, v in obj.items()}
     if isinstance(obj, list):
         return [_unjsonify(v) for v in obj]

@@ -545,6 +545,113 @@ class PrinterEvaluator(Evaluator):
         return {}
 
 
+class DetectionMAPEvaluator(Evaluator):
+    """Mean average precision over detection outputs
+    (Evaluator.cpp REGISTER_EVALUATOR detection_map, DetectionMAPEvaluator.cpp).
+
+    input: a detection_output layer — rows of
+    (image_id, label, score, xmin, ymin, xmax, ymax), [b, K*7].
+    label: ground-truth SequenceBatch rows (label, xmin, ymin, xmax, ymax,
+    difficult). AP per class via the VOC integral method; result is the
+    mean over classes with at least one gt box.
+    """
+
+    def __init__(self, input: LayerOutput, label: LayerOutput,
+                 overlap_threshold: float = 0.5, background_id: int = 0,
+                 evaluate_difficult: bool = False, name: str = "detection_map"):
+        self.name = name
+        self.inputs = [input, label]
+        self.overlap_threshold = overlap_threshold
+        self.background_id = background_id
+        self.evaluate_difficult = evaluate_difficult
+        self.start()
+
+    def start(self):
+        self._dets = []          # (class, score, image_key, box)
+        self._gts = {}           # (image_key, class) -> [(box, difficult)]
+        self._img_base = 0
+
+    @staticmethod
+    def _iou(a, b):
+        lt = np.maximum(a[:2], b[:2])
+        rb = np.minimum(a[2:], b[2:])
+        wh = np.clip(rb - lt, 0.0, None)
+        inter = wh[0] * wh[1]
+        ua = max(a[2] - a[0], 0) * max(a[3] - a[1], 0) + \
+            max(b[2] - b[0], 0) * max(b[3] - b[1], 0) - inter
+        return inter / ua if ua > 0 else 0.0
+
+    def eval_batch(self, values, n_real):
+        det, lab = values
+        det = np.asarray(_to_np(det)[0] if isinstance(_to_np(det), tuple)
+                         else _to_np(det))[:n_real].reshape(n_real, -1, 7)
+        ld = _to_np(lab)
+        if isinstance(ld, tuple):
+            gdata, glens = ld
+            lab_rows = [gdata[i][:int(glens[i])] for i in range(n_real)]
+        else:
+            lab_rows = [ld[i] for i in range(n_real)]
+        for i in range(n_real):
+            key = self._img_base + i
+            for row in det[i]:
+                cls = int(row[1])
+                if cls < 0 or cls == self.background_id:
+                    continue
+                self._dets.append((cls, float(row[2]), key, row[3:7].copy()))
+            for g in lab_rows[i]:
+                cls = int(g[0])
+                diff = bool(g[5]) if len(g) > 5 else False
+                self._gts.setdefault((key, cls), []).append(
+                    (np.asarray(g[1:5], np.float64), diff))
+        self._img_base += n_real
+
+    def result(self):
+        classes = sorted({c for _, c in self._gts})
+        aps = []
+        for c in classes:
+            gt_items = {k: v for k, v in self._gts.items() if k[1] == c}
+            n_pos = sum(1 for v in gt_items.values() for b, d in v
+                        if self.evaluate_difficult or not d)
+            dets = sorted((d for d in self._dets if d[0] == c),
+                          key=lambda d: -d[1])
+            matched = {k: [False] * len(v) for k, v in gt_items.items()}
+            tp, fp = [], []
+            for _, score, key, box in dets:
+                gts = gt_items.get((key, c), [])
+                best, best_j = 0.0, -1
+                for j, (gbox, diff) in enumerate(gts):
+                    ov = self._iou(box, gbox)
+                    if ov > best:
+                        best, best_j = ov, j
+                if best >= self.overlap_threshold and best_j >= 0:
+                    gbox, diff = gts[best_j]
+                    if diff and not self.evaluate_difficult:
+                        continue       # difficult boxes neither tp nor fp
+                    if not matched[(key, c)][best_j]:
+                        matched[(key, c)][best_j] = True
+                        tp.append(1.0)
+                        fp.append(0.0)
+                    else:
+                        tp.append(0.0)
+                        fp.append(1.0)
+                else:
+                    tp.append(0.0)
+                    fp.append(1.0)
+            if n_pos == 0:
+                continue
+            tp = np.cumsum(tp) if tp else np.zeros(0)
+            fp = np.cumsum(fp) if fp else np.zeros(0)
+            recall = tp / n_pos
+            precision = tp / np.maximum(tp + fp, 1e-12)
+            ap = 0.0
+            for t in np.arange(0.0, 1.01, 0.1):   # VOC 11-point
+                p = precision[recall >= t].max() if np.any(recall >= t) \
+                    else 0.0
+                ap += p / 11.0
+            aps.append(min(ap, 1.0))
+        return {self.name: float(np.mean(aps)) if aps else 0.0}
+
+
 # ---------------------------------------------------------------------------
 # v2-style DSL constructors (trainer_config_helpers/evaluators.py names)
 
@@ -583,6 +690,10 @@ def sum_evaluator(input, **kw):
 
 def column_sum(input, **kw):
     return ColumnSumEvaluator(input, **kw)
+
+
+def detection_map(input, label, **kw):
+    return DetectionMAPEvaluator(input, label, **kw)
 
 
 def maxid_printer(input, **kw):

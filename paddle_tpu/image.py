@@ -1,0 +1,110 @@
+"""Image preprocessing utilities — python/paddle/v2/image.py parity.
+
+Pure-numpy implementations (the reference shells out to cv2; PIL/cv2 stay
+optional here so the loaders work in minimal containers): resize_short,
+center/random crop, flip, CHW conversion, and the simple_transform /
+load_and_transform pipelines the image demos feed through.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def load_image_bytes(data: bytes, is_color: bool = True) -> np.ndarray:
+    """Decode an encoded image buffer to HWC uint8 (needs PIL)."""
+    import io
+
+    from PIL import Image
+
+    im = Image.open(io.BytesIO(data))
+    im = im.convert("RGB" if is_color else "L")
+    arr = np.asarray(im)
+    return arr if is_color else arr[..., None]
+
+
+def load_image(path: str, is_color: bool = True) -> np.ndarray:
+    with open(path, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def _resize_bilinear(im: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Bilinear resize in numpy (HWC)."""
+    ih, iw = im.shape[:2]
+    if (ih, iw) == (h, w):
+        return im
+    ys = np.linspace(0, ih - 1, h)
+    xs = np.linspace(0, iw - 1, w)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, ih - 1)
+    x1 = np.minimum(x0 + 1, iw - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    im = im.astype(np.float32)
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Scale so the SHORT side equals `size` (image.py:143)."""
+    h, w = im.shape[:2]
+    if h < w:
+        nh, nw = size, int(round(w * size / h))
+    else:
+        nh, nw = int(round(h * size / w)), size
+    return _resize_bilinear(im, nh, nw)
+
+
+def to_chw(im: np.ndarray, order=(2, 0, 1)) -> np.ndarray:
+    """HWC -> CHW (the framework's flat channel-major feed layout)."""
+    return im.transpose(order)
+
+
+def center_crop(im: np.ndarray, size: int, is_color: bool = True) -> np.ndarray:
+    h, w = im.shape[:2]
+    hs = max((h - size) // 2, 0)
+    ws = max((w - size) // 2, 0)
+    return im[hs:hs + size, ws:ws + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color: bool = True,
+                rng: np.random.RandomState = None) -> np.ndarray:
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    hs = rng.randint(0, max(h - size, 0) + 1)
+    ws = rng.randint(0, max(w - size, 0) + 1)
+    return im[hs:hs + size, ws:ws + size]
+
+
+def left_right_flip(im: np.ndarray) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color: bool = True,
+                     mean=None, rng=None) -> np.ndarray:
+    """resize-short -> crop (random+flip when training, center otherwise)
+    -> CHW float32 -> optional mean subtraction (image.py:265)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if (rng or np.random).randint(2) == 1:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        im -= mean.reshape((-1,) + (1,) * (im.ndim - 1)) if mean.ndim == 1 \
+            else mean
+    return im
+
+
+def load_and_transform(path: str, resize_size: int, crop_size: int,
+                       is_train: bool, is_color: bool = True,
+                       mean=None) -> np.ndarray:
+    return simple_transform(load_image(path, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

@@ -119,6 +119,10 @@ def recurrent_group(step, input, reverse: bool = False,
         assert not seq_inputs, \
             "recurrent_group: mix of SubsequenceInput and plain sequence " \
             "in-links is not supported — wrap all of them"
+        bounds = {(s.max_segments, s.max_sub_len) for s in sub_inputs}
+        assert len(bounds) == 1, \
+            "recurrent_group: every SubsequenceInput must carry the same " \
+            f"max_segments/max_sub_len bounds, got {sorted(bounds)}"
         seq_inputs = [s.input for s in sub_inputs]
     assert seq_inputs, "recurrent_group needs at least one sequence input"
 

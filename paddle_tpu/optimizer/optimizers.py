@@ -108,9 +108,35 @@ class Optimizer:
         for k, v in params.items():
             if k in getattr(self, "sparse_params", ()):
                 state["slots"][k]["_t"] = jnp.zeros((v.shape[0],), jnp.int32)
+        # StaticPruningHook (ParameterUpdaterHook.cpp:33-140): a one-shot
+        # mask keeping the largest-|w| (1 - sparsity_ratio) fraction of the
+        # initial weights, applied after every update
+        for k, v in params.items():
+            hook = self._pruning_hook(k)
+            if hook is not None:
+                if k in getattr(self, "sparse_params", ()):
+                    raise ValueError(
+                        f"param {k!r}: pruning hook + sparse_update is "
+                        "unsupported — the row-sparse path would skip the "
+                        "mask; use a dense table or drop the hook")
+                ratio = getattr(hook, "sparsity_ratio", 0.5)
+                kth = jnp.quantile(jnp.abs(v).astype(jnp.float32).ravel(),
+                                   ratio)
+                state["slots"][k]["_mask"] = (
+                    jnp.abs(v) >= kth).astype(v.dtype)
         if self.model_average is not None:
             state["avg"] = {k: v for k, v in params.items()}
         return state
+
+    def _pruning_hook(self, k):
+        attr = self.param_attrs.get(k)
+        hooks = getattr(attr, "update_hooks", None) if attr else None
+        if hooks is None:
+            return None
+        for h in (hooks if isinstance(hooks, (list, tuple)) else [hooks]):
+            if getattr(h, "type", None) == "pruning":
+                return h
+        return None
 
     def _adjust_grad(self, k, p, g):
         """Clipping + L1/L2 (elementwise, so valid on full params or row
@@ -166,6 +192,11 @@ class Optimizer:
                 # in the pytree and current
                 ns = dict(ns)
                 ns["_t"] = jnp.full_like(state["slots"][k]["_t"], step)
+            if "_mask" in state["slots"][k]:
+                mask = state["slots"][k]["_mask"]
+                np_ = np_ * mask
+                ns = dict(ns)
+                ns["_mask"] = mask
             new_params[k] = np_
             new_slots[k] = ns
         new_state = {"step": step, "num_samples": num_samples,

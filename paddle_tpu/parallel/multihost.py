@@ -39,12 +39,26 @@ def init_distributed(coordinator_address: Optional[str] = None,
     reference's cloud auto-discovery. Returns (process_index,
     process_count) and records them in the global config.
     """
-    if jax.process_count() == 1 and (coordinator_address or num_processes):
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids, **kw)
+    # IMPORTANT: nothing may touch the XLA backend (jax.devices/
+    # process_count) before jax.distributed.initialize, or it raises.
+    already = False
+    try:
+        already = jax.distributed.is_initialized()
+    except AttributeError:   # older jax: probe the client handle
+        already = getattr(getattr(jax._src.distributed, "global_state", None),
+                          "client", None) is not None
+    if not already:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids, **kw)
+        except Exception:
+            # explicit cluster args must not fail silently; the bare
+            # auto-detect call may (standalone single-process run)
+            if coordinator_address or num_processes:
+                raise
     g = config_mod.global_config()
     g.process_index = jax.process_index()
     g.process_count = jax.process_count()

@@ -123,12 +123,16 @@ def provider_reader(p: Union[DataProvider, Callable],
             return
         settings = p.settings(**hook_kwargs)
         out: List[Any] = [] if p.cache == CacheType.CACHE_PASS_IN_MEM else None
-        if p.should_shuffle in (None, True) and p.pool_size > 0:
+        if p.should_shuffle in (None, True):
+            # reference semantics: shuffle by default; pool_size <= 0 means
+            # an UNBOUNDED pool (whole pass buffered then shuffled)
+            pool_cap = p.pool_size if p.pool_size and p.pool_size > 0 \
+                else float("inf")
             pool: List[Any] = []
             for fname in files:
                 for sample in p(settings, fname):
                     pool.append(sample)
-                    if len(pool) >= p.pool_size:
+                    if len(pool) >= pool_cap:
                         random.shuffle(pool)
                         for s in pool:
                             if out is not None:

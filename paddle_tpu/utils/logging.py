@@ -1,0 +1,58 @@
+"""Logging + version stamping — paddle/utils glog/gflags surface.
+
+Reference: paddle/utils/Logging.h (glog wrappers initializeLogging,
+setMinLogLevel, installFailureWriter) and Version.h (version::printVersion,
+paddle/scripts' PADDLE_VERSION stamp). Python logging plays glog's role;
+the format mirrors glog's `[LEVEL datetime file:line]` so log-scraping
+tooling carries over.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+VERSION = "0.2.0"               # round-2 framework version stamp
+ISA_TARGET = "tpu-xla"          # the reference stamped WITH_GPU/avx flags
+
+_FMT = "[%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s"
+_initialized = False
+
+
+def initialize_logging(level: int = logging.INFO) -> logging.Logger:
+    """initializeLogging parity: root logger with the glog line format."""
+    global _initialized
+    logger = logging.getLogger("paddle_tpu")
+    if not _initialized:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FMT, "%m%d %H:%M:%S"))
+        logger.addHandler(handler)
+        logger.propagate = False
+        _initialized = True
+    logger.setLevel(level)
+    return logger
+
+
+def get_logger(name: str = "paddle_tpu") -> logging.Logger:
+    initialize_logging()
+    return logging.getLogger(name)
+
+
+def set_min_log_level(level: int) -> None:
+    """setMinLogLevel parity (glog numeric levels also accepted: 0..3 ->
+    INFO/WARNING/ERROR/FATAL)."""
+    glog_map = {0: logging.INFO, 1: logging.WARNING, 2: logging.ERROR,
+                3: logging.CRITICAL}
+    initialize_logging().setLevel(glog_map.get(level, level))
+
+
+def version() -> str:
+    """version::printVersion parity — framework + runtime versions."""
+    import jax
+
+    return (f"paddle_tpu {VERSION} (target {ISA_TARGET}, "
+            f"jax {jax.__version__})")
+
+
+def print_version() -> None:
+    print(version())

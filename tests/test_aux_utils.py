@@ -1,0 +1,152 @@
+"""Aux-surface parity tests: pruning hook (ParameterUpdaterHook.cpp),
+detection mAP evaluator, Ploter (v2/plot), image transforms (v2/image.py),
+and glog-style logging (paddle/utils/Logging.h)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import evaluator as E
+from paddle_tpu.core.sequence import pack_sequences
+
+
+class TestPruningHook:
+    def test_static_pruning_masks_updates(self):
+        import jax.numpy as jnp
+        from paddle_tpu.attr import HookAttribute, Param
+        from paddle_tpu.optimizer.optimizers import Momentum
+
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+        out = paddle.layer.fc(
+            x, size=6, act=paddle.activation.Tanh(),
+            param_attr=Param(name="pruned_w",
+                             update_hooks=HookAttribute("pruning",
+                                                        sparsity_ratio=0.5)),
+            bias_attr=False)
+        cost = paddle.layer.sum_cost(out)
+        topo = paddle.Topology(cost)
+        params = paddle.create_parameters(topo)
+        opt = Momentum(learning_rate=0.1, momentum=0.9).bind(topo.param_specs)
+        state = opt.init_state(params.raw)
+        mask = np.asarray(state["slots"]["pruned_w"]["_mask"])
+        assert 0.3 <= mask.mean() <= 0.7      # ~half pruned
+        grads = {"pruned_w": jnp.ones_like(params.raw["pruned_w"])}
+        new_params, new_state = opt.update(params.raw, grads, state, 4)
+        w = np.asarray(new_params["pruned_w"])
+        assert np.all(w[mask == 0] == 0.0)    # pruned slots stay dead
+        assert np.any(w[mask == 1] != 0.0)
+        # mask persists in the new state
+        np.testing.assert_array_equal(
+            np.asarray(new_state["slots"]["pruned_w"]["_mask"]), mask)
+
+
+    def test_update_hooks_survive_serialization(self):
+        from paddle_tpu.attr import HookAttribute, Param
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+        out = paddle.layer.fc(
+            x, size=3, bias_attr=False,
+            param_attr=Param(name="w",
+                             update_hooks=HookAttribute("pruning", 0.7)))
+        topo = paddle.Topology(out)
+        topo2 = paddle.Topology.deserialize(topo.serialize())
+        h = topo2.param_specs["w"].attr.update_hooks[0]
+        assert h.type == "pruning" and h.sparsity_ratio == 0.7
+
+    def test_pruning_rejects_sparse_params(self):
+        from paddle_tpu.attr import HookAttribute, Param
+        from paddle_tpu.optimizer.optimizers import Momentum
+        ids = paddle.layer.data("ids", paddle.data_type.integer_value(100))
+        emb = paddle.layer.embedding(
+            ids, size=8,
+            param_attr=Param(name="tbl", sparse_update=True,
+                             update_hooks=HookAttribute("pruning", 0.5)))
+        cost = paddle.layer.sum_cost(emb)
+        topo = paddle.Topology(cost)
+        params = paddle.create_parameters(topo)
+        opt = Momentum(learning_rate=0.1).bind(topo.param_specs,
+                                               sparse_params=["tbl"])
+        with pytest.raises(ValueError, match="pruning hook"):
+            opt.init_state(params.raw)
+
+
+class _FakeLayer:
+    def __init__(self, name):
+        self.name = name
+
+
+class TestDetectionMAP:
+    def test_perfect_predictions_map_1(self):
+        ev = E.detection_map(_FakeLayer("det"), _FakeLayer("gt"))
+        # one image, two gt boxes of classes 1 and 2, detections match
+        det = np.zeros((1, 2, 7), np.float32)
+        det[0, 0] = [0, 1, 0.9, 0.1, 0.1, 0.4, 0.4]
+        det[0, 1] = [0, 2, 0.8, 0.5, 0.5, 0.9, 0.9]
+        gt = pack_sequences([np.array([[1, .1, .1, .4, .4, 0],
+                                       [2, .5, .5, .9, .9, 0]], np.float32)])
+        ev.eval_batch([det.reshape(1, -1), gt], 1)
+        assert ev.result()["detection_map"] == pytest.approx(1.0)
+
+    def test_wrong_boxes_map_0(self):
+        ev = E.detection_map(_FakeLayer("det"), _FakeLayer("gt"))
+        det = np.zeros((1, 1, 7), np.float32)
+        det[0, 0] = [0, 1, 0.9, 0.6, 0.6, 0.9, 0.9]   # misses the gt
+        gt = pack_sequences([np.array([[1, .1, .1, .3, .3, 0]], np.float32)])
+        ev.eval_batch([det.reshape(1, -1), gt], 1)
+        assert ev.result()["detection_map"] == pytest.approx(0.0)
+
+    def test_duplicate_detection_is_fp(self):
+        ev = E.detection_map(_FakeLayer("det"), _FakeLayer("gt"))
+        det = np.zeros((1, 2, 7), np.float32)
+        det[0, 0] = [0, 1, 0.9, 0.1, 0.1, 0.4, 0.4]
+        det[0, 1] = [0, 1, 0.8, 0.1, 0.1, 0.4, 0.4]   # duplicate
+        gt = pack_sequences([np.array([[1, .1, .1, .4, .4, 0]], np.float32)])
+        ev.eval_batch([det.reshape(1, -1), gt], 1)
+        m = ev.result()["detection_map"]
+        assert 0.9 < m <= 1.0                  # AP still ~1 (dup ranks after)
+
+
+class TestPloter:
+    def test_collects_and_resets(self):
+        from paddle_tpu.plot import Ploter
+        p = Ploter("train", "test")
+        p.append("train", 0, 1.0)
+        p.append("train", 1, 0.5)
+        assert p.data("train").value == [1.0, 0.5]
+        p.plot()                               # headless-safe
+        p.reset()
+        assert p.data("train").value == []
+
+
+class TestImage:
+    def test_resize_short_and_center_crop(self):
+        from paddle_tpu import image as img
+        im = np.arange(20 * 10 * 3, dtype=np.uint8).reshape(20, 10, 3)
+        r = img.resize_short(im, 8)
+        assert min(r.shape[:2]) == 8 and r.shape[0] == 16
+        c = img.center_crop(r, 8)
+        assert c.shape[:2] == (8, 8)
+
+    def test_simple_transform_chw(self):
+        from paddle_tpu import image as img
+        im = np.random.RandomState(0).randint(
+            0, 255, (32, 24, 3)).astype(np.uint8)
+        out = img.simple_transform(im, 16, 12, is_train=False,
+                                   mean=[1.0, 2.0, 3.0])
+        assert out.shape == (3, 12, 12)
+        assert out.dtype == np.float32
+
+    def test_flip(self):
+        from paddle_tpu import image as img
+        im = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+        np.testing.assert_allclose(img.left_right_flip(im)[:, 0], im[:, 1])
+
+
+class TestLogging:
+    def test_glog_format_and_version(self, capsys):
+        from paddle_tpu.utils import logging as plog
+        lg = plog.get_logger()
+        plog.set_min_log_level(0)
+        lg.info("hello")
+        err = capsys.readouterr().err
+        assert "hello" in err and err.startswith("[I ")
+        assert "paddle_tpu" in plog.version()
