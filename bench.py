@@ -196,6 +196,49 @@ def bench_lstm(batch: int, hidden: int, seq_len: int = 100,
     return _measure(trainer, feed, batch, iters, warmup)
 
 
+def bench_flash_attention(batch: int = 4, seq_len: int = 4096, heads: int = 8,
+                          head_dim: int = 128, iters: int = 20,
+                          warmup: int = 3):
+    """Fused flash attention vs plain XLA attention (causal, bf16) — the
+    long-context primitive. Reports flash ms, xla ms, and their ratio;
+    no 2017 baseline row exists (the reference had no attention kernel)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_attention import (_lens_mask, _reference,
+                                                 flash_attention)
+
+    rng = np.random.RandomState(0)
+    shape = (batch, seq_len, heads, head_dim)
+    q = jax.device_put(jnp.asarray(rng.randn(*shape))).astype(jnp.bfloat16)
+    k = jax.device_put(jnp.asarray(rng.randn(*shape))).astype(jnp.bfloat16)
+    v = jax.device_put(jnp.asarray(rng.randn(*shape))).astype(jnp.bfloat16)
+    lens = jnp.full((batch,), seq_len, jnp.int32)
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, kv_lens=lens,
+                                                causal=True))
+    mask = _lens_mask(lens, lens, seq_len, seq_len, True)
+    r = jax.jit(lambda q, k, v: _reference(q, k, v, mask,
+                                           head_dim ** -0.5))
+
+    def measure(fn):
+        for _ in range(warmup):
+            fn(q, k, v).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(q, k, v)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    flash_ms = measure(f)
+    xla_ms = measure(r)
+    # causal forward FLOPs: two [T, d] matmuls over the T^2/2 valid pairs
+    flops = batch * heads * (seq_len ** 2 / 2) * head_dim * 2 * 2
+    return {"ms": round(flash_ms, 4), "xla_ms": round(xla_ms, 4),
+            "vs_xla": round(xla_ms / flash_ms, 3),
+            "tflops": round(flops / flash_ms / 1e9, 2)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite", default="all", choices=["headline", "all"])
@@ -237,6 +280,8 @@ def main():
             "lstm_bs64_h256", bench_lstm(64, 256, iters=args.iters))
         suite["lstm_bs128_h1280"] = _emit(
             "lstm_bs128_h1280", bench_lstm(128, 1280, iters=half))
+        suite["flash_attention_t4096"] = _emit(
+            "flash_attention_t4096", bench_flash_attention(iters=half))
 
     head = suite["alexnet_bs128"]
     print(json.dumps({

@@ -32,6 +32,8 @@ class GlobalConfig:
     seed: int = 0
     # FPE-trap equivalent (TrainerMain.cpp:49): raise at the first NaN.
     debug_nans: bool = False
+    # Pallas flash attention for tile-friendly shapes on TPU
+    use_flash_attention: bool = True
     initialized: bool = False
 
 

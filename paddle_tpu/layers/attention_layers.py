@@ -14,6 +14,7 @@ sp is purely a mesh decision — SURVEY §2.4's sequence-parallel row.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import LayerMeta, make_layer, register_layer
@@ -59,15 +60,24 @@ class DotProductAttentionLayer:
             out = sp_ops.ring_attention(q, k, v, mesh, lengths=ks.lengths,
                                         causal=causal)
         else:
-            b, tq = q.shape[0], q.shape[1]
-            tk = k.shape[1]
-            kv_valid = (jnp.arange(tk)[None, :] <
-                        ks.lengths[:, None])            # [b, Tk]
-            mask = jnp.broadcast_to(kv_valid[:, None, :], (b, tq, tk))
-            if causal:
-                tri = jnp.tril(jnp.ones((tq, tk), bool))
-                mask = mask & tri[None]
-            out = sp_ops.attention(q, k, v, mask=mask)
+            # fused flash kernel on TPU when tile-friendly; XLA otherwise
+            from paddle_tpu.config import global_config
+            from paddle_tpu.ops import pallas_attention as flash
+            if (global_config().use_flash_attention and
+                    jax.default_backend() == "tpu" and
+                    flash.flash_supported(q, k)):
+                out = flash.flash_attention(q, k, v, kv_lens=ks.lengths,
+                                            causal=causal)
+            else:
+                b, tq = q.shape[0], q.shape[1]
+                tk = k.shape[1]
+                kv_valid = (jnp.arange(tk)[None, :] <
+                            ks.lengths[:, None])        # [b, Tk]
+                mask = jnp.broadcast_to(kv_valid[:, None, :], (b, tq, tk))
+                if causal:
+                    tri = jnp.tril(jnp.ones((tq, tk), bool))
+                    mask = mask & tri[None]
+                out = sp_ops.attention(q, k, v, mask=mask)
         return qs.with_data(_merge_heads(out))
 
 

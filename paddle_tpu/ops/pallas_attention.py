@@ -1,0 +1,236 @@
+"""Fused flash attention in Pallas — the TPU hot-loop for attention.
+
+Like ops/pallas_rnn.py, this is the one-hop-beyond-XLA fusion: plain
+attention materializes the [b, h, Tq, Tk] score matrix in HBM (the
+quadratic term that kills long sequences); this kernel streams K/V blocks
+through VMEM with online softmax, computing the padding/causal mask
+IN-KERNEL from per-row lengths, so primal HBM traffic is linear in
+sequence length. Single-chip counterpart of
+parallel/sequence_parallel.py's ring attention (the same online-softmax
+update run across chips).
+
+Semantics match parallel/sequence_parallel.attention with a
+lengths+causal mask exactly (tests assert parity): padded K/V positions
+are ignored, q rows at/past their length return 0. The kernel is the
+PRIMAL path; under jax.grad the custom_vjp recomputes with the XLA
+reference, which IS quadratic in memory — long-sequence TRAINING should
+shard over the `sp` mesh axis (ring attention) instead, as the docs say.
+
+Used automatically by the attention layer on TPU for tile-friendly
+shapes (head_dim % 8 == 0); `interpret=True` runs on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref,
+                  acc_scr, m_scr, l_scr, *, scale, nk, block_q, block_k,
+                  causal):
+    j = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # block-skip: nothing to do when this K block is entirely past the
+    # row's kv_len, or (causal) entirely above the diagonal
+    i = pl.program_id(0)
+    q_len = lens_ref[i, 0]
+    kv_len = lens_ref[i, 1]
+    needed = kk * block_k < kv_len
+    if causal:
+        needed = needed & (kk * block_k <= j * block_q + block_q - 1)
+
+    @pl.when(needed)
+    def _block():
+        q = q_ref[0]                                  # [bq, d]
+        k = k_ref[0]                                  # [bk, d]
+        v = v_ref[0]                                  # [bk, d]
+        # dots in the input dtype (bf16 rides the MXU single-pass), f32
+        # accumulation; HIGHEST keeps f32 inputs full-precision
+        # (ops/linear convention — default truncates even f32 operands)
+        # but is only legal on f32 operands
+        prec = jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else None
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=prec) * scale
+
+        # in-kernel mask from lengths (+causal) — nothing quadratic in HBM
+        rows = j * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = kk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = (rows < q_len) & (cols < kv_len)
+        if causal:
+            valid = valid & (cols <= rows)
+        s = jnp.where(valid, s, NEG_INF)              # [bq, bk]
+
+        m_old = m_scr[:]                              # [bq, 128] (bcast)
+        s_max = jnp.max(s, axis=-1, keepdims=True)    # [bq, 1]
+        m_new = jnp.maximum(m_old, s_max)             # [bq, 128]
+        alpha = jnp.exp(m_old[:, 0:1] - m_new[:, 0:1])
+        # explicit zero on masked entries: with a finite NEG_INF, a row
+        # masked in EVERY block would otherwise see exp(s - m) == 1 junk
+        p = jnp.where(valid, jnp.exp(s - m_new[:, 0:1]), 0.0)  # [bq, bk]
+        l_new = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+            precision=prec)
+        m_scr[:] = m_new
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kk == nk - 1)
+    def _finish():
+        l = l_scr[:][:, 0:1]
+        out_ref[0] = jnp.where(l > 0.0, acc_scr[:] / jnp.maximum(l, 1e-30),
+                               0.0).astype(out_ref.dtype)
+
+
+def _flash_call(q3, k3, v3, lens2, *, scale, block_q, block_k, causal,
+                interpret):
+    """q3: [bh, Tq, d]; k3/v3: [bh, Tk, d]; lens2: [bh, 2] int32
+    (q_len, kv_len per row)."""
+    bh, tq, d = q3.shape
+    tk = k3.shape[1]
+    nq = tq // block_q
+    nk = tk // block_k
+
+    kernel = functools.partial(_flash_kernel, scale=scale, nk=nk,
+                               block_q=block_q, block_k=block_k,
+                               causal=causal)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),    # lens [bh, 2], whole
+            pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(lens2, q3, k3, v3)
+
+
+def _lens_mask(q_lens, kv_lens, tq, tk, causal):
+    """[b, Tq, Tk] bool mask equivalent to the in-kernel computation."""
+    rows = jnp.arange(tq, dtype=jnp.int32)
+    cols = jnp.arange(tk, dtype=jnp.int32)
+    m = (rows[None, :, None] < q_lens[:, None, None]) & \
+        (cols[None, None, :] < kv_lens[:, None, None])
+    if causal:
+        m = m & (cols[None, None, :] <= rows[None, :, None])
+    return m
+
+
+def _reference(q, k, v, mask, scale):
+    """XLA attention — also the custom_vjp backward (see module docstring)."""
+    prec = jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else None
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32,
+                        precision=prec) * scale
+    if mask is not None:
+        logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    if mask is not None:
+        # fully-masked rows: softmax over all -inf is uniform; zero them
+        any_valid = jnp.any(mask, axis=-1)[:, None, :, None]
+        w = jnp.where(any_valid, w, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32,
+                      precision=prec).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, q_lens, kv_lens, causal, scale, block_q, block_k,
+           interpret):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    lens2 = jnp.stack([q_lens, kv_lens], axis=1).astype(jnp.int32)  # [b, 2]
+    lens2 = jnp.repeat(lens2, h, axis=0)                            # [bh, 2]
+    out = _flash_call(q3, k3, v3, lens2, scale=scale, block_q=block_q,
+                      block_k=block_k, causal=causal, interpret=interpret)
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, q_lens, kv_lens, causal, scale, block_q, block_k,
+               interpret):
+    out = _flash(q, k, v, q_lens, kv_lens, causal, scale, block_q, block_k,
+                 interpret)
+    return out, (q, k, v, q_lens, kv_lens)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, ct):
+    q, k, v, q_lens, kv_lens = res
+    mask = _lens_mask(q_lens, kv_lens, q.shape[1], k.shape[1], causal)
+    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, mask, scale),
+                     q, k, v)
+    dq, dk, dv = vjp(ct)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    q_lens: Optional[jnp.ndarray] = None,
+                    kv_lens: Optional[jnp.ndarray] = None,
+                    causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 512, block_k: int = 512,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Flash attention with ragged-length + causal masking in-kernel.
+
+    q: [b, Tq, h, d]; k, v: [b, Tk, h, d]; q_lens / kv_lens: [b] int
+    valid lengths (None = full). Returns [b, Tq, h, d]; q rows at/past
+    q_lens are zero. Inputs are padded to block multiples internally.
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    if q_lens is None:
+        q_lens = jnp.full((b,), tq, jnp.int32)
+    if kv_lens is None:
+        kv_lens = jnp.full((b,), tk, jnp.int32)
+    block_q = min(block_q, max(tq, 8))
+    block_k = min(block_k, max(tk, 8))
+    pad_q = (-tq) % block_q
+    pad_k = (-tk) % block_k
+    if pad_q or pad_k:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+    out = _flash(q, k, v, q_lens, kv_lens, causal, scale, block_q, block_k,
+                 interpret)
+    if pad_q:
+        out = out[:, :tq]
+    return out
+
+
+def flash_supported(q: jnp.ndarray, k: jnp.ndarray) -> bool:
+    """Shape gate: MXU-friendly head dim and a sequence long enough that
+    streaming K/V beats one fused XLA softmax."""
+    d = q.shape[-1]
+    return d % 8 == 0 and q.shape[1] >= 8 and k.shape[1] >= 8

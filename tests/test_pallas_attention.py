@@ -1,0 +1,122 @@
+"""Flash attention kernel (ops/pallas_attention.py) — parity against the
+XLA reference in interpret mode, exactly as test_pallas_rnn.py pins the
+fused RNN kernels. Covers ragged kv lengths, q lengths, causal masking,
+block padding, gradients (custom_vjp), and the attention-layer dispatch
+gate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas_attention import (_lens_mask, _reference,
+                                             flash_attention,
+                                             flash_supported)
+
+
+def make_qkv(rng, b=2, tq=24, tk=40, h=2, d=16, dtype=jnp.float32):
+    q = jnp.asarray(rng.randn(b, tq, h, d)).astype(dtype)
+    k = jnp.asarray(rng.randn(b, tk, h, d)).astype(dtype)
+    v = jnp.asarray(rng.randn(b, tk, h, d)).astype(dtype)
+    return q, k, v
+
+
+def ref(q, k, v, q_lens=None, kv_lens=None, causal=False):
+    b, tq = q.shape[0], q.shape[1]
+    tk = k.shape[1]
+    ql = q_lens if q_lens is not None else jnp.full((b,), tq, jnp.int32)
+    kl = kv_lens if kv_lens is not None else jnp.full((b,), tk, jnp.int32)
+    return _reference(q, k, v, _lens_mask(ql, kl, tq, tk, causal),
+                      q.shape[-1] ** -0.5)
+
+
+class TestFlashParity:
+    def test_full_attention(self, rng):
+        q, k, v = make_qkv(rng)
+        out = flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref(q, k, v)),
+                                   atol=2e-5)
+
+    def test_ragged_kv_lengths(self, rng):
+        q, k, v = make_qkv(rng)
+        kl = jnp.asarray([17, 40], jnp.int32)
+        out = flash_attention(q, k, v, kv_lens=kl, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref(q, k, v, kv_lens=kl)), atol=2e-5)
+
+    def test_q_lengths_zero_invalid_rows(self, rng):
+        q, k, v = make_qkv(rng)
+        ql = jnp.asarray([10, 24], jnp.int32)
+        out = flash_attention(q, k, v, q_lens=ql, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref(q, k, v, q_lens=ql)), atol=2e-5)
+        assert np.all(np.asarray(out)[0, 10:] == 0.0)
+
+    def test_causal(self, rng):
+        q, k, v = make_qkv(rng, tq=32, tk=32)
+        kl = jnp.asarray([32, 20], jnp.int32)
+        out = flash_attention(q, k, v, kv_lens=kl, causal=True,
+                              interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(ref(q, k, v, kv_lens=kl, causal=True)), atol=2e-5)
+
+    def test_multi_block_online_softmax(self, rng):
+        # forces several K blocks + padding (block 16 on T=70/90)
+        q, k, v = make_qkv(rng, tq=70, tk=90)
+        kl = jnp.asarray([90, 33], jnp.int32)
+        out = flash_attention(q, k, v, kv_lens=kl, block_q=16, block_k=16,
+                              interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref(q, k, v, kv_lens=kl)), atol=2e-5)
+
+    def test_fully_masked_row_returns_zero(self, rng):
+        q, k, v = make_qkv(rng)
+        kl = jnp.asarray([0, 5], jnp.int32)
+        out = flash_attention(q, k, v, kv_lens=kl, interpret=True)
+        assert np.all(np.asarray(out)[0] == 0.0)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_gradients_match_reference(self, rng):
+        q, k, v = make_qkv(rng, tq=16, tk=24)
+        kl = jnp.asarray([24, 12], jnp.int32)
+
+        def f_flash(q_, k_, v_):
+            return flash_attention(q_, k_, v_, kv_lens=kl, causal=True,
+                                   interpret=True).sum()
+
+        def f_ref(q_, k_, v_):
+            return ref(q_, k_, v_, kv_lens=kl, causal=True).sum()
+
+        gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
+    def test_supported_gate(self, rng):
+        q, k, _ = make_qkv(rng)
+        assert flash_supported(q, k)
+        q3 = jnp.zeros((2, 24, 2, 15))      # d % 8 != 0
+        assert not flash_supported(q3, k)
+
+
+class TestLayerDispatch:
+    def test_layer_uses_reference_on_cpu_and_flash_flag(self, rng):
+        """On the CPU test backend the layer must take the XLA path; the
+        flash gate is TPU-only. Semantics are identical either way."""
+        from paddle_tpu.core.sequence import pack_sequences
+        from paddle_tpu.core.topology import Topology
+        s_rows = [rng.randn(5, 8).astype(np.float32),
+                  rng.randn(7, 8).astype(np.float32)]
+        s = paddle.layer.data("s", paddle.data_type.dense_vector_sequence(8))
+        att = paddle.layer.dot_product_attention(s, num_heads=2)
+        topo = Topology(att)
+        params = topo.init_params(jax.random.PRNGKey(0))
+        outs, _ = topo.forward(params, topo.init_state(),
+                               {"s": pack_sequences(s_rows)}, mode="test",
+                               rng=jax.random.PRNGKey(1))
+        out = outs[att.name]
+        assert np.all(np.isfinite(np.asarray(out.data)))
+        assert paddle.config.global_config().use_flash_attention
