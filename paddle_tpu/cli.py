@@ -64,7 +64,7 @@ def _build_trainer(ns: Dict[str, Any], init_model_path: Optional[str]):
                       evaluators=ns.get("evaluators"))
 
 
-def _synthetic_batch(trainer, batch_size: int):
+def _synthetic_batch(trainer, batch_size: int, seq_len: int = 16):
     """One synthetic batch matching the topology's data contract (the
     --job=time mode needs shapes, not data)."""
     from paddle_tpu.core.data_type import SeqType
@@ -74,7 +74,7 @@ def _synthetic_batch(trainer, batch_size: int):
         row = []
         for _, t in trainer.topology.data_type():
             if t.seq_type != SeqType.NO_SEQUENCE:
-                n = 16
+                n = seq_len
                 if t.kind == "integer":
                     row.append([int(v) for v in rng.randint(0, t.dim, n)])
                 else:
@@ -88,9 +88,10 @@ def _synthetic_batch(trainer, batch_size: int):
     return samples
 
 
-def _job_time(trainer, batch_size: int, iters: int) -> int:
+def _job_time(trainer, batch_size: int, iters: int,
+              seq_len: int = 16) -> int:
     """TrainerBenchmark.cpp parity: timed train steps, update included."""
-    batch = _synthetic_batch(trainer, batch_size)
+    batch = _synthetic_batch(trainer, batch_size, seq_len)
 
     def reader():
         while True:
@@ -112,6 +113,7 @@ def _job_time(trainer, batch_size: int, iters: int) -> int:
     ms = 1000.0 * float(np.mean(steady))
     print(json.dumps({"metric": "train_ms_per_batch", "value": round(ms, 3),
                       "unit": "ms/batch", "batch_size": batch_size,
+                      "seq_len": seq_len,
                       "iters": len(steady)}))
     return 0
 
@@ -164,6 +166,9 @@ def main(argv=None) -> int:
     tr.add_argument("--num_passes", type=int, default=None)
     tr.add_argument("--batch_size", type=int, default=128,
                     help="--job=time synthetic batch size")
+    tr.add_argument("--seq_len", type=int, default=16,
+                    help="synthetic sequence length for --job=time "
+                         "(benchmark/README.md uses 100 for IMDB LSTM)")
     tr.add_argument("--iters", type=int, default=20,
                     help="--job=time timed steps")
     tr.add_argument("--save_dir", default=None)
@@ -182,7 +187,8 @@ def main(argv=None) -> int:
     ns = _load_config(args.config)
     trainer = _build_trainer(ns, args.init_model_path)
     if args.job == "time":
-        return _job_time(trainer, args.batch_size, args.iters)
+        return _job_time(trainer, args.batch_size, args.iters,
+                         args.seq_len)
     if args.job == "test":
         return _job_test(trainer, ns)
     return _job_train(trainer, ns, args)

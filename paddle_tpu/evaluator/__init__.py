@@ -552,18 +552,24 @@ class DetectionMAPEvaluator(Evaluator):
     input: a detection_output layer — rows of
     (image_id, label, score, xmin, ymin, xmax, ymax), [b, K*7].
     label: ground-truth SequenceBatch rows (label, xmin, ymin, xmax, ymax,
-    difficult). AP per class via the VOC integral method; result is the
-    mean over classes with at least one gt box.
+    difficult). AP per class via `ap_type`: '11point' (VOC 11-point
+    interpolation, the reference default) or 'integral' (area under the
+    raw precision-recall curve) — DetectionMAPEvaluator's ap_type option.
+    Result is the mean over classes with at least one gt box.
     """
 
     def __init__(self, input: LayerOutput, label: LayerOutput,
                  overlap_threshold: float = 0.5, background_id: int = 0,
-                 evaluate_difficult: bool = False, name: str = "detection_map"):
+                 evaluate_difficult: bool = False, ap_type: str = "11point",
+                 name: str = "detection_map"):
+        ap_type = ap_type.lower()   # reference spells it 'Integral'
+        assert ap_type in ("11point", "integral"), ap_type
         self.name = name
         self.inputs = [input, label]
         self.overlap_threshold = overlap_threshold
         self.background_id = background_id
         self.evaluate_difficult = evaluate_difficult
+        self.ap_type = ap_type
         self.start()
 
     def start(self):
@@ -644,10 +650,16 @@ class DetectionMAPEvaluator(Evaluator):
             recall = tp / n_pos
             precision = tp / np.maximum(tp + fp, 1e-12)
             ap = 0.0
-            for t in np.arange(0.0, 1.01, 0.1):   # VOC 11-point
-                p = precision[recall >= t].max() if np.any(recall >= t) \
-                    else 0.0
-                ap += p / 11.0
+            if self.ap_type == "11point":
+                for t in np.arange(0.0, 1.01, 0.1):
+                    p = precision[recall >= t].max() if np.any(recall >= t) \
+                        else 0.0
+                    ap += p / 11.0
+            else:                                 # integral: sum p * dR
+                prev_r = 0.0
+                for p, r in zip(precision, recall):
+                    ap += p * (r - prev_r)
+                    prev_r = r
             aps.append(min(ap, 1.0))
         return {self.name: float(np.mean(aps)) if aps else 0.0}
 

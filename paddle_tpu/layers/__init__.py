@@ -30,6 +30,9 @@ from paddle_tpu.layers import crf_layers as _crf       # noqa: F401
 from paddle_tpu.layers import attention_layers as _attn  # noqa: F401
 from paddle_tpu.layers import misc_layers as _misc     # noqa: F401
 from paddle_tpu.layers import detection_layers as _det  # noqa: F401
+from paddle_tpu.layers import extra_layers as _extra   # noqa: F401
+from paddle_tpu.layers.beam import (BeamInput,
+                                    cross_entropy_over_beam)  # noqa: F401
 from paddle_tpu.layers.attention_layers import (dot_product_attention,
                                                 multi_head_attention)
 
@@ -540,7 +543,7 @@ def classification_error(input, label, name=None, **kw) -> LayerOutput:
 
 
 # crf / ctc re-exported from crf_layers
-from paddle_tpu.layers.crf_layers import (crf, crf_decoding, ctc,
+from paddle_tpu.layers.crf_layers import (crf, crf_decoding, crf_error, ctc,
                                           warp_ctc)  # noqa: E402,F401
 
 
@@ -729,3 +732,61 @@ def detection_output(input_loc, input_conf, priorbox, num_classes: int,
                       keep_top_k=keep_top_k,
                       confidence_threshold=confidence_threshold,
                       background_id=background_id)
+
+
+# ---------------------------------------------------------------------------
+# bilinear / addressing / normalization extras
+# (reference layers.py tensor_layer:4714, conv_shift_layer:4659,
+#  linear_comb_layer:4604, prelu_layer:6262, row_l2_norm_layer:2889,
+#  switch_order_layer:6445)
+
+
+def tensor(a, b, size: int, act=None, name=None, param_attr=None,
+           bias_attr=None, **kw) -> LayerOutput:
+    return make_layer("tensor", name, [a, b], size=size,
+                      act=act_mod.to_name(act), param_attr=param_attr,
+                      bias_attr=bias_attr)
+
+
+tensor_layer = tensor
+
+
+def conv_shift(a, b, name=None, **kw) -> LayerOutput:
+    return make_layer("conv_shift", name, [a, b])
+
+
+conv_shift_layer = conv_shift
+
+
+def linear_comb(weights, vectors, size: int = None, name=None,
+                **kw) -> LayerOutput:
+    return make_layer("convex_comb", name, [weights, vectors], size=size)
+
+
+linear_comb_layer = linear_comb
+convex_comb_layer = linear_comb
+
+
+def prelu(input, partial_sum: int = 1, name=None, param_attr=None,
+          **kw) -> LayerOutput:
+    return make_layer("prelu", name, [input], partial_sum=partial_sum,
+                      param_attr=param_attr)
+
+
+prelu_layer = prelu
+
+
+def row_l2_norm(input, name=None, **kw) -> LayerOutput:
+    return make_layer("row_l2_norm", name, [input])
+
+
+row_l2_norm_layer = row_l2_norm
+
+
+def switch_order(input, reshape_axis=None, height=None, width=None,
+                 name=None, **kw) -> LayerOutput:
+    return make_layer("switch_order", name, [input], height=height,
+                      width=width)
+
+
+switch_order_layer = switch_order

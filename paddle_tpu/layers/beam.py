@@ -269,3 +269,173 @@ class BeamSearchLayer:
         top_lens = jnp.where(has_eos, first_eos + 1, L).astype(jnp.int32)
         return BeamResult(top_seqs[:, 0, :], top_lens[:, 0],
                           top_seqs, top_lens, top_scores)
+
+
+# ---------------------------------------------------------------------------
+# cross_entropy_over_beam — learning-to-search cost
+# (CrossEntropyOverBeam.cpp:193, .h BeamExpansion/CostForOneSequence;
+#  DSL cross_entropy_over_beam + BeamInput, layers.py:5961-5985)
+
+
+def _segment_starts(seg_ids: jnp.ndarray, n_rows: int) -> jnp.ndarray:
+    """First position of each segment id in a [S] segment-id vector."""
+    eq = seg_ids[None, :] == jnp.arange(n_rows, dtype=jnp.int32)[:, None]
+    return jnp.argmax(eq, axis=1).astype(jnp.int32)
+
+
+def _beam_cost_one_sequence(scores, starts, ids, gold):
+    """The reference's CostForOneSequence as static-shape JAX.
+
+    scores[e]: [S_e] flat candidate scores of expansion e
+    starts[e]: [R_e]  start offset of each beam row inside scores[e]
+    ids[e]:    [R_e, K_e] selected candidate ids per row, -1 padded
+    gold[e]:   scalar int — gold candidate id within the gold row
+
+    Follows CrossEntropyOverBeam.cpp: track the gold row through the
+    expansions (calValidExpandStep), reconstruct every surviving path at
+    the last valid expansion and walk parents backward
+    (constructTotalExpansion), then softmax over all path scores with the
+    gold appended as an extra path when it fell off the beam
+    (globallyNormalizedScore).
+    """
+    E = len(ids)
+
+    # --- calValidExpandStep: gold row/col per expansion -------------------
+    gold_rows, gold_cols = [], []
+    grow = jnp.int32(0)
+    for e in range(E):
+        ide = ids[e]
+        K = ide.shape[1]
+        row_ids = jnp.take(ide, jnp.clip(grow, 0, ide.shape[0] - 1), axis=0)
+        hit = row_ids == gold[e]
+        col = jnp.where(jnp.any(hit), jnp.argmax(hit), -1).astype(jnp.int32)
+        gold_rows.append(grow)
+        gold_cols.append(col)
+        if e + 1 < E:
+            # next expansion's gold row = # of selected (non -1) candidates
+            # before the gold's flat slot in this expansion
+            off = grow * K + jnp.maximum(col, 0)
+            flat = ide.reshape(-1)
+            before = jnp.arange(flat.shape[0]) < off
+            grow = jnp.sum((flat != -1) & before).astype(jnp.int32)
+
+    found = jnp.stack([c != -1 for c in gold_cols])            # [E]
+    fell = jnp.argmax(~found).astype(jnp.int32)                # first miss
+    last = jnp.where(jnp.any(~found), fell, E - 1)             # valid-1
+
+    def branch(l):
+        """Total-expansion softmax assuming expansion `l` is the last."""
+        ide = ids[l]
+        R, K = ide.shape
+        flat = ide.reshape(-1)                                 # [R*K]
+        valid = flat != -1
+        cnt = jnp.cumsum(valid) - valid.astype(jnp.int32)      # exclusive
+        n_paths = jnp.sum(valid).astype(jnp.int32)
+        P = R * K + 1                                          # + gold slot
+
+        # path slot p <- flat candidate position (scatter by compact rank)
+        slot_of = jnp.where(valid, cnt, P)                     # drop invalid
+        path_flat = jnp.full((P,), 0, jnp.int32).at[slot_of].set(
+            jnp.arange(R * K, dtype=jnp.int32), mode="drop")
+        parent = path_flat // K                                # row in exp l
+        row_id = jnp.take(flat, path_flat) + jnp.take(starts[l], parent)
+
+        extra = gold_cols[l] == -1
+        gold_slot = jnp.where(extra, n_paths,
+                              jnp.take(cnt, gold_rows[l] * K +
+                                       jnp.maximum(gold_cols[l], 0)))
+        slots = jnp.arange(P, dtype=jnp.int32)
+        is_gold_extra = extra & (slots == gold_slot)
+        row_id = jnp.where(
+            is_gold_extra,
+            gold[l] + jnp.take(starts[l], gold_rows[l]), row_id)
+        parent = jnp.where(is_gold_extra, gold_rows[l], parent)
+
+        Sl = scores[l].shape[0]
+        total = jnp.take(scores[l], jnp.clip(row_id, 0, Sl - 1))
+
+        # walk parents back through earlier expansions
+        for b in range(l - 1, -1, -1):
+            idb = ids[b].reshape(-1)
+            Kb = ids[b].shape[1]
+            # row r of expansion b+1 <-> flat candidate slot r of
+            # expansion b (the reference's parentIdsInBeam_ indexing)
+            pidx = jnp.clip(parent, 0, idb.shape[0] - 1)
+            cand = jnp.take(idb, pidx)
+            prow = pidx // Kb
+            rid = cand + jnp.take(starts[b], prow)
+            rid = jnp.where(is_gold_extra,
+                            gold[b] + jnp.take(starts[b], gold_rows[b]), rid)
+            parent = jnp.where(is_gold_extra, gold_rows[b], prow)
+            Sb = scores[b].shape[0]
+            total = total + jnp.take(scores[b], jnp.clip(rid, 0, Sb - 1))
+
+        live = slots < (n_paths + extra.astype(jnp.int32))
+        logits = jnp.where(live, total, _NEG)
+        return jax.nn.logsumexp(logits) - jnp.take(logits, gold_slot)
+
+    return lax.switch(last, [lambda l=l: branch(l) for l in range(E)])
+
+
+@register_layer("cross_entropy_over_beam")
+class CrossEntropyOverBeamLayer:
+    """Cross entropy over all candidate paths of a multi-step beam search
+    (CrossEntropyOverBeam.cpp:193). Inputs come in triples per expansion:
+    candidate scores (sequence or nested sequence of scalars), selected
+    candidate ids (kmax_seq_score output), and the gold id."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        assert len(input_metas) % 3 == 0, \
+            "cross_entropy_over_beam takes triples of inputs"
+        cfg["n_beams"] = len(input_metas) // 3
+        return LayerMeta(size=1), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        E = cfg["n_beams"]
+        scores, starts, ids, gold = [], [], [], []
+        b = None
+        for e in range(E):
+            sc, sel, gd = inputs[3 * e], inputs[3 * e + 1], inputs[3 * e + 2]
+            assert isinstance(sc, SequenceBatch), \
+                "candidate_scores must be a sequence"
+            b = sc.batch_size
+            s = sc.data.reshape(b, sc.max_len)
+            sel_d = sel.data if isinstance(sel, SequenceBatch) else sel
+            if sel_d.ndim == 2:
+                sel_d = sel_d[:, None, :]                       # [b, 1, K]
+            R = sel_d.shape[1]
+            if sc.is_nested:
+                st = jax.vmap(lambda g: _segment_starts(g, R))(sc.segment_ids)
+            else:
+                st = jnp.zeros((b, R), jnp.int32)
+            gd_d = gd.data if isinstance(gd, SequenceBatch) else gd
+            scores.append(s)
+            starts.append(st)
+            ids.append(sel_d.astype(jnp.int32))
+            gold.append(gd_d.reshape(b).astype(jnp.int32))
+
+        def one(args):
+            sc_r, st_r, id_r, gd_r = args
+            return _beam_cost_one_sequence(sc_r, st_r, id_r, gd_r)
+
+        return jax.vmap(one)((scores, starts, ids, gold))
+
+
+class BeamInput:
+    """One beam expansion triple for cross_entropy_over_beam
+    (layers.py:5961)."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+def cross_entropy_over_beam(input, name=None, **kw) -> LayerOutput:
+    beams = input if isinstance(input, (list, tuple)) else [input]
+    nodes = []
+    for bi in beams:
+        nodes += [bi.candidate_scores, bi.selected_candidates, bi.gold]
+    return make_layer("cross_entropy_over_beam", name, nodes)

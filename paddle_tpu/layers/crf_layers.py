@@ -4,8 +4,8 @@ Reference: gserver/layers/{CRFLayer, CRFDecodingLayer, LinearChainCRF.cpp}
 (forward-algorithm NLL + viterbi decode; parameter layout (n+2, n): row 0 =
 start scores a, row 1 = end scores b, rows 2.. = transition matrix w — see
 LinearChainCRF.h comments) and {CTCLayer, LinearChainCTC.cpp,
-WarpCTCLayer.cpp}. CTC uses optax.ctc_loss (the XLA-native equivalent of
-warp-ctc).
+WarpCTCLayer.cpp}. CTC uses the in-tree lattice forward algorithm
+(paddle_tpu/ops/ctc.py, LinearChainCTC.cpp parity).
 """
 
 from __future__ import annotations
@@ -151,6 +151,19 @@ class CRFDecodingLayer:
         return SequenceBatch(path, seq.lengths)
 
 
+@register_layer("crf_error")
+class CRFDecodingErrorLayer(CRFDecodingLayer):
+    """Alias registration for decoding-error output (Layer.h:30
+    REGISTER_LAYER(crf_error, CRFDecodingErrorLayer)): viterbi-decode and
+    emit the per-position 0/1 disagreement with the label, which is what
+    CRFDecodingLayer already does when given a label input."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        assert len(input_metas) == 2, "crf_error needs emissions + label"
+        return CRFDecodingLayer.build(name, cfg, input_metas)
+
+
 @register_layer("ctc")
 class CTCLayer:
     @staticmethod
@@ -159,14 +172,15 @@ class CTCLayer:
 
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
-        import optax
+        from paddle_tpu.ops.ctc import ctc_loss
         seq: SequenceBatch = inputs[0]       # [b, T, n] probs or logits
         labels: SequenceBatch = inputs[1]    # [b, U] int
         logits = seq.data
         # reference ctc_layer consumes SOFTMAX output (CTCLayer::forward
         # works on normalized probs), so the default converts probs ->
         # log-space; warp_ctc passes from_logits=True (raw activations,
-        # warp-ctc softmaxes internally — here optax does).
+        # warp-ctc softmaxes internally — here ctc_loss's internal
+        # log_softmax does, a no-op on already-normalized log-probs).
         if not cfg.get("from_logits", False):
             logits = jnp.log(jnp.maximum(logits, 1e-10))
         logit_pad = 1.0 - seq.mask()
@@ -177,12 +191,12 @@ class CTCLayer:
         # LinearChainCTC.cpp:86 pins blank = numClasses-1 (the LAST id) —
         # `ctc` therefore defaults to last; WarpCTCLayer.cpp:33 reads a
         # configurable blank from config (proto default 0) — `warp_ctc`
-        # passes blank=0 unless overridden. optax takes blank_id directly.
+        # passes blank=0 unless overridden.
         blank = cfg.get("blank")
         if blank is None:
             blank = logits.shape[-1] - 1
-        return optax.ctc_loss(logits, logit_pad, lab.astype(jnp.int32),
-                              lab_pad, blank_id=blank)
+        return ctc_loss(logits, logit_pad, lab.astype(jnp.int32),
+                        lab_pad, blank_id=blank)
 
 
 def crf(input, label, size=None, param_attr=None, name=None, **kw):
@@ -200,6 +214,11 @@ def crf_decoding(input, size=None, label=None, param_attr=None, name=None, **kw)
 
 
 crf_decoding_layer = crf_decoding
+
+
+def crf_error(input, label, size=None, param_attr=None, name=None, **kw):
+    return make_layer("crf_error", name, [input, label], size=size,
+                      param_attr=param_attr)
 
 
 def ctc(input, label, size=None, blank=None, name=None, **kw):

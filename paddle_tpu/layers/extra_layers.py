@@ -1,0 +1,185 @@
+"""The last round of reference-parity layers: bilinear tensor product,
+circular correlation, linear (convex) combination, parametric ReLU,
+row L2 normalization, and NCHW->NHWC order switching.
+
+Reference: paddle/gserver/layers/{TensorLayer.cpp:22, ConvShiftLayer.cpp:57,
+ConvexCombinationLayer.cpp:59, ParameterReluLayer.cpp:22,
+RowL2NormLayer.cpp:44, SwitchOrderLayer.cpp:20}; DSL wrappers
+trainer_config_helpers/layers.py (tensor_layer, conv_shift_layer,
+linear_comb_layer, prelu_layer, row_l2_norm_layer, switch_order_layer).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers
+from paddle_tpu.core.registry import (LayerMeta, ParamAttr, ParamSpec,
+                                      default_weight_init, register_layer)
+from paddle_tpu.layers.base import _apply_act, _map_seq, _payload
+from paddle_tpu.layers.conv_layers import ensure_nhwc
+
+
+@register_layer("tensor")
+class TensorLayer:
+    """Bilinear tensor product out[b, k] = e1[b] @ W_k @ e2[b]
+    (TensorLayer.cpp:22 — per-output-unit weight slabs of shape
+    [in1, in2]; here one [out, in1, in2] tensor contracted on the MXU
+    via einsum instead of the reference's per-slab mul loop)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        assert len(input_metas) == 2, "tensor layer takes exactly 2 inputs"
+        size = cfg["size"]
+        h, w = input_metas[0].size, input_metas[1].size
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        cfg["_w_name"] = wname
+        specs = [ParamSpec(wname, (size, h, w),
+                           default_weight_init(a, fan_in_axes=(1, 2)), a)]
+        if cfg.get("bias_attr") is not False:
+            battr = ParamAttr.of(None if cfg.get("bias_attr") in (True, None)
+                                 else cfg.get("bias_attr"))
+            bname = battr.name or f"_{name}.wbias"
+            specs.append(ParamSpec(bname, (size,), initializers.zeros, battr))
+            cfg["_bias_name"] = bname
+        return LayerMeta(size=size, seq_level=input_metas[0].seq_level), \
+            specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        w = params[cfg["_w_name"]]
+        e1, e2 = _payload(inputs[0]), _payload(inputs[1])
+        out = jnp.einsum("...i,kij,...j->...k", e1, w, e2)
+        if cfg.get("_bias_name"):
+            out = out + params[cfg["_bias_name"]]
+        out = _apply_act(out, cfg.get("act", "linear"))
+        ref = inputs[0]
+        return ref.with_data(out) if hasattr(ref, "with_data") else out
+
+
+@register_layer("conv_shift")
+class ConvShiftLayer:
+    """Circular correlation for NTM-style addressing
+    (ConvShiftLayer.cpp:57): c[i] = sum_j a[(i+j) mod M] * w[j], with j
+    running over the centered window of the (odd-sized) shift input."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        n = input_metas[1].size
+        assert n % 2 == 1, "conv_shift: shift input size must be odd"
+        cfg["_n"] = n
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=m.seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        n = cfg["_n"]
+        half = (n - 1) // 2
+        a = _payload(inputs[0])
+        w = _payload(inputs[1])
+        # a_{i+j} = roll(a, -j)[i]; the window j in [-half, half] maps to
+        # shift-input column j + half.  n is tiny (NTM window), so an
+        # unrolled sum of rolls fuses into one elementwise XLA kernel.
+        out = sum(jnp.roll(a, -j, axis=-1) * w[..., j + half:j + half + 1]
+                  for j in range(-half, half + 1))
+        ref = inputs[0]
+        return ref.with_data(out) if hasattr(ref, "with_data") else out
+
+
+@register_layer("convex_comb")
+class ConvexCombinationLayer:
+    """Weighted sum of dataDim-sized blocks of input 1 by input 0
+    (ConvexCombinationLayer.cpp:59; DSL linear_comb_layer):
+    out[b, j] = sum_i w[b, i] * v[b, i * dataDim + j]."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        wdim = input_metas[0].size
+        vdim = input_metas[1].size
+        size = cfg.get("size") or vdim // wdim
+        assert wdim * size == vdim, (
+            f"convex_comb: weight dim {wdim} * data dim {size} != {vdim}")
+        cfg["_wdim"], cfg["_ddim"] = wdim, size
+        return LayerMeta(size=size, seq_level=input_metas[0].seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        m, d = cfg["_wdim"], cfg["_ddim"]
+        w = _payload(inputs[0])
+        v = _payload(inputs[1])
+        out = jnp.einsum("...m,...md->...d", w, v.reshape(v.shape[:-1] + (m, d)))
+        ref = inputs[0]
+        return ref.with_data(out) if hasattr(ref, "with_data") else out
+
+
+@register_layer("prelu")
+class ParameterReluLayer:
+    """y = x > 0 ? x : w * x with a learned slope per group of partial_sum
+    consecutive channels (ParameterReluLayer.cpp:22, .h:45 partial_sum:
+    1 = per-element, channel size = per-channel, input size = one shared
+    slope)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        ps = cfg.get("partial_sum", 1)
+        assert ps > 0 and m.size % ps == 0, (
+            f"prelu: partial_sum {ps} must divide input size {m.size}")
+        a = ParamAttr.of(cfg.get("param_attr"))
+        wname = a.name or f"_{name}.w0"
+        cfg["_w_name"], cfg["_ps"] = wname, ps
+        specs = [ParamSpec(wname, (m.size // ps,),
+                           a.initializer or initializers.constant(0.25), a)]
+        return LayerMeta(size=m.size, seq_level=m.seq_level, height=m.height,
+                         width=m.width, channels=m.channels), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        w = jnp.repeat(params[cfg["_w_name"]], cfg["_ps"])
+
+        def act(x):
+            return jnp.where(x > 0, x, w.reshape((1,) * (x.ndim - 1) + (-1,))
+                             * x)
+
+        return _map_seq(act, inputs[0])
+
+
+@register_layer("row_l2_norm")
+class RowL2NormLayer:
+    """out = in / ||in||_2 per row (RowL2NormLayer.cpp:44)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        return LayerMeta(size=m.size, seq_level=m.seq_level), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        def norm(x):
+            return x / jnp.sqrt(jnp.sum(jnp.square(x), axis=-1,
+                                        keepdims=True))
+
+        return _map_seq(norm, inputs[0])
+
+
+@register_layer("switch_order")
+class SwitchOrderLayer:
+    """Switch a flattened NCHW feature map to NHWC order
+    (SwitchOrderLayer.cpp:20; the reference's reshape_conf height/width
+    axes only regroup the flat output, which downstream fc layers ignore).
+    """
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        h = cfg.get("height") or m.height
+        w = cfg.get("width") or m.width
+        c = m.channels or (m.size // max(h * w, 1))
+        cfg["_ic"], cfg["_ih"], cfg["_iw"] = c, h, w
+        return LayerMeta(size=m.size, height=h, width=w, channels=c), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
+        return x.reshape(x.shape[0], -1)

@@ -187,16 +187,34 @@ class KmaxSeqScoreLayer:
     """Top-k positions of per-step scores within each sequence
     (KmaxSeqScoreLayer.cpp; DSL kmax_seq_score_layer:6667). Output [b, k]
     int32 position ids, -1 padded past the sequence length — feeds
-    sub_nested_seq selection in beam decoding stacks."""
+    sub_nested_seq selection in beam decoding stacks. On a nested input
+    the reference emits one row of top-k ids PER SUBSEQUENCE, relative to
+    the subsequence start (CrossEntropyOverBeam adds the start back as
+    basePos) — here that is a [b, R, k] SequenceBatch over subsequences."""
     @staticmethod
     def build(name, cfg, input_metas):
-        return LayerMeta(size=cfg.get("beam_size", 1), is_integer=True), [], []
+        lvl = 1 if input_metas[0].seq_level == 2 else 0
+        return LayerMeta(size=cfg.get("beam_size", 1), seq_level=lvl,
+                         is_integer=True), [], []
 
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
         seq: SequenceBatch = inputs[0]
         k = cfg.get("beam_size", 1)
         scores = seq.data.reshape(seq.batch_size, seq.max_len)
+        if seq.is_nested:
+            T = seq.max_len
+            rows = jnp.arange(T, dtype=jnp.int32)
+            eq = seq.segment_ids[:, None, :] == rows[None, :, None]  # [b,R,T]
+            per_row = jnp.where(eq, scores[:, None, :], -jnp.inf)
+            vals, idx = jax.lax.top_k(per_row, min(k, T))      # [b, R, k]
+            start = jnp.argmax(eq, axis=2).astype(jnp.int32)   # [b, R]
+            rel = idx.astype(jnp.int32) - start[..., None]
+            rel = jnp.where(jnp.isfinite(vals), rel, -1)
+            if rel.shape[2] < k:
+                rel = jnp.pad(rel, ((0, 0), (0, 0), (0, k - rel.shape[2])),
+                              constant_values=-1)
+            return SequenceBatch(rel, seq.num_segments)
         scores = jnp.where(seq.bool_mask(), scores, -jnp.inf)
         vals, idx = jax.lax.top_k(scores, min(k, scores.shape[1]))
         idx = jnp.where(jnp.isfinite(vals), idx, -1).astype(jnp.int32)

@@ -31,9 +31,10 @@ def prior_boxes(layer_h: int, layer_w: int, image_h: int, image_w: int,
     matching the reference's interleaved box/variance layout
     (PriorBox.cpp:49-67: 4 coords then 4 variances per prior).
 
-    Prior order per cell mirrors the reference loop: one box per min_size
-    (aspect 1), then one sqrt(min*max) box per max_size, then one box per
-    flipped aspect ratio (r and 1/r) at the last min_size.
+    Prior order per cell mirrors the reference loop exactly
+    (PriorBox.cpp:103-130): for EACH min_size, the aspect-1 box followed
+    immediately by its sqrt(min*max) boxes (one per max_size), then one
+    box per flipped aspect ratio (r and 1/r) at the last min_size.
     """
     assert len(variance) == 4
     step_w = image_w / layer_w
@@ -43,7 +44,6 @@ def prior_boxes(layer_h: int, layer_w: int, image_h: int, image_w: int,
     shapes = []
     for s in min_sizes:
         shapes.append((s, s))
-    for s in min_sizes:
         for m in max_sizes:
             d = math.sqrt(s * m)
             shapes.append((d, d))

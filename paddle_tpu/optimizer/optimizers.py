@@ -110,22 +110,36 @@ class Optimizer:
                 state["slots"][k]["_t"] = jnp.zeros((v.shape[0],), jnp.int32)
         # StaticPruningHook (ParameterUpdaterHook.cpp:33-140): a one-shot
         # mask keeping the largest-|w| (1 - sparsity_ratio) fraction of the
-        # initial weights, applied after every update
+        # weights AS SEEN HERE, applied after every update. Like the
+        # reference (which masks at init() after the load), the mask must
+        # derive from the weights you intend to train: load checkpoints
+        # into Parameters BEFORE constructing the trainer, or call
+        # SGD.refresh_update_hooks() after a late load.
+        for k in params:
+            if self._pruning_hook(k) is not None and \
+                    k in getattr(self, "sparse_params", ()):
+                raise ValueError(
+                    f"param {k!r}: pruning hook + sparse_update is "
+                    "unsupported — the row-sparse path would skip the "
+                    "mask; use a dense table or drop the hook")
+        self.refresh_hooks(params, state)
+        if self.model_average is not None:
+            state["avg"] = {k: v for k, v in params.items()}
+        return state
+
+    def refresh_hooks(self, params, state):
+        """Recompute pruning masks from the CURRENT parameter values — for
+        weights loaded after the optimizer state was created (the
+        reference hook masks the loaded value because init() runs post-
+        load; see StaticPruningHook ordering note in init_state)."""
         for k, v in params.items():
             hook = self._pruning_hook(k)
             if hook is not None:
-                if k in getattr(self, "sparse_params", ()):
-                    raise ValueError(
-                        f"param {k!r}: pruning hook + sparse_update is "
-                        "unsupported — the row-sparse path would skip the "
-                        "mask; use a dense table or drop the hook")
                 ratio = getattr(hook, "sparsity_ratio", 0.5)
                 kth = jnp.quantile(jnp.abs(v).astype(jnp.float32).ravel(),
                                    ratio)
                 state["slots"][k]["_mask"] = (
                     jnp.abs(v) >= kth).astype(v.dtype)
-        if self.model_average is not None:
-            state["avg"] = {k: v for k, v in params.items()}
         return state
 
     def _pruning_hook(self, k):

@@ -54,11 +54,25 @@ def init_distributed(coordinator_address: Optional[str] = None,
                 num_processes=num_processes,
                 process_id=process_id,
                 local_device_ids=local_device_ids, **kw)
-        except Exception:
-            # explicit cluster args must not fail silently; the bare
-            # auto-detect call may (standalone single-process run)
+        except ValueError:
+            # ValueError is jax's arg-validation signal ("coordinator_
+            # address should be defined") — i.e. auto-detect found NO
+            # cluster environment. Only that case may fall back to a
+            # standalone single-process run, and only when the caller
+            # passed no explicit cluster args.
             if coordinator_address or num_processes:
                 raise
+        except RuntimeError as e:
+            # "must be called before any JAX calls" = the backend is
+            # already warm in a standalone process; same no-cluster
+            # fallback, but an explicit cluster request must still fail
+            if coordinator_address or num_processes or \
+                    "before" not in str(e):
+                raise
+        # anything else (RuntimeError, grpc connect/timeout failures) is a
+        # REAL cluster error: a scheduler environment was detected but the
+        # coordinator is unreachable. Re-raise rather than silently train
+        # this process on 1/N of the data.
     g = config_mod.global_config()
     g.process_index = jax.process_index()
     g.process_count = jax.process_count()

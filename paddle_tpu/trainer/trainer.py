@@ -85,6 +85,8 @@ class SGD:
         self.opt_state = self.optimizer.init_state(parameters.raw)
         self._rng = jax.random.PRNGKey(global_config().seed)
         self._step_count = 0
+        if mesh is None:
+            mesh = self._default_mesh()
         self.mesh = mesh
         # explicit stage map for pipeline parallelism over the mesh `pp`
         # axis (ParallelNeuralNetwork deviceId-pinning parity):
@@ -94,6 +96,38 @@ class SGD:
         self._test_step = self._build_test_step()
 
     # ------------------------------------------------------------------
+    def refresh_update_hooks(self):
+        """Recompute parameter-hook state (pruning masks) from the current
+        parameter values — call after loading weights into an
+        already-constructed trainer (ParameterUpdaterHook init-after-load
+        parity)."""
+        self.opt_state = self.optimizer.refresh_hooks(
+            self.parameters.raw, self.opt_state)
+
+    @staticmethod
+    def _default_mesh():
+        """trainer_count > 1 without an explicit mesh = transparent data
+        parallelism, the v2 contract where trainer_count>1 selected
+        MultiGradientMachine (GradientMachine.cpp:29). trainer_count=0
+        means "all local devices" (Flags.cpp:23 semantics)."""
+        import warnings
+        tc = global_config().trainer_count
+        if tc <= 1:
+            return None
+        n_dev = len(jax.devices())
+        if n_dev < 2:
+            warnings.warn(
+                f"trainer_count={tc} requested but only {n_dev} device "
+                "is visible; training single-device", stacklevel=3)
+            return None
+        if tc > n_dev:
+            warnings.warn(
+                f"trainer_count={tc} > {n_dev} visible devices; using "
+                f"dp={n_dev}", stacklevel=3)
+            tc = n_dev
+        from paddle_tpu.parallel.mesh import data_parallel_mesh
+        return data_parallel_mesh(tc)
+
     def _loss_and_metrics(self, params, state, feed, rng, n_real, mode,
                           sparse_sub=None, injected=None, skip=()):
         outs, new_state = self.topology.forward(

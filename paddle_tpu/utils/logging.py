@@ -12,7 +12,7 @@ from __future__ import annotations
 import logging
 import sys
 
-VERSION = "0.2.0"               # round-2 framework version stamp
+VERSION = "0.3.0"               # round-3 framework version stamp
 ISA_TARGET = "tpu-xla"          # the reference stamped WITH_GPU/avx flags
 
 _FMT = "[%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s"

@@ -1,0 +1,130 @@
+"""CTC loss goldens: brute-force alignment enumeration, hand-checked
+lattices, and parity with optax's independent implementation.
+
+Reference: LinearChainCTC.cpp:86-200 (the lattice this reimplements) and
+test_CTCLayer.cpp (the reference checks its CTC against alternate
+implementations the same way).
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.ctc import ctc_loss
+
+
+def collapse(path, blank):
+    """B(pi): merge repeats then strip blanks."""
+    out = []
+    prev = None
+    for s in path:
+        if s != prev:
+            if s != blank:
+                out.append(s)
+            prev = s
+    return tuple(out)
+
+
+def brute_force_nll(logits, label, blank):
+    """-log sum over all alignments that collapse to `label`."""
+    T, C = logits.shape
+    p = np.exp(logits - np.log(np.exp(logits).sum(-1, keepdims=True)))
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path, blank) == tuple(label):
+            total += np.prod([p[t, path[t]] for t in range(T)])
+    return -np.log(total)
+
+
+def run_ctc(logits_rows, labels_rows, blank=0):
+    """Pad ragged per-sample logits/labels into the batched call."""
+    b = len(logits_rows)
+    T = max(r.shape[0] for r in logits_rows)
+    C = logits_rows[0].shape[1]
+    U = max((len(l) for l in labels_rows), default=1) or 1
+    logits = np.zeros((b, T, C), np.float32)
+    lpad = np.ones((b, T), np.float32)
+    labels = np.zeros((b, U), np.int32)
+    labpad = np.ones((b, U), np.float32)
+    for i, (lr, lab) in enumerate(zip(logits_rows, labels_rows)):
+        logits[i, :lr.shape[0]] = lr
+        lpad[i, :lr.shape[0]] = 0.0
+        labels[i, :len(lab)] = lab
+        labpad[i, :len(lab)] = 0.0
+    return np.asarray(ctc_loss(jnp.asarray(logits), jnp.asarray(lpad),
+                               jnp.asarray(labels), jnp.asarray(labpad),
+                               blank_id=blank))
+
+
+class TestBruteForceGoldens:
+    @pytest.mark.parametrize("T,C,label,blank", [
+        (2, 2, [1], 0),
+        (3, 3, [1, 2], 0),
+        (4, 3, [1, 1], 0),          # repeated label needs a blank between
+        (4, 3, [2], 2 - 1),         # nonzero blank id
+        (3, 3, [0, 1], 2),          # blank = last class (ctc default)
+        (5, 2, [1, 1, 1], 0),       # tight fit: single feasible alignment
+    ])
+    def test_matches_alignment_enumeration(self, T, C, label, blank):
+        rng = np.random.RandomState(hash((T, C, blank)) % 2**31)
+        logits = rng.randn(T, C).astype(np.float32)
+        want = brute_force_nll(logits, label, blank)
+        got = run_ctc([logits], [label], blank)[0]
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_impossible_label_is_inf(self):
+        # T=1 cannot emit two labels
+        logits = np.zeros((1, 3), np.float32)
+        got = run_ctc([logits], [[1, 2]], 0)[0]
+        assert got > 1e10
+
+    def test_hand_computed_uniform_lattice(self):
+        # T=2, C=2, uniform probs (each 0.5), label [1], blank 0:
+        # alignments: (1,1), (0,1), (1,0) -> 3 * 0.25
+        logits = np.zeros((2, 2), np.float32)
+        got = run_ctc([logits], [[1]], 0)[0]
+        np.testing.assert_allclose(got, -np.log(0.75), rtol=1e-5)
+
+
+class TestOptaxParity:
+    def test_random_batch_matches_optax(self):
+        optax = pytest.importorskip("optax")
+        rng = np.random.RandomState(0)
+        b, T, C, U = 4, 12, 7, 4
+        logits = rng.randn(b, T, C).astype(np.float32)
+        lpad = np.zeros((b, T), np.float32)
+        lpad[1, 9:] = 1.0
+        lpad[3, 6:] = 1.0
+        labels = rng.randint(1, C, (b, U)).astype(np.int32)
+        labpad = np.zeros((b, U), np.float32)
+        labpad[0, 2:] = 1.0
+        labpad[3, 1:] = 1.0
+        args = (jnp.asarray(logits), jnp.asarray(lpad), jnp.asarray(labels),
+                jnp.asarray(labpad))
+        ours = np.asarray(ctc_loss(*args, blank_id=0))
+        theirs = np.asarray(optax.ctc_loss(*args, blank_id=0))
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+    def test_gradients_match_optax(self):
+        optax = pytest.importorskip("optax")
+        rng = np.random.RandomState(1)
+        b, T, C, U = 2, 6, 4, 2
+        logits = jnp.asarray(rng.randn(b, T, C).astype(np.float32))
+        lpad = jnp.zeros((b, T))
+        labels = jnp.asarray(rng.randint(1, C, (b, U)).astype(np.int32))
+        labpad = jnp.zeros((b, U))
+        g_ours = jax.grad(lambda x: ctc_loss(
+            x, lpad, labels, labpad, blank_id=0).sum())(logits)
+        g_opt = jax.grad(lambda x: optax.ctc_loss(
+            x, lpad, labels, labpad, blank_id=0).sum())(logits)
+        np.testing.assert_allclose(np.asarray(g_ours), np.asarray(g_opt),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_empty_label(self):
+        # all-blank path only
+        logits = np.zeros((1, 3, 2), np.float32)
+        got = run_ctc([logits[0]], [[]], 0)[0]
+        np.testing.assert_allclose(got, -3 * np.log(0.5), rtol=1e-5)

@@ -1,0 +1,193 @@
+"""Forward-semantics tests for the round-3 layer additions: bilinear tensor
+product, conv_shift circular correlation, linear_comb, prelu, row_l2_norm,
+switch_order, crf_error, and cross_entropy_over_beam.
+
+Values are pinned against hand-computed numpy expectations, mirroring the
+reference's dedicated unit tests (test_LayerGrad.cpp cases for tensor /
+conv_shift / convex_comb / prelu, test_CrossEntropyOverBeamGrad.cpp for the
+beam cost).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.sequence import pack_nested_sequences, pack_sequences
+from paddle_tpu.core.topology import Topology
+
+L = paddle.layer
+
+
+def run(out, feed, mode="test", seed=0):
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(seed))
+    outs, _ = topo.forward(params, topo.init_state(), feed, mode=mode,
+                           rng=jax.random.PRNGKey(seed + 1))
+    return outs[out.name], params
+
+
+class TestTensorLayer:
+    def test_bilinear_product(self):
+        rng = np.random.RandomState(0)
+        av = rng.randn(3, 4).astype(np.float32)
+        bv = rng.randn(3, 5).astype(np.float32)
+        a = L.data("a", paddle.data_type.dense_vector(4))
+        b = L.data("b", paddle.data_type.dense_vector(5))
+        out = L.tensor(a, b, size=2)
+        got, params = run(out, {"a": jnp.asarray(av), "b": jnp.asarray(bv)})
+        w = np.asarray(params[out.name and f"_{out.name}.w0"])
+        bias = np.asarray(params[f"_{out.name}.wbias"])
+        want = np.einsum("bi,kij,bj->bk", av, w, bv) + bias
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-5)
+
+
+class TestConvShift:
+    def test_circular_correlation(self):
+        # hand example: M=4, N=3 window; c[i] = sum_j a[(i+j) mod 4] * w[j]
+        av = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+        wv = np.array([[0.5, 1.0, -1.0]], np.float32)   # j = -1, 0, +1
+        a = L.data("a", paddle.data_type.dense_vector(4))
+        w = L.data("w", paddle.data_type.dense_vector(3))
+        got, _ = run(L.conv_shift(a, w),
+                     {"a": jnp.asarray(av), "w": jnp.asarray(wv)})
+        want = np.zeros((1, 4), np.float32)
+        for i in range(4):
+            for j in (-1, 0, 1):
+                want[0, i] += av[0, (i + j) % 4] * wv[0, j + 1]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+class TestLinearComb:
+    def test_weighted_block_sum(self):
+        wv = np.array([[2.0, -1.0]], np.float32)                 # m=2
+        vv = np.array([[1.0, 2.0, 3.0, 10.0, 20.0, 30.0]], np.float32)
+        w = L.data("w", paddle.data_type.dense_vector(2))
+        v = L.data("v", paddle.data_type.dense_vector(6))
+        got, _ = run(L.linear_comb(w, v),
+                     {"w": jnp.asarray(wv), "v": jnp.asarray(vv)})
+        want = 2.0 * vv[:, :3] - 1.0 * vv[:, 3:]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+class TestPRelu:
+    def test_negative_slope_init(self):
+        xv = np.array([[-2.0, -1.0, 1.0, 2.0]], np.float32)
+        x = L.data("x", paddle.data_type.dense_vector(4))
+        got, _ = run(L.prelu(x), {"x": jnp.asarray(xv)})
+        want = np.where(xv > 0, xv, 0.25 * xv)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_partial_sum_groups(self):
+        x = L.data("x", paddle.data_type.dense_vector(6))
+        out = L.prelu(x, partial_sum=3)
+        topo = Topology(out)
+        (pname, spec), = topo.param_specs.items()
+        assert spec.shape == (2,)   # 6 / partial_sum 3
+
+
+class TestRowL2Norm:
+    def test_unit_rows(self):
+        rng = np.random.RandomState(1)
+        xv = rng.randn(3, 5).astype(np.float32)
+        x = L.data("x", paddle.data_type.dense_vector(5))
+        got, _ = run(L.row_l2_norm(x), {"x": jnp.asarray(xv)})
+        want = xv / np.linalg.norm(xv, axis=1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+class TestSwitchOrder:
+    def test_nchw_to_nhwc(self):
+        c, h, w = 2, 2, 3
+        xv = np.arange(1 * c * h * w, dtype=np.float32).reshape(1, -1)
+        x = L.data("x", paddle.data_type.dense_vector(c * h * w),
+                   height=h, width=w)
+        got, _ = run(L.switch_order(x), {"x": jnp.asarray(xv)})
+        want = xv.reshape(1, c, h, w).transpose(0, 2, 3, 1).reshape(1, -1)
+        np.testing.assert_allclose(np.asarray(got), want)
+
+
+class TestCrfError:
+    def test_zero_one_disagreement(self):
+        rng = np.random.RandomState(2)
+        rows = [rng.randn(4, 3).astype(np.float32)]
+        x = L.data("x", paddle.data_type.dense_vector_sequence(3))
+        lbl = L.data("lbl", paddle.data_type.integer_value_sequence(3))
+        feed = {"x": pack_sequences(rows),
+                "lbl": pack_sequences(
+                    [rng.randint(0, 3, 4).astype(np.int32)])}
+        err, _ = run(L.crf_error(x, lbl), feed)
+        dec, _ = run(L.crf_decoding(x), feed)
+        want = (np.asarray(dec.data)[0, :4] !=
+                np.asarray(feed["lbl"].data)[0, :4]).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(err.data)[0, :4], want)
+
+
+def _beam_nodes(scores1, beam1):
+    s1 = L.data("s1", paddle.data_type.dense_vector_sequence(1))
+    g1 = L.data("g1", paddle.data_type.integer_value(100))
+    sel1 = L.kmax_seq_score(s1, beam_size=beam1)
+    return s1, sel1, g1
+
+
+class TestCrossEntropyOverBeam:
+    def test_single_expansion_gold_in_beam(self):
+        # 1 sequence, 4 candidates, top-2 beam; gold is the best candidate
+        sc = np.array([0.1, 2.0, 0.3, 1.0], np.float32)
+        s1, sel1, g1 = _beam_nodes(sc, 2)
+        cost = L.cross_entropy_over_beam(L.BeamInput(s1, sel1, g1))
+        feed = {"s1": pack_sequences([sc[:, None]]),
+                "g1": jnp.asarray([1])}
+        got, _ = run(cost, feed)
+        # top-2 = ids {1, 3}; softmax over their scores, gold at id 1
+        sel = np.array([2.0, 1.0])
+        want = -(sel[0] - np.log(np.exp(sel).sum()))
+        np.testing.assert_allclose(float(np.asarray(got)[0]), want,
+                                   rtol=1e-5)
+
+    def test_single_expansion_gold_off_beam(self):
+        # gold (id 0) falls off the top-2 beam -> appended as extra path
+        sc = np.array([0.1, 2.0, 0.3, 1.0], np.float32)
+        s1, sel1, g1 = _beam_nodes(sc, 2)
+        cost = L.cross_entropy_over_beam(L.BeamInput(s1, sel1, g1))
+        feed = {"s1": pack_sequences([sc[:, None]]),
+                "g1": jnp.asarray([0])}
+        got, _ = run(cost, feed)
+        paths = np.array([2.0, 1.0, 0.1])   # beam paths + gold as extra
+        want = -(paths[2] - np.log(np.exp(paths).sum()))
+        np.testing.assert_allclose(float(np.asarray(got)[0]), want,
+                                   rtol=1e-5)
+
+    def test_two_expansions_path_scores(self):
+        # expansion 0: 4 candidates, top-2 selected (ids 1 then 3).
+        # expansion 1: one subsequence per selected candidate, 3 candidates
+        # each, top-2 per subsequence. Paths = 4; score = sum along chain.
+        sc1 = np.array([0.1, 2.0, 0.3, 1.0], np.float32)
+        sc2_rows = [np.array([[0.5], [1.5], [0.2]], np.float32),   # for id 1
+                    np.array([[0.9], [0.4], [1.1]], np.float32)]   # for id 3
+        s1 = L.data("s1", paddle.data_type.dense_vector_sequence(1))
+        s2 = L.data("s2", paddle.data_type.dense_vector_sub_sequence(1))
+        sel1 = L.kmax_seq_score(s1, beam_size=2)
+        sel2 = L.kmax_seq_score(s2, beam_size=2)
+        g1 = L.data("g1", paddle.data_type.integer_value(100))
+        g2 = L.data("g2", paddle.data_type.integer_value(100))
+        cost = L.cross_entropy_over_beam([
+            L.BeamInput(s1, sel1, g1), L.BeamInput(s2, sel2, g2)])
+        feed = {"s1": pack_sequences([sc1[:, None]]),
+                "s2": pack_nested_sequences([sc2_rows]),
+                "g1": jnp.asarray([1]),      # gold candidate: id 1 (col 0)
+                "g2": jnp.asarray([1])}      # gold within subsequence 0
+        got, _ = run(cost, feed)
+        # kmax rows: exp0 -> [1, 3]; exp1 row0 -> [1, 0], row1 -> [2, 0]
+        # paths (flat order): (row0,[1,0]) then (row1,[2,0])
+        path_scores = np.array([
+            sc1[1] + 1.5,   # row 0, inner id 1  <- gold path
+            sc1[1] + 0.5,   # row 0, inner id 0
+            sc1[3] + 1.1,   # row 1, inner id 2
+            sc1[3] + 0.9,   # row 1, inner id 0
+        ])
+        want = -(path_scores[0] - np.log(np.exp(path_scores).sum()))
+        np.testing.assert_allclose(float(np.asarray(got)[0]), want,
+                                   rtol=1e-5)

@@ -294,6 +294,26 @@ CONFIGS = {
     "data_norm": lambda rng: (lambda x, f: (L.data_norm(weighted(x)), f))(
         *dense(rng)),
     "selective_fc": lambda rng: _selfc_cfg(rng),
+    # --- bilinear / addressing / normalization extras
+    "tensor": lambda rng: (lambda a, b, f: (
+        L.tensor(a, b, size=4, act=paddle.activation.Tanh()), f))(
+        *_two_dense(rng)),
+    "conv_shift": lambda rng: (lambda rv: (lambda a, fa, w, fw: (
+        L.conv_shift(weighted(a), L.fc(w, size=3,
+                                       act=paddle.activation.Sigmoid())),
+        {**fa, **fw}))(*dense(rv, "a"), *dense(rv, "w", d=3)))(rng),
+    "convex_comb": lambda rng: (lambda rv: (lambda w, fw, v, fv: (
+        L.linear_comb(L.fc(w, size=3, act=paddle.activation.Sigmoid()),
+                      weighted(v)),
+        {**fw, **fv}))(*dense(rv, "w", d=3), *dense(rv, "v", d=12)))(rng),
+    "prelu": lambda rng: (lambda x, f: (
+        L.prelu(weighted(x), partial_sum=2), f))(*dense(rng)),
+    "row_l2_norm": lambda rng: (lambda x, f: (
+        L.row_l2_norm(weighted(x)), f))(*dense(rng)),
+    "switch_order": lambda rng: (lambda x, f: (
+        L.switch_order(L.img_conv(x, filter_size=1, num_filters=2)), f))(
+        *image(rng, h=3, w=4)),
+    "cross_entropy_over_beam": lambda rng: _beam_cost_cfg(rng),
 }
 
 
@@ -453,6 +473,26 @@ def _get_output_cfg(rng):
     return L.get_output(g, "gaux"), f
 
 
+def _beam_cost_cfg(rng):
+    """Two-expansion learning-to-search cost: level-1 scores -> kmax top-2,
+    nested second-expansion scores -> per-subsequence kmax."""
+    s1, f1 = seq(rng, "bs1", lens=(4, 5), d=5)
+    ns, f2 = nested(rng, "bs2", d=4)
+    sc1 = L.fc(s1, size=1, act=paddle.activation.Tanh())
+    sc2 = L.fc(ns, size=1, act=paddle.activation.Tanh())
+    sel1 = L.kmax_seq_score(sc1, beam_size=2)
+    sel2 = L.kmax_seq_score(sc2, beam_size=2)
+    g1 = L.data("g1", paddle.data_type.integer_value(4))
+    g2 = L.data("g2", paddle.data_type.integer_value(2))
+    feed = {**f1, **f2,
+            "g1": jnp.asarray(rng.randint(0, 4, 2)),
+            "g2": jnp.asarray(rng.randint(0, 2, 2))}
+    cost = L.cross_entropy_over_beam([
+        paddle.layer.BeamInput(sc1, sel1, g1),
+        paddle.layer.BeamInput(sc2, sel2, g2)])
+    return cost, feed
+
+
 # Types with no meaningful parameter gradient path: integer/argmax outputs,
 # pure config nodes, or train-time-only diagnostics. Each entry says why.
 SKIP = {
@@ -462,6 +502,7 @@ SKIP = {
     "eos_id": "0/1 indicator output",
     "kmax_seq_score": "integer top-k indices output",
     "crf_decoding": "integer viterbi path output",
+    "crf_error": "0/1 viterbi-vs-label disagreement output",
     "classification_error": "0/1 error metric",
     "detection_output": "NMS-selected id/box report (inference only)",
     "priorbox": "constant anchor generator",

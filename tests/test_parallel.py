@@ -33,10 +33,10 @@ def _reader(n=64, dim=32, k=8, seed=3):
     return reader
 
 
-def _run(mesh, passes=3):
+def _run(mesh, passes=3, trainer_count=1):
     from paddle_tpu.core import registry
     registry.reset_name_counters()
-    paddle.init(use_tpu=False, seed=0)
+    paddle.init(use_tpu=False, seed=0, trainer_count=trainer_count)
     cost = _net()
     params = paddle.create_parameters(paddle.Topology(cost))
     tr = paddle.SGD(cost=cost, parameters=params,
@@ -93,3 +93,36 @@ class TestGraftEntry:
         sys.path.insert(0, "/root/repo")
         import __graft_entry__ as g
         g.dryrun_multichip(8)
+
+
+class TestTrainerCountMesh:
+    def test_trainer_count_builds_dp_mesh(self):
+        """paddle.init(trainer_count=4) + plain SGD must shard over 4
+        devices with no explicit mesh= (GradientMachine.cpp:29 —
+        trainer_count>1 transparently selected MultiGradientMachine)."""
+        from paddle_tpu.core import registry
+        registry.reset_name_counters()
+        paddle.init(use_tpu=False, seed=0, trainer_count=4)
+        try:
+            cost = _net()
+            params = paddle.create_parameters(paddle.Topology(cost))
+            tr = paddle.SGD(cost=cost, parameters=params,
+                            update_equation=paddle.optimizer.Momentum(
+                                learning_rate=0.1, momentum=0.9))
+            assert tr.mesh is not None
+            assert dict(tr.mesh.shape)[DP_AXIS] == 4
+            costs = []
+            tr.train(_reader(), num_passes=2,
+                     event_handler=lambda e: costs.append(e.cost)
+                     if isinstance(e, paddle.event.EndIteration) else None)
+            assert costs and np.isfinite(costs).all()
+        finally:
+            paddle.init(use_tpu=False, seed=0, trainer_count=1)
+
+    def test_trainer_count_numerics_match_explicit_mesh(self):
+        explicit = _run(create_mesh([(DP_AXIS, 4)]))
+        try:
+            implicit = _run(None, trainer_count=4)
+        finally:
+            paddle.init(use_tpu=False, seed=0, trainer_count=1)
+        np.testing.assert_allclose(implicit, explicit, rtol=1e-5)

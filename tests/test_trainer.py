@@ -3,6 +3,7 @@ test_Trainer / test_TrainerOnePass: a few batches of a real config must
 run and converge)."""
 
 import io
+import os
 
 import numpy as np
 import pytest
@@ -123,16 +124,25 @@ class TestSGDTrain:
 
 def test_debug_nans_flag_raises_at_source():
     """config.init(debug_nans=True) = the FPE-trap discipline
-    (TrainerMain.cpp:49): NaN-producing math raises instead of propagating."""
-    import jax
-    import jax.numpy as jnp
-    import pytest as _pytest
-    from paddle_tpu import config as cfg
-    cfg.init(debug_nans=True)
-    try:
-        assert cfg.global_config().debug_nans
-        with _pytest.raises(FloatingPointError):
-            jnp.log(jnp.zeros(())) * 0.0  # -inf * 0 -> nan, must trap
-    finally:
-        jax.config.update("jax_debug_nans", False)
-        cfg.init(debug_nans=False)
+    (TrainerMain.cpp:49): NaN-producing math raises instead of propagating.
+
+    Runs in a fresh subprocess: jax_debug_nans only instruments newly
+    compiled executables, so a warm in-process compilation cache (from any
+    earlier test) would defeat the trap and make this test order-dependent.
+    """
+    import subprocess
+    import sys
+    script = (
+        "from paddle_tpu import config as cfg\n"
+        "import jax.numpy as jnp\n"
+        "cfg.init(debug_nans=True)\n"
+        "assert cfg.global_config().debug_nans\n"
+        "try:\n"
+        "    jnp.log(jnp.zeros(())) * 0.0  # -inf * 0 -> nan, must trap\n"
+        "except FloatingPointError:\n"
+        "    print('TRAPPED')\n"
+    )
+    r = subprocess.run([sys.executable, "-c", script], capture_output=True,
+                       text=True, timeout=300,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert "TRAPPED" in r.stdout, f"nan did not trap:\n{r.stdout}\n{r.stderr}"
