@@ -232,10 +232,35 @@ def bench_flash_attention(batch: int = 4, seq_len: int = 4096, heads: int = 8,
 
     flash_ms = measure(f)
     xla_ms = measure(r)
+
+    # training step (fwd+bwd) — exercises the Pallas backward kernels
+    def loss_of(fn):
+        return jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+            fn(q, k, v).astype(jnp.float32) ** 2), argnums=(0, 1, 2)))
+
+    fg, rg = loss_of(f._fun if hasattr(f, "_fun") else (
+        lambda q, k, v: flash_attention(q, k, v, kv_lens=lens,
+                                        causal=True))), \
+        loss_of(lambda q, k, v: _reference(q, k, v, mask, head_dim ** -0.5))
+
+    def measure_grad(fn):
+        for _ in range(warmup):
+            jax.block_until_ready(fn(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    flash_grad_ms = measure_grad(fg)
+    xla_grad_ms = measure_grad(rg)
     # causal forward FLOPs: two [T, d] matmuls over the T^2/2 valid pairs
     flops = batch * heads * (seq_len ** 2 / 2) * head_dim * 2 * 2
     return {"ms": round(flash_ms, 4), "xla_ms": round(xla_ms, 4),
             "vs_xla": round(xla_ms / flash_ms, 3),
+            "grad_ms": round(flash_grad_ms, 4),
+            "xla_grad_ms": round(xla_grad_ms, 4),
+            "grad_vs_xla": round(xla_grad_ms / flash_grad_ms, 3),
             "tflops": round(flops / flash_ms / 1e9, 2)}
 
 

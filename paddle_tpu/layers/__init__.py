@@ -265,12 +265,12 @@ img_conv_layer = img_conv
 
 def img_pool(input, pool_size: int, name=None, num_channels=None,
              pool_type=None, stride: int = 1, padding: int = 0,
-             pool_size_x=None, **kw) -> LayerOutput:
+             pool_size_x=None, ceil_mode: bool = True, **kw) -> LayerOutput:
     return make_layer("pool", name, [input], pool_size=pool_size,
                       pool_size_x=pool_size_x,
                       channels=num_channels, pool_type=pool_mod.to_name(
                           pool_type or "max"),
-                      stride=stride, padding=padding)
+                      stride=stride, padding=padding, ceil_mode=ceil_mode)
 
 
 def global_img_pool(input, name=None, pool_type=None, **kw) -> LayerOutput:

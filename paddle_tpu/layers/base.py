@@ -81,7 +81,23 @@ class DataLayer:
 
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
-        return inputs[0]
+        v = inputs[0]
+        # Mixed-precision entry cast: dense float feeds drop to the
+        # compute dtype ONCE here, so the whole activation graph runs
+        # bf16 (ops preserve their input dtype; without this, an f32
+        # feed keeps every elementwise chain f32 and doubles HBM
+        # traffic — see the resnet trace analysis in docs/perf.md).
+        it: InputType = cfg["input_type"]
+        if it.kind != "integer":
+            from paddle_tpu.ops.linear import compute_dtype
+            cd = compute_dtype()
+            if cd != jnp.float32:
+                if isinstance(v, SequenceBatch):
+                    if jnp.issubdtype(v.data.dtype, jnp.floating):
+                        v = v.with_data(v.data.astype(cd))
+                elif jnp.issubdtype(v.dtype, jnp.floating):
+                    v = v.astype(cd)
+        return v
 
 
 @register_layer("fc")

@@ -92,8 +92,9 @@ class PoolLayer:
         kx = cfg.get("pool_size_x") or ky
         s = cfg.get("stride", 1)
         p = cfg.get("padding", 0)
-        oh = pool_ops.pool_out_size(ih, ky, s, p)
-        ow = pool_ops.pool_out_size(iw, kx, s, p)
+        cm = cfg.get("ceil_mode", True)
+        oh = pool_ops.pool_out_size(ih, ky, s, p, cm)
+        ow = pool_ops.pool_out_size(iw, kx, s, p, cm)
         cfg["_ic"], cfg["_ih"], cfg["_iw"] = c, ih, iw
         return (LayerMeta(size=c * oh * ow, height=oh, width=ow, channels=c),
                 [], [])
@@ -105,10 +106,11 @@ class PoolLayer:
         kx = cfg.get("pool_size_x") or ky
         s = cfg.get("stride", 1)
         p = cfg.get("padding", 0)
+        cm = cfg.get("ceil_mode", True)
         ptype = cfg.get("pool_type", "max")
         if ptype in ("max", "cudnn-max"):
-            return pool_ops.max_pool2d(x, (ky, kx), s, p)
-        return pool_ops.avg_pool2d(x, (ky, kx), s, p)
+            return pool_ops.max_pool2d(x, (ky, kx), s, p, ceil_mode=cm)
+        return pool_ops.avg_pool2d(x, (ky, kx), s, p, ceil_mode=cm)
 
 
 @register_layer("img_cmrnorm")

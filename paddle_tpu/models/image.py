@@ -225,7 +225,11 @@ def resnet(depth: int = 50, height: int = 224, width: int = 224,
     img, lbl = _image_inputs(height, width, channels, num_classes)
     t = _conv_bn("rn_stem", img, 7, 64, stride=2, padding=3,
                  num_channels=channels)
-    t = layer.img_pool(t, pool_size=3, stride=2, padding=1, name="rn_pool1")
+    # floor pooling (ceil_mode=False) keeps the canonical 56/28/14/7
+    # feature-map chain — divisible by the TPU's 8-sublane tiling, where
+    # caffe ceil's 57/29/15 chain pads every map by ~12%
+    t = layer.img_pool(t, pool_size=3, stride=2, padding=1,
+                       ceil_mode=False, name="rn_pool1")
     nf = 64
     for si, n in enumerate(reps):
         for bi in range(n):

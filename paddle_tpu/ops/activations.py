@@ -110,7 +110,9 @@ def abs_act(x):
 
 @register("softmax")
 def softmax(x):
-    return jax.nn.softmax(x, axis=-1)
+    # always normalize in f32: bf16 exp/sum under mixed precision loses
+    # probability mass and destabilizes the CE loss right above it
+    return jax.nn.softmax(x.astype(jnp.float32), axis=-1)
 
 
 @register("sequence_softmax")

@@ -32,10 +32,11 @@ def cross_entropy(probs_or_logits: jnp.ndarray, labels: jnp.ndarray, *,
     numerically-stable log_softmax path, which is what the jit graph should
     prefer (XLA fuses it into one kernel).
     """
+    x = probs_or_logits.astype(jnp.float32)   # stable log under bf16 mode
     if from_logits:
-        lp = jax.nn.log_softmax(probs_or_logits, axis=-1)
+        lp = jax.nn.log_softmax(x, axis=-1)
     else:
-        lp = jnp.log(jnp.maximum(probs_or_logits, eps))
+        lp = jnp.log(jnp.maximum(x, eps))
     return _one_hot_nll(lp, labels)
 
 

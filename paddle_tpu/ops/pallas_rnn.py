@@ -4,16 +4,24 @@ Reference parity: the hand-fused CUDA recurrences hl_cuda_lstm.cu /
 hl_gpu_gru.cuh — the one place the reference found XLA-era fusion
 insufficient and wrote kernels by hand. Same story on TPU: a lax.scan
 LSTM re-reads h/c from HBM every step; this kernel keeps the recurrent
-state in VMEM scratch across the whole sequence (grid over time), so each
-step is one MXU matmul [b,h]x[h,4h] plus VPU gate math with zero HBM
-traffic for the carry.
+weight AND state resident in VMEM across the whole sequence (grid over
+time — v5e has ~100+ MB of usable VMEM, so even h=1280's [1280,5120]
+weight stays resident), and each step is one MXU matmul [b,h]x[h,4h]
+plus VPU gate math with zero HBM traffic for the carry.
 
-Semantics match ops/recurrent.lstm_scan/gru_scan exactly (tests assert
-parity): padded steps freeze the carry and zero the output; final state
-is the last VALID step's state. The kernel is the PRIMAL (inference)
-path; under jax.grad the custom_vjp runs the lax reference once forward
-and once backward — identical cost to the plain scan, so training never
-pays a duplicate forward.
+Training is fused end-to-end for the LSTM (hl_cuda_lstm.cu does both
+directions; so do we): the forward kernel streams out the activated
+gates and cell sequence as residuals, and a reverse-time backward kernel
+carries dh/dc in VMEM while emitting dz — the pre-activation cotangent —
+from which the weight/bias/peephole grads fall out as ONE large
+MXU-friendly matmul outside the kernel (sum_t h_{t-1}^T dz_t), instead
+of T tiny rank-updates.
+
+MXU passes run in the global compute dtype (bf16 under mixed precision,
+f32 otherwise) with f32 accumulation; gate math and carries are always
+f32. Semantics match ops/recurrent.lstm_scan/gru_scan exactly (tests
+assert forward AND gradient parity): padded steps freeze the carry and
+zero the output; final state is the last VALID step's state.
 
 Kernels are used on the TPU backend when shapes are tile-friendly
 (h % 128 == 0, batch % 8 == 0) and activations are the defaults;
@@ -37,12 +45,19 @@ def _sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
+def _mxu_dtype():
+    from paddle_tpu.ops.linear import compute_dtype
+    cd = compute_dtype()
+    return jnp.bfloat16 if cd == jnp.bfloat16 else jnp.float32
+
+
 # ---------------------------------------------------------------------------
-# LSTM
+# LSTM — forward kernel
 
 
 def _lstm_kernel(lens_ref, x4_ref, w_ref, b_ref, peep_ref,
-                 out_ref, hT_ref, cT_ref, h_scr, c_scr):
+                 out_ref, cseq_ref, gates_ref, hT_ref, cT_ref,
+                 h_scr, c_scr):
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -50,12 +65,13 @@ def _lstm_kernel(lens_ref, x4_ref, w_ref, b_ref, peep_ref,
         h_scr[:] = jnp.zeros_like(h_scr)
         c_scr[:] = jnp.zeros_like(c_scr)
 
-    x4 = x4_ref[0]                                    # [b, 4h]
+    x4 = x4_ref[0].astype(jnp.float32)                # [b, 4h]
     h = h_scr[:]
     c = c_scr[:]
     hdim = h.shape[-1]
 
-    z = x4 + jnp.dot(h, w_ref[:], preferred_element_type=jnp.float32) \
+    z = x4 + jnp.dot(h.astype(w_ref.dtype), w_ref[:],
+                     preferred_element_type=jnp.float32) \
         + b_ref[0]
     zi = z[:, :hdim]
     zf = z[:, hdim:2 * hdim]
@@ -76,14 +92,78 @@ def _lstm_kernel(lens_ref, x4_ref, w_ref, b_ref, peep_ref,
     c_keep = jnp.where(valid, c_new, c)
     h_scr[:] = h_keep
     c_scr[:] = c_keep
-    out_ref[0] = jnp.where(valid, h_new, jnp.zeros_like(h_new))
+    out_ref[0] = jnp.where(valid, h_new,
+                           jnp.zeros_like(h_new)).astype(out_ref.dtype)
+    cseq_ref[0] = c_keep.astype(cseq_ref.dtype)
+    gates_ref[0] = jnp.concatenate([i_g, f_g, cand, o_g],
+                                   axis=-1).astype(gates_ref.dtype)
     hT_ref[:] = h_keep
     cT_ref[:] = c_keep
 
 
+# ---------------------------------------------------------------------------
+# LSTM — backward kernel (reverse time; dh/dc carried in VMEM)
+
+
+def _lstm_bwd_kernel(T, lens_ref, w_ref, peep_ref, gates_ref, cseq_ref,
+                     cprev_ref, dhseq_ref, dhT_ref, dcT_ref,
+                     dz_ref, dh_scr, dc_scr):
+    idx = pl.program_id(0)
+    t = T - 1 - idx
+
+    @pl.when(idx == 0)
+    def _init():
+        dh_scr[:] = dhT_ref[:]
+        dc_scr[:] = dcT_ref[:]
+
+    g4 = gates_ref[0].astype(jnp.float32)             # [b, 4h]
+    hdim = dh_scr.shape[-1]
+    i_g = g4[:, :hdim]
+    f_g = g4[:, hdim:2 * hdim]
+    cand = g4[:, 2 * hdim:3 * hdim]
+    o_g = g4[:, 3 * hdim:]
+    c_t = cseq_ref[0].astype(jnp.float32)
+    c_prev = cprev_ref[0].astype(jnp.float32)
+    c_prev = jnp.where(t > 0, c_prev, jnp.zeros_like(c_prev))
+    pi = peep_ref[0:1, :]
+    pf = peep_ref[1:2, :]
+    po = peep_ref[2:3, :]
+
+    valid = (lens_ref[:] > t)                         # [b, 1]
+    dh_t = dh_scr[:] + jnp.where(valid, dhseq_ref[0].astype(jnp.float32),
+                                 0.0)
+    tc = jnp.tanh(c_t)
+    do = dh_t * tc
+    dzo = do * o_g * (1.0 - o_g)
+    dc_t = dc_scr[:] + dh_t * o_g * (1.0 - tc * tc) + dzo * po
+    di = dc_t * cand
+    dzi = di * i_g * (1.0 - i_g)
+    df = dc_t * c_prev
+    dzf = df * f_g * (1.0 - f_g)
+    dg = dc_t * i_g
+    dzc = dg * (1.0 - cand * cand)
+    dz = jnp.concatenate([dzi, dzf, dzc, dzo], axis=-1)
+    dz = jnp.where(valid, dz, jnp.zeros_like(dz))
+
+    # dh_{t-1} = dz @ w^T (contract the 4h dim of both)
+    dh_prev = jax.lax.dot_general(
+        dz.astype(w_ref.dtype), w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dc_prev = dc_t * f_g + dzi * pi + dzf * pf
+
+    dh_scr[:] = jnp.where(valid, dh_prev, dh_scr[:])
+    dc_scr[:] = jnp.where(valid, dc_prev, dc_scr[:])
+    dz_ref[0] = dz.astype(dz_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# LSTM — lax reference (semantics oracle; CPU / odd-shape fallback path)
+
+
 def _lstm_ref(x4, lens2d, w, bias2d, peep2d):
-    """Pure-lax reference with identical semantics — the backward pass
-    (pallas forward + lax-vjp backward via custom_vjp below)."""
+    """Pure-lax implementation with identical semantics — what the
+    fused kernel is tested against (tests/test_pallas_rnn.py pins both
+    forward and gradient parity)."""
     b, T, four_h = x4.shape
     h = four_h // 4
     lens = lens2d.reshape(b)
@@ -111,56 +191,23 @@ def _lstm_ref(x4, lens2d, w, bias2d, peep2d):
     return jnp.moveaxis(outs, 0, 1), hT, cT
 
 
+# ---------------------------------------------------------------------------
+# LSTM — custom-vjp wrapper: fused forward AND fused backward
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def _lstm_pallas(x4, lens2d, w, bias2d, peep2d, interpret):
+    out, hT, cT, _, _ = _lstm_fwd_call(x4, lens2d, w, bias2d, peep2d,
+                                       interpret)
+    return out, hT, cT
+
+
+def _lstm_fwd_call(x4, lens2d, w, bias2d, peep2d, interpret):
     b, T, four_h = x4.shape
     h = four_h // 4
-    xt = jnp.moveaxis(x4, 1, 0)
-    out, hT, cT = _lstm_call(xt, lens2d, w, bias2d, peep2d, b, T, four_h, h,
-                             interpret)
-    return jnp.moveaxis(out, 0, 1), hT, cT
-
-
-def _lstm_fwd(x4, lens2d, w, bias2d, peep2d, interpret):
-    # Under differentiation (training), run the lax reference ONCE and keep
-    # its vjp closure as the residual: same total cost as the plain scan
-    # path (one forward + one backward), no kernel re-execution. The fused
-    # kernel is the inference/primal path.
-    out, vjp = jax.vjp(_lstm_ref, x4, lens2d, w, bias2d, peep2d)
-    return out, (vjp, lens2d.shape)
-
-
-def _lstm_bwd(interpret, res, ct):
-    vjp, lens_shape = res
-    gx4, _, gw, gb, gp = vjp(ct)
-    glens = jnp.zeros(lens_shape, jax.dtypes.float0)
-    return gx4, glens, gw, gb, gp
-
-
-_lstm_pallas.defvjp(_lstm_fwd, _lstm_bwd)
-
-
-def lstm_sequence(x4: jnp.ndarray, lengths: jnp.ndarray, w: jnp.ndarray,
-                  bias: Optional[jnp.ndarray],
-                  peep: Optional[jnp.ndarray], *,
-                  interpret: bool = False
-                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """x4: [b, T, 4h] f32; returns (h_seq [b,T,h], hT [b,h], cT [b,h]).
-    Differentiable: forward runs the fused kernel, backward the lax vjp."""
-    b, T, four_h = x4.shape
-    h = four_h // 4
-    lens = lengths.astype(jnp.int32).reshape(b, 1)
-    b_arr = (bias if bias is not None
-             else jnp.zeros((four_h,), jnp.float32)).reshape(1, four_h) \
-        .astype(jnp.float32)
-    p_arr = (peep.reshape(3, h) if peep is not None
-             else jnp.zeros((3, h), jnp.float32)).astype(jnp.float32)
-    return _lstm_pallas(x4.astype(jnp.float32), lens, w.astype(jnp.float32),
-                        b_arr, p_arr, interpret)
-
-
-def _lstm_call(xt, lens, w, b_arr, p_arr, b, T, four_h, h, interpret):
-    return pl.pallas_call(
+    mxu = _mxu_dtype()
+    xt = jnp.moveaxis(x4, 1, 0).astype(mxu)
+    out, cseq, gates, hT, cT = pl.pallas_call(
         _lstm_kernel,
         grid=(T,),
         in_specs=[
@@ -173,12 +220,18 @@ def _lstm_call(xt, lens, w, b_arr, p_arr, b, T, four_h, h, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, b, h), lambda t: (t, 0, 0),
-                         memory_space=pltpu.VMEM),
+                         memory_space=pltpu.VMEM),            # h seq
+            pl.BlockSpec((1, b, h), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),            # c seq
+            pl.BlockSpec((1, b, four_h), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),            # gates
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((T, b, h), jnp.float32),
+            jax.ShapeDtypeStruct((T, b, h), mxu),     # h stream
+            jax.ShapeDtypeStruct((T, b, h), mxu),     # c stream (residual)
+            jax.ShapeDtypeStruct((T, b, four_h), mxu),
             jax.ShapeDtypeStruct((b, h), jnp.float32),
             jax.ShapeDtypeStruct((b, h), jnp.float32),
         ],
@@ -186,8 +239,105 @@ def _lstm_call(xt, lens, w, b_arr, p_arr, b, T, four_h, h, interpret):
             pltpu.VMEM((b, h), jnp.float32),
             pltpu.VMEM((b, h), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(lens, xt, w, b_arr, p_arr)
+    )(lens2d, xt, w.astype(mxu), bias2d, peep2d)
+    return jnp.moveaxis(out, 0, 1), hT, cT, cseq, gates
+
+
+def _lstm_fwd(x4, lens2d, w, bias2d, peep2d, interpret):
+    out, hT, cT, cseq, gates = _lstm_fwd_call(x4, lens2d, w, bias2d, peep2d,
+                                              interpret)
+    res = (lens2d, w, peep2d, cseq, gates,
+           jnp.moveaxis(out, 1, 0), jnp.zeros((0,), x4.dtype))
+    return (out, hT, cT), res
+
+
+def _lstm_bwd(interpret, res, ct):
+    lens2d, w, peep2d, cseq, gates, hseq_tb, x4_token = res
+    x4_dtype = x4_token.dtype
+    d_out, d_hT, d_cT = ct
+    T, b, h = cseq.shape
+    four_h = 4 * h
+    mxu = _mxu_dtype()
+    d_out_tb = jnp.moveaxis(d_out, 1, 0)
+
+    rev = lambda t: (T - 1 - t, 0, 0)                  # noqa: E731
+    rev_prev = lambda t: (jnp.maximum(T - 2 - t, 0), 0, 0)  # noqa: E731
+    dz = pl.pallas_call(
+        functools.partial(_lstm_bwd_kernel, T),
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),             # lens
+            pl.BlockSpec(memory_space=pltpu.VMEM),             # w
+            pl.BlockSpec(memory_space=pltpu.VMEM),             # peep
+            pl.BlockSpec((1, b, four_h), rev,
+                         memory_space=pltpu.VMEM),             # gates
+            pl.BlockSpec((1, b, h), rev, memory_space=pltpu.VMEM),   # c_t
+            pl.BlockSpec((1, b, h), rev_prev,
+                         memory_space=pltpu.VMEM),             # c_{t-1}
+            pl.BlockSpec((1, b, h), rev, memory_space=pltpu.VMEM),   # dh_seq
+            pl.BlockSpec(memory_space=pltpu.VMEM),             # dhT
+            pl.BlockSpec(memory_space=pltpu.VMEM),             # dcT
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, four_h), rev, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((T, b, four_h), mxu)],
+        scratch_shapes=[
+            pltpu.VMEM((b, h), jnp.float32),
+            pltpu.VMEM((b, h), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(lens2d, w.astype(mxu), peep2d, gates, cseq, cseq, d_out_tb,
+      d_hT.astype(jnp.float32), d_cT.astype(jnp.float32))[0]
+
+    # Parameter grads as single large contractions (MXU work, not T tiny
+    # rank-1 updates): dw = sum_t h_{t-1}^T dz_t over (t, b).
+    hprev = jnp.concatenate(
+        [jnp.zeros((1, b, h), hseq_tb.dtype), hseq_tb[:-1]], axis=0)
+    dw = jax.lax.dot_general(
+        hprev.reshape(T * b, h).astype(mxu),
+        dz.reshape(T * b, four_h).astype(mxu),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    # f32-ACCUMULATING reductions over the bf16 stream (dtype=f32 keeps
+    # the bf16 multiply fused into the reduce; an explicit .astype would
+    # materialize a full f32 copy of dz — 0.6 ms at h=1280 in traces)
+    dbias = jnp.sum(dz, axis=(0, 1), dtype=jnp.float32).reshape(1, four_h)
+    cprev = jnp.concatenate(
+        [jnp.zeros((1, b, h), cseq.dtype), cseq[:-1]], axis=0)
+    dpi = jnp.sum(dz[..., :h] * cprev, axis=(0, 1), dtype=jnp.float32)
+    dpf = jnp.sum(dz[..., h:2 * h] * cprev, axis=(0, 1), dtype=jnp.float32)
+    dpo = jnp.sum(dz[..., 3 * h:] * cseq, axis=(0, 1), dtype=jnp.float32)
+    dpeep = jnp.stack([dpi, dpf, dpo])
+    dx4 = jnp.moveaxis(dz, 0, 1).astype(x4_dtype)
+    glens = jnp.zeros(lens2d.shape, jax.dtypes.float0)
+    return dx4, glens, dw.astype(w.dtype), dbias, dpeep
+
+
+_lstm_pallas.defvjp(_lstm_fwd, _lstm_bwd)
+
+
+def lstm_sequence(x4: jnp.ndarray, lengths: jnp.ndarray, w: jnp.ndarray,
+                  bias: Optional[jnp.ndarray],
+                  peep: Optional[jnp.ndarray], *,
+                  interpret: bool = False
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """x4: [b, T, 4h]; returns (h_seq [b,T,h] f32, hT [b,h], cT [b,h]).
+    Differentiable: fused Pallas kernels both directions."""
+    b, T, four_h = x4.shape
+    h = four_h // 4
+    lens = lengths.astype(jnp.int32).reshape(b, 1)
+    b_arr = (bias if bias is not None
+             else jnp.zeros((four_h,), jnp.float32)).reshape(1, four_h) \
+        .astype(jnp.float32)
+    p_arr = (peep.reshape(3, h) if peep is not None
+             else jnp.zeros((3, h), jnp.float32)).astype(jnp.float32)
+    return _lstm_pallas(x4, lens, w, b_arr, p_arr, interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -202,16 +352,16 @@ def _gru_kernel(lens_ref, x3_ref, wg_ref, wc_ref, b_ref,
     def _init():
         h_scr[:] = jnp.zeros_like(h_scr)
 
-    x3 = x3_ref[0]                                    # [b, 3h]
+    x3 = x3_ref[0].astype(jnp.float32)                # [b, 3h]
     h = h_scr[:]
     hdim = h.shape[-1]
 
-    zr = x3[:, :2 * hdim] + jnp.dot(h, wg_ref[:],
+    zr = x3[:, :2 * hdim] + jnp.dot(h.astype(wg_ref.dtype), wg_ref[:],
                                     preferred_element_type=jnp.float32) \
         + b_ref[0, :2 * hdim]
     z = _sigmoid(zr[:, :hdim])
     r = _sigmoid(zr[:, hdim:])
-    cand = x3[:, 2 * hdim:] + jnp.dot(r * h, wc_ref[:],
+    cand = x3[:, 2 * hdim:] + jnp.dot((r * h).astype(wc_ref.dtype), wc_ref[:],
                                       preferred_element_type=jnp.float32) \
         + b_ref[0, 2 * hdim:]
     c = jnp.tanh(cand)
@@ -258,6 +408,8 @@ def _gru_pallas(x3, lens2d, w, bias2d, interpret):
 
 
 def _gru_fwd(x3, lens2d, w, bias2d, interpret):
+    # GRU training keeps the lax vjp (one forward + one backward, same
+    # cost as the plain scan); only the LSTM has the full fused backward.
     out, vjp = jax.vjp(_gru_ref, x3, lens2d, w, bias2d)
     return out, (vjp, lens2d.shape)
 
@@ -288,6 +440,7 @@ def gru_sequence(x3: jnp.ndarray, lengths: jnp.ndarray, w: jnp.ndarray,
 
 
 def _gru_call(xt, lens, w, b_arr, b, T, three_h, h, interpret):
+    mxu = _mxu_dtype()
     return pl.pallas_call(
         _gru_kernel,
         grid=(T,),
@@ -310,7 +463,8 @@ def _gru_call(xt, lens, w, b_arr, b, T, three_h, h, interpret):
         ],
         scratch_shapes=[pltpu.VMEM((b, h), jnp.float32)],
         interpret=interpret,
-    )(lens, xt, w[:, :2 * h], w[:, 2 * h:], b_arr)
+    )(lens, xt.astype(mxu), w[:, :2 * h].astype(mxu),
+      w[:, 2 * h:].astype(mxu), b_arr)
 
 
 # ---------------------------------------------------------------------------
@@ -324,18 +478,21 @@ def _on_tpu() -> bool:
         return False
 
 
-_VMEM_BUDGET = 12 * 1024 * 1024   # ~16 MB/core minus headroom
+# v5e-class chips expose ~128 MB of VMEM (measured: a 120 MB scratch
+# compiles and runs); leave headroom for double-buffered stream blocks
+_VMEM_BUDGET = 96 * 1024 * 1024
 
 
 def _vmem_bytes(b: int, h: int, gates: int) -> int:
-    """Rough VMEM residency of the fused kernel: weights + one x block +
-    out block + state scratches/outputs, all f32."""
+    """Rough VMEM residency of the fused kernel: resident weights + the
+    double-buffered per-step stream blocks + state scratches."""
     gh = gates * h
-    return 4 * (h * gh          # recurrent weight
-                + b * gh        # x4/x3 time block
-                + gh            # bias
-                + 3 * h         # peephole
-                + b * h * 4)    # out block + final states + scratches
+    mxu_bytes = 2 if _mxu_dtype() == jnp.bfloat16 else 4
+    return (mxu_bytes * h * gh          # recurrent weight (resident)
+            + 2 * mxu_bytes * b * gh    # x block (double-buffered)
+            + 2 * mxu_bytes * b * gh    # gates block
+            + 4 * gh + 12 * h           # bias + peephole
+            + 4 * b * h * 8)            # h/c stream blocks + scratches
 
 
 def pallas_ok(b: int, h: int, act: str, gate_act: str,

@@ -17,25 +17,30 @@ def _pair(v) -> Tuple[int, int]:
     return (int(v), int(v))
 
 
-def _ceil_pads(in_size: int, kernel: int, stride: int, padding: int):
+def _ceil_pads(in_size: int, kernel: int, stride: int, padding: int,
+               ceil_mode: bool = True):
     """Caffe ceil-mode window arithmetic (reference PoolLayer /
     config_parser pooling output size): out = ceil((in - k + 2p)/s) + 1,
     clipped so the last window starts inside in+p; returns (out,
     (left_pad, right_pad)) with the asymmetric right pad that makes
-    reduce_window produce exactly `out` windows."""
-    out = pool_out_size(in_size, kernel, stride, padding)
+    reduce_window produce exactly `out` windows. ceil_mode=False is the
+    img_pool_layer ceil_mode flag (floor arithmetic — and on TPU the
+    floor chain 56/28/14/7 tiles the 8-sublane register file exactly,
+    where ceil's 57/29/15 pads every map ~12%)."""
+    out = pool_out_size(in_size, kernel, stride, padding, ceil_mode)
     right = (out - 1) * stride + kernel - in_size - padding
     return out, (padding, max(right, 0))
 
 
-def max_pool2d(x: jnp.ndarray, kernel, stride=None, padding=0) -> jnp.ndarray:
+def max_pool2d(x: jnp.ndarray, kernel, stride=None, padding=0,
+               ceil_mode: bool = True) -> jnp.ndarray:
     """x: [N,H,W,C]. Ceil-mode (caffe) window arithmetic like the
     reference's PoolLayer."""
     kh, kw = _pair(kernel)
     sh, sw = _pair(stride if stride is not None else kernel)
     ph, pw = _pair(padding)
-    _, pads_h = _ceil_pads(x.shape[1], kh, sh, ph)
-    _, pads_w = _ceil_pads(x.shape[2], kw, sw, pw)
+    _, pads_h = _ceil_pads(x.shape[1], kh, sh, ph, ceil_mode)
+    _, pads_w = _ceil_pads(x.shape[2], kw, sw, pw, ceil_mode)
     return lax.reduce_window(
         x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
         lax.max, (1, kh, kw, 1), (1, sh, sw, 1),
@@ -43,12 +48,13 @@ def max_pool2d(x: jnp.ndarray, kernel, stride=None, padding=0) -> jnp.ndarray:
 
 
 def avg_pool2d(x: jnp.ndarray, kernel, stride=None, padding=0,
-               exclude_padding: bool = True) -> jnp.ndarray:
+               exclude_padding: bool = True,
+               ceil_mode: bool = True) -> jnp.ndarray:
     kh, kw = _pair(kernel)
     sh, sw = _pair(stride if stride is not None else kernel)
     ph, pw = _pair(padding)
-    _, pads_h = _ceil_pads(x.shape[1], kh, sh, ph)
-    _, pads_w = _ceil_pads(x.shape[2], kw, sw, pw)
+    _, pads_h = _ceil_pads(x.shape[1], kh, sh, ph, ceil_mode)
+    _, pads_w = _ceil_pads(x.shape[2], kw, sw, pw, ceil_mode)
     dims, strides = (1, kh, kw, 1), (1, sh, sw, 1)
     pads = ((0, 0), pads_h, pads_w, (0, 0))
     sums = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)

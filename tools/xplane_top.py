@@ -1,0 +1,65 @@
+"""Summarize a JAX TPU .xplane.pb trace: top HLO ops by self time.
+
+Usage: python tools/xplane_top.py /tmp/jax_trace [n]
+Part of the profiling loop (utils/stats.py Stat.h parity bridges Python
+scopes into these traces; this reads the device side back out).
+"""
+
+import collections
+import glob
+import sys
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: E501  (TF bundles the TSL xplane schema)
+
+
+def load(path):
+    xs = sorted(glob.glob(f"{path}/**/*.xplane.pb", recursive=True))
+    assert xs, f"no xplane under {path}"
+    sp = xplane_pb2.XSpace()
+    with open(xs[-1], "rb") as f:
+        sp.ParseFromString(f.read())
+    return sp
+
+
+def top_ops(sp, n=25):
+    """Aggregate XLA op self-times on the TPU device plane."""
+    rows = []
+    for p in sp.planes:
+        if "TPU" not in p.name and "/device" not in p.name.lower():
+            continue
+        ev_meta = {m.id: m for m in p.event_metadata.values()}
+        st_meta = {m.id: m.name for m in p.stat_metadata.values()}
+        for line in p.lines:
+            if line.name not in ("XLA Ops", "XLA TraceMe", "Steps"):
+                if "XLA Ops" != line.name:
+                    continue
+            agg = collections.defaultdict(lambda: [0.0, 0])
+            for e in line.events:
+                md = ev_meta.get(e.metadata_id)
+                name = md.name if md else str(e.metadata_id)
+                cat = ""
+                for s in list(md.stats if md else []) + list(e.stats):
+                    if st_meta.get(s.metadata_id) == "hlo_category":
+                        cat = s.str_value or s.ref_value
+                key = (name, cat)
+                agg[key][0] += e.duration_ps / 1e9   # -> ms
+                agg[key][1] += 1
+            total = sum(v[0] for v in agg.values())
+            rows.append((p.name, line.name, total, agg))
+    for pname, lname, total, agg in rows:
+        print(f"\n== {pname} / {lname}: total {total:.3f} ms")
+        by_cat = collections.defaultdict(float)
+        for (nm, cat), (ms, cnt) in agg.items():
+            by_cat[cat or "?"] += ms
+        print("-- by category:")
+        for cat, ms in sorted(by_cat.items(), key=lambda x: -x[1]):
+            print(f"   {cat:<30} {ms:9.3f} ms  {100*ms/max(total,1e-9):5.1f}%")
+        print("-- top ops:")
+        for (nm, cat), (ms, cnt) in sorted(agg.items(),
+                                           key=lambda x: -x[1][0])[:n]:
+            print(f"   {ms:9.3f} ms x{cnt:<4} [{cat:<18}] {nm[:90]}")
+
+
+if __name__ == "__main__":
+    sp = load(sys.argv[1] if len(sys.argv) > 1 else "/tmp/jax_trace")
+    top_ops(sp, int(sys.argv[2]) if len(sys.argv) > 2 else 25)
