@@ -1,0 +1,140 @@
+"""GAN on synthetic 2-D data — the alternating-optimization demo.
+
+Reference: v1_api_demo/gan/gan_trainer.py + gan_conf.py — two gradient
+machines (generator stack and discriminator stack) sharing parameters by
+name, with the *other* network's weights marked is_static in each
+machine, trained alternately.
+
+TPU-native shape: two topologies over ONE shared Parameters object.
+  d_trainer: sample -> D -> real/fake cross-entropy (G not in graph).
+  g_trainer: noise -> G -> D(static) -> cross-entropy against "real".
+Parameter sharing is by explicit param names; freezing is
+attr.Param(is_static=True) (the optimizer skips static params). The
+alternation drives SGD.train_batch — the step-level API standing in for
+the reference's per-machine forwardBackward.
+
+Run: python demo/gan/gan_trainer.py [--passes N]
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+NZ = 10          # noise dimension
+
+
+def _attr(name, static):
+    return paddle.attr.Param(name=name, is_static=static,
+                             initial_std=0.1)
+
+
+def generator(z, static=False):
+    """noise [b, NZ] -> fake sample [b, 2] (gan_conf.py generator)."""
+    h = paddle.layer.fc(z, size=64, act=paddle.activation.Relu(),
+                        param_attr=_attr("g_h1.w", static),
+                        bias_attr=_attr("g_h1.b", static))
+    h = paddle.layer.fc(h, size=64, act=paddle.activation.Relu(),
+                        param_attr=_attr("g_h2.w", static),
+                        bias_attr=_attr("g_h2.b", static))
+    return paddle.layer.fc(h, size=2, act=None,
+                           param_attr=_attr("g_out.w", static),
+                           bias_attr=_attr("g_out.b", static))
+
+
+def discriminator(x, static=False):
+    """sample [b, 2] -> P(real) over 2 classes (gan_conf.py
+    discriminator)."""
+    h = paddle.layer.fc(x, size=64, act=paddle.activation.Relu(),
+                        param_attr=_attr("d_h1.w", static),
+                        bias_attr=_attr("d_h1.b", static))
+    h = paddle.layer.fc(h, size=64, act=paddle.activation.Relu(),
+                        param_attr=_attr("d_h2.w", static),
+                        bias_attr=_attr("d_h2.b", static))
+    return paddle.layer.fc(h, size=2, act=paddle.activation.Softmax(),
+                           param_attr=_attr("d_out.w", static),
+                           bias_attr=_attr("d_out.b", static))
+
+
+def build_trainers(lr=1e-3):
+    from paddle_tpu.core import registry
+    registry.reset_name_counters()
+
+    # discriminator machine: D trainable, G absent
+    sample = paddle.layer.data("sample", paddle.data_type.dense_vector(2))
+    d_label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+    d_cost = paddle.layer.classification_cost(
+        discriminator(sample, static=False), d_label, name="d_cost")
+
+    # generator machine: G trainable, D frozen (is_static)
+    noise = paddle.layer.data("noise", paddle.data_type.dense_vector(NZ))
+    g_label = paddle.layer.data("glabel", paddle.data_type.integer_value(2))
+    fake = generator(noise, static=False)
+    g_cost = paddle.layer.classification_cost(
+        discriminator(fake, static=True), g_label, name="g_cost")
+
+    params = paddle.create_parameters(paddle.Topology(d_cost))
+    d_trainer = paddle.SGD(cost=d_cost, parameters=params,
+                           update_equation=paddle.optimizer.Adam(
+                               learning_rate=lr, beta1=0.5))
+    # same Parameters object: SGD fills the G params in, D params shared
+    g_trainer = paddle.SGD(cost=g_cost, parameters=params,
+                           update_equation=paddle.optimizer.Adam(
+                               learning_rate=lr, beta1=0.5))
+    return d_trainer, g_trainer, fake, params
+
+
+def real_batch(rng, n):
+    """The target distribution: N(mean=[1, -1], cov=diag(0.5, 0.3))."""
+    return (rng.randn(n, 2) * np.array([0.5, 0.3]) +
+            np.array([1.0, -1.0])).astype("float32")
+
+
+def fake_batch(g_trainer, fake_node, params, rng, n):
+    z = rng.randn(n, NZ).astype("float32")
+    out = paddle.infer(output_layer=fake_node, parameters=params,
+                      input=[(z[i],) for i in range(n)])
+    return np.asarray(out), z
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=30)
+    ap.add_argument("--batch_size", type=int, default=128)
+    ap.add_argument("--batches_per_pass", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    paddle.init(seed=0)
+    d_trainer, g_trainer, fake_node, params = build_trainers()
+    rng = np.random.RandomState(0)
+    n = args.batch_size
+
+    d_hist, g_hist = [], []
+    for p in range(args.passes):
+        for _ in range(args.batches_per_pass):
+            # --- discriminator step on real(1) + fake(0) ---------------
+            fake, _ = fake_batch(g_trainer, fake_node, params, rng, n)
+            real = real_batch(rng, n)
+            xs = np.concatenate([real, fake])
+            ys = np.array([1] * n + [0] * n, np.int32)
+            order = rng.permutation(2 * n)
+            d_batch = [(xs[i], int(ys[i])) for i in order]
+            d_loss, _ = d_trainer.train_batch(d_batch)
+            # --- generator step: fool D (labels all "real") ------------
+            z = rng.randn(n, NZ).astype("float32")
+            g_batch = [(z[i], 1) for i in range(n)]
+            g_loss, _ = g_trainer.train_batch(g_batch)
+        d_hist.append(d_loss)
+        g_hist.append(g_loss)
+        fake, _ = fake_batch(g_trainer, fake_node, params, rng, 256)
+        print(f"pass {p}: d_loss={d_loss:.4f} g_loss={g_loss:.4f} "
+              f"fake_mean={fake.mean(0).round(3)} "
+              f"fake_std={fake.std(0).round(3)}", flush=True)
+    return d_hist, g_hist
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)

@@ -347,6 +347,41 @@ class SGD:
             if checkpoint_manager is not None:
                 self.save_checkpoint(checkpoint_manager)
 
+    def _own_params(self):
+        """This topology's parameter subset. Parameters may be SHARED
+        across trainers (GAN-style alternating optimization: two SGDs,
+        one Parameters object); the jitted step and the optimizer must
+        only see/update the params this trainer's graph owns."""
+        raw = self.parameters.raw
+        return {k: raw[k] for k in self.topology.param_specs}
+
+    def _merge_params(self, new_params):
+        merged = dict(self.parameters.raw)
+        merged.update(new_params)
+        self.parameters.replace(merged)
+
+    def train_batch(self, data_batch, feeding=None):
+        """Run ONE optimizer step on a batch (list of sample tuples) and
+        return (cost, metrics).
+
+        The step-level API alternating-optimization setups need (the v1
+        GAN demo drove GradientMachine.forwardBackward per network;
+        here two SGD instances sharing one Parameters object call
+        train_batch in turn — see demo/gan)."""
+        from paddle_tpu.trainer.data_feeder import DataFeeder
+        feeder = DataFeeder(self.topology.data_type(), feeding)
+        feed = feeder(data_batch)
+        n_real = jnp.asarray(feed.pop("__batch_size__"), jnp.int32)
+        self._rng, sub = jax.random.split(self._rng)
+        (new_params, self.opt_state, new_state, loss, metrics,
+         eval_outs) = self._train_step(
+            self._own_params(), self.opt_state, self.parameters.state,
+            feed, sub, n_real)
+        self._merge_params(new_params)
+        self.parameters.state = new_state
+        self._step_count += 1
+        return float(loss), {k: float(v) for k, v in metrics.items()}
+
     def _run_pass(self, pass_id, reader, feeder, event_handler,
                   num_batches_per_pass, checkpoint_manager=None,
                   checkpoint_period: int = 0):
@@ -366,9 +401,9 @@ class SGD:
             with stat_timer("train_step"):
                 (new_params, self.opt_state, new_state, loss,
                  metrics, eval_outs) = self._train_step(
-                    self.parameters.raw, self.opt_state,
+                    self._own_params(), self.opt_state,
                     self.parameters.state, feed, sub, n_real)
-            self.parameters.replace(new_params)
+            self._merge_params(new_params)
             self.parameters.state = new_state
             self._step_count += 1
             metrics_np = {k: float(v) for k, v in metrics.items()}
@@ -392,7 +427,7 @@ class SGD:
         feeder = DataFeeder(self.topology.data_type(), feeding)
         totals: Dict[str, float] = {}
         total_loss, n = 0.0, 0
-        params = self.optimizer.test_params(self.parameters.raw,
+        params = self.optimizer.test_params(self._own_params(),
                                             self.opt_state)
         # test() may run mid-pass (from an EndIteration handler): save the
         # evaluators' training accumulators and restore them afterwards so
