@@ -14,3 +14,5 @@ __all__ = ["mesh_mod", "create_mesh", "data_parallel_mesh", "DP_AXIS",
 from paddle_tpu.parallel.multihost import (init_distributed,  # noqa: F401
                                            process_reader, global_batch,
                                            is_coordinator)
+from paddle_tpu.parallel.async_sgd import (AsyncSGDIsland,  # noqa: F401
+                                           average_pytree, average_local)

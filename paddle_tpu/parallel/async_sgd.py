@@ -1,0 +1,109 @@
+"""Asynchronous data parallelism, TPU-native form: local SGD islands.
+
+Reference: the async-SGD path — `ParameterServer2::asyncSGD`
+(paddle/pserver/ParameterServer2.cpp:457) and the Go pserver's
+barrier-free `SendGrad` (go/pserver/service.go:221) — lets each trainer
+push gradients and fetch parameters WITHOUT waiting for its peers, with
+`max_async_count` bounding staleness. The payoff is straggler tolerance;
+the price is stale gradients.
+
+On TPU the intra-slice case is moot: the synchronous in-program
+all-reduce over ICI is faster than any parameter-server hop, so "async
+within a slice" would be a de-optimization. The case that survives is
+ACROSS loosely-coupled workers (separate hosts/processes over DCN,
+preemptible pools): there, the modern equivalent of async SGD is
+**local SGD** — every island steps independently on its own shard
+(parameters allowed to drift = bounded staleness), and islands
+periodically reconcile by averaging parameters instead of streaming
+per-step gradients through a server. Same tolerance property, no server,
+and the reconciliation is one collective.
+
+Two surfaces:
+
+- `average_pytree(tree)` — cross-PROCESS parameter averaging (the
+  reconciliation collective), built on multihost allgather; identity in
+  single-process runs.
+- `AsyncSGDIsland(trainer, sync_period)` — wraps an SGD trainer; its
+  `train_batch` counts local steps and reconciles every `sync_period`
+  (max_async_count parity: the drift bound). Works per-process (each
+  process owns one island) or with several islands in one process
+  (testing / simulation), via `sync_group=` a list of Parameters to
+  average with.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def average_pytree(tree):
+    """Average a pytree of arrays across all jax processes.
+
+    Every process must call this with the same structure (a collective).
+    Single-process: returns the tree unchanged."""
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    def avg(x):
+        g = multihost_utils.process_allgather(x)   # [P, ...]
+        return jnp.mean(g, axis=0).astype(x.dtype)
+
+    return jax.tree_util.tree_map(avg, tree)
+
+
+def average_local(trees: Sequence):
+    """Average parameter dicts of several in-process islands (the
+    simulation/test path; also useful for model soups)."""
+    out = []
+    keys = trees[0].keys()
+    for t in trees:
+        assert t.keys() == keys, "islands must share parameter names"
+    avg = {k: jnp.mean(jnp.stack([t[k] for t in trees]), axis=0)
+           for k in keys}
+    # each island gets an INDEPENDENT buffer: the jitted train step
+    # donates its parameter buffers, so sharing one array across islands
+    # would let island A's step delete island B's weights
+    return [{k: v.copy() for k, v in avg.items()} for _ in trees]
+
+
+class AsyncSGDIsland:
+    """Local-SGD wrapper: train independently, reconcile periodically.
+
+    trainer:      a paddle_tpu SGD instance (this island's)
+    sync_period:  local steps between reconciliations — the staleness
+                  bound (ParameterServer2's max_async_count role)
+    sync_group:   None = average across jax PROCESSES (each process one
+                  island); or a list of Parameters objects of sibling
+                  in-process islands (this trainer's included).
+    """
+
+    def __init__(self, trainer, sync_period: int = 8,
+                 sync_group: Optional[Sequence] = None):
+        assert sync_period >= 1
+        self.trainer = trainer
+        self.sync_period = sync_period
+        self.sync_group = sync_group
+        self._local_steps = 0
+
+    def train_batch(self, batch, feeding=None):
+        loss, metrics = self.trainer.train_batch(batch, feeding)
+        self._local_steps += 1
+        if self._local_steps % self.sync_period == 0:
+            self.reconcile()
+        return loss, metrics
+
+    def reconcile(self):
+        """Average parameters across the island group now."""
+        if self.sync_group is None:
+            self.trainer.parameters.replace(
+                average_pytree(self.trainer.parameters.raw))
+        else:
+            raws = [p.raw for p in self.sync_group]
+            averaged = average_local(raws)
+            for p, a in zip(self.sync_group, averaged):
+                p.replace(a)

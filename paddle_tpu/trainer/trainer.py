@@ -63,6 +63,18 @@ class SGD:
                                        for li in ev.inputs})
         self.topology = Topology(
             self.costs, extra_outputs=self.extra_layers + eval_inputs)
+        # validate evaluator inputs NOW: every name must be a graph node
+        # or a data (feed) layer of this topology — a typo'd name used to
+        # surface only at step time as a KeyError deep in the jit
+        feed_names = {name for name, _ in self.topology.data_type()}
+        known = set(self.topology.by_name) | feed_names
+        for ev in self.evaluators:
+            for li in ev.inputs:
+                if li.name not in known:
+                    raise ValueError(
+                        f"evaluator {ev.name!r} input {li.name!r} is "
+                        "neither a layer in this topology nor one of its "
+                        f"data layers {sorted(feed_names)}")
         self.parameters = parameters
         # ensure state entries exist (parameters.create fills them, but a
         # Parameters loaded from tar may lack new state keys)

@@ -9,7 +9,10 @@ assembled with multihost.global_batch. Prints one line per step:
 ``STEP <i> <loss>`` — the parent asserts both processes printed the same
 losses.
 
-Usage: python multihost_worker.py <coordinator_port> <process_id>
+Usage: python multihost_worker.py <coordinator_port> <process_id> [mode]
+mode: "sync" (default, dp over a global mesh) or "async" (local-SGD
+islands: each process trains alone, reconciling by parameter averaging
+every few steps — parallel/async_sgd.py, the pserver asyncSGD parity).
 """
 
 import os
@@ -28,6 +31,7 @@ jax.config.update("jax_platforms", "cpu")
 
 def main():
     port, pid = int(sys.argv[1]), int(sys.argv[2])
+    mode = sys.argv[3] if len(sys.argv) > 3 else "sync"
     from paddle_tpu.parallel import (global_batch, init_distributed,
                                      is_coordinator, process_reader)
     from paddle_tpu.parallel.mesh import DP_AXIS, batch_sharding, create_mesh
@@ -37,6 +41,9 @@ def main():
     assert (pi, pc) == (pid, 2), (pi, pc)
     assert is_coordinator() == (pid == 0)
     assert len(jax.devices()) == 8, jax.devices()
+
+    if mode == "async":
+        return async_main(pid)
 
     mesh = create_mesh([(DP_AXIS, 8)])
     sharding = batch_sharding(mesh)
@@ -75,6 +82,39 @@ def main():
         w, loss = step(w, x, y)
         print(f"STEP {it} {float(loss):.10f}", flush=True)
 
+    jax.distributed.shutdown()
+
+
+def async_main(pid):
+    """Local-SGD islands across two real processes: train independently
+    on different shards, reconcile every 4 steps via average_pytree."""
+    from paddle_tpu.parallel import average_pytree
+    rng = np.random.RandomState(100 + pid)     # DIFFERENT data per island
+    w_true = np.random.RandomState(9).randn(4, 1).astype(np.float32)
+    w = jnp.zeros((4, 1), jnp.float32)
+
+    @jax.jit
+    def step(w, x, y):
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        return w - 0.2 * g, loss
+
+    first = last = None
+    for it in range(12):
+        x = jnp.asarray(rng.randn(32, 4).astype(np.float32))
+        y = x @ jnp.asarray(w_true)
+        w, loss = step(w, x, y)
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+        if (it + 1) % 4 == 0:
+            w = average_pytree(w)
+            # after reconciliation both islands hold identical weights
+            print(f"SYNCW {it} {float(jnp.sum(jnp.abs(w))):.8f}",
+                  flush=True)
+    print(f"STEP 0 {first:.10f}", flush=True)
+    print(f"STEP 1 {last:.10f}", flush=True)
     jax.distributed.shutdown()
 
 

@@ -245,3 +245,26 @@ class TestIntegration:
         res = tr.test(reader)
         assert "chunk_f1" in res.metrics
         assert res.metrics["chunk_f1"] > 0.9         # learnable rule
+
+
+def test_typod_evaluator_input_fails_at_construction():
+    """A wrong evaluator input name must fail when the SGD is built, not
+    as a KeyError deep inside the first jitted step."""
+    import pytest as _pytest
+    from paddle_tpu.core import registry
+    registry.reset_name_counters()
+    paddle.init(use_tpu=False, seed=0)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+    out = paddle.layer.fc(x, size=2, act=paddle.activation.Softmax())
+    lbl = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(out, lbl)
+    params = paddle.create_parameters(paddle.Topology(cost))
+
+    class NameOnly:
+        name = "labelz"          # typo: feed layer is "y"
+    ev = paddle.evaluator.classification_error(out, lbl)
+    ev.inputs = [out, NameOnly()]
+    with _pytest.raises(ValueError, match="labelz"):
+        paddle.SGD(cost=cost, parameters=params,
+                   update_equation=paddle.optimizer.Adam(1e-3),
+                   evaluators=[ev])

@@ -1,0 +1,110 @@
+"""Real-TPU smoke tests for the compiled Mosaic kernel paths.
+
+The regular suite runs every Pallas kernel in interpret mode on CPU;
+these tests exercise the COMPILED path on actual TPU hardware (the gap
+ADVICE round 2 flagged: interpret-only coverage can hide Mosaic
+compile/tiling failures). They self-skip off-TPU, so the CPU CI lane is
+unaffected; run the TPU lane with:
+
+    PADDLE_TPU_SMOKE=1 python -m pytest tests/test_tpu_smoke.py -q
+
+(the env var tells conftest.py to keep the real backend instead of the
+virtual 8-device CPU mesh).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _on_tpu(),
+                                reason="needs real TPU hardware")
+
+
+class TestFlashAttentionCompiled:
+    @pytest.mark.parametrize("tq,tk,d", [
+        (512, 512, 128),
+        (100, 100, 64),        # ragged T -> exercises block rounding/pad
+        (1024, 256, 128),      # cross lengths
+    ])
+    def test_forward_matches_reference(self, tq, tk, d):
+        from paddle_tpu.ops.pallas_attention import (_lens_mask, _reference,
+                                                     flash_attention)
+        rng = np.random.RandomState(0)
+        b, h = 2, 4
+        q = jnp.asarray(rng.randn(b, tq, h, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, tk, h, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, tk, h, d).astype(np.float32))
+        lens_q = jnp.asarray([tq, max(tq // 2, 1)], jnp.int32)
+        lens_k = jnp.asarray([tk, max(tk // 3, 1)], jnp.int32)
+        out = flash_attention(q, k, v, q_lens=lens_q, kv_lens=lens_k,
+                              causal=False)
+        mask = _lens_mask(lens_q, lens_k, tq, tk, False)
+        want = _reference(q, k, v, mask, d ** -0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_backward_matches_reference(self):
+        from paddle_tpu.ops.pallas_attention import (_lens_mask, _reference,
+                                                     flash_attention)
+        rng = np.random.RandomState(1)
+        b, t, h, d = 2, 256, 4, 128
+        q = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+        lens = jnp.asarray([t, t // 2], jnp.int32)
+
+        def f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, kv_lens=lens,
+                                           q_lens=lens, causal=True) ** 2)
+
+        mask = _lens_mask(lens, lens, t, t, True)
+
+        def r(q, k, v):
+            return jnp.sum(_reference(q, k, v, mask, d ** -0.5)
+                           .astype(jnp.float32) ** 2)
+
+        gf = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.jit(jax.grad(r, argnums=(0, 1, 2)))(q, k, v)
+        for a, b_ in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=5e-2, atol=5e-2)
+
+
+class TestLstmCompiled:
+    def test_train_step_matches_lax(self):
+        from paddle_tpu.ops import pallas_rnn
+        rng = np.random.RandomState(2)
+        b, T, h = 16, 12, 128
+        x4 = jnp.asarray(rng.randn(b, T, 4 * h).astype(np.float32) * 0.1)
+        w = jnp.asarray(rng.randn(h, 4 * h).astype(np.float32) * 0.1)
+        bias = jnp.asarray(rng.randn(4 * h).astype(np.float32) * 0.1)
+        lens = jnp.asarray(rng.randint(3, T + 1, b), jnp.int32)
+
+        def f(x4, w, bias):
+            out, hT, cT = pallas_rnn.lstm_sequence(x4, lens, w, bias, None)
+            return jnp.sum(out ** 2) + jnp.sum(hT) + jnp.sum(cT)
+
+        def r(x4, w, bias):
+            out, hT, cT = pallas_rnn._lstm_ref(
+                x4, lens.reshape(b, 1), w, bias.reshape(1, -1),
+                jnp.zeros((3, h)))
+            return jnp.sum(out ** 2) + jnp.sum(hT) + jnp.sum(cT)
+
+        vf, gf = jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))(
+            x4, w, bias)
+        vr, gr = jax.jit(jax.value_and_grad(r, argnums=(0, 1, 2)))(
+            x4, w, bias)
+        np.testing.assert_allclose(float(vf), float(vr), rtol=1e-3)
+        for a, b_ in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-2, atol=1e-3)
