@@ -196,6 +196,39 @@ def bench_lstm(batch: int, hidden: int, seq_len: int = 100,
     return _measure(trainer, feed, batch, iters, warmup)
 
 
+def bench_transformer(batch: int = 8, seq_len: int = 1024,
+                      d_model: int = 512, n_layers: int = 6,
+                      iters: int = 10, warmup: int = 3):
+    """Decoder-only LM train step (flash-attention path end-to-end).
+    No 2017 baseline exists; reported for the TPU-era model family."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+    from paddle_tpu.core.sequence import SequenceBatch
+
+    spec = models.transformer_lm(vocab_size=32000, d_model=d_model,
+                                 n_heads=8, n_layers=n_layers,
+                                 d_ff=4 * d_model, max_len=seq_len)
+    params = paddle.create_parameters(paddle.Topology(spec.cost))
+    trainer = paddle.SGD(cost=spec.cost, parameters=params,
+                         update_equation=paddle.optimizer.Adam(
+                             learning_rate=1e-4))
+    rng = np.random.RandomState(0)
+    lens = np.full((batch,), seq_len, np.int32)
+
+    def seq_feed(arr):
+        return SequenceBatch(jax.device_put(jnp.asarray(arr)),
+                             jax.device_put(jnp.asarray(lens)))
+
+    ids = rng.randint(0, 32000, (batch, seq_len + 1))
+    feed = {spec.data.name: seq_feed(ids[:, :-1].astype("int32")),
+            f"{'tfm'}_positions": seq_feed(
+                np.tile(np.arange(seq_len, dtype="int32"), (batch, 1))),
+            spec.label.name: seq_feed(ids[:, 1:].astype("int32"))}
+    return _measure(trainer, feed, batch, iters, warmup)
+
+
 def bench_flash_attention(batch: int = 4, seq_len: int = 4096, heads: int = 8,
                           head_dim: int = 128, iters: int = 20,
                           warmup: int = 3):
@@ -307,6 +340,8 @@ def main():
             "lstm_bs128_h1280", bench_lstm(128, 1280, iters=half))
         suite["flash_attention_t4096"] = _emit(
             "flash_attention_t4096", bench_flash_attention(iters=half))
+        suite["transformer_lm_bs8_t1024"] = _emit(
+            "transformer_lm_bs8_t1024", bench_transformer(iters=half))
 
     head = suite["alexnet_bs128"]
     print(json.dumps({

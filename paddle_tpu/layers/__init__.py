@@ -790,3 +790,9 @@ def switch_order(input, reshape_axis=None, height=None, width=None,
 
 
 switch_order_layer = switch_order
+
+
+def layer_norm(input, name=None, param_attr=None, **kw) -> LayerOutput:
+    """Per-position layer normalization (modern extra for the
+    transformer zoo)."""
+    return make_layer("layer_norm", name, [input], param_attr=param_attr)

@@ -142,7 +142,7 @@ class FCLayer:
             if isinstance(val, SequenceBatch):
                 ref = val
         if b is not None:
-            out = out + b
+            out = out + b.astype(out.dtype)   # f32 master bias: no promote
         mask = ref.mask() if ref is not None else None
         out = _apply_act(out, cfg.get("act", "linear"), mask)
         return ref.with_data(out) if ref is not None else out
@@ -230,7 +230,7 @@ class AddtoLayer:
         ref = next((v for v in inputs if isinstance(v, SequenceBatch)), None)
         out = sum(_payload(v) for v in inputs)
         if cfg.get("_bias_name"):
-            out = out + params[cfg["_bias_name"]]
+            out = out + params[cfg["_bias_name"]].astype(out.dtype)
         out = _apply_act(out, cfg.get("act", "linear"))
         return ref.with_data(out) if ref is not None else out
 
@@ -427,16 +427,30 @@ class TransLayer:
 
 @register_layer("slice")
 class SliceLayer:
-    """Feature slice [start, end) — identity_projection with offset."""
+    """Feature slice [start, end) — identity_projection with offset. On
+    an image input whose slice bounds fit the channel count, this is a
+    CHANNEL slice (the payload is 4D NHWC, so x[..., a:b] slices c) and
+    the image meta is preserved for downstream conv/pool layers."""
     @staticmethod
     def build(name, cfg, input_metas):
         m = input_metas[0]
-        return LayerMeta(size=cfg["end"] - cfg["start"],
-                         seq_level=m.seq_level), [], []
+        n = cfg["end"] - cfg["start"]
+        if m.channels and m.height and cfg["end"] <= m.channels:
+            cfg["_chan"] = (m.channels, m.height, m.width)
+            return LayerMeta(size=n * m.height * m.width, height=m.height,
+                             width=m.width, channels=n,
+                             seq_level=m.seq_level), [], []
+        return LayerMeta(size=n, seq_level=m.seq_level), [], []
 
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
-        return _map_seq(lambda x: x[..., cfg["start"]:cfg["end"]], inputs[0])
+        def cut(x):
+            if cfg.get("_chan") and x.ndim == 2:
+                from paddle_tpu.layers.conv_layers import ensure_nhwc
+                x = ensure_nhwc(x, *cfg["_chan"])
+            return x[..., cfg["start"]:cfg["end"]]
+
+        return _map_seq(cut, inputs[0])
 
 
 @register_layer("scaling_projection")

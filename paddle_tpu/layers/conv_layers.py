@@ -77,7 +77,8 @@ class ConvLayer:
                                 dilation=cfg.get("dilation", 1),
                                 groups=cfg.get("groups", 1))
         if cfg.get("_bias_name"):
-            y = y + params[cfg["_bias_name"]]
+            # f32 master bias must not promote the bf16 activation map
+            y = y + params[cfg["_bias_name"]].astype(y.dtype)
         return act_ops.get(cfg.get("act", "linear"))(y)
 
 
@@ -315,7 +316,7 @@ class Conv3DLayer:
                             stride=cfg.get("stride", 1),
                             padding=cfg.get("padding", 0))
         if cfg.get("_bias_name"):
-            y = y + params[cfg["_bias_name"]]
+            y = y + params[cfg["_bias_name"]].astype(y.dtype)
         return act_ops.get(cfg.get("act", "linear"))(y)
 
 
@@ -358,7 +359,7 @@ class DeConv3DLayer:
                                       stride=cfg.get("stride", 1),
                                       padding=cfg.get("padding", 0))
         if cfg.get("_bias_name"):
-            y = y + params[cfg["_bias_name"]]
+            y = y + params[cfg["_bias_name"]].astype(y.dtype)
         return act_ops.get(cfg.get("act", "linear"))(y)
 
 

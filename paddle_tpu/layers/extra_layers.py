@@ -11,6 +11,7 @@ linear_comb_layer, prelu_layer, row_l2_norm_layer, switch_order_layer).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core import initializers
@@ -52,7 +53,7 @@ class TensorLayer:
         e1, e2 = _payload(inputs[0]), _payload(inputs[1])
         out = jnp.einsum("...i,kij,...j->...k", e1, w, e2)
         if cfg.get("_bias_name"):
-            out = out + params[cfg["_bias_name"]]
+            out = out + params[cfg["_bias_name"]].astype(out.dtype)
         out = _apply_act(out, cfg.get("act", "linear"))
         ref = inputs[0]
         return ref.with_data(out) if hasattr(ref, "with_data") else out
@@ -139,8 +140,8 @@ class ParameterReluLayer:
         w = jnp.repeat(params[cfg["_w_name"]], cfg["_ps"])
 
         def act(x):
-            return jnp.where(x > 0, x, w.reshape((1,) * (x.ndim - 1) + (-1,))
-                             * x)
+            wx = w.reshape((1,) * (x.ndim - 1) + (-1,)).astype(x.dtype)
+            return jnp.where(x > 0, x, wx * x)
 
         return _map_seq(act, inputs[0])
 
@@ -183,3 +184,40 @@ class SwitchOrderLayer:
     def apply(ctx, name, cfg, params, inputs):
         x = ensure_nhwc(inputs[0], cfg["_ic"], cfg["_ih"], cfg["_iw"])
         return x.reshape(x.shape[0], -1)
+
+
+@register_layer("layer_norm")
+class LayerNormLayer:
+    """Per-position layer normalization with learned gain/bias — the
+    modern extra the transformer zoo needs (not in the 2017 reference;
+    compute in ops/norm.layer_norm). Statistics in f32, the normalized
+    map emitted in the activation dtype (mixed-precision policy)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        a = ParamAttr.of(cfg.get("param_attr"))
+        gname = a.name or f"_{name}.w0"
+        bname = f"_{name}.wbias"
+        cfg["_g_name"], cfg["_b_name"] = gname, bname
+        specs = [ParamSpec(gname, (m.size,), initializers.ones, a),
+                 ParamSpec(bname, (m.size,), initializers.zeros,
+                           ParamAttr())]
+        return LayerMeta(size=m.size, seq_level=m.seq_level), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        g = params[cfg["_g_name"]]
+        b = params[cfg["_b_name"]]
+
+        def norm(x):
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=-1, keepdims=True)
+            var = jnp.maximum(
+                jnp.mean(xf * xf, axis=-1, keepdims=True) - mean * mean,
+                0.0)
+            inv = jax.lax.rsqrt(var + 1e-5)
+            y = (xf - mean) * inv
+            return (y * g + b).astype(x.dtype)
+
+        return _map_seq(norm, inputs[0])

@@ -20,3 +20,4 @@ __all__ = [
     "convolution_net", "ngram_lm", "nmt_attention", "nmt_generator",
     "wide_and_deep", "movielens_regression", "crf_tagger", "rnn_crf_tagger",
 ]
+from paddle_tpu.models.transformer import transformer_lm  # noqa: F401

@@ -131,14 +131,18 @@ def vgg16(height: int = 224, width: int = 224, channels: int = 3,
 
 
 def _inception(name, input, f1, f3r, f3, f5r, f5, proj):
-    c1 = layer.img_conv(input, filter_size=1, num_filters=f1, act=act.Relu(),
-                        name=f"{name}_1x1")
-    c3r = layer.img_conv(input, filter_size=1, num_filters=f3r,
-                         act=act.Relu(), name=f"{name}_3x3r")
+    # the three 1x1 branches (direct, 3x3-reducer, 5x5-reducer) merge
+    # into ONE wide 1x1 conv + channel slices: same math, but the block
+    # input is read from HBM once instead of three times and the merged
+    # matmul has 3x the N dim for the MXU (inception blocks are
+    # bandwidth-bound at these channel counts)
+    c1x1 = layer.img_conv(input, filter_size=1, num_filters=f1 + f3r + f5r,
+                          act=act.Relu(), name=f"{name}_1x1s")
+    c1 = layer.slice_projection(c1x1, 0, f1)
+    c3r = layer.slice_projection(c1x1, f1, f1 + f3r)
+    c5r = layer.slice_projection(c1x1, f1 + f3r, f1 + f3r + f5r)
     c3 = layer.img_conv(c3r, filter_size=3, num_filters=f3, padding=1,
                         act=act.Relu(), name=f"{name}_3x3")
-    c5r = layer.img_conv(input, filter_size=1, num_filters=f5r,
-                         act=act.Relu(), name=f"{name}_5x5r")
     c5 = layer.img_conv(c5r, filter_size=5, num_filters=f5, padding=2,
                         act=act.Relu(), name=f"{name}_5x5")
     mp = layer.img_pool(input, pool_size=3, stride=1, padding=1,

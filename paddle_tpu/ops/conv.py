@@ -37,7 +37,9 @@ def conv2d(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0,
     ph, pw = _pair(padding)
     dh, dw = _pair(dilation)
     cd = compute_dtype()
-    out_dtype = x.dtype
+    # mixed precision: output follows the compute dtype, not a (possibly
+    # f32) input — same policy as ops/linear.matmul
+    out_dtype = x.dtype if cd == jnp.float32 else cd
     # On the bf16 path we must NOT pass preferred_element_type: the conv
     # VJP rule can't transpose mixed (bf16 operand, f32 cotangent) convs.
     # The MXU accumulates bf16 passes in f32 internally either way.
@@ -65,7 +67,9 @@ def conv2d_transpose(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0) -> 
     ph, pw = _pair(padding)
     kh, kw = w.shape[0], w.shape[1]
     cd = compute_dtype()
-    out_dtype = x.dtype
+    # mixed precision: output follows the compute dtype, not a (possibly
+    # f32) input — same policy as ops/linear.matmul
+    out_dtype = x.dtype if cd == jnp.float32 else cd
     pet = jnp.float32 if cd == jnp.float32 else None
     if cd != jnp.float32:
         x = x.astype(cd)
@@ -89,7 +93,9 @@ def conv3d(x: jnp.ndarray, w: jnp.ndarray, *, stride=1, padding=0) -> jnp.ndarra
         padding = (padding,) * 3
     pads = tuple((p, p) for p in padding)
     cd = compute_dtype()
-    out_dtype = x.dtype
+    # mixed precision: output follows the compute dtype, not a (possibly
+    # f32) input — same policy as ops/linear.matmul
+    out_dtype = x.dtype if cd == jnp.float32 else cd
     pet = jnp.float32 if cd == jnp.float32 else None
     if cd != jnp.float32:
         x = x.astype(cd)
@@ -153,7 +159,9 @@ def conv3d_transpose(x: jnp.ndarray, w: jnp.ndarray, *, stride=1,
     pads = tuple((k[i] - 1 - padding[i], k[i] - 1 - padding[i])
                  for i in range(3))
     cd = compute_dtype()
-    out_dtype = x.dtype
+    # mixed precision: output follows the compute dtype, not a (possibly
+    # f32) input — same policy as ops/linear.matmul
+    out_dtype = x.dtype if cd == jnp.float32 else cd
     pet = jnp.float32 if cd == jnp.float32 else None
     if cd != jnp.float32:
         x = x.astype(cd)

@@ -29,12 +29,17 @@ def matmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     compute_dtype bfloat16 -> cast inputs, single fast MXU pass.
     """
     cd = compute_dtype()
-    out_dtype = jnp.promote_types(a.dtype, b.dtype)
     if cd != jnp.float32:
+        # mixed precision: activations stay in the compute dtype — f32
+        # master weights must NOT promote the output (a bf16 x @ f32 w
+        # promoting to f32 silently ran every elementwise chain after
+        # every fc in f32, doubling HBM traffic; see docs/perf.md)
+        out_dtype = cd
         a = a.astype(cd)
         b = b.astype(cd)
         prec = None
     else:
+        out_dtype = jnp.promote_types(a.dtype, b.dtype)
         prec = jax.lax.Precision.HIGHEST
     return jnp.matmul(a, b, precision=prec,
                       preferred_element_type=jnp.float32).astype(out_dtype)
@@ -44,7 +49,7 @@ def fc(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray] = None) -> jnp.n
     """x: [..., in], w: [in, out], b: [out]."""
     y = matmul(x, w)
     if b is not None:
-        y = y + b
+        y = y + b.astype(y.dtype)   # f32 master bias must not promote y
     return y
 
 

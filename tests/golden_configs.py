@@ -288,6 +288,41 @@ def word_embedding_ngram():
     return L.fc(h, size=1000, act=A.Softmax(), name="next_word")
 
 
+def extra_algebra_layers():
+    """Round-3 zoo additions: tensor, conv_shift, linear_comb, prelu,
+    row_l2_norm, switch_order."""
+    a = L.data("a", D.dense_vector(6))
+    b = L.data("b", D.dense_vector(5))
+    t = L.tensor(a, b, size=4, act=A.Tanh(), name="bilinear1")
+    shift = L.data("shift", D.dense_vector(3))
+    cs = L.conv_shift(L.prelu(t, partial_sum=2, name="prelu1"), shift,
+                      name="cshift1")
+    lc = L.linear_comb(cs, L.fc(a, size=4 * 3, name="vecs"), name="lc1")
+    return L.row_l2_norm(lc, name="rl2n1")
+
+
+def switch_order_net():
+    img = L.data("im", D.dense_vector(2 * 4 * 4), height=4, width=4)
+    c = L.img_conv(img, filter_size=3, num_filters=3, padding=1,
+                   name="so_conv")
+    sw = L.switch_order(c, name="switch1")
+    return L.fc(sw, size=5, name="so_fc")
+
+
+def beam_cost_net():
+    """Learning-to-search: kmax over level-1 and nested scores feeding
+    cross_entropy_over_beam."""
+    s1 = L.data("s1", D.dense_vector_sequence(1))
+    s2 = L.data("s2", D.dense_vector_sub_sequence(1))
+    sel1 = L.kmax_seq_score(s1, beam_size=2, name="sel1")
+    sel2 = L.kmax_seq_score(s2, beam_size=2, name="sel2")
+    g1 = L.data("g1", D.integer_value(100))
+    g2 = L.data("g2", D.integer_value(100))
+    return L.cross_entropy_over_beam(
+        [L.BeamInput(s1, sel1, g1), L.BeamInput(s2, sel2, g2)],
+        name="beam_ce")
+
+
 CONFIGS = {
     "simple_fc": simple_fc,
     "img_layers": img_layers,
@@ -315,4 +350,7 @@ CONFIGS = {
     "generation_helpers": generation_helpers,
     "deep_speech_row_conv": deep_speech_row_conv,
     "word_embedding_ngram": word_embedding_ngram,
+    "extra_algebra_layers": extra_algebra_layers,
+    "switch_order_net": switch_order_net,
+    "beam_cost_net": beam_cost_net,
 }

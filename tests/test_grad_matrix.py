@@ -314,6 +314,8 @@ CONFIGS = {
         L.switch_order(L.img_conv(x, filter_size=1, num_filters=2)), f))(
         *image(rng, h=3, w=4)),
     "cross_entropy_over_beam": lambda rng: _beam_cost_cfg(rng),
+    "layer_norm": lambda rng: (lambda x, f: (
+        L.layer_norm(weighted(x)), f))(*dense(rng)),
 }
 
 

@@ -159,3 +159,33 @@ class TestTagger:
     def test_rnn_crf_tagger_forward(self):
         _forward_only(M.rnn_crf_tagger(vocab_size=50, num_labels=5,
                                        emb_size=8, hidden_size=8))
+
+
+class TestTransformerLM:
+    def test_trains_and_uses_attention(self):
+        from paddle_tpu import models
+        from paddle_tpu.core import registry
+        registry.reset_name_counters()
+        paddle.init(seed=0)
+        spec = models.transformer_lm(vocab_size=50, d_model=32, n_heads=4,
+                                     n_layers=2, d_ff=64, max_len=32)
+        params = paddle.create_parameters(paddle.Topology(spec.cost))
+        tr = paddle.SGD(cost=spec.cost, parameters=params,
+                        update_equation=paddle.optimizer.Adam(
+                            learning_rate=3e-3))
+        rng = np.random.RandomState(0)
+
+        def batch(b=8, T=12):
+            rows = []
+            for _ in range(b):
+                # learnable pattern: next token = (tok + 1) % 50
+                start = rng.randint(0, 50)
+                ids = [(start + i) % 50 for i in range(T + 1)]
+                rows.append((ids[:T], list(range(T)), ids[1:]))
+            return rows
+
+        first = None
+        for _ in range(30):
+            loss, _ = tr.train_batch(batch())
+            first = first if first is not None else loss
+        assert loss < first * 0.8, (first, loss)

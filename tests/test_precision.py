@@ -6,6 +6,7 @@ benchmark mode. These tests pin: numeric sanity of the cast matmul/conv
 path, parameters staying f32, and a model actually training under it.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -27,11 +28,14 @@ def test_matmul_bf16_accumulates_f32(bf16_mode):
     rng = np.random.RandomState(0)
     a = rng.randn(64, 256).astype("float32")
     b = rng.randn(256, 128).astype("float32")
-    y = np.asarray(linear.matmul(a, b))
-    assert y.dtype == np.float32
+    out = linear.matmul(a, b)
+    # mixed-precision policy: activations come back in the compute dtype
+    # (f32 master weights must not promote the activation graph)
+    assert out.dtype == jnp.bfloat16
+    y = np.asarray(out.astype(jnp.float32))
     ref = a @ b
-    # bf16 has ~8 mantissa bits; relative error per dot of length 256
-    # with f32 accumulation stays well under 2%.
+    # bf16 has ~8 mantissa bits; f32 accumulation + one bf16 output
+    # rounding keeps mean relative error well under 2%.
     err = np.abs(y - ref) / (np.abs(ref) + 1e-3)
     assert float(err.mean()) < 0.02
 
@@ -41,11 +45,11 @@ def test_conv_bf16_close_to_f32(bf16_mode):
     rng = np.random.RandomState(1)
     x = rng.randn(2, 16, 16, 8).astype("float32")
     w = rng.randn(3, 3, 8, 16).astype("float32")
-    y16 = np.asarray(conv.conv2d(x, w, stride=1, padding=1))
+    out16 = conv.conv2d(x, w, stride=1, padding=1)
     global_config().compute_dtype = "float32"
     y32 = np.asarray(conv.conv2d(x, w, stride=1, padding=1))
     global_config().compute_dtype = "bfloat16"
-    assert y16.dtype == np.float32
+    y16 = np.asarray(out16.astype(jnp.float32))
     # bf16 inputs, f32 accumulation: mean relative error ~1.5% on N(0,1)
     # data (relative error blows up only where the output is near zero).
     rel = np.abs(y16 - y32) / (np.abs(y32) + 1e-1)
