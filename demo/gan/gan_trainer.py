@@ -137,4 +137,6 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    sys.exit(0 if main() else 1)
+    d_hist, g_hist = main()
+    ok = np.isfinite(d_hist).all() and np.isfinite(g_hist).all()
+    sys.exit(0 if ok else 1)

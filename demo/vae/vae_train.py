@@ -106,4 +106,5 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    sys.exit(0 if main() else 1)
+    hist = main()
+    sys.exit(0 if (np.isfinite(hist).all() and hist[-1] < hist[0]) else 1)

@@ -208,8 +208,10 @@ def identity_projection(input, offset: int = 0, size: Optional[int] = None, **kw
     return slice_projection(input, offset, offset + sz)
 
 
-def slice_projection(input, start: int, end: int, **kw) -> LayerOutput:
-    return make_layer("slice", None, [input], start=start, end=end)
+def slice_projection(input, start: int, end: int,
+                     channel_slice: bool = False, **kw) -> LayerOutput:
+    return make_layer("slice", None, [input], start=start, end=end,
+                      channel_slice=channel_slice)
 
 
 def table_projection(input, size: int, param_attr=None, **kw) -> LayerOutput:

@@ -427,15 +427,19 @@ class TransLayer:
 
 @register_layer("slice")
 class SliceLayer:
-    """Feature slice [start, end) — identity_projection with offset. On
-    an image input whose slice bounds fit the channel count, this is a
-    CHANNEL slice (the payload is 4D NHWC, so x[..., a:b] slices c) and
-    the image meta is preserved for downstream conv/pool layers."""
+    """Feature slice [start, end) — identity_projection with offset.
+    With channel_slice=True on an image input, [start, end) indexes
+    CHANNELS instead (the payload is 4D NHWC, so x[..., a:b] slices c)
+    and the image meta is preserved for downstream conv/pool layers —
+    opt-in so pre-existing flat-feature slices keep their semantics."""
     @staticmethod
     def build(name, cfg, input_metas):
         m = input_metas[0]
         n = cfg["end"] - cfg["start"]
-        if m.channels and m.height and cfg["end"] <= m.channels:
+        if cfg.get("channel_slice"):
+            assert m.channels and m.height and cfg["end"] <= m.channels, \
+                f"channel_slice needs an image input with >= {cfg['end']} " \
+                "channels"
             cfg["_chan"] = (m.channels, m.height, m.width)
             return LayerMeta(size=n * m.height * m.width, height=m.height,
                              width=m.width, channels=n,

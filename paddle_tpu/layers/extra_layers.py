@@ -158,8 +158,11 @@ class RowL2NormLayer:
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
         def norm(x):
-            return x / jnp.sqrt(jnp.sum(jnp.square(x), axis=-1,
-                                        keepdims=True))
+            # eps guard: all-zero rows (padded sequence steps) must give
+            # 0, not 0/0 = NaN (codebase convention, cf. cos_sim)
+            return x / jnp.maximum(
+                jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True)),
+                1e-12)
 
         return _map_seq(norm, inputs[0])
 

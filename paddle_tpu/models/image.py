@@ -138,9 +138,10 @@ def _inception(name, input, f1, f3r, f3, f5r, f5, proj):
     # bandwidth-bound at these channel counts)
     c1x1 = layer.img_conv(input, filter_size=1, num_filters=f1 + f3r + f5r,
                           act=act.Relu(), name=f"{name}_1x1s")
-    c1 = layer.slice_projection(c1x1, 0, f1)
-    c3r = layer.slice_projection(c1x1, f1, f1 + f3r)
-    c5r = layer.slice_projection(c1x1, f1 + f3r, f1 + f3r + f5r)
+    c1 = layer.slice_projection(c1x1, 0, f1, channel_slice=True)
+    c3r = layer.slice_projection(c1x1, f1, f1 + f3r, channel_slice=True)
+    c5r = layer.slice_projection(c1x1, f1 + f3r, f1 + f3r + f5r,
+                                 channel_slice=True)
     c3 = layer.img_conv(c3r, filter_size=3, num_filters=f3, padding=1,
                         act=act.Relu(), name=f"{name}_3x3")
     c5 = layer.img_conv(c5r, filter_size=5, num_filters=f5, padding=2,

@@ -11,10 +11,13 @@ update run across chips).
 
 Semantics match parallel/sequence_parallel.attention with a
 lengths+causal mask exactly (tests assert parity): padded K/V positions
-are ignored, q rows at/past their length return 0. The kernel is the
-PRIMAL path; under jax.grad the custom_vjp recomputes with the XLA
-reference, which IS quadratic in memory — long-sequence TRAINING should
-shard over the `sp` mesh axis (ring attention) instead, as the docs say.
+are ignored, q rows at/past their length return 0. Training is fused
+both directions (FlashAttention-2 style): the forward saves only the
+per-row logsumexp; the backward kernels recompute each block's softmax
+from it while streaming dq per q-block and dk/dv per k-block, so HBM
+stays linear in T in BOTH passes (2.4x XLA on the T=4096 train step;
+the round-2 version fell back to the quadratic XLA vjp). Beyond one
+chip, ring attention over the `sp` mesh axis shards the same math.
 
 Used automatically by the attention layer on TPU for tile-friendly
 shapes (head_dim % 8 == 0); `interpret=True` runs on CPU for tests.
@@ -33,9 +36,15 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
-                  acc_scr, m_scr, l_scr, *, scale, nk, block_q, block_k,
-                  causal):
+def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *refs,
+                  scale, nk, block_q, block_k, causal, save_lse):
+    # the logsumexp residual is only written on the training path; the
+    # primal/inference call skips the [bh, Tq, 128] f32 stream entirely
+    if save_lse:
+        lse_ref, acc_scr, m_scr, l_scr = refs
+    else:
+        acc_scr, m_scr, l_scr = refs
+        lse_ref = None
     j = pl.program_id(1)
     kk = pl.program_id(2)
 
@@ -97,17 +106,19 @@ def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
         l = l_scr[:][:, 0:1]
         out_ref[0] = jnp.where(l > 0.0, acc_scr[:] / jnp.maximum(l, 1e-30),
                                0.0).astype(out_ref.dtype)
-        # logsumexp per row — the backward's softmax residual
-        m = m_scr[:][:, 0:1]
-        lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)),
-                        NEG_INF)
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        if save_lse:
+            # logsumexp per row — the backward's softmax residual
+            m = m_scr[:][:, 0:1]
+            lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)),
+                            NEG_INF)
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _flash_call(q3, k3, v3, lens2, *, scale, block_q, block_k, causal,
-                interpret):
+                interpret, save_lse=True):
     """q3: [bh, Tq, d]; k3/v3: [bh, Tk, d]; lens2: [bh, 2] int32
-    (q_len, kv_len per row). Returns (out, lse[bh, Tq, 128])."""
+    (q_len, kv_len per row). Returns (out, lse[bh, Tq, 128]) with
+    save_lse, else just out."""
     bh, tq, d = q3.shape
     tk = k3.shape[1]
     nq = tq // block_q
@@ -115,8 +126,12 @@ def _flash_call(q3, k3, v3, lens2, *, scale, block_q, block_k, causal,
 
     kernel = functools.partial(_flash_kernel, scale=scale, nk=nk,
                                block_q=block_q, block_k=block_k,
-                               causal=causal)
-    return pl.pallas_call(
+                               causal=causal, save_lse=save_lse)
+    lse_specs = [pl.BlockSpec((1, block_q, 128),
+                              lambda i, j, kk: (i, j, 0))] if save_lse else []
+    lse_shapes = [jax.ShapeDtypeStruct((bh, tq, 128), jnp.float32)] \
+        if save_lse else []
+    outs = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -127,12 +142,10 @@ def _flash_call(q3, k3, v3, lens2, *, scale, block_q, block_k, causal,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda i, j, kk: (i, j, 0)),
-        ],
+        ] + lse_specs,
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, tq, 128), jnp.float32),
-        ],
+        ] + lse_shapes,
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -140,6 +153,7 @@ def _flash_call(q3, k3, v3, lens2, *, scale, block_q, block_k, causal,
         ],
         interpret=interpret,
     )(lens2, q3, k3, v3)
+    return outs if save_lse else (outs[0], None)
 
 
 # ---------------------------------------------------------------------------
@@ -358,7 +372,7 @@ def _flash(q, k, v, q_lens, kv_lens, causal, scale, block_q, block_k,
     out, _ = _flash_call(_to_heads(q), _to_heads(k), _to_heads(v), lens2,
                          scale=scale, block_q=block_q,
                          block_k=block_k, causal=causal,
-                         interpret=interpret)
+                         interpret=interpret, save_lse=False)
     return _from_heads(out, b, h)
 
 

@@ -55,9 +55,17 @@ def _mxu_dtype():
 # LSTM — forward kernel
 
 
-def _lstm_kernel(lens_ref, x4_ref, w_ref, b_ref, peep_ref,
-                 out_ref, cseq_ref, gates_ref, hT_ref, cT_ref,
-                 h_scr, c_scr):
+def _lstm_kernel(save_res, lens_ref, x4_ref, w_ref, b_ref, peep_ref,
+                 *refs):
+    # residual streams (c sequence + activated gates) exist only on the
+    # training path; the primal/inference call skips them so its HBM
+    # write traffic stays one h-stream wide
+    if save_res:
+        (out_ref, cseq_ref, gates_ref, hT_ref, cT_ref,
+         h_scr, c_scr) = refs
+    else:
+        out_ref, hT_ref, cT_ref, h_scr, c_scr = refs
+        cseq_ref = gates_ref = None
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -94,9 +102,10 @@ def _lstm_kernel(lens_ref, x4_ref, w_ref, b_ref, peep_ref,
     c_scr[:] = c_keep
     out_ref[0] = jnp.where(valid, h_new,
                            jnp.zeros_like(h_new)).astype(out_ref.dtype)
-    cseq_ref[0] = c_keep.astype(cseq_ref.dtype)
-    gates_ref[0] = jnp.concatenate([i_g, f_g, cand, o_g],
-                                   axis=-1).astype(gates_ref.dtype)
+    if save_res:
+        cseq_ref[0] = c_keep.astype(cseq_ref.dtype)
+        gates_ref[0] = jnp.concatenate([i_g, f_g, cand, o_g],
+                                       axis=-1).astype(gates_ref.dtype)
     hT_ref[:] = h_keep
     cT_ref[:] = c_keep
 
@@ -197,18 +206,29 @@ def _lstm_ref(x4, lens2d, w, bias2d, peep2d):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def _lstm_pallas(x4, lens2d, w, bias2d, peep2d, interpret):
-    out, hT, cT, _, _ = _lstm_fwd_call(x4, lens2d, w, bias2d, peep2d,
-                                       interpret)
+    out, hT, cT = _lstm_fwd_call(x4, lens2d, w, bias2d, peep2d,
+                                 interpret, save_res=False)
     return out, hT, cT
 
 
-def _lstm_fwd_call(x4, lens2d, w, bias2d, peep2d, interpret):
+def _lstm_fwd_call(x4, lens2d, w, bias2d, peep2d, interpret,
+                   save_res=True):
     b, T, four_h = x4.shape
     h = four_h // 4
     mxu = _mxu_dtype()
     xt = jnp.moveaxis(x4, 1, 0).astype(mxu)
-    out, cseq, gates, hT, cT = pl.pallas_call(
-        _lstm_kernel,
+    res_out_specs = [
+        pl.BlockSpec((1, b, h), lambda t: (t, 0, 0),
+                     memory_space=pltpu.VMEM),             # c seq
+        pl.BlockSpec((1, b, four_h), lambda t: (t, 0, 0),
+                     memory_space=pltpu.VMEM),             # gates
+    ] if save_res else []
+    res_out_shapes = [
+        jax.ShapeDtypeStruct((T, b, h), mxu),
+        jax.ShapeDtypeStruct((T, b, four_h), mxu),
+    ] if save_res else []
+    outs = pl.pallas_call(
+        functools.partial(_lstm_kernel, save_res),
         grid=(T,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),            # lens [b,1]
@@ -221,17 +241,13 @@ def _lstm_fwd_call(x4, lens2d, w, bias2d, peep2d, interpret):
         out_specs=[
             pl.BlockSpec((1, b, h), lambda t: (t, 0, 0),
                          memory_space=pltpu.VMEM),            # h seq
-            pl.BlockSpec((1, b, h), lambda t: (t, 0, 0),
-                         memory_space=pltpu.VMEM),            # c seq
-            pl.BlockSpec((1, b, four_h), lambda t: (t, 0, 0),
-                         memory_space=pltpu.VMEM),            # gates
+        ] + res_out_specs + [
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((T, b, h), mxu),     # h stream
-            jax.ShapeDtypeStruct((T, b, h), mxu),     # c stream (residual)
-            jax.ShapeDtypeStruct((T, b, four_h), mxu),
+        ] + res_out_shapes + [
             jax.ShapeDtypeStruct((b, h), jnp.float32),
             jax.ShapeDtypeStruct((b, h), jnp.float32),
         ],
@@ -243,12 +259,16 @@ def _lstm_fwd_call(x4, lens2d, w, bias2d, peep2d, interpret):
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(lens2d, xt, w.astype(mxu), bias2d, peep2d)
-    return jnp.moveaxis(out, 0, 1), hT, cT, cseq, gates
+    if save_res:
+        out, cseq, gates, hT, cT = outs
+        return jnp.moveaxis(out, 0, 1), hT, cT, cseq, gates
+    out, hT, cT = outs
+    return jnp.moveaxis(out, 0, 1), hT, cT
 
 
 def _lstm_fwd(x4, lens2d, w, bias2d, peep2d, interpret):
     out, hT, cT, cseq, gates = _lstm_fwd_call(x4, lens2d, w, bias2d, peep2d,
-                                              interpret)
+                                              interpret, save_res=True)
     res = (lens2d, w, peep2d, cseq, gates,
            jnp.moveaxis(out, 1, 0), jnp.zeros((0,), x4.dtype))
     return (out, hT, cT), res

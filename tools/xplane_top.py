@@ -30,9 +30,8 @@ def top_ops(sp, n=25):
         ev_meta = {m.id: m for m in p.event_metadata.values()}
         st_meta = {m.id: m.name for m in p.stat_metadata.values()}
         for line in p.lines:
-            if line.name not in ("XLA Ops", "XLA TraceMe", "Steps"):
-                if "XLA Ops" != line.name:
-                    continue
+            if line.name not in ("XLA Ops", "Steps"):
+                continue
             agg = collections.defaultdict(lambda: [0.0, 0])
             for e in line.events:
                 md = ev_meta.get(e.metadata_id)
