@@ -63,8 +63,46 @@ def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *refs,
     if causal:
         needed = needed & (kk * block_k <= j * block_q + block_q - 1)
 
-    @pl.when(needed)
-    def _block():
+    # an interior tile needs NO mask at all: every row is under q_len,
+    # every col under kv_len, and (causal) the whole tile sits at or
+    # below the diagonal — skipping the iota/compare/where VPU work there
+    # is the standard flash fast path (most tiles are interior)
+    interior = (j * block_q + block_q <= q_len) & \
+        (kk * block_k + block_k <= kv_len)
+    if causal:
+        interior = interior & (kk * block_k + block_k - 1 <= j * block_q)
+
+    def _online_update(s, p_mask, prec, v):
+        m_old = m_scr[:]                              # [bq, 128] (bcast)
+        s_max = jnp.max(s, axis=-1, keepdims=True)    # [bq, 1]
+        m_new = jnp.maximum(m_old, s_max)             # [bq, 128]
+        alpha = jnp.exp(m_old[:, 0:1] - m_new[:, 0:1])
+        p = jnp.exp(s - m_new[:, 0:1])                # [bq, bk]
+        if p_mask is not None:
+            # explicit zero on masked entries: with a finite NEG_INF, a
+            # row masked in EVERY block would otherwise see
+            # exp(s - m) == 1 junk
+            p = jnp.where(p_mask, p, 0.0)
+        l_new = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+            precision=prec)
+        m_scr[:] = m_new
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(needed & interior)
+    def _fast_block():
+        q = q_ref[0]                                  # [bq, d]
+        k = k_ref[0]                                  # [bk, d]
+        v = v_ref[0]                                  # [bk, d]
+        prec = jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else None
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=prec) * scale
+        _online_update(s, None, prec, v)
+
+    @pl.when(needed & ~interior)
+    def _masked_block():
         q = q_ref[0]                                  # [bq, d]
         k = k_ref[0]                                  # [bk, d]
         v = v_ref[0]                                  # [bk, d]
@@ -86,20 +124,7 @@ def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *refs,
         if causal:
             valid = valid & (cols <= rows)
         s = jnp.where(valid, s, NEG_INF)              # [bq, bk]
-
-        m_old = m_scr[:]                              # [bq, 128] (bcast)
-        s_max = jnp.max(s, axis=-1, keepdims=True)    # [bq, 1]
-        m_new = jnp.maximum(m_old, s_max)             # [bq, 128]
-        alpha = jnp.exp(m_old[:, 0:1] - m_new[:, 0:1])
-        # explicit zero on masked entries: with a finite NEG_INF, a row
-        # masked in EVERY block would otherwise see exp(s - m) == 1 junk
-        p = jnp.where(valid, jnp.exp(s - m_new[:, 0:1]), 0.0)  # [bq, bk]
-        l_new = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
-            precision=prec)
-        m_scr[:] = m_new
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        _online_update(s, valid, prec, v)
 
     @pl.when(kk == nk - 1)
     def _finish():
