@@ -394,6 +394,37 @@ class SGD:
         self._step_count += 1
         return float(loss), {k: float(v) for k, v in metrics.items()}
 
+    @staticmethod
+    def _prefetched(reader, feeder, depth: int = 2):
+        """Run feed CONVERSION (python->padded arrays->device transfer) in
+        a background thread, `depth` batches ahead — the DoubleBuffer
+        discipline (DataProvider.h:249) applied to the feeder itself. On
+        slow-memory hosts the numpy pack of an image batch costs as much
+        as the device step; overlapping the two restores device-bound
+        throughput. Order and semantics are unchanged."""
+        import queue
+        import threading
+        q: "queue.Queue" = queue.Queue(maxsize=depth)
+        DONE = object()
+
+        def work():
+            try:
+                for item in reader():
+                    q.put((None, feeder(item)))
+                q.put((None, DONE))
+            except BaseException as e:      # surfaced in the main thread
+                q.put((e, None))
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        while True:
+            err, feed = q.get()
+            if err is not None:
+                raise err
+            if feed is DONE:
+                return
+            yield feed
+
     def _run_pass(self, pass_id, reader, feeder, event_handler,
                   num_batches_per_pass, checkpoint_manager=None,
                   checkpoint_period: int = 0):
@@ -402,12 +433,11 @@ class SGD:
         n_batches = 0
         for ev in self.evaluators:
             ev.start()
-        for batch_id, data_batch in enumerate(reader()):
+        for batch_id, feed in enumerate(self._prefetched(reader, feeder)):
             if num_batches_per_pass is not None and \
                     batch_id >= num_batches_per_pass:
                 break
             event_handler(evt.BeginIteration(pass_id, batch_id))
-            feed = feeder(data_batch)
             n_real = jnp.asarray(feed.pop("__batch_size__"), jnp.int32)
             self._rng, sub = jax.random.split(self._rng)
             with stat_timer("train_step"):
@@ -449,8 +479,7 @@ class SGD:
                   if k != "inputs"} for ev in self.evaluators]
         for ev in self.evaluators:
             ev.start()
-        for data_batch in reader():
-            feed = feeder(data_batch)
+        for feed in self._prefetched(reader, feeder):
             n_real = jnp.asarray(feed.pop("__batch_size__"), jnp.int32)
             loss, metrics, eval_outs = self._test_step(
                 params, self.parameters.state, feed, n_real)
