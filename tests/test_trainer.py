@@ -146,3 +146,26 @@ def test_debug_nans_flag_raises_at_source():
                        text=True, timeout=300,
                        env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert "TRAPPED" in r.stdout, f"nan did not trap:\n{r.stdout}\n{r.stderr}"
+
+
+def test_reader_exception_propagates_through_prefetch():
+    """The background feed-conversion thread must surface reader errors
+    in the caller, not swallow them."""
+    paddle.init(use_tpu=False, seed=0)
+    from paddle_tpu.core import registry
+    registry.reset_name_counters()
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+    y = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(
+        paddle.layer.fc(x, size=2, act=paddle.activation.Softmax()), y)
+    params = paddle.create_parameters(paddle.Topology(cost))
+    tr = paddle.SGD(cost=cost, parameters=params,
+                    update_equation=paddle.optimizer.Adam(1e-3))
+    rng = np.random.RandomState(0)
+
+    def bad_reader():
+        yield [(rng.randn(4).astype("float32"), 1) for _ in range(8)]
+        raise RuntimeError("reader blew up")
+
+    with pytest.raises(RuntimeError, match="reader blew up"):
+        tr.train(bad_reader, num_passes=1, event_handler=lambda e: None)
