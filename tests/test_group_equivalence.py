@@ -104,3 +104,33 @@ class TestGroupEquivalence:
         np.testing.assert_allclose(np.asarray(gf["eqb"]),
                                    np.asarray(gg["eqb"]),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestGruGroupEquivalence:
+    def test_gru_step_group_matches_grumemory(self):
+        """A recurrent_group of gru_step must equal the fused grumemory
+        when they share the [h, 3h] recurrent weight and gate bias
+        (sequence_layer_group.conf discipline for GRU)."""
+        rng = np.random.RandomState(3)
+        h = 4
+        rows = [rng.randn(3, 3 * h).astype(np.float32),
+                rng.randn(5, 3 * h).astype(np.float32)]
+        x3 = L.data("x3", paddle.data_type.dense_vector_sequence(3 * h))
+        feed = {"x3": pack_sequences(rows)}
+
+        fused = L.grumemory(
+            x3, param_attr=paddle.attr.Param(name="gru_W"),
+            bias_attr=paddle.attr.Param(name="gru_b"), name="f_gru")
+
+        def step(inp):
+            mem = L.memory(name="g_gru", size=h)
+            return L.gru_step(inp, mem, size=h,
+                              param_attr=paddle.attr.Param(name="gru_W"),
+                              bias_attr=paddle.attr.Param(name="gru_b"),
+                              name="g_gru")
+
+        grouped = L.recurrent_group(step=step, input=x3, name="gru_grp")
+        outs, _ = _forward([fused, grouped], feed, seed=4)
+        np.testing.assert_allclose(np.asarray(outs[fused.name].data),
+                                   np.asarray(outs[grouped.name].data),
+                                   rtol=1e-6, atol=1e-6)
