@@ -1,0 +1,223 @@
+"""PTRecordIO — chunked record files; the elastic data plane's format.
+
+Reference role: the Go master partitioned RecordIO chunks into tasks
+(go/master/service.go:106) and trainers streamed records per task; the
+C++ DataProviders did the disk IO. Here:
+
+- the native codec is `paddle_tpu/native/recordio.cc` (C ABI, built
+  on demand with the system compiler and loaded via ctypes);
+- this module holds a byte-identical pure-Python twin (used when no
+  compiler exists) and the user-facing API:
+
+      write_records(path, records_iter)
+      num_chunks(path) / read_chunk(path, k) -> [bytes]
+      chunk_reader(path)     -> the Coordinator's chunk_reader callable
+      chunk_descriptors(path) -> chunk list for Coordinator(chunks=...)
+
+Layout (little-endian u32): chunk := magic "PTRC" | num_records |
+payload_len | crc32(payload) | payload; payload := (len | bytes)*.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import tempfile
+import zlib
+from typing import Iterable, List, Optional
+
+_MAGIC = 0x50545243
+_HDR = struct.Struct("<IIII")
+
+# --------------------------------------------------------------- native
+
+_lib = None
+_lib_tried = False
+
+
+def _native() -> Optional[ctypes.CDLL]:
+    """Build (once) and load the native codec; None if no compiler."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "recordio.cc")
+    if not os.path.exists(src):
+        return None
+    import shutil
+    cc = shutil.which("g++") or shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        return None
+    so = os.path.join(tempfile.gettempdir(),
+                      f"libptrecordio_{os.getuid()}.so")
+    try:
+        if (not os.path.exists(so) or
+                os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run([cc, "-O2", "-shared", "-fPIC", "-o", so, src],
+                           check=True, capture_output=True, timeout=120)
+        lib = ctypes.CDLL(so)
+    except Exception:
+        return None
+    lib.pt_writer_open.restype = ctypes.c_void_p
+    lib.pt_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+    lib.pt_writer_write.restype = ctypes.c_int
+    lib.pt_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint32]
+    lib.pt_writer_close.restype = ctypes.c_int
+    lib.pt_writer_close.argtypes = [ctypes.c_void_p]
+    lib.pt_reader_open.restype = ctypes.c_void_p
+    lib.pt_reader_open.argtypes = [ctypes.c_char_p]
+    lib.pt_reader_num_chunks.restype = ctypes.c_uint32
+    lib.pt_reader_num_chunks.argtypes = [ctypes.c_void_p]
+    lib.pt_reader_seek_chunk.restype = ctypes.c_int
+    lib.pt_reader_seek_chunk.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.pt_reader_next.restype = ctypes.c_int64
+    lib.pt_reader_next.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.POINTER(
+                                       ctypes.c_uint8))]
+    lib.pt_reader_close.restype = None
+    lib.pt_reader_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+# --------------------------------------------------------------- writing
+
+
+def write_records(path: str, records: Iterable[bytes],
+                  max_chunk_bytes: int = 1 << 20,
+                  use_native: Optional[bool] = None) -> None:
+    """Write an iterable of byte records as a PTRecordIO file."""
+    lib = _native() if use_native in (None, True) else None
+    if use_native is True and lib is None:
+        raise RuntimeError("native recordio codec unavailable")
+    if lib is not None:
+        w = lib.pt_writer_open(path.encode(), max_chunk_bytes)
+        if not w:
+            raise OSError(f"cannot open {path!r} for writing")
+        try:
+            for rec in records:
+                if lib.pt_writer_write(w, rec, len(rec)) != 0:
+                    raise OSError("recordio write failed")
+        finally:
+            if lib.pt_writer_close(w) != 0:
+                raise OSError("recordio flush/close failed")
+        return
+    # pure-python twin
+    with open(path, "wb") as f:
+        payload = bytearray()
+        n = 0
+
+        def flush():
+            nonlocal payload, n
+            if not n:
+                return
+            f.write(_HDR.pack(_MAGIC, n, len(payload),
+                              zlib.crc32(bytes(payload)) & 0xFFFFFFFF))
+            f.write(payload)
+            payload = bytearray()
+            n = 0
+
+        for rec in records:
+            payload += struct.pack("<I", len(rec)) + rec
+            n += 1
+            if len(payload) >= max_chunk_bytes:
+                flush()
+        flush()
+
+
+# --------------------------------------------------------------- reading
+
+
+def _py_index(path: str) -> List[tuple]:
+    chunks = []
+    with open(path, "rb") as f:
+        while True:
+            off = f.tell()
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                break
+            magic, n, plen, crc = _HDR.unpack(hdr)
+            if magic != _MAGIC:
+                raise ValueError(f"{path}: bad chunk magic at {off}")
+            chunks.append((off, n, plen, crc))
+            f.seek(plen, 1)
+    return chunks
+
+
+def num_chunks(path: str, use_native: Optional[bool] = None) -> int:
+    lib = _native() if use_native in (None, True) else None
+    if lib is not None:
+        r = lib.pt_reader_open(path.encode())
+        if not r:
+            raise OSError(f"cannot open {path!r}")
+        try:
+            return int(lib.pt_reader_num_chunks(r))
+        finally:
+            lib.pt_reader_close(r)
+    return len(_py_index(path))
+
+
+def read_chunk(path: str, k: int,
+               use_native: Optional[bool] = None) -> List[bytes]:
+    """All records of chunk k (crc-validated)."""
+    lib = _native() if use_native in (None, True) else None
+    if use_native is True and lib is None:
+        raise RuntimeError("native recordio codec unavailable")
+    if lib is not None:
+        r = lib.pt_reader_open(path.encode())
+        if not r:
+            raise OSError(f"cannot open {path!r}")
+        try:
+            rc = lib.pt_reader_seek_chunk(r, k)
+            if rc == -2:
+                raise ValueError(f"{path}: chunk {k} crc mismatch")
+            if rc != 0:
+                raise IndexError(f"{path}: no chunk {k}")
+            out = []
+            ptr = ctypes.POINTER(ctypes.c_uint8)()
+            while True:
+                ln = lib.pt_reader_next(r, ctypes.byref(ptr))
+                if ln < 0:
+                    break
+                out.append(ctypes.string_at(ptr, ln))
+            return out
+        finally:
+            lib.pt_reader_close(r)
+    chunks = _py_index(path)
+    if k >= len(chunks):
+        raise IndexError(f"{path}: no chunk {k}")
+    off, n, plen, crc = chunks[k]
+    with open(path, "rb") as f:
+        f.seek(off + _HDR.size)
+        payload = f.read(plen)
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise ValueError(f"{path}: chunk {k} crc mismatch")
+    out = []
+    cur = 0
+    while cur + 4 <= plen:
+        (ln,) = struct.unpack_from("<I", payload, cur)
+        out.append(bytes(payload[cur + 4:cur + 4 + ln]))
+        cur += 4 + ln
+    return out
+
+
+# ------------------------------------------------------- coordinator glue
+
+
+def chunk_descriptors(path: str) -> List[tuple]:
+    """[(path, k)] — the opaque chunk list for Coordinator(chunks=...)."""
+    return [(path, k) for k in range(num_chunks(path))]
+
+
+def chunk_reader(deserialize=None):
+    """Returns the Coordinator-side chunk_reader: takes a (path, k)
+    descriptor, yields (deserialized) records of that chunk."""
+    def read(desc):
+        path, k = desc
+        for rec in read_chunk(path, k):
+            yield deserialize(rec) if deserialize else rec
+    return read
