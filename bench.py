@@ -110,7 +110,8 @@ def _build(name):
     if name.startswith("googlenet"):
         return models.googlenet(), 224 * 224 * 3, 1000
     if name.startswith("resnet50"):
-        return models.resnet50(), 224 * 224 * 3, 1000
+        return (models.resnet50(tpu_stem="tpustem" in name),
+                224 * 224 * 3, 1000)
     raise KeyError(name)
 
 
@@ -318,35 +319,64 @@ def main():
         print(json.dumps({"bench": name, **res}), file=sys.stderr)
         return res
 
+    def _row(name, thunk, retries=2):
+        """One suite row, retried on transient failure. The tunneled TPU's
+        compile RPC can reset mid-suite ("response body closed"); a flaky
+        row must cost a retry, not the whole artifact."""
+        err = None
+        for attempt in range(retries + 1):
+            try:
+                return _emit(name, thunk())
+            except Exception as e:  # noqa: BLE001 — record and move on
+                err = e
+                print(json.dumps({"bench": name, "attempt": attempt,
+                                  "error": str(e)[:300]}), file=sys.stderr)
+        return {"ms": -1.0, "error": str(err)[:300]}
+
     suite = {}
-    suite["alexnet_bs128"] = _emit(
-        "alexnet_bs128", bench_image("alexnet_bs128", 128, iters=args.iters))
+    suite["alexnet_bs128"] = _row(
+        "alexnet_bs128",
+        lambda: bench_image("alexnet_bs128", 128, iters=args.iters))
 
     if args.suite == "all":
         half = max(args.iters // 2, 5)
-        suite["alexnet_bs512"] = _emit(
-            "alexnet_bs512", bench_image("alexnet_bs512", 512, iters=half))
-        suite["smallnet_bs128"] = _emit(
-            "smallnet_bs128", bench_image("smallnet_bs128", 128,
-                                          iters=args.iters))
-        suite["googlenet_bs128"] = _emit(
-            "googlenet_bs128", bench_image("googlenet_bs128", 128,
-                                           iters=half))
-        suite["resnet50_bs128"] = _emit(
-            "resnet50_bs128", bench_image("resnet50_bs128", 128, iters=half))
-        suite["lstm_bs64_h256"] = _emit(
-            "lstm_bs64_h256", bench_lstm(64, 256, iters=args.iters))
-        suite["lstm_bs128_h1280"] = _emit(
-            "lstm_bs128_h1280", bench_lstm(128, 1280, iters=half))
-        suite["flash_attention_t4096"] = _emit(
-            "flash_attention_t4096", bench_flash_attention(iters=half))
-        suite["transformer_lm_bs8_t1024"] = _emit(
-            "transformer_lm_bs8_t1024", bench_transformer(iters=half))
+        suite["alexnet_bs512"] = _row(
+            "alexnet_bs512",
+            lambda: bench_image("alexnet_bs512", 512, iters=half))
+        suite["smallnet_bs128"] = _row(
+            "smallnet_bs128",
+            lambda: bench_image("smallnet_bs128", 128, iters=args.iters))
+        suite["googlenet_bs128"] = _row(
+            "googlenet_bs128",
+            lambda: bench_image("googlenet_bs128", 128, iters=half))
+        suite["resnet50_bs128"] = _row(
+            "resnet50_bs128",
+            lambda: bench_image("resnet50_bs128", 128, iters=half))
+        suite["resnet50_bs128_tpustem"] = _row(
+            "resnet50_bs128_tpustem",
+            lambda: bench_image("resnet50_bs128_tpustem", 128, iters=half))
+        suite["lstm_bs64_h256"] = _row(
+            "lstm_bs64_h256", lambda: bench_lstm(64, 256, iters=args.iters))
+        suite["lstm_bs128_h1280"] = _row(
+            "lstm_bs128_h1280", lambda: bench_lstm(128, 1280, iters=half))
+        suite["flash_attention_t4096"] = _row(
+            "flash_attention_t4096", lambda: bench_flash_attention(iters=half))
+        suite["transformer_lm_bs8_t1024"] = _row(
+            "transformer_lm_bs8_t1024", lambda: bench_transformer(iters=half))
 
-    head = suite["alexnet_bs128"]
+    head_name = "alexnet_bs128"
+    head = suite[head_name]
+    if head.get("ms", -1) <= 0:  # headline row lost to a persistent flake:
+        # fall back to another successful row, RENAMING the metric so a
+        # consumer never records a different benchmark under the alexnet
+        # label; if nothing succeeded, exit non-zero with a null value.
+        head_name, head = next(
+            ((n, r) for n, r in suite.items() if r.get("ms", -1) > 0),
+            (head_name, head))
+    ok = head.get("ms", -1) > 0
     print(json.dumps({
-        "metric": "alexnet_bs128_train_ms_per_batch",
-        "value": head["ms"],
+        "metric": f"{head_name}_train_ms_per_batch",
+        "value": head["ms"] if ok else None,
         "unit": "ms/batch",
         "vs_baseline": head.get("vs_baseline"),
         "dtype": args.dtype,
@@ -354,7 +384,7 @@ def main():
         "suite": suite,
         "skipped": {k: "needs multi-chip slice" for k in MULTICHIP_ROWS},
     }))
-    return 0
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

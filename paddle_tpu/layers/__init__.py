@@ -286,6 +286,14 @@ def global_img_pool(input, name=None, pool_type=None, **kw) -> LayerOutput:
 img_pool_layer = img_pool
 
 
+def space_to_depth(input, factor: int = 2, name=None, num_channels=None,
+                   **kw) -> LayerOutput:
+    """Fold factor x factor spatial blocks into channels (TPU stem trick;
+    see layers/extra_layers.py SpaceToDepthLayer)."""
+    return make_layer("space_to_depth", name, [input], factor=factor,
+                      channels=num_channels)
+
+
 def img_cmrnorm(input, size: int = 5, scale: float = 0.0128,
                 power: float = 0.75, name=None, **kw) -> LayerOutput:
     return make_layer("img_cmrnorm", name, [input], size=size, scale=scale,

@@ -189,6 +189,39 @@ class SwitchOrderLayer:
         return x.reshape(x.shape[0], -1)
 
 
+@register_layer("space_to_depth")
+class SpaceToDepthLayer:
+    """[b,h,w,c] -> [b,h/f,w/f,c*f*f] block rearrangement — a TPU-first
+    extra with no reference counterpart: folding 2x2 spatial blocks into
+    channels lets an image-stem conv contract over c*f*f input channels
+    instead of 3, so its implicit GEMM tiles onto the MXU instead of
+    padding a 3-deep contraction up to a full register lane. Used by
+    models.image.resnet(tpu_stem=True)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        f = cfg.get("factor", 2)
+        ic = cfg.get("channels") or m.channels
+        ih, iw = m.height, m.width
+        assert ic and ih and iw, (
+            f"space_to_depth {name}: input needs channel/height/width meta")
+        assert ih % f == 0 and iw % f == 0, (
+            f"space_to_depth {name}: {ih}x{iw} not divisible by factor {f}")
+        cfg["_ic"], cfg["_ih"], cfg["_iw"], cfg["_f"] = ic, ih, iw, f
+        return LayerMeta(size=m.size or ic * ih * iw, height=ih // f,
+                         width=iw // f, channels=ic * f * f), [], []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        f = cfg["_f"]
+        x = ensure_nhwc(_payload(inputs[0]), cfg["_ic"], cfg["_ih"],
+                        cfg["_iw"])
+        b, h, w, c = x.shape
+        x = x.reshape(b, h // f, f, w // f, f, c).transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(b, h // f, w // f, f * f * c)
+
+
 @register_layer("layer_norm")
 class LayerNormLayer:
     """Per-position layer normalization with learned gain/bias — the

@@ -224,12 +224,23 @@ def _bottleneck_block(name, x, nf, stride):
 
 
 def resnet(depth: int = 50, height: int = 224, width: int = 224,
-           channels: int = 3, num_classes: int = 1000) -> ModelSpec:
+           channels: int = 3, num_classes: int = 1000,
+           tpu_stem: bool = False) -> ModelSpec:
     kind, reps = _RESNET_BLOCKS[depth]
     block = _basic_block if kind == "basic" else _bottleneck_block
     img, lbl = _image_inputs(height, width, channels, num_classes)
-    t = _conv_bn("rn_stem", img, 7, 64, stride=2, padding=3,
-                 num_channels=channels)
+    if tpu_stem:
+        # space-to-depth stem (the MLPerf-era TPU trick): fold 2x2 blocks
+        # into channels so the stem conv contracts over 12 channels at
+        # 112x112 instead of 3 at 224x224 — same downsampling, a 10x10
+        # effective receptive field covering the default 7x7, and an
+        # implicit GEMM that tiles onto the MXU. A model VARIANT, not the
+        # default (weights are not interchangeable with the 7x7 stem).
+        t = layer.space_to_depth(img, factor=2, num_channels=channels)
+        t = _conv_bn("rn_stem", t, 5, 64, stride=1, padding=2)
+    else:
+        t = _conv_bn("rn_stem", img, 7, 64, stride=2, padding=3,
+                     num_channels=channels)
     # floor pooling (ceil_mode=False) keeps the canonical 56/28/14/7
     # feature-map chain — divisible by the TPU's 8-sublane tiling, where
     # caffe ceil's 57/29/15 chain pads every map by ~12%

@@ -153,6 +153,9 @@ CONFIGS = {
     "img_cmrnorm": lambda rng: (lambda x, f: (
         L.img_cmrnorm(L.img_conv(x, filter_size=1, num_filters=3), size=3),
         f))(*image(rng)),
+    "space_to_depth": lambda rng: (lambda x, f: (
+        L.fc(L.space_to_depth(L.img_conv(x, filter_size=1, num_filters=2),
+                              factor=2), size=3), f))(*image(rng, h=4, w=4)),
     "maxout": lambda rng: (lambda x, f: (
         L.maxout(L.img_conv(x, filter_size=1, num_filters=4), groups=2), f))(
         *image(rng, h=3, w=3)),

@@ -90,6 +90,18 @@ class TestImageModels:
         _train_steps(M.resnet(18, height=32, width=32, num_classes=10),
                      steps=1, n=4)
 
+    def test_resnet18_tpu_stem_trains(self):
+        _train_steps(M.resnet(18, height=32, width=32, num_classes=10,
+                              tpu_stem=True), steps=1, n=4)
+
+    def test_resnet_tpu_stem_shape_chain(self):
+        """The s2d stem must reproduce the default stem's 112->56 map chain
+        (so every stage downstream sees identical shapes)."""
+        spec = M.resnet50(num_classes=10, tpu_stem=True)
+        topo = paddle.Topology(spec.cost)
+        bn = topo.by_name["rn_stem_bn"].meta
+        assert (bn.height, bn.width, bn.channels) == (112, 112, 64)
+
     def test_resnet50_builds(self):
         spec = M.resnet50(num_classes=1000)
         topo = paddle.Topology(spec.cost)
