@@ -35,6 +35,7 @@ from paddle_tpu.layers.beam import (BeamInput,
                                     cross_entropy_over_beam)  # noqa: F401
 from paddle_tpu.layers.attention_layers import (dot_product_attention,
                                                 multi_head_attention)
+from paddle_tpu.layers.moe_layers import moe, moe_aux_cost  # noqa: F401
 
 
 def _listify(x):

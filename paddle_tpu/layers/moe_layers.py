@@ -1,0 +1,138 @@
+"""Mixture-of-experts layers — the `ep` (expert-parallel) leg of the mesh.
+
+No 2017 reference counterpart (like dot_product_attention and layer_norm,
+a TPU-era extra beyond parity): a capacity-routed top-k MoE FFN
+(ops/moe.py) as a graph layer, plus a companion cost layer exposing the
+router's load-balance auxiliary loss through the normal multi-cost
+trainer path (SGD accepts a list of cost nodes).
+
+The two layers share the gate parameter by name, so `moe_aux_cost`
+re-derives the routing statistics from the same router the forward pass
+used — one extra [n,d]x[d,E] matmul, which keeps the aux loss an
+ordinary cost node instead of a side channel through the forward ctx.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers
+from paddle_tpu.core.registry import (LayerMeta, ParamAttr, ParamSpec,
+                                      default_weight_init, make_layer,
+                                      register_layer)
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import moe as moe_ops
+
+
+def _gate_name(name, cfg):
+    a = ParamAttr.of(cfg.get("param_attr"))
+    return a.name or f"_{name}.gate", a
+
+
+def _flatten(v):
+    """-> (x2d [n,d], valid [n] or None, restore(y2d) -> like v)."""
+    if isinstance(v, SequenceBatch):
+        b, t, d = v.data.shape
+        valid = v.mask().reshape(b * t)
+        return (v.data.reshape(b * t, d), valid,
+                lambda y: v.with_data(y.reshape(b, t, d)))
+    return v, None, lambda y: y
+
+
+@register_layer("moe")
+class MoELayer:
+    """Top-k capacity-routed expert FFN: x -> combine(experts(dispatch(x))).
+
+    cfg: expert_num E, expert_hidden f, k (default 2), capacity_factor
+    (default 1.25). Parameters: gate [d,E], up [E,d,f], down [E,f,d]
+    (no biases — router + expert matmuls carry the capacity, matching
+    the usual MoE formulation). Output size = input size."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        d = m.size
+        E = cfg["expert_num"]
+        f = cfg.get("expert_hidden") or 4 * d
+        gname, a = _gate_name(name, cfg)
+        cfg["_gate"], cfg["_up"], cfg["_down"] = \
+            gname, f"_{name}.moe_up", f"_{name}.moe_down"
+        specs = [
+            ParamSpec(gname, (d, E), default_weight_init(a, fan_in_axes=(0,)),
+                      a),
+            ParamSpec(cfg["_up"], (E, d, f),
+                      initializers.msra((1,)), ParamAttr()),
+            ParamSpec(cfg["_down"], (E, f, d),
+                      initializers.msra((1,)), ParamAttr()),
+        ]
+        return LayerMeta(size=d, seq_level=m.seq_level), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        x2d, valid, restore = _flatten(inputs[0])
+        y, _aux = moe_ops.moe_ffn(
+            x2d, valid, params[cfg["_gate"]], params[cfg["_up"]],
+            params[cfg["_down"]], k=cfg.get("k", 2),
+            capacity_factor=cfg.get("capacity_factor", 1.25),
+            mesh=getattr(ctx, "mesh", None))
+        return restore(y)
+
+
+@register_layer("moe_aux_cost")
+class MoEAuxCostLayer:
+    """Router load-balance loss of a `moe` layer as a per-sample cost node
+    (constant across the batch row dim so the trainer's batch-mean
+    recovers the scalar). Shares the moe layer's gate parameter by name;
+    `coeff` scales the loss (0.01 is the usual setting)."""
+
+    @staticmethod
+    def build(name, cfg, input_metas):
+        m = input_metas[0]
+        d = m.size
+        E = cfg["expert_num"]
+        gname = cfg["gate_param"]
+        cfg["_gate"] = gname
+        # shared parameter: declare a spec IDENTICAL to the moe layer's
+        # (same attr + initializer, built from the forwarded param_attr),
+        # so Topology's first-seen dedup picks the same thing either way
+        a = ParamAttr.of(cfg.get("param_attr"))
+        specs = [ParamSpec(gname, (d, E),
+                           default_weight_init(a, fan_in_axes=(0,)), a)]
+        return LayerMeta(size=1, seq_level=0), specs, []
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        v = inputs[0]
+        x2d, valid, _ = _flatten(v)
+        logits = jnp.dot(x2d.astype(jnp.float32),
+                         params[cfg["_gate"]].astype(jnp.float32))
+        capacity = moe_ops.moe_capacity(
+            x2d.shape[0], cfg["expert_num"], cfg.get("k", 2),
+            cfg.get("capacity_factor", 1.25))
+        _, _, aux = moe_ops.moe_dispatch(logits, valid, k=cfg.get("k", 2),
+                                         capacity=capacity)
+        b = v.data.shape[0] if isinstance(v, SequenceBatch) else v.shape[0]
+        return jnp.full((b,), cfg.get("coeff", 0.01), jnp.float32) * aux
+
+
+def moe(input, expert_num: int, expert_hidden=None, k: int = 2,
+        capacity_factor: float = 1.25, name=None, param_attr=None,
+        **kw):
+    """Mixture-of-experts FFN layer (see MoELayer)."""
+    return make_layer("moe", name, [input], expert_num=expert_num,
+                      expert_hidden=expert_hidden, k=k,
+                      capacity_factor=capacity_factor,
+                      param_attr=param_attr)
+
+
+def moe_aux_cost(input, moe_layer, coeff: float = 0.01, name=None, **kw):
+    """Load-balance cost for `moe_layer`, fed the same input node."""
+    return make_layer("moe_aux_cost", name, [input],
+                      expert_num=moe_layer.config["expert_num"],
+                      k=moe_layer.config.get("k", 2),
+                      capacity_factor=moe_layer.config.get(
+                          "capacity_factor", 1.25),
+                      gate_param=moe_layer.config["_gate"],
+                      param_attr=moe_layer.config.get("param_attr"),
+                      coeff=coeff)

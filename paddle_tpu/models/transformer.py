@@ -24,12 +24,20 @@ from paddle_tpu.models.image import ModelSpec
 def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
                    n_heads: int = 8, n_layers: int = 6,
                    d_ff: int = 2048, max_len: int = 2048,
+                   moe_experts: int = 0, moe_k: int = 2,
+                   moe_aux_coeff: float = 0.01,
                    name: str = "tfm") -> ModelSpec:
     """tokens + positions -> N pre-norm blocks -> next-token CE.
 
     Feed contract: (token_ids, position_ids, next_token_ids) — three
     integer sequences of equal length (positions are just 0..T-1; a data
     input keeps the graph free of iota-on-ragged-length corner cases).
+
+    moe_experts > 0 swaps every block's dense FFN for a top-`moe_k`
+    capacity-routed mixture of `moe_experts` experts (layers.moe); the
+    router load-balance losses join the CE as extra cost nodes
+    (spec.cost becomes a list — SGD takes it as-is), and the expert
+    tables shard over the mesh's `ep` axis when one exists.
     """
     toks = layer.data(f"{name}_tokens", integer_value_sequence(vocab_size))
     pos = layer.data(f"{name}_positions", integer_value_sequence(max_len))
@@ -39,6 +47,7 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
         layer.embedding(toks, size=d_model, name=f"{name}_tok_emb"),
         layer.embedding(pos, size=d_model, name=f"{name}_pos_emb"),
     ], name=f"{name}_emb")
+    aux_costs = []
 
     for i in range(n_layers):
         ln1 = layer.layer_norm(x, name=f"{name}_l{i}_ln1")
@@ -56,17 +65,25 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
         x = layer.addto([x, proj], name=f"{name}_l{i}_res1")
 
         ln2 = layer.layer_norm(x, name=f"{name}_l{i}_ln2")
-        up = layer.fc(ln2, size=d_ff, act=act.Relu(),
-                      name=f"{name}_l{i}_up")
-        down = layer.fc(up, size=d_model, bias_attr=False,
-                        name=f"{name}_l{i}_down")
-        x = layer.addto([x, down], name=f"{name}_l{i}_res2")
+        if moe_experts > 0:
+            ffn = layer.moe(ln2, expert_num=moe_experts,
+                            expert_hidden=d_ff, k=moe_k,
+                            name=f"{name}_l{i}_moe")
+            aux_costs.append(layer.moe_aux_cost(
+                ln2, ffn, coeff=moe_aux_coeff, name=f"{name}_l{i}_aux"))
+        else:
+            up = layer.fc(ln2, size=d_ff, act=act.Relu(),
+                          name=f"{name}_l{i}_up")
+            ffn = layer.fc(up, size=d_model, bias_attr=False,
+                           name=f"{name}_l{i}_down")
+        x = layer.addto([x, ffn], name=f"{name}_l{i}_res2")
 
     xf = layer.layer_norm(x, name=f"{name}_lnf")
     logits = layer.fc(xf, size=vocab_size, act=act.Softmax(),
                       name=f"{name}_head")
     cost = layer.cross_entropy_cost(logits, nxt, name=f"{name}_cost")
     spec = ModelSpec(name="transformer_lm", data=toks, label=nxt,
-                     output=logits, cost=cost)
+                     output=logits,
+                     cost=[cost] + aux_costs if aux_costs else cost)
     spec.positions = pos
     return spec

@@ -13,6 +13,7 @@ Axis conventions (the scaling-book recipe):
   mp — model/tensor parallel (features)   <- parallel_nn device placement
   sp — sequence/context parallel (time)   <- (new; no 2017 equivalent)
   pp — pipeline stages                    <- ParallelNeuralNetwork layer pinning
+  ep — expert parallel (MoE expert dim)   <- (new; no 2017 equivalent)
 """
 
 from __future__ import annotations
@@ -27,6 +28,7 @@ DP_AXIS = "dp"
 MP_AXIS = "mp"
 SP_AXIS = "sp"
 PP_AXIS = "pp"
+EP_AXIS = "ep"
 
 
 def create_mesh(shape: Sequence[Tuple[str, int]],

@@ -31,7 +31,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddle_tpu.parallel.mesh import MP_AXIS
+from paddle_tpu.parallel.mesh import EP_AXIS, MP_AXIS
 
 
 Rule = Tuple[str, P]
@@ -39,6 +39,7 @@ Rule = Tuple[str, P]
 
 def default_rules() -> List[Rule]:
     return [
+        (r".*\.moe_(up|down)$", P(EP_AXIS, None, None)),    # expert tables
         (r".*emb.*\.w0$|.*emb.*_w$", P(MP_AXIS, None)),     # embedding rows
         (r".*\.w\d+$|.*_w$", P(None, MP_AXIS)),             # fc columns
         (r".*wbias$|.*_b$|.*moving_.*", P()),               # 1-D: replicate
@@ -53,6 +54,8 @@ def _spec_fits(shape: Sequence[int], spec: P, mesh: Mesh) -> bool:
         if axis is None:
             continue
         axes = axis if isinstance(axis, tuple) else (axis,)
+        if any(a not in mesh.shape for a in axes):
+            return False        # rule names an axis this mesh doesn't have
         n = int(np.prod([mesh.shape[a] for a in axes]))
         if dim % n != 0:
             return False
@@ -61,9 +64,9 @@ def _spec_fits(shape: Sequence[int], spec: P, mesh: Mesh) -> bool:
 
 def spec_for(name: str, shape: Sequence[int], mesh: Mesh,
              rules: Optional[Sequence[Rule]] = None) -> P:
-    """PartitionSpec for one parameter (first matching + fitting rule)."""
-    if MP_AXIS not in mesh.shape or mesh.shape[MP_AXIS] == 1:
-        return P()
+    """PartitionSpec for one parameter (first matching + fitting rule;
+    rules whose axes the mesh lacks — e.g. mp rules on a dp-only mesh,
+    the ep rule on a mesh without experts — fall back to replication)."""
     ndim = len(shape)
     for pat, spec in (rules or default_rules()):
         if re.match(pat, name):

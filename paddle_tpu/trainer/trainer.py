@@ -242,9 +242,10 @@ class SGD:
         if self.mesh is not None:
             from paddle_tpu.parallel import tensor_parallel as tp
             from paddle_tpu.parallel.data_parallel import shard_train_step
-            from paddle_tpu.parallel.mesh import MP_AXIS
+            from paddle_tpu.parallel.mesh import EP_AXIS, MP_AXIS
             p_sh = o_sh = None
-            if MP_AXIS in self.mesh.shape and self.mesh.shape[MP_AXIS] > 1:
+            if any(ax in self.mesh.shape and self.mesh.shape[ax] > 1
+                   for ax in (MP_AXIS, EP_AXIS)):
                 # shard over the LIVE param dict (may hold extra entries,
                 # e.g. a tar checkpoint from an older topology)
                 from jax.sharding import NamedSharding

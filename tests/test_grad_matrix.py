@@ -285,6 +285,8 @@ CONFIGS = {
     "multibox_loss": lambda rng: _multibox_cfg(rng),
     # --- attention / misc
     "dot_product_attention": lambda rng: _attn_cfg(rng),
+    "moe": lambda rng: _moe_cfg(rng),
+    "moe_aux_cost": lambda rng: _moe_cfg(rng, aux=True),
     "multiplex": lambda rng: _multiplex_cfg(rng),
     "clip": lambda rng: (lambda x, f: (
         L.clip(weighted(x), min=-0.6, max=0.6), f))(*dense(rng)),
@@ -393,6 +395,20 @@ def _ctc_cfg(rng):
         [rng.randint(0, 4, 2).astype(np.int32),
          rng.randint(0, 4, 3).astype(np.int32)])
     return L.ctc(probs, lbl, size=5), f
+
+
+def _moe_cfg(rng, aux=False):
+    # ample capacity + a seeded weighted input keeps every finite-diff
+    # perturbation far from a routing boundary (argmax is piecewise
+    # constant; at a tie the numeric and analytic grads legitimately
+    # differ, so the config must avoid ties, not the check)
+    s, f = seq(rng, lens=(3, 4), d=6)
+    x = wseq(s)
+    node = L.moe(x, expert_num=2, expert_hidden=5, k=2,
+                 capacity_factor=2.0)
+    if aux:
+        node = L.moe_aux_cost(x, node, coeff=1.0)
+    return node, f
 
 
 def _attn_cfg(rng):
