@@ -112,6 +112,8 @@ def _build(name):
     if name.startswith("resnet50"):
         return (models.resnet50(tpu_stem="tpustem" in name),
                 224 * 224 * 3, 1000)
+    if name.startswith("vgg16"):
+        return models.vgg16(), 224 * 224 * 3, 1000
     raise KeyError(name)
 
 
@@ -139,7 +141,8 @@ def _measure(trainer, feed, batch, iters, warmup):
         ms, carry = _slope_time(step, carry, (feed, key, n_real),
                                 max(iters * 10, 200), 0)
     ms = max(ms, 1e-3)   # sub-us slopes are timing noise on tiny models
-    res = {"ms": round(ms, 4)}
+    res = {"ms": round(ms, 4),
+           "samples_per_sec": round(batch / (ms / 1e3), 1)}
     if flops:
         tflops = flops / (ms / 1e3) / 1e12
         res["tflops"] = round(tflops, 2)
@@ -355,6 +358,9 @@ def main():
         suite["resnet50_bs128_tpustem"] = _row(
             "resnet50_bs128_tpustem",
             lambda: bench_image("resnet50_bs128_tpustem", 128, iters=half))
+        suite["vgg16_bs128"] = _row(
+            "vgg16_bs128",
+            lambda: bench_image("vgg16_bs128", 128, iters=half))
         suite["lstm_bs64_h256"] = _row(
             "lstm_bs64_h256", lambda: bench_lstm(64, 256, iters=args.iters))
         suite["lstm_bs128_h1280"] = _row(
@@ -382,6 +388,13 @@ def main():
         "dtype": args.dtype,
         "device": getattr(dev, "device_kind", str(dev)),
         "suite": suite,
+        "north_star": {
+            # BASELINE.json metric: ResNet-50 samples/sec/chip >= V100
+            # use_gpu throughput (~400 f32 / ~900 mixed samples/s)
+            "resnet50_samples_per_sec_per_chip":
+                suite.get("resnet50_bs128", {}).get("samples_per_sec"),
+            "target": ">= V100 use_gpu throughput (BASELINE.json)",
+        },
         "skipped": {k: "needs multi-chip slice" for k in MULTICHIP_ROWS},
     }))
     return 0 if ok else 1

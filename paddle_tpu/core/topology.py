@@ -104,7 +104,7 @@ class Topology:
                 output_names: Optional[Sequence[str]] = None,
                 sparse_sub: Optional[Dict[str, Any]] = None,
                 injected: Optional[Dict[str, Any]] = None,
-                skip: Sequence[str] = (), mesh=None):
+                skip: Sequence[str] = (), mesh=None, n_real=None):
         """Pure forward pass.
 
         Returns (outputs_dict, new_state). `outputs_dict` maps layer name ->
@@ -119,6 +119,10 @@ class Topology:
         ctx = ApplyContext(mode, rng, state)
         ctx.sparse_sub = sparse_sub
         ctx.mesh = mesh     # layers may pick sp/mp-aware code paths
+        # real (un-padded) rows in the batch; row-COUPLED layers (moe
+        # capacity routing) must exclude feeder pad rows, which the
+        # per-row cost mask cannot do for them
+        ctx.n_real = n_real
         values: Dict[str, Any] = dict(injected or {})
         skip_set = set(skip)
         wanted = set(output_names) if output_names is not None else \

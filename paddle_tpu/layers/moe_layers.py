@@ -30,14 +30,24 @@ def _gate_name(name, cfg):
     return a.name or f"_{name}.gate", a
 
 
-def _flatten(v):
-    """-> (x2d [n,d], valid [n] or None, restore(y2d) -> like v)."""
+def _flatten(v, ctx=None):
+    """-> (x2d [n,d], valid [n] or None, restore(y2d) -> like v).
+
+    Routing is row-COUPLED (padded rows eat expert capacity and change
+    real rows' outputs), so validity must come from the data: sequence
+    inputs carry it in their lengths (the feeder pads rows at length 0);
+    dense inputs take it from ctx.n_real (the trainer's un-padded row
+    count), falling back to all-valid outside a trainer step."""
     if isinstance(v, SequenceBatch):
         b, t, d = v.data.shape
         valid = v.mask().reshape(b * t)
         return (v.data.reshape(b * t, d), valid,
                 lambda y: v.with_data(y.reshape(b, t, d)))
-    return v, None, lambda y: y
+    n_real = getattr(ctx, "n_real", None) if ctx is not None else None
+    valid = None
+    if n_real is not None:
+        valid = (jnp.arange(v.shape[0]) < n_real).astype(jnp.float32)
+    return v, valid, lambda y: y
 
 
 @register_layer("moe")
@@ -54,6 +64,10 @@ class MoELayer:
         m = input_metas[0]
         d = m.size
         E = cfg["expert_num"]
+        k = cfg.get("k", 2)
+        assert 1 <= k <= E, (
+            f"moe {name}: k={k} must be in [1, expert_num={E}] "
+            "(a third round over 2 experts would double-dispatch)")
         f = cfg.get("expert_hidden") or 4 * d
         gname, a = _gate_name(name, cfg)
         cfg["_gate"], cfg["_up"], cfg["_down"] = \
@@ -70,7 +84,7 @@ class MoELayer:
 
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
-        x2d, valid, restore = _flatten(inputs[0])
+        x2d, valid, restore = _flatten(inputs[0], ctx)
         y, _aux = moe_ops.moe_ffn(
             x2d, valid, params[cfg["_gate"]], params[cfg["_up"]],
             params[cfg["_down"]], k=cfg.get("k", 2),
@@ -104,7 +118,7 @@ class MoEAuxCostLayer:
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
         v = inputs[0]
-        x2d, valid, _ = _flatten(v)
+        x2d, valid, _ = _flatten(v, ctx)
         logits = jnp.dot(x2d.astype(jnp.float32),
                          params[cfg["_gate"]].astype(jnp.float32))
         capacity = moe_ops.moe_capacity(

@@ -51,6 +51,8 @@ def moe_dispatch(gate_logits: jnp.ndarray, valid: Optional[jnp.ndarray],
     perfectly uniform router).
     """
     n, num_experts = gate_logits.shape
+    assert 1 <= k <= num_experts, (
+        f"moe_dispatch: k={k} must be in [1, num_experts={num_experts}]")
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
     if valid is None:
         valid = jnp.ones((n,), jnp.float32)

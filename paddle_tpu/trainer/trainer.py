@@ -144,7 +144,7 @@ class SGD:
                           sparse_sub=None, injected=None, skip=()):
         outs, new_state = self.topology.forward(
             params, state, feed, mode=mode, rng=rng, sparse_sub=sparse_sub,
-            injected=injected, skip=skip, mesh=self.mesh)
+            injected=injected, skip=skip, mesh=self.mesh, n_real=n_real)
         b = None
         total = 0.0
         metrics = {}

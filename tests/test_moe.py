@@ -74,6 +74,61 @@ class TestDispatch:
                                    rtol=1e-5, atol=1e-5)
 
 
+class TestRowCoupling:
+    def test_k_beyond_experts_rejected(self):
+        with pytest.raises(AssertionError):
+            moe_ops.moe_dispatch(jnp.zeros((4, 2)), None, k=3, capacity=4)
+        with pytest.raises(AssertionError):
+            paddle.init(use_tpu=False, seed=0)
+            x = L.data("x", paddle.data_type.dense_vector(6))
+            L.moe(x, expert_num=2, k=3)
+
+    def test_masked_rows_match_trimmed_batch(self):
+        """At fixed capacity, dispatch/combine/aux over (real + masked
+        pad) rows must equal the trimmed-batch result row for row — pad
+        rows eat no capacity and don't skew the aux statistics."""
+        rng = np.random.RandomState(0)
+        logits6 = jnp.asarray(rng.randn(6, 2).astype(np.float32))
+        logits8 = jnp.concatenate([logits6, jnp.zeros((2, 2))], axis=0)
+        valid = jnp.asarray([1.0] * 6 + [0.0] * 2)
+        d6, c6, a6 = moe_ops.moe_dispatch(logits6, None, k=2, capacity=3)
+        d8, c8, a8 = moe_ops.moe_dispatch(logits8, valid, k=2, capacity=3)
+        np.testing.assert_allclose(np.asarray(d8)[:6], np.asarray(d6))
+        np.testing.assert_allclose(np.asarray(c8)[:6], np.asarray(c6),
+                                   rtol=1e-6)
+        assert float(np.asarray(d8)[6:].sum()) == 0.0
+        np.testing.assert_allclose(float(a8), float(a6), rtol=1e-6)
+
+    def test_trainer_n_real_reaches_dense_routing(self):
+        """The forward ctx's n_real must mask feeder pad rows for DENSE
+        (non-sequence) moe inputs: with it, the aux statistics see 6
+        rows; without it, the 2 zero pad rows join the router and move
+        the aux value."""
+        paddle.init(use_tpu=False, seed=0)
+        from paddle_tpu.core.registry import reset_name_counters
+        reset_name_counters()
+        rng = np.random.RandomState(0)
+        xv = rng.randn(6, 6).astype(np.float32)
+        xpad = np.concatenate([xv, np.zeros((2, 6), np.float32)])
+        x = L.data("x", paddle.data_type.dense_vector(6))
+        node = L.moe(x, expert_num=2, expert_hidden=5, k=2, name="m")
+        aux = L.moe_aux_cost(x, node, coeff=1.0, name="aux")
+        topo = paddle.Topology(aux)
+        params = topo.init_params()
+        state = topo.init_state()
+
+        def run(feed_x, n_real):
+            outs, _ = topo.forward(params, state, {"x": jnp.asarray(feed_x)},
+                                   mode="test", n_real=n_real)
+            return float(np.asarray(outs["aux"])[0])
+
+        full = run(xv, jnp.asarray(6))
+        masked = run(xpad, jnp.asarray(6))
+        unmasked = run(xpad, None)
+        np.testing.assert_allclose(masked, full, rtol=1e-5)
+        assert abs(unmasked - full) > 1e-4   # the mask actually bites
+
+
 def _lm_batch(rng, b=8, T=8, vocab=50):
     ids = rng.randint(0, vocab, (b, T)).astype("int32")
     return [(ids[i], np.arange(T, dtype="int32"), ids[i]) for i in range(b)]
