@@ -273,7 +273,8 @@ class RecurrentGroupLayer:
                 feed[fname] = mv
             outs, _ = sub.forward(params, {}, feed, mode=ctx.mode,
                                   rng=ctx.rng_for(f"{name}@{0}"),
-                                  output_names=list(out_names) + link_names)
+                                  output_names=list(out_names) + link_names,
+                                  n_real=getattr(ctx, "n_real", None))
             new_mems = tuple(
                 outs[ln].data if isinstance(outs[ln], SequenceBatch)
                 else outs[ln] for ln in link_names)
@@ -400,7 +401,8 @@ def _apply_nested_group(ctx: ApplyContext, name, cfg, params, inputs):
             feed[fname] = mv
         outs, _ = sub.forward(params, {}, feed, mode=ctx.mode,
                               rng=ctx.rng_for(f"{name}@nested"),
-                              output_names=list(out_names) + link_names)
+                              output_names=list(out_names) + link_names,
+                              n_real=getattr(ctx, "n_real", None))
         valid = s_idx < n_seg
 
         def freeze(nv, ov):

@@ -19,5 +19,7 @@ __all__ = [
     "resnet", "resnet50", "stacked_lstm_net", "bidi_lstm_net",
     "convolution_net", "ngram_lm", "nmt_attention", "nmt_generator",
     "wide_and_deep", "movielens_regression", "crf_tagger", "rnn_crf_tagger",
+    "transformer_lm", "TransformerDecoder",
 ]
 from paddle_tpu.models.transformer import transformer_lm  # noqa: F401
+from paddle_tpu.models.decode import TransformerDecoder  # noqa: F401

@@ -1,0 +1,181 @@
+"""Incremental (KV-cache) decoding for the transformer LM.
+
+The recurrent zoo generates through `beam_search` (the dynamic
+RecurrentGradientMachine parity path); the transformer needs the modern
+equivalent: a jit-compiled autoregressive loop that carries per-layer
+K/V caches instead of re-running the prefix every step. This module
+reimplements `models.transformer.transformer_lm`'s forward functionally
+over the SAME parameter table (the DSL fixes parameter names, so a
+trained `Parameters` dict drops straight in); `tests/test_decode.py`
+pins step-wise logits against the training graph token for token.
+
+TPU shape discipline: one compilation per (batch, prompt_len, max_len,
+temperature) combination — the prompt prefills in a single batched
+causal pass (one big MXU matmul chain), then `lax.scan` extends one
+token at a time with `dynamic_update_slice` into fixed-size caches.
+Parameters are a jit argument (not trace constants), so one decoder
+serves updated parameter tables without retracing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _ln(x, g, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.maximum(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                      - mean * mean, 0.0)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * g + b).astype(x.dtype)
+
+
+def _heads(x, h):
+    return x.reshape(x.shape[:-1] + (h, x.shape[-1] // h))
+
+
+class TransformerDecoder:
+    """Greedy / temperature sampling with per-layer KV caches.
+
+    params: the training-side parameter dict (Parameters.raw or
+    Topology.init_params output). Config args mirror transformer_lm."""
+
+    def __init__(self, params, *, n_layers: int, n_heads: int,
+                 name: str = "tfm"):
+        prefix = f"_{name}"
+        self.p = {k: jnp.asarray(v) for k, v in params.items()
+                  if k.startswith(prefix)}
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.name = name
+        self._jitted = {}
+
+    # ---------------------------------------------------------------- core
+    def _embed(self, p, ids, pos):
+        n = self.name
+        return (p[f"_{n}_tok_emb.w0"][ids]
+                + p[f"_{n}_pos_emb.w0"][pos])
+
+    def _block(self, p, i, x, k_cache, v_cache, pos, kv_len):
+        """One decoder block over a [b, t, d] slice; reads/extends the
+        [b, T, h, dh] caches at positions [pos, pos+t)."""
+        n, h = self.name, self.n_heads
+        ln1 = _ln(x, p[f"_{n}_l{i}_ln1.w0"], p[f"_{n}_l{i}_ln1.wbias"])
+        q = _heads(ln1 @ p[f"_{n}_l{i}_q.w0"], h)
+        k = _heads(ln1 @ p[f"_{n}_l{i}_k.w0"], h)
+        v = _heads(ln1 @ p[f"_{n}_l{i}_v.w0"], h)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+        t = x.shape[1]
+        T = k_cache.shape[1]
+        scale = q.shape[-1] ** -0.5
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q,
+                            k_cache.astype(q.dtype)) * scale
+        # causal against absolute positions: query row j sits at pos + j
+        qpos = pos + jnp.arange(t)[:, None]
+        kpos = jnp.arange(T)[None, :]
+        mask = (kpos <= qpos) & (kpos < kv_len)
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", w, v_cache.astype(q.dtype))
+        attn = attn.reshape(x.shape)
+        x = x + attn @ p[f"_{n}_l{i}_proj.w0"]
+        ln2 = _ln(x, p[f"_{n}_l{i}_ln2.w0"], p[f"_{n}_l{i}_ln2.wbias"])
+        up = jax.nn.relu(ln2 @ p[f"_{n}_l{i}_up.w0"]
+                         + p[f"_{n}_l{i}_up.wbias"])
+        x = x + up @ p[f"_{n}_l{i}_down.w0"]
+        return x, k_cache, v_cache
+
+    def _logits(self, p, x):
+        n = self.name
+        x = _ln(x, p[f"_{n}_lnf.w0"], p[f"_{n}_lnf.wbias"])
+        return x @ p[f"_{n}_head.w0"] + p[f"_{n}_head.wbias"]
+
+    def _forward(self, p, ids, pos, caches, cache_pos, kv_len):
+        """ids [b, t] -> (logits [b, t, V], caches')."""
+        x = self._embed(p, ids, pos)
+        new_caches = []
+        for i, (kc, vc) in enumerate(caches):
+            x, kc, vc = self._block(p, i, x, kc, vc, cache_pos, kv_len)
+            new_caches.append((kc, vc))
+        return self._logits(p, x), new_caches
+
+    # ------------------------------------------------------------- generate
+    def _build(self, plen: int, max_len: int,
+               temperature: Optional[float]):
+        n, h = self.name, self.n_heads
+
+        def sample(lg, key):
+            if temperature is None:
+                return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                key, lg.astype(jnp.float32) / temperature).astype(jnp.int32)
+
+        def run(p, prompt, rng):
+            b = prompt.shape[0]
+            d = p[f"_{n}_tok_emb.w0"].shape[1]
+            dtype = p[f"_{n}_tok_emb.w0"].dtype
+            caches = [(jnp.zeros((b, max_len, h, d // h), dtype),
+                       jnp.zeros((b, max_len, h, d // h), dtype))
+                      for _ in range(self.n_layers)]
+            # prefill: one batched causal pass over the prompt
+            pos = jnp.arange(plen)[None, :].repeat(b, 0)
+            logits, caches = self._forward(p, prompt, pos, caches, 0, plen)
+            k0, rng = jax.random.split(rng)
+            first = sample(logits[:, -1], k0)
+
+            def step(carry, key):
+                caches, tok, pp = carry
+                lg, caches = self._forward(
+                    p, tok[:, None], jnp.full((b, 1), pp, jnp.int32),
+                    caches, pp, pp + 1)
+                return (caches, sample(lg[:, -1], key), pp + 1), tok
+
+            n_steps = max_len - plen - 1
+            keys = jax.random.split(rng, n_steps) if n_steps > 0 else \
+                jnp.zeros((0, 2), jnp.uint32)
+            (_, last_tok, _), toks = jax.lax.scan(
+                step, (caches, first, jnp.int32(plen)), keys)
+            return jnp.concatenate(
+                [toks.transpose(1, 0), last_tok[:, None]], axis=1)
+
+        return jax.jit(run)
+
+    def generate(self, prompt, max_len: int,
+                 temperature: Optional[float] = None,
+                 rng: Optional[jax.Array] = None,
+                 eos_id: Optional[int] = None):
+        """prompt [b, P] int32 -> per-row generated ids (length
+        max_len - P, trimmed at eos_id when given).
+
+        temperature None = greedy argmax; otherwise categorical at the
+        given temperature. max_len bounds prompt + generation (the KV
+        cache size)."""
+        import numpy as np
+        prompt = jnp.asarray(prompt, jnp.int32)
+        plen = int(prompt.shape[1])
+        assert max_len > plen, f"max_len {max_len} <= prompt length {plen}"
+        pos_rows = self.p[f"_{self.name}_pos_emb.w0"].shape[0]
+        assert max_len <= pos_rows, (
+            f"max_len {max_len} exceeds the position table ({pos_rows} "
+            "rows) — jit gathers clamp silently, so positions past the "
+            "table would all reuse its last row")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        key = (plen, int(max_len), temperature)
+        if key not in self._jitted:
+            self._jitted[key] = self._build(plen, int(max_len), temperature)
+        out = np.asarray(self._jitted[key](self.p, prompt, rng))
+        if eos_id is None:
+            return [list(map(int, row)) for row in out]
+        rows = []
+        for row in out:
+            hit = np.where(row == eos_id)[0]
+            rows.append(list(map(int, row[:hit[0] + 1] if len(hit) else row)))
+        return rows
