@@ -24,6 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.ops import moe as moe_ops
+
 
 def _ln(x, g, b, eps=1e-5):
     xf = x.astype(jnp.float32)
@@ -45,13 +47,24 @@ class TransformerDecoder:
     Topology.init_params output). Config args mirror transformer_lm."""
 
     def __init__(self, params, *, n_layers: int, n_heads: int,
-                 name: str = "tfm"):
+                 name: str = "tfm", moe_k: int = 2,
+                 moe_capacity_factor: float = 1.25):
         prefix = f"_{name}"
         self.p = {k: jnp.asarray(v) for k, v in params.items()
                   if k.startswith(prefix)}
         self.n_layers = n_layers
         self.n_heads = n_heads
         self.name = name
+        # MoE blocks are auto-detected from the parameter table, but k
+        # is NOT recoverable from it: moe_k MUST match the training
+        # config or decode silently diverges. Routing capacity is
+        # computed from the tokens of each CALL (prefill = b*plen
+        # tokens, a decode step = b), so it differs from the training
+        # graph's full-sequence capacity — raise moe_capacity_factor
+        # enough that inference never drops tokens if you need
+        # decode == training-forward numerics.
+        self.moe_k = moe_k
+        self.moe_capacity_factor = moe_capacity_factor
         self._jitted = {}
 
     # ---------------------------------------------------------------- core
@@ -87,9 +100,18 @@ class TransformerDecoder:
         attn = attn.reshape(x.shape)
         x = x + attn @ p[f"_{n}_l{i}_proj.w0"]
         ln2 = _ln(x, p[f"_{n}_l{i}_ln2.w0"], p[f"_{n}_l{i}_ln2.wbias"])
-        up = jax.nn.relu(ln2 @ p[f"_{n}_l{i}_up.w0"]
-                         + p[f"_{n}_l{i}_up.wbias"])
-        x = x + up @ p[f"_{n}_l{i}_down.w0"]
+        if f"_{n}_l{i}_moe.gate" in p:
+            b_, t_, d_ = ln2.shape
+            y2d, _ = moe_ops.moe_ffn(
+                ln2.reshape(b_ * t_, d_), None,
+                p[f"_{n}_l{i}_moe.gate"], p[f"_{n}_l{i}_moe.moe_up"],
+                p[f"_{n}_l{i}_moe.moe_down"], k=self.moe_k,
+                capacity_factor=self.moe_capacity_factor)
+            x = x + y2d.reshape(b_, t_, d_)
+        else:
+            up = jax.nn.relu(ln2 @ p[f"_{n}_l{i}_up.w0"]
+                             + p[f"_{n}_l{i}_up.wbias"])
+            x = x + up @ p[f"_{n}_l{i}_down.w0"]
         return x, k_cache, v_cache
 
     def _logits(self, p, x):

@@ -26,6 +26,7 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
                    d_ff: int = 2048, max_len: int = 2048,
                    moe_experts: int = 0, moe_k: int = 2,
                    moe_aux_coeff: float = 0.01,
+                   moe_capacity_factor: float = 1.25,
                    name: str = "tfm") -> ModelSpec:
     """tokens + positions -> N pre-norm blocks -> next-token CE.
 
@@ -68,6 +69,7 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
         if moe_experts > 0:
             ffn = layer.moe(ln2, expert_num=moe_experts,
                             expert_hidden=d_ff, k=moe_k,
+                            capacity_factor=moe_capacity_factor,
                             name=f"{name}_l{i}_moe")
             aux_costs.append(layer.moe_aux_cost(
                 ln2, ffn, coeff=moe_aux_coeff, name=f"{name}_l{i}_aux"))

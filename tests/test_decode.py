@@ -19,13 +19,13 @@ CFG = dict(vocab_size=40, d_model=16, n_heads=2, n_layers=2, d_ff=32,
            max_len=32)
 
 
-def _model():
+def _model(seed=7, **overrides):
     paddle.init(use_tpu=False, seed=0)
     from paddle_tpu.core.registry import reset_name_counters
     reset_name_counters()
-    spec = models.transformer_lm(**CFG)
+    spec = models.transformer_lm(**{**CFG, **overrides})
     topo = paddle.Topology(spec.cost)
-    params = topo.init_params(jax.random.PRNGKey(7))
+    params = topo.init_params(jax.random.PRNGKey(seed))
     return spec, topo, params
 
 
@@ -117,6 +117,30 @@ class TestGreedyParity:
         eid = rows[0][1] if len(set(rows[0])) > 1 else rows[0][0]
         trimmed = dec.generate(prompt, max_len=12, eos_id=eid)
         assert trimmed[0] == rows[0][:rows[0].index(eid) + 1]
+
+    def test_moe_decode_follows_graph_in_no_drop_regime(self):
+        """MoE blocks are auto-detected from the param table. Capacity
+        derives from each call's token count, so graph parity is only
+        guaranteed when nothing drops — pin it there (ample factor)."""
+        spec, topo, params = _model(seed=3, moe_experts=4,
+                                    moe_capacity_factor=8.0)
+        dec = models.TransformerDecoder(params, n_layers=CFG["n_layers"],
+                                        n_heads=CFG["n_heads"],
+                                        moe_capacity_factor=8.0)
+        rng = np.random.RandomState(2)
+        b, plen, max_len = 2, 3, 8
+        prompt = rng.randint(0, CFG["vocab_size"], (b, plen)).astype("int32")
+        got = dec.generate(prompt, max_len=max_len)
+
+        # graph side: same no-drop regime needs a high factor too — the
+        # graph's capacity covers b*T tokens, which is already ample
+        prefix = prompt.copy()
+        for step in range(max_len - plen):
+            want = _graph_argmax(topo, spec, params, prefix)
+            for row in range(b):
+                assert got[row][step] == int(want[row]), (step, row)
+            prefix = np.concatenate(
+                [prefix, want[:, None].astype("int32")], axis=1)
 
     def test_temperature_sampling_varies(self):
         spec, topo, params = _model()
