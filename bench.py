@@ -301,6 +301,42 @@ def bench_flash_attention(batch: int = 4, seq_len: int = 4096, heads: int = 8,
             "tflops": round(flops / flash_ms / 1e9, 2)}
 
 
+def bench_decode(batch: int = 8, prompt_len: int = 32, max_len: int = 544,
+                 d_model: int = 512, n_layers: int = 6, iters: int = 3):
+    """KV-cache autoregressive decoding throughput (tokens/sec across the
+    batch) on the transformer LM. No 2017 baseline; the RNN era's
+    generation analogue is beam_search. `ms` is per-token latency."""
+    import time
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+
+    spec = models.transformer_lm(vocab_size=32000, d_model=d_model,
+                                 n_heads=8, n_layers=n_layers,
+                                 d_ff=4 * d_model, max_len=max_len)
+    topo = paddle.Topology(spec.cost)
+    params = topo.init_params(jax.random.PRNGKey(0))
+    # the decoder computes in the params' dtype; cast so this row matches
+    # the suite's mixed-precision mode instead of silently running f32
+    from paddle_tpu.config import global_config
+    cdt = global_config().compute_dtype
+    if cdt != "float32":
+        params = {k: v.astype(cdt) for k, v in params.items()}
+    dec = models.TransformerDecoder(params, n_layers=n_layers, n_heads=8)
+    prompt = np.random.RandomState(0).randint(
+        0, 32000, (batch, prompt_len)).astype("int32")
+    dec.generate(prompt, max_len=max_len)        # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        rows = dec.generate(prompt, max_len=max_len)
+    dt = (time.perf_counter() - t0) / iters
+    n_new = len(rows[0])
+    return {"ms": round(dt / n_new * 1e3, 4),
+            "tokens_per_sec": round(batch * n_new / dt, 1),
+            "new_tokens": n_new, "batch": batch}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite", default="all", choices=["headline", "all"])
@@ -369,6 +405,8 @@ def main():
             "flash_attention_t4096", lambda: bench_flash_attention(iters=half))
         suite["transformer_lm_bs8_t1024"] = _row(
             "transformer_lm_bs8_t1024", lambda: bench_transformer(iters=half))
+        suite["decode_bs8_512tok"] = _row(
+            "decode_bs8_512tok", lambda: bench_decode())
 
     head_name = "alexnet_bs128"
     head = suite[head_name]

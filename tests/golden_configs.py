@@ -323,6 +323,26 @@ def beam_cost_net():
         name="beam_ce")
 
 
+def moe_block():
+    """Transformer-style MoE FFN + its aux cost node (cost-list model)."""
+    x = L.data("tok", D.dense_vector_sequence(16))
+    ln = L.layer_norm(x, name="moe_ln")
+    ffn = L.moe(ln, expert_num=4, expert_hidden=32, k=2, name="moe1")
+    aux = L.moe_aux_cost(ln, ffn, coeff=0.01, name="moe_aux")
+    lbl = L.data("y", D.integer_value_sequence(8))
+    head = L.fc(ffn, size=8, act=A.Softmax(), name="moe_head")
+    return [L.cross_entropy_cost(head, lbl, name="moe_ce"), aux]
+
+
+def tpu_stem_net():
+    """space_to_depth stem (resnet tpu_stem variant's shape chain)."""
+    img = L.data("im", D.dense_vector(3 * 8 * 8), height=8, width=8)
+    s2d = L.space_to_depth(img, factor=2, num_channels=3, name="s2d1")
+    c = L.img_conv(s2d, filter_size=3, num_filters=8, padding=1,
+                   name="stem_conv")
+    return L.fc(c, size=4, name="stem_fc")
+
+
 CONFIGS = {
     "simple_fc": simple_fc,
     "img_layers": img_layers,
@@ -353,4 +373,6 @@ CONFIGS = {
     "extra_algebra_layers": extra_algebra_layers,
     "switch_order_net": switch_order_net,
     "beam_cost_net": beam_cost_net,
+    "moe_block": moe_block,
+    "tpu_stem_net": tpu_stem_net,
 }
