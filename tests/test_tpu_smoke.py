@@ -108,3 +108,66 @@ class TestLstmCompiled:
         for a, b_ in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=1e-2, atol=1e-3)
+
+
+class TestCpuTpuParity:
+    """The reference's CPU<->GPU parity discipline (test_matrixCompare.cpp,
+    test_CpuGpuVector.cpp) applied for real: the SAME jitted computation
+    on the TPU backend vs the in-process CPU backend, asserted allclose.
+    JAX always carries a CPU backend, so this needs no process tricks."""
+
+    def _both(self, fn, *args):
+        # placement follows the committed inputs (jit's device= kwarg is
+        # deprecated): default device_put -> TPU, explicit put -> CPU
+        cpu = jax.devices("cpu")[0]
+        on_t = jax.jit(fn)(*args)
+        on_c = jax.jit(fn)(
+            *jax.tree_util.tree_map(lambda a: jax.device_put(a, cpu), args))
+        return (jax.tree_util.tree_map(np.asarray, on_t),
+                jax.tree_util.tree_map(np.asarray, on_c))
+
+    def test_fc_train_grads(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(32, 64).astype(np.float32))
+        w = jnp.asarray(rng.randn(64, 16).astype(np.float32))
+
+        def loss(x, w):
+            from paddle_tpu.ops import linear
+            return jnp.sum(jax.nn.softmax(linear.matmul(x, w)) ** 2)
+
+        t, c = self._both(jax.grad(loss, argnums=(0, 1)), x, w)
+        for a, b in zip(t, c):
+            np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-4)
+
+    def test_conv_bn_forward(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(4, 16, 16, 8).astype(np.float32))
+        k = jnp.asarray(rng.randn(3, 3, 8, 16).astype(np.float32) * 0.1)
+
+        def f(x, k):
+            from paddle_tpu.ops import conv as conv_ops
+            from paddle_tpu.ops import norm as norm_ops
+            y = conv_ops.conv2d(x, k, stride=1, padding=1)
+            g = jnp.ones((16,), jnp.float32)
+            b = jnp.zeros((16,), jnp.float32)
+            out, _, _ = norm_ops.batch_norm_train(
+                y, g, b, jnp.zeros((16,)), jnp.ones((16,)))
+            return out
+
+        t, c = self._both(f, x, k)
+        np.testing.assert_allclose(t, c, rtol=2e-3, atol=2e-3)
+
+    def test_seqpool_embedding_path(self):
+        rng = np.random.RandomState(2)
+        ids = jnp.asarray(rng.randint(0, 50, (8, 12)).astype(np.int32))
+        table = jnp.asarray(rng.randn(50, 24).astype(np.float32))
+        lens = jnp.asarray(rng.randint(1, 13, (8,)), jnp.int32)
+
+        def f(table, ids):
+            e = table[ids]                              # [b, T, d]
+            m = (jnp.arange(12)[None, :] < lens[:, None]).astype(e.dtype)
+            s = jnp.sum(e * m[:, :, None], axis=1)
+            return s / jnp.maximum(lens[:, None].astype(e.dtype), 1.0)
+
+        t, c = self._both(f, table, ids)
+        np.testing.assert_allclose(t, c, rtol=1e-4, atol=1e-5)
