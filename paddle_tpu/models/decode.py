@@ -128,11 +128,32 @@ class TransformerDecoder:
             new_caches.append((kc, vc))
         return self._logits(p, x), new_caches
 
+    def _prefill(self, p, prompt, plen, max_len):
+        """Allocate the fixed-size caches and run the one batched causal
+        pass over the prompt. -> (last-position logits path input, caches)."""
+        n, h = self.name, self.n_heads
+        b = prompt.shape[0]
+        d = p[f"_{n}_tok_emb.w0"].shape[1]
+        dtype = p[f"_{n}_tok_emb.w0"].dtype
+        caches = [(jnp.zeros((b, max_len, h, d // h), dtype),
+                   jnp.zeros((b, max_len, h, d // h), dtype))
+                  for _ in range(self.n_layers)]
+        pos = jnp.arange(plen)[None, :].repeat(b, 0)
+        return self._forward(p, prompt, pos, caches, 0, plen)
+
+    def _validate(self, prompt, max_len):
+        plen = int(prompt.shape[1])
+        assert max_len > plen, f"max_len {max_len} <= prompt length {plen}"
+        pos_rows = self.p[f"_{self.name}_pos_emb.w0"].shape[0]
+        assert max_len <= pos_rows, (
+            f"max_len {max_len} exceeds the position table ({pos_rows} "
+            "rows) — jit gathers clamp silently, so positions past the "
+            "table would all reuse its last row")
+        return plen
+
     # ------------------------------------------------------------- generate
     def _build(self, plen: int, max_len: int,
                temperature: Optional[float]):
-        n, h = self.name, self.n_heads
-
         def sample(lg, key):
             if temperature is None:
                 return jnp.argmax(lg, axis=-1).astype(jnp.int32)
@@ -141,14 +162,7 @@ class TransformerDecoder:
 
         def run(p, prompt, rng):
             b = prompt.shape[0]
-            d = p[f"_{n}_tok_emb.w0"].shape[1]
-            dtype = p[f"_{n}_tok_emb.w0"].dtype
-            caches = [(jnp.zeros((b, max_len, h, d // h), dtype),
-                       jnp.zeros((b, max_len, h, d // h), dtype))
-                      for _ in range(self.n_layers)]
-            # prefill: one batched causal pass over the prompt
-            pos = jnp.arange(plen)[None, :].repeat(b, 0)
-            logits, caches = self._forward(p, prompt, pos, caches, 0, plen)
+            logits, caches = self._prefill(p, prompt, plen, max_len)
             k0, rng = jax.random.split(rng)
             first = sample(logits[:, -1], k0)
 
@@ -169,6 +183,89 @@ class TransformerDecoder:
 
         return jax.jit(run)
 
+    # ---------------------------------------------------------- beam search
+    def _build_beam(self, plen: int, max_len: int, beam_size: int,
+                    eos_id: int):
+        n = self.name
+        K = beam_size
+
+        def run(p, prompt):
+            b = prompt.shape[0]
+            V = p[f"_{n}_head.w0"].shape[1]
+            logits, caches = self._prefill(p, prompt, plen, max_len)
+            lp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+            # seed K lanes with the top-K first tokens
+            scores, tok0 = jax.lax.top_k(lp0, K)          # [b, K]
+            caches = [(jnp.repeat(kc, K, axis=0), jnp.repeat(vc, K, axis=0))
+                      for kc, vc in caches]               # [b*K, ...]
+            tokens = jnp.full((b, K, max_len - plen), eos_id, jnp.int32)
+            tokens = tokens.at[:, :, 0].set(tok0)
+            alive = tok0 != eos_id                        # [b, K]
+
+            def step(carry, t):
+                caches, tokens, scores, alive = carry
+                last = tokens[:, :, t - 1].reshape(b * K)
+                lg, caches2 = self._forward(
+                    p, last[:, None],
+                    jnp.full((b * K, 1), plen + t - 1, jnp.int32),
+                    caches, plen + t - 1, plen + t)
+                lp = jax.nn.log_softmax(
+                    lg[:, -1].astype(jnp.float32)).reshape(b, K, V)
+                # finished beams: only the eos continuation, at no cost —
+                # the lane's score freezes and it keeps emitting eos
+                frozen = jnp.full((V,), -1e30).at[eos_id].set(0.0)
+                lp = jnp.where(alive[:, :, None], lp, frozen[None, None])
+                total = scores[:, :, None] + lp           # [b, K, V]
+                scores2, flat = jax.lax.top_k(total.reshape(b, K * V), K)
+                parent = flat // V                        # [b, K]
+                tok = (flat % V).astype(jnp.int32)
+                # reorder histories + caches to follow the winning parents
+                gather = lambda a: jnp.take_along_axis(a, parent[..., None],
+                                                       axis=1)
+                tokens2 = jnp.take_along_axis(
+                    tokens, parent[:, :, None], axis=1).at[:, :, t].set(tok)
+                pflat = (jnp.arange(b)[:, None] * K + parent).reshape(-1)
+                caches2 = [(kc[pflat], vc[pflat]) for kc, vc in caches2]
+                alive2 = gather(alive[..., None])[..., 0] & (tok != eos_id)
+                return (caches2, tokens2, scores2, alive2), 0
+
+            n_steps = max_len - plen - 1
+            (caches, tokens, scores, alive), _ = jax.lax.scan(
+                step, (caches, tokens, scores, alive),
+                jnp.arange(1, n_steps + 1))
+            return tokens, scores
+
+        return jax.jit(run)
+
+    def beam_search(self, prompt, max_len: int, beam_size: int = 4,
+                    eos_id: int = 0, num_results: Optional[int] = None):
+        """prompt [b, P] -> per-sample n-best [(score, tokens), ...],
+        best first — the transformer analogue of the recurrent zoo's
+        `beam_search` layer (scores are summed token log-probs; finished
+        beams freeze at their EOS). Rows are trimmed at the first EOS."""
+        import numpy as np
+        prompt = jnp.asarray(prompt, jnp.int32)
+        plen = self._validate(prompt, max_len)
+        n_keep = num_results if num_results is not None else beam_size
+        assert 1 <= n_keep <= beam_size, (
+            f"num_results={num_results} must be in [1, beam_size]")
+        key = ("beam", plen, int(max_len), beam_size, eos_id)
+        if key not in self._jitted:
+            self._jitted[key] = self._build_beam(plen, int(max_len),
+                                                 beam_size, eos_id)
+        toks, scores = self._jitted[key](self.p, prompt)
+        toks, scores = np.asarray(toks), np.asarray(scores)
+        out = []
+        for bi in range(toks.shape[0]):
+            rows = []
+            for ki in range(toks.shape[1]):
+                row = list(map(int, toks[bi, ki]))
+                if eos_id in row:
+                    row = row[:row.index(eos_id) + 1]
+                rows.append((float(scores[bi, ki]), row))
+            out.append(rows[:n_keep])
+        return out
+
     def generate(self, prompt, max_len: int,
                  temperature: Optional[float] = None,
                  rng: Optional[jax.Array] = None,
@@ -181,13 +278,7 @@ class TransformerDecoder:
         cache size)."""
         import numpy as np
         prompt = jnp.asarray(prompt, jnp.int32)
-        plen = int(prompt.shape[1])
-        assert max_len > plen, f"max_len {max_len} <= prompt length {plen}"
-        pos_rows = self.p[f"_{self.name}_pos_emb.w0"].shape[0]
-        assert max_len <= pos_rows, (
-            f"max_len {max_len} exceeds the position table ({pos_rows} "
-            "rows) — jit gathers clamp silently, so positions past the "
-            "table would all reuse its last row")
+        plen = self._validate(prompt, max_len)
         if rng is None:
             rng = jax.random.PRNGKey(0)
         key = (plen, int(max_len), temperature)

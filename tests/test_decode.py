@@ -154,3 +154,63 @@ class TestGreedyParity:
         assert a != bb          # different keys explore different paths
         g = dec.generate(prompt, max_len=16)
         assert g == dec.generate(prompt, max_len=16)   # greedy is stable
+
+
+class TestBeamSearch:
+    def test_beam1_equals_greedy(self):
+        spec, topo, params = _model()
+        dec = models.TransformerDecoder(params, n_layers=CFG["n_layers"],
+                                        n_heads=CFG["n_heads"])
+        rng = np.random.RandomState(4)
+        prompt = rng.randint(0, CFG["vocab_size"], (2, 3)).astype("int32")
+        eid = CFG["vocab_size"] - 1
+        greedy = dec.generate(prompt, max_len=10, eos_id=eid)
+        beam = dec.beam_search(prompt, max_len=10, beam_size=1, eos_id=eid)
+        for row in range(2):
+            assert beam[row][0][1] == greedy[row]
+
+    def test_nbest_sorted_and_scores_match_graph(self):
+        """Beam scores must equal the training graph's summed token
+        log-probs for the returned sequence (teacher-forced recompute)."""
+        spec, topo, params = _model()
+        dec = models.TransformerDecoder(params, n_layers=CFG["n_layers"],
+                                        n_heads=CFG["n_heads"])
+        rng = np.random.RandomState(5)
+        b, plen, max_len, K = 2, 3, 9, 3
+        prompt = rng.randint(0, CFG["vocab_size"], (b, plen)).astype("int32")
+        eid = CFG["vocab_size"] - 1
+        results = dec.beam_search(prompt, max_len=max_len, beam_size=K,
+                                  eos_id=eid)
+        for bi in range(b):
+            scores = [s for s, _ in results[bi]]
+            assert scores == sorted(scores, reverse=True)
+            # recompute the best row's score through the graph
+            score, row = results[bi][0]
+            full = np.concatenate([prompt[bi], np.array(row, "int32")])
+            want = 0.0
+            for t in range(len(row)):
+                pre = full[None, :plen + t]
+                lens = jnp.full((1,), pre.shape[1], jnp.int32)
+                sb = lambda a: SequenceBatch(jnp.asarray(a), lens)
+                pos = np.arange(pre.shape[1], dtype="int32")[None]
+                feed = {spec.data.name: sb(pre),
+                        spec.positions.name: sb(pos),
+                        spec.label.name: sb(pre)}
+                outs, _ = topo.forward(params, topo.init_state(), feed,
+                                       mode="test",
+                                       output_names=[spec.output.name])
+                probs = np.asarray(outs[spec.output.name].data[0, -1])
+                want += float(np.log(max(probs[row[t]], 1e-30)))
+                if row[t] == eid:
+                    break
+            np.testing.assert_allclose(score, want, rtol=1e-3, atol=1e-3)
+
+    def test_beams_are_distinct(self):
+        spec, topo, params = _model()
+        dec = models.TransformerDecoder(params, n_layers=CFG["n_layers"],
+                                        n_heads=CFG["n_heads"])
+        prompt = np.zeros((1, 2), "int32")
+        res = dec.beam_search(prompt, max_len=8, beam_size=4,
+                              eos_id=CFG["vocab_size"] - 1)
+        rows = [tuple(r) for _, r in res[0]]
+        assert len(set(rows)) == len(rows)
