@@ -100,11 +100,15 @@ def memory(name: str, size: int, boot_layer: Optional[LayerOutput] = None,
 
 
 def recurrent_group(step, input, reverse: bool = False,
-                    name: Optional[str] = None, **kw) -> LayerOutput:
+                    name: Optional[str] = None, remat: bool = False,
+                    **kw) -> LayerOutput:
     """Run `step` over every timestep of the input sequence(s).
 
     input: LayerOutput sequence(s) and/or StaticInput(s). Returns the
     sequence of step outputs (a level-1 SequenceBatch node).
+    remat=True jax.checkpoints the step body: the backward pass keeps
+    only the per-step memory carries and recomputes step interiors
+    (identical numerics, less activation memory on long sequences).
     """
     from paddle_tpu.core.registry import _auto_name
     from paddle_tpu.core.topology import Topology
@@ -171,8 +175,10 @@ def recurrent_group(step, input, reverse: bool = False,
     # Hoist sub-params into the group node.
     outer_inputs = seq_inputs + [s.input for s in static_inputs] + \
         group["boot_layers"]
+    group_kw = {"remat": True} if remat else {}
     node = make_layer(
         "recurrent_group", gname, outer_inputs,
+        **group_kw,
         n_seq=len(seq_inputs), n_static=len(static_inputs),
         reverse=reverse,
         nested=nested,
@@ -295,6 +301,11 @@ class RecurrentGroupLayer:
             return merged, tuple(outs_t)
 
         tidx = jnp.arange(T, dtype=jnp.int32)
+        if cfg.get("remat"):
+            # jax.checkpoint the step body: backward keeps only the memory
+            # carries per timestep and recomputes the step interior — the
+            # FLOPs-for-memory trade for long sequences
+            body = jax.checkpoint(body)
         _, outs_all = lax.scan(body, tuple(mems), (tidx, xs))
 
         def finalize(outs):
@@ -429,6 +440,8 @@ def _apply_nested_group(ctx: ApplyContext, name, cfg, params, inputs):
     s_idx = jnp.arange(S, dtype=jnp.int32)
     xs = tuple((jnp.moveaxis(dat, 0, 1), jnp.moveaxis(ilen, 0, 1))
                for dat, ilen in views)          # [S, b, L, d], [S, b]
+    if cfg.get("remat"):
+        body = jax.checkpoint(body)     # same trade as the flat path
     _, outs_all = lax.scan(body, tuple(mems), (s_idx, xs))
 
     results = []

@@ -29,7 +29,7 @@ from paddle_tpu.parallel.mesh import PP_AXIS
 
 def pipeline(stage_fn: Callable, stage_params, x: jnp.ndarray, mesh: Mesh,
              num_microbatches: Optional[int] = None,
-             axis_name: str = PP_AXIS) -> jnp.ndarray:
+             axis_name: str = PP_AXIS, remat: bool = False) -> jnp.ndarray:
     """Run `stage_fn` as an n-stage pipeline.
 
     stage_fn(params_i, x_mb) -> y_mb, shape-preserving ([mb, ...] in/out).
@@ -37,9 +37,16 @@ def pipeline(stage_fn: Callable, stage_params, x: jnp.ndarray, mesh: Mesh,
       (stage i's slice lives on chip i — sharded over `pp`).
     x: [batch, ...] global input; split into `num_microbatches` equal
       microbatches (default: n_stages, the minimum that fills the ring).
+    remat: wrap each stage in jax.checkpoint so the backward pass holds
+      only stage-BOUNDARY activations per tick and recomputes the stage
+      interior — the FLOPs-for-memory trade (identical numerics; the
+      standard companion of microbatch pipelining, since scan otherwise
+      saves every tick's interior residuals for the reversed pass).
 
     Returns [batch, ...] outputs (replicated over pp).
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     n = mesh.shape[axis_name]
     for leaf in jax.tree_util.tree_leaves(stage_params):
         assert leaf.shape[0] == n, \

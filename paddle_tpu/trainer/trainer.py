@@ -41,7 +41,8 @@ class SGD:
     def __init__(self, cost, parameters: Parameters, update_equation,
                  extra_layers: Optional[Sequence[LayerOutput]] = None,
                  is_local: bool = True, mesh=None, evaluators=None,
-                 pipeline_stages=None, **kwargs):
+                 pipeline_stages=None, pipeline_remat: bool = False,
+                 **kwargs):
         costs = cost if isinstance(cost, (list, tuple)) else [cost]
         self.costs = list(costs)
         self.extra_layers = list(extra_layers or [])
@@ -104,6 +105,9 @@ class SGD:
         # axis (ParallelNeuralNetwork deviceId-pinning parity):
         # [[stage0 layer names], [stage1 ...], ...]
         self.pipeline_stages = pipeline_stages
+        # jax.checkpoint each pipeline stage: backward holds only stage
+        # boundaries and recomputes interiors (FLOPs-for-memory trade)
+        self.pipeline_remat = pipeline_remat
         self._train_step = self._build_train_step()
         self._test_step = self._build_test_step()
 
@@ -277,7 +281,8 @@ class SGD:
 
         def step(params, opt_state, state, feed, rng, n_real):
             def loss_fn(p):
-                y = pipeline(stage_fn, stack_params(p), feed[x_src], mesh)
+                y = pipeline(stage_fn, stack_params(p), feed[x_src], mesh,
+                             remat=self.pipeline_remat)
                 return self._loss_and_metrics(
                     p, state, feed, rng, n_real, "train",
                     injected={body_end: y}, skip=body_names)

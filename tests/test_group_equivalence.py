@@ -134,3 +134,53 @@ class TestGruGroupEquivalence:
         np.testing.assert_allclose(np.asarray(outs[fused.name].data),
                                    np.asarray(outs[grouped.name].data),
                                    rtol=1e-6, atol=1e-6)
+
+
+class TestGroupRemat:
+    def test_remat_group_identical_grads(self):
+        """recurrent_group(remat=True) must produce bit-identical loss and
+        gradients — jax.checkpoint changes only what the backward stores."""
+        from paddle_tpu.core import registry
+        from paddle_tpu.core.sequence import pack_sequences
+        from paddle_tpu.core.topology import Topology
+
+        def build(remat):
+            registry.reset_name_counters()
+            paddle.init(use_tpu=False, seed=0)
+            x = paddle.layer.data(
+                "x", paddle.data_type.dense_vector_sequence(6))
+
+            def step(xt):
+                prev = paddle.layer.memory(name="h", size=8)
+                return paddle.layer.fc(
+                    paddle.layer.concat([xt, prev]), size=8,
+                    act=paddle.activation.Tanh(), name="h")
+
+            out = paddle.layer.recurrent_group(step, x, remat=remat,
+                                               name="rg")
+            pooled = paddle.layer.pooling(out, paddle.pooling.Sum())
+            return Topology(paddle.layer.fc(pooled, size=1, name="o"))
+
+        rng = np.random.RandomState(0)
+        rows = [rng.randn(t, 6).astype("float32") for t in (3, 5)]
+        feed = {"x": pack_sequences(rows)}
+
+        results = []
+        for remat in (False, True):
+            topo = build(remat)
+            params = topo.init_params(jax.random.PRNGKey(1))
+
+            def loss(p):
+                outs, _ = topo.forward(p, topo.init_state(), feed,
+                                       mode="train",
+                                       rng=jax.random.PRNGKey(2))
+                return jnp.sum(outs["o"] ** 2)
+
+            val, grads = jax.jit(jax.value_and_grad(loss))(params)
+            results.append((float(val),
+                            {k: np.asarray(v) for k, v in grads.items()}))
+
+        (v0, g0), (v1, g1) = results
+        assert v0 == v1
+        for k in g0:
+            np.testing.assert_array_equal(g0[k], g1[k], err_msg=k)

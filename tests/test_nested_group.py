@@ -205,3 +205,44 @@ class TestNestedGroup:
                                 mode="test", rng=jax.random.PRNGKey(1))
         got = outs[g.name]
         np.testing.assert_array_equal(np.asarray(got.lengths), [2, 3])
+
+
+class TestNestedGroupRemat:
+    def test_remat_nested_group_identical(self):
+        """remat=True on a NESTED group must checkpoint its scan body too
+        (not just the flat path) — outputs and grads bit-identical."""
+        rows, seq = nested_feed()
+
+        def build(remat):
+            from paddle_tpu.core import registry
+            registry.reset_name_counters()
+            ns = L.data("ns", paddle.data_type.dense_vector_sub_sequence(3))
+
+            def step(sub):
+                return L.pooling(L.fc(sub, size=4, name="nf",
+                                      act=paddle.activation.Tanh()),
+                                 pooling_type=paddle.pooling.Avg())
+
+            g = L.recurrent_group(step=step, input=L.SubsequenceInput(ns),
+                                  remat=remat, name="nrg")
+            pooled = L.pooling(g, pooling_type=paddle.pooling.Sum())
+            return Topology(L.fc(pooled, size=1, name="no"))
+
+        vals = []
+        for remat in (False, True):
+            topo = build(remat)
+            params = topo.init_params(jax.random.PRNGKey(5))
+
+            def loss(p):
+                outs, _ = topo.forward(p, topo.init_state(), {"ns": seq},
+                                       mode="train",
+                                       rng=jax.random.PRNGKey(6))
+                return jnp.sum(outs["no"] ** 2)
+
+            val, grads = jax.jit(jax.value_and_grad(loss))(params)
+            vals.append((float(val),
+                         {k: np.asarray(v) for k, v in grads.items()}))
+        (v0, g0), (v1, g1) = vals
+        assert v0 == v1
+        for k in g0:
+            np.testing.assert_array_equal(g0[k], g1[k], err_msg=k)

@@ -38,14 +38,15 @@ def _reader(n_batches=3, b=8):
     return reader
 
 
-def _train(mesh=None, stages=None):
+def _train(mesh=None, stages=None, remat=False):
     paddle.init(seed=0)
     cost = _model()
     params = paddle.create_parameters(paddle.Topology(cost))
     tr = paddle.SGD(cost=cost, parameters=params,
                     update_equation=paddle.optimizer.Momentum(
                         learning_rate=0.1, momentum=0.9),
-                    mesh=mesh, pipeline_stages=stages)
+                    mesh=mesh, pipeline_stages=stages,
+                    pipeline_remat=remat)
     losses = []
     tr.train(_reader(), num_passes=2,
              event_handler=lambda e: losses.append(e.cost)
@@ -58,6 +59,21 @@ class TestPipelineSGD:
         mesh = create_mesh([(PP_AXIS, 2)])
         tr_pp, losses_pp = _train(mesh, [["pfc0", "pfc1"],
                                          ["pfc2", "pfc3"]])
+        tr_ref, losses_ref = _train()
+        np.testing.assert_allclose(losses_pp, losses_ref,
+                                   rtol=1e-4, atol=1e-5)
+        for k in tr_ref.parameters.raw:
+            np.testing.assert_allclose(
+                np.asarray(tr_pp.parameters.raw[k]),
+                np.asarray(tr_ref.parameters.raw[k]),
+                rtol=1e-4, atol=1e-5, err_msg=k)
+
+    def test_pp2_remat_matches_single_device(self):
+        """jax.checkpoint on the stages trades FLOPs for memory but must
+        not change a single bit of the math."""
+        mesh = create_mesh([(PP_AXIS, 2)])
+        tr_pp, losses_pp = _train(mesh, [["pfc0", "pfc1"],
+                                         ["pfc2", "pfc3"]], remat=True)
         tr_ref, losses_ref = _train()
         np.testing.assert_allclose(losses_pp, losses_ref,
                                    rtol=1e-4, atol=1e-5)
