@@ -27,12 +27,17 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
                    moe_experts: int = 0, moe_k: int = 2,
                    moe_aux_coeff: float = 0.01,
                    moe_capacity_factor: float = 1.25,
+                   dropout: float = 0.0,
                    name: str = "tfm") -> ModelSpec:
     """tokens + positions -> N pre-norm blocks -> next-token CE.
 
     Feed contract: (token_ids, position_ids, next_token_ids) — three
     integer sequences of equal length (positions are just 0..T-1; a data
     input keeps the graph free of iota-on-ragged-length corner cases).
+
+    dropout > 0 adds residual-branch dropout after the attention
+    projection and the FFN (train mode only; the KV-cache decoder and
+    test mode see the deterministic graph).
 
     moe_experts > 0 swaps every block's dense FFN for a top-`moe_k`
     capacity-routed mixture of `moe_experts` experts (layers.moe); the
@@ -63,6 +68,8 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
                                            name=f"{name}_l{i}_attn")
         proj = layer.fc(attn, size=d_model, bias_attr=False,
                         name=f"{name}_l{i}_proj")
+        if dropout > 0:
+            proj = layer.dropout(proj, dropout, name=f"{name}_l{i}_drop1")
         x = layer.addto([x, proj], name=f"{name}_l{i}_res1")
 
         ln2 = layer.layer_norm(x, name=f"{name}_l{i}_ln2")
@@ -78,6 +85,8 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
                           name=f"{name}_l{i}_up")
             ffn = layer.fc(up, size=d_model, bias_attr=False,
                            name=f"{name}_l{i}_down")
+        if dropout > 0:
+            ffn = layer.dropout(ffn, dropout, name=f"{name}_l{i}_drop2")
         x = layer.addto([x, ffn], name=f"{name}_l{i}_res2")
 
     xf = layer.layer_norm(x, name=f"{name}_lnf")

@@ -10,6 +10,7 @@ the jitted update.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -28,4 +29,12 @@ def make_schedule(name: str, lr: float, a: float = 0.0, b: float = 0.0):
         return lambda t: lr * jnp.power(a, jnp.floor(t / b))
     if name == "linear":
         return lambda t: jnp.maximum(lr - a * t, b)
+    if name == "noam":
+        # transformer warmup-then-rsqrt decay (beyond the 2017 set):
+        # lr * min(t^-1/2, t * warmup^-3/2) with a = warmup steps/samples
+        # (b unused). Peaks at lr / sqrt(a) when t == a.
+        warm = max(a, 1.0)
+        return lambda t: lr * jnp.minimum(
+            jax.lax.rsqrt(jnp.maximum(t, 1.0)),
+            t * (warm ** -1.5))
     raise ValueError(f"unknown learning_rate_schedule {name!r}")

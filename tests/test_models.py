@@ -201,3 +201,45 @@ class TestTransformerLM:
             loss, _ = tr.train_batch(batch())
             first = first if first is not None else loss
         assert loss < first * 0.8, (first, loss)
+
+
+class TestTransformerOptions:
+    def test_dropout_trains_and_test_mode_deterministic(self):
+        spec = M.transformer_lm(vocab_size=40, d_model=16, n_heads=2,
+                                n_layers=1, d_ff=32, max_len=16,
+                                dropout=0.2)
+        topo = paddle.Topology(spec.cost)
+        params = topo.init_params()
+        from paddle_tpu.core.sequence import SequenceBatch
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 40, (2, 6)).astype("int32")
+        lens = jnp.full((2,), 6, jnp.int32)
+        sb = lambda a: SequenceBatch(jnp.asarray(a), lens)
+        pos = np.tile(np.arange(6, dtype="int32"), (2, 1))
+        feed = {spec.data.name: sb(ids), spec.positions.name: sb(pos),
+                spec.label.name: sb(ids)}
+        import jax
+        # test mode: dropout is identity -> deterministic
+        o1, _ = topo.forward(params, topo.init_state(), feed, mode="test")
+        o2, _ = topo.forward(params, topo.init_state(), feed, mode="test")
+        np.testing.assert_array_equal(
+            np.asarray(o1[spec.cost.name]), np.asarray(o2[spec.cost.name]))
+        # train mode: two rng keys give different costs (dropout active)
+        t1, _ = topo.forward(params, topo.init_state(), feed, mode="train",
+                             rng=jax.random.PRNGKey(1))
+        t2, _ = topo.forward(params, topo.init_state(), feed, mode="train",
+                             rng=jax.random.PRNGKey(2))
+        assert not np.allclose(np.asarray(t1[spec.cost.name]),
+                               np.asarray(t2[spec.cost.name]))
+
+    def test_noam_schedule_shape(self):
+        from paddle_tpu.optimizer.schedules import make_schedule
+        import jax.numpy as jnp
+        f = make_schedule("noam", lr=1.0, a=100.0)
+        warm = [float(f(jnp.asarray(t, jnp.float32))) for t in
+                (1, 50, 100, 400, 10000)]
+        assert warm[0] < warm[1] < warm[2]          # rising during warmup
+        assert warm[2] > warm[3] > warm[4]          # decaying after
+        np.testing.assert_allclose(warm[2], 100 ** -0.5, rtol=1e-5)
+        np.testing.assert_allclose(warm[3], 400 ** -0.5, rtol=1e-5)
