@@ -479,8 +479,24 @@ def classification_cost(input, label, weight=None, name=None,
     return make_layer("multi-class-cross-entropy", name, nodes)
 
 
-def cross_entropy_cost(input, label, name=None, **kw) -> LayerOutput:
-    return make_layer("multi-class-cross-entropy", name, [input, label])
+def cross_entropy_cost(input, label, name=None, from_logits: bool = False,
+                       label_smoothing: float = 0.0, **kw) -> LayerOutput:
+    # non-default options only, so existing serialized topologies (and
+    # the golden corpus) are byte-stable
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing={label_smoothing} must be in [0, 1)")
+    if label_smoothing > 0.0 and not from_logits:
+        raise ValueError(
+            "label_smoothing needs from_logits=True (the probs CE path "
+            "gathers only the label column)")
+    opts = {}
+    if from_logits:
+        opts["from_logits"] = True
+    if label_smoothing > 0.0:
+        opts["label_smoothing"] = label_smoothing
+    return make_layer("multi-class-cross-entropy", name, [input, label],
+                      **opts)
 
 
 def cross_entropy_with_selfnorm_cost(input, label, name=None,

@@ -56,7 +56,9 @@ class CrossEntropyCost:
         pred, label = inputs[0], inputs[1]
         out = _seq_or_sample_cost(
             lambda p, l: cost_ops.cross_entropy(
-                p, l, from_logits=cfg.get("from_logits", False)), pred, label)
+                p, l, from_logits=cfg.get("from_logits", False),
+                label_smoothing=cfg.get("label_smoothing", 0.0)),
+            pred, label)
         if len(inputs) > 2:  # weight input
             out = out * _payload(inputs[2]).reshape(out.shape)
         return out

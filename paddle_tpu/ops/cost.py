@@ -29,18 +29,28 @@ def _one_hot_nll(log_probs: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 
 
 def cross_entropy(probs_or_logits: jnp.ndarray, labels: jnp.ndarray, *,
-                  from_logits: bool = False, eps: float = 1e-10) -> jnp.ndarray:
+                  from_logits: bool = False, eps: float = 1e-10,
+                  label_smoothing: float = 0.0) -> jnp.ndarray:
     """Multi-class CE with integer labels (classification_cost).
 
     The reference applies softmax in the preceding layer and CE on probs
     (CostLayer.cpp MultiClassCrossEntropy); from_logits=True fuses the
     numerically-stable log_softmax path, which is what the jit graph should
-    prefer (XLA fuses it into one kernel).
+    prefer (XLA fuses it into one kernel). label_smoothing=a mixes the
+    one-hot target with uniform mass a/V (logits path only — the probs
+    path stays the gather-only fast form).
     """
     if from_logits:
         x = probs_or_logits.astype(jnp.float32)   # stable log under bf16
         lp = jax.nn.log_softmax(x, axis=-1)
+        if label_smoothing > 0.0:
+            a = label_smoothing
+            return -((1.0 - a) * _gather_label(lp, labels)
+                     + a * jnp.mean(lp, axis=-1))
         return _one_hot_nll(lp, labels)
+    assert label_smoothing == 0.0, \
+        "label_smoothing needs from_logits=True (probs CE gathers only " \
+        "the label column)"
     # probs path: gather the label's prob FIRST, then upcast+log only the
     # gathered column — elementwise astype/log commute with the gather,
     # so numerics are identical, but the [.., V] tensor is never

@@ -180,3 +180,42 @@ class TestEmbedding:
         np.testing.assert_allclose(out[0, 0], [0, 1, 2])
         np.testing.assert_allclose(out[0, 1], [9, 10, 11])
         np.testing.assert_allclose(out[0, 2], [0, 0, 0])
+
+
+class TestLabelSmoothing:
+    def test_smoothing_value_matches_manual(self):
+        rng = np.random.RandomState(0)
+        logits = jnp.asarray(rng.randn(4, 7).astype(np.float32))
+        labels = jnp.asarray([0, 3, 6, 2], jnp.int32)
+        a = 0.1
+        got = cost.cross_entropy(logits, labels, from_logits=True,
+                                     label_smoothing=a)
+        lp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+        want = [-( (1 - a) * lp[i, int(labels[i])] + a * lp[i].mean())
+                for i in range(4)]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_zero_smoothing_is_plain_ce(self):
+        rng = np.random.RandomState(1)
+        logits = jnp.asarray(rng.randn(3, 5).astype(np.float32))
+        labels = jnp.asarray([1, 4, 0], jnp.int32)
+        a = cost.cross_entropy(logits, labels, from_logits=True)
+        b = cost.cross_entropy(logits, labels, from_logits=True,
+                                   label_smoothing=0.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_probs_path_rejects_smoothing(self):
+        with pytest.raises(AssertionError):
+            cost.cross_entropy(jnp.ones((2, 3)) / 3,
+                               jnp.zeros((2,), jnp.int32),
+                               label_smoothing=0.1)
+        # and at graph-construction time, as a real exception
+        import paddle_tpu as paddle
+        L = paddle.layer
+        x = L.data("lsx", paddle.data_type.dense_vector(3))
+        lbl = L.data("lsy", paddle.data_type.integer_value(3))
+        with pytest.raises(ValueError, match="from_logits"):
+            L.cross_entropy_cost(x, lbl, label_smoothing=0.1)
+        with pytest.raises(ValueError, match="must be in"):
+            L.cross_entropy_cost(x, lbl, from_logits=True,
+                                 label_smoothing=-0.1)
