@@ -27,7 +27,7 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
                    moe_experts: int = 0, moe_k: int = 2,
                    moe_aux_coeff: float = 0.01,
                    moe_capacity_factor: float = 1.25,
-                   dropout: float = 0.0,
+                   dropout: float = 0.0, label_smoothing: float = 0.0,
                    name: str = "tfm") -> ModelSpec:
     """tokens + positions -> N pre-norm blocks -> next-token CE.
 
@@ -90,11 +90,17 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
         x = layer.addto([x, ffn], name=f"{name}_l{i}_res2")
 
     xf = layer.layer_norm(x, name=f"{name}_lnf")
-    logits = layer.fc(xf, size=vocab_size, act=act.Softmax(),
+    # the head emits LOGITS and the CE runs from_logits (logsumexp +
+    # gather — no vocab-sized softmax tensor materializes in training);
+    # the softmax probs are a separate paramless node for inference
+    logits = layer.fc(xf, size=vocab_size, act=None,
                       name=f"{name}_head")
-    cost = layer.cross_entropy_cost(logits, nxt, name=f"{name}_cost")
+    probs = layer.addto([logits], act=act.Softmax(), name=f"{name}_probs")
+    cost = layer.cross_entropy_cost(logits, nxt, from_logits=True,
+                                    label_smoothing=label_smoothing,
+                                    name=f"{name}_cost")
     spec = ModelSpec(name="transformer_lm", data=toks, label=nxt,
-                     output=logits,
+                     output=probs,
                      cost=[cost] + aux_costs if aux_costs else cost)
     spec.positions = pos
     return spec

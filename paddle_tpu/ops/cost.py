@@ -24,10 +24,6 @@ def _gather_label(x: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
                                axis=-1)[..., 0]
 
 
-def _one_hot_nll(log_probs: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    return -_gather_label(log_probs, labels)
-
-
 def cross_entropy(probs_or_logits: jnp.ndarray, labels: jnp.ndarray, *,
                   from_logits: bool = False, eps: float = 1e-10,
                   label_smoothing: float = 0.0) -> jnp.ndarray:
@@ -41,16 +37,22 @@ def cross_entropy(probs_or_logits: jnp.ndarray, labels: jnp.ndarray, *,
     path stays the gather-only fast form).
     """
     if from_logits:
+        # lse - x_label form: log_softmax would MATERIALIZE a [.., V]
+        # f32 tensor; logsumexp is a reduction (max-subtracted, stable)
+        # and the label term is a gather, so the forward never writes a
+        # vocab-sized intermediate. With smoothing a, the uniform term
+        # mean(log_softmax) = mean(x) - lse is a reduction too.
         x = probs_or_logits.astype(jnp.float32)   # stable log under bf16
-        lp = jax.nn.log_softmax(x, axis=-1)
+        lse = jax.scipy.special.logsumexp(x, axis=-1)
+        nll = lse - _gather_label(x, labels)
         if label_smoothing > 0.0:
             a = label_smoothing
-            return -((1.0 - a) * _gather_label(lp, labels)
-                     + a * jnp.mean(lp, axis=-1))
-        return _one_hot_nll(lp, labels)
-    assert label_smoothing == 0.0, \
-        "label_smoothing needs from_logits=True (probs CE gathers only " \
-        "the label column)"
+            return (1.0 - a) * nll + a * (lse - jnp.mean(x, axis=-1))
+        return nll
+    if label_smoothing != 0.0:
+        raise ValueError(
+            "label_smoothing needs from_logits=True (probs CE gathers "
+            "only the label column)")
     # probs path: gather the label's prob FIRST, then upcast+log only the
     # gathered column — elementwise astype/log commute with the gather,
     # so numerics are identical, but the [.., V] tensor is never

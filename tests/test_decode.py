@@ -24,7 +24,9 @@ def _model(seed=7, **overrides):
     from paddle_tpu.core.registry import reset_name_counters
     reset_name_counters()
     spec = models.transformer_lm(**{**CFG, **overrides})
-    topo = paddle.Topology(spec.cost)
+    costs = spec.cost if isinstance(spec.cost, list) else [spec.cost]
+    # include the (paramless) probs node so _graph_argmax can read it
+    topo = paddle.Topology(costs, extra_outputs=[spec.output])
     params = topo.init_params(jax.random.PRNGKey(seed))
     return spec, topo, params
 

@@ -205,7 +205,7 @@ class TestLabelSmoothing:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_probs_path_rejects_smoothing(self):
-        with pytest.raises(AssertionError):
+        with pytest.raises(ValueError, match="from_logits"):
             cost.cross_entropy(jnp.ones((2, 3)) / 3,
                                jnp.zeros((2,), jnp.int32),
                                label_smoothing=0.1)
