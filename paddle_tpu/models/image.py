@@ -30,7 +30,14 @@ from paddle_tpu.core.registry import LayerOutput
 
 @dataclasses.dataclass
 class ModelSpec:
-    """A built model: feed via .data/.label, train on .cost, eval .error."""
+    """A built model: feed via .data/.label, train on .cost, eval .error.
+
+    `output` is the inference head. It is usually inside the cost graph,
+    but may be a side branch the training graph deliberately skips
+    (transformer_lm's probs node — its cost trains on logits); build
+    inference topologies from `output` itself (`Topology(spec.output)`,
+    what trainer/inference.Inference does), or pass
+    `extra_outputs=[spec.output]` when one topology must serve both."""
     name: str
     data: LayerOutput
     label: LayerOutput

@@ -91,8 +91,10 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
 
     xf = layer.layer_norm(x, name=f"{name}_lnf")
     # the head emits LOGITS and the CE runs from_logits (logsumexp +
-    # gather — no vocab-sized softmax tensor materializes in training);
-    # the softmax probs are a separate paramless node for inference
+    # gather — no vocab-sized softmax tensor materializes in the training
+    # forward); the softmax probs are a separate paramless SIDE branch:
+    # Topology(spec.cost) does not contain it by design — build inference
+    # topologies from spec.output (see ModelSpec docstring)
     logits = layer.fc(xf, size=vocab_size, act=None,
                       name=f"{name}_head")
     probs = layer.addto([logits], act=act.Softmax(), name=f"{name}_probs")
