@@ -238,17 +238,23 @@ class TransformerDecoder:
         return jax.jit(run)
 
     def beam_search(self, prompt, max_len: int, beam_size: int = 4,
-                    eos_id: int = 0, num_results: Optional[int] = None):
+                    eos_id: int = 0, num_results: Optional[int] = None,
+                    length_penalty: float = 0.0):
         """prompt [b, P] -> per-sample n-best [(score, tokens), ...],
         best first — the transformer analogue of the recurrent zoo's
         `beam_search` layer (scores are summed token log-probs; finished
-        beams freeze at their EOS). Rows are trimmed at the first EOS."""
+        beams freeze at their EOS). Rows are trimmed at the first EOS.
+
+        length_penalty alpha > 0 re-ranks by score / len(tokens)^alpha
+        (GNMT-style normalization, applied host-side over the K lanes;
+        the in-device search itself stays raw-log-prob greedy-by-sum)."""
         import numpy as np
         prompt = jnp.asarray(prompt, jnp.int32)
         plen = self._validate(prompt, max_len)
         n_keep = num_results if num_results is not None else beam_size
         assert 1 <= n_keep <= beam_size, (
             f"num_results={num_results} must be in [1, beam_size]")
+        assert length_penalty >= 0.0, length_penalty
         key = ("beam", plen, int(max_len), beam_size, eos_id)
         if key not in self._jitted:
             self._jitted[key] = self._build_beam(plen, int(max_len),
@@ -262,7 +268,12 @@ class TransformerDecoder:
                 row = list(map(int, toks[bi, ki]))
                 if eos_id in row:
                     row = row[:row.index(eos_id) + 1]
-                rows.append((float(scores[bi, ki]), row))
+                s = float(scores[bi, ki])
+                if length_penalty > 0.0:
+                    s = s / (max(len(row), 1) ** length_penalty)
+                rows.append((s, row))
+            if length_penalty > 0.0:
+                rows.sort(key=lambda sr: -sr[0])
             out.append(rows[:n_keep])
         return out
 

@@ -216,3 +216,22 @@ class TestBeamSearch:
                               eos_id=CFG["vocab_size"] - 1)
         rows = [tuple(r) for _, r in res[0]]
         assert len(set(rows)) == len(rows)
+
+
+class TestLengthPenalty:
+    def test_normalized_rerank(self):
+        spec, topo, params = _model()
+        dec = models.TransformerDecoder(params, n_layers=CFG["n_layers"],
+                                        n_heads=CFG["n_heads"])
+        prompt = np.zeros((1, 2), "int32")
+        eid = CFG["vocab_size"] - 1
+        raw = dec.beam_search(prompt, max_len=9, beam_size=4, eos_id=eid)
+        norm = dec.beam_search(prompt, max_len=9, beam_size=4, eos_id=eid,
+                               length_penalty=1.0)
+        # same candidate set, scores divided by row length, re-sorted
+        raw_map = {tuple(r): s for s, r in raw[0]}
+        for s, r in norm[0]:
+            np.testing.assert_allclose(
+                s, raw_map[tuple(r)] / max(len(r), 1), rtol=1e-6)
+        scores = [s for s, _ in norm[0]]
+        assert scores == sorted(scores, reverse=True)
