@@ -72,14 +72,31 @@ def _compiled_flops(compiled) -> float | None:
         return None
 
 
-def _slope_time(step, carry, extra, iters, warmup):
-    """Update-inclusive ms/batch via slope timing: run N and 2N chained
-    steps (each chain ends in ONE device->host readback of the loss, the
-    only sync every transport honors) and take (T2N - TN)/N. The
-    difference cancels the constant sync/transport latency, which on a
-    tunneled TPU (~100 ms RTT) would otherwise dominate; the chain itself
-    serializes on-device because each step consumes the previous step's
-    params. Mirrors paddle --job=time (update time included)."""
+#: repetitions per bench row; the recorded ms is the MEDIAN of this many
+#: independent slope measurements, with min/max kept as the spread.
+#: Single-shot rows through a flaky tunnel produced a 2.8x LSTM
+#: contradiction between BENCH_r03.json and docs/perf.md — never again.
+N_REPS = 5
+
+
+def _slope_once(chain, iters):
+    """One slope sample: run N and 2N chained steps (each chain ends in
+    ONE device->host readback of the loss, the only sync every transport
+    honors) and take (T2N - TN)/N. The difference cancels the constant
+    sync/transport latency, which on a tunneled TPU (~100 ms RTT) would
+    otherwise dominate; the chain itself serializes on-device because
+    each step consumes the previous step's params. Mirrors paddle
+    --job=time (update time included)."""
+    n = max(iters // 2, 2)
+    t1 = chain(n)
+    t2 = chain(2 * n)
+    return max((t2 - t1) / n, 1e-6)
+
+
+def _slope_time(step, carry, extra, iters, warmup, reps=N_REPS):
+    """Median-of-`reps` slope timings with spread, plus the live carry
+    (the step donates its input buffers, so the caller's original
+    (p, o, s) are dead after the first call)."""
     feed, key, n_real = extra
     p, o, s = carry
 
@@ -93,12 +110,17 @@ def _slope_time(step, carry, extra, iters, warmup):
 
     for _ in range(warmup):
         chain(1)
-    n = max(iters // 2, 2)
-    t1 = chain(n)
-    t2 = chain(2 * n)
-    # return the live carry too: the step donates its input buffers, so
-    # the caller's original (p, o, s) are dead after the first call
-    return max((t2 - t1) / n, 1e-6), (p, o, s)
+    samples = sorted(_slope_once(chain, iters) for _ in range(reps))
+    return samples, (p, o, s)
+
+
+def _spread(samples):
+    """{ms: median, min, max, reps} from sorted slope samples."""
+    mid = len(samples) // 2
+    med = (samples[mid] if len(samples) % 2 else
+           (samples[mid - 1] + samples[mid]) / 2)
+    return {"ms": med, "min": round(samples[0], 4),
+            "max": round(samples[-1], 4), "reps": len(samples)}
 
 
 def _build(name):
@@ -133,16 +155,17 @@ def _measure(trainer, feed, batch, iters, warmup):
         step, flops = compiled, _compiled_flops(compiled)
     except Exception:
         step, flops = trainer._train_step, None
-    ms, carry = _slope_time(step, (p, o, s), (feed, key, n_real), iters,
-                            warmup)
-    if ms < 5.0:
+    samples, carry = _slope_time(step, (p, o, s), (feed, key, n_real),
+                                 iters, warmup)
+    if samples[len(samples) // 2] < 5.0:
         # fast model: long chains so per-step slope noise (tunnel RTT
         # jitter / chain readback) amortizes away
-        ms, carry = _slope_time(step, carry, (feed, key, n_real),
-                                max(iters * 10, 200), 0)
-    ms = max(ms, 1e-3)   # sub-us slopes are timing noise on tiny models
-    res = {"ms": round(ms, 4),
-           "samples_per_sec": round(batch / (ms / 1e3), 1)}
+        samples, carry = _slope_time(step, carry, (feed, key, n_real),
+                                     max(iters * 10, 200), 0)
+    res = _spread([max(s, 1e-3) for s in samples])  # clamp timing noise
+    ms = res["ms"]
+    res["ms"] = round(ms, 4)
+    res["samples_per_sec"] = round(batch / (ms / 1e3), 1)
     if flops:
         tflops = flops / (ms / 1e3) / 1e12
         res["tflops"] = round(tflops, 2)
@@ -261,14 +284,18 @@ def bench_flash_attention(batch: int = 4, seq_len: int = 4096, heads: int = 8,
     def measure(fn):
         for _ in range(warmup):
             fn(q, k, v).block_until_ready()
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(q, k, v)
-        out.block_until_ready()
-        return (time.perf_counter() - t0) / iters * 1e3
+        samples = []
+        for _ in range(N_REPS):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(q, k, v)
+            out.block_until_ready()
+            samples.append((time.perf_counter() - t0) / iters * 1e3)
+        return sorted(samples)
 
-    flash_ms = measure(f)
-    xla_ms = measure(r)
+    flash_s = measure(f)
+    xla_s = measure(r)
+    flash_ms, xla_ms = _spread(flash_s)["ms"], _spread(xla_s)["ms"]
 
     # training step (fwd+bwd) — exercises the Pallas backward kernels
     def loss_of(fn):
@@ -283,17 +310,22 @@ def bench_flash_attention(batch: int = 4, seq_len: int = 4096, heads: int = 8,
     def measure_grad(fn):
         for _ in range(warmup):
             jax.block_until_ready(fn(q, k, v))
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(q, k, v)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / iters * 1e3
+        samples = []
+        for _ in range(N_REPS):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(q, k, v)
+            jax.block_until_ready(out)
+            samples.append((time.perf_counter() - t0) / iters * 1e3)
+        return sorted(samples)
 
-    flash_grad_ms = measure_grad(fg)
-    xla_grad_ms = measure_grad(rg)
+    fg_s, rg_s = measure_grad(fg), measure_grad(rg)
+    flash_grad_ms, xla_grad_ms = _spread(fg_s)["ms"], _spread(rg_s)["ms"]
     # causal forward FLOPs: two [T, d] matmuls over the T^2/2 valid pairs
     flops = batch * heads * (seq_len ** 2 / 2) * head_dim * 2 * 2
-    return {"ms": round(flash_ms, 4), "xla_ms": round(xla_ms, 4),
+    return {"ms": round(flash_ms, 4),
+            "min": round(flash_s[0], 4), "max": round(flash_s[-1], 4),
+            "reps": N_REPS, "xla_ms": round(xla_ms, 4),
             "vs_xla": round(xla_ms / flash_ms, 3),
             "grad_ms": round(flash_grad_ms, 4),
             "xla_grad_ms": round(xla_grad_ms, 4),
@@ -327,12 +359,18 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, max_len: int = 544,
     prompt = np.random.RandomState(0).randint(
         0, 32000, (batch, prompt_len)).astype("int32")
     dec.generate(prompt, max_len=max_len)        # compile
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        rows = dec.generate(prompt, max_len=max_len)
-    dt = (time.perf_counter() - t0) / iters
+    samples = []
+    for _ in range(N_REPS):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            rows = dec.generate(prompt, max_len=max_len)
+        samples.append((time.perf_counter() - t0) / iters)
+    samples.sort()
     n_new = len(rows[0])
+    dt = _spread(samples)["ms"]  # median seconds-per-generate
     return {"ms": round(dt / n_new * 1e3, 4),
+            "min": round(samples[0] / n_new * 1e3, 4),
+            "max": round(samples[-1] / n_new * 1e3, 4), "reps": N_REPS,
             "tokens_per_sec": round(batch * n_new / dt, 1),
             "new_tokens": n_new, "batch": batch}
 
