@@ -79,24 +79,26 @@ def _compiled_flops(compiled) -> float | None:
 N_REPS = 5
 
 
-def _slope_once(chain, iters):
-    """One slope sample: run N and 2N chained steps (each chain ends in
-    ONE device->host readback of the loss, the only sync every transport
-    honors) and take (T2N - TN)/N. The difference cancels the constant
-    sync/transport latency, which on a tunneled TPU (~100 ms RTT) would
-    otherwise dominate; the chain itself serializes on-device because
-    each step consumes the previous step's params. Mirrors paddle
-    --job=time (update time included)."""
-    n = max(iters // 2, 2)
-    t1 = chain(n)
-    t2 = chain(2 * n)
-    return max((t2 - t1) / n, 1e-6)
+#: one slope chain must run at least this long so tunnel RTT jitter
+#: (tens of ms per readback) amortizes below ~1 ms/step of slope noise
+_MIN_CHAIN_MS = 1200.0
 
 
 def _slope_time(step, carry, extra, iters, warmup, reps=N_REPS):
-    """Median-of-`reps` slope timings with spread, plus the live carry
-    (the step donates its input buffers, so the caller's original
-    (p, o, s) are dead after the first call)."""
+    """Median-of-`reps` slope timings with spread, plus the live carry.
+
+    One slope sample runs N and 2N chained steps (each chain ends in ONE
+    device->host readback of the loss, the only sync every transport
+    honors) and takes (T2N - TN)/N: the difference cancels the constant
+    sync/transport latency, which on a tunneled TPU (~100 ms RTT) would
+    otherwise dominate. The chain serializes on-device because each step
+    consumes the previous step's params. N is grown adaptively until a
+    single chain takes >= _MIN_CHAIN_MS: with short chains the slope
+    inherits RTT jitter / N, which at N=5 was +-10 ms/step on the
+    transformer row — worse than the effect being measured. Mirrors
+    paddle --job=time (update time included). The caller's (p, o, s) are
+    dead after the first call (the step donates its buffers); the live
+    carry is returned."""
     feed, key, n_real = extra
     p, o, s = carry
 
@@ -110,8 +112,15 @@ def _slope_time(step, carry, extra, iters, warmup, reps=N_REPS):
 
     for _ in range(warmup):
         chain(1)
-    samples = sorted(_slope_once(chain, iters) for _ in range(reps))
-    return samples, (p, o, s)
+    n = max(iters // 2, 2)
+    while chain(n) < _MIN_CHAIN_MS and n < 4096:
+        n = min(n * 2, 4096)
+    samples = []
+    for _ in range(reps):
+        t1 = chain(n)
+        t2 = chain(2 * n)
+        samples.append(max((t2 - t1) / n, 1e-6))
+    return sorted(samples), (p, o, s)
 
 
 def _spread(samples):
@@ -139,9 +148,16 @@ def _build(name):
     raise KeyError(name)
 
 
-def _measure(trainer, feed, batch, iters, warmup):
+def _measure(trainer, feed, batch, iters, warmup, extra_flops=0.0):
     """ms/batch + TFLOP/s + MFU for one trainer/feed pair. Uses the AOT
-    compiled step both for cost analysis and timing (one compilation)."""
+    compiled step both for cost analysis and timing (one compilation).
+
+    extra_flops: analytic model FLOPs of Pallas custom calls, which
+    XLA's cost analysis cannot see (it returns -2 for custom calls) —
+    without this the flash-attention and fused-LSTM rows undercount
+    their own matmuls. Callers pass the MODEL-FLOPs convention
+    (forward + 2x forward for backward) — NOT the kernels' actual
+    recompute FLOPs, so MFU stays the standard conservative metric."""
     import jax
     import jax.numpy as jnp
 
@@ -157,16 +173,12 @@ def _measure(trainer, feed, batch, iters, warmup):
         step, flops = trainer._train_step, None
     samples, carry = _slope_time(step, (p, o, s), (feed, key, n_real),
                                  iters, warmup)
-    if samples[len(samples) // 2] < 5.0:
-        # fast model: long chains so per-step slope noise (tunnel RTT
-        # jitter / chain readback) amortizes away
-        samples, carry = _slope_time(step, carry, (feed, key, n_real),
-                                     max(iters * 10, 200), 0)
     res = _spread([max(s, 1e-3) for s in samples])  # clamp timing noise
     ms = res["ms"]
     res["ms"] = round(ms, 4)
     res["samples_per_sec"] = round(batch / (ms / 1e3), 1)
     if flops:
+        flops += extra_flops
         tflops = flops / (ms / 1e3) / 1e12
         res["tflops"] = round(tflops, 2)
         peak = _device_peak_flops(jax.devices()[0])
@@ -220,7 +232,13 @@ def bench_lstm(batch: int, hidden: int, seq_len: int = 100,
                                           jax.device_put(jnp.asarray(lengths))),
             spec.label.name: jax.device_put(
                 rng.randint(0, 2, (batch,)).astype("int32"))}
-    return _measure(trainer, feed, batch, iters, warmup)
+    # the Pallas LSTM kernels hide the recurrent matmuls from XLA's cost
+    # analysis: T steps of [b,h]x[h,4h] in the forward and the same chain
+    # again for dh in the backward (the weight-grad matmul runs OUTSIDE
+    # the kernel and is already counted)
+    recurrent = 2 * seq_len * batch * hidden * 4 * hidden * 2
+    return _measure(trainer, feed, batch, iters, warmup,
+                    extra_flops=float(recurrent))
 
 
 def bench_transformer(batch: int = 8, seq_len: int = 1024,
@@ -253,7 +271,14 @@ def bench_transformer(batch: int = 8, seq_len: int = 1024,
             f"{'tfm'}_positions": seq_feed(
                 np.tile(np.arange(seq_len, dtype="int32"), (batch, 1))),
             spec.label.name: seq_feed(ids[:, 1:].astype("int32"))}
-    return _measure(trainer, feed, batch, iters, warmup)
+    # flash attention is a Pallas custom call = invisible to XLA's cost
+    # analysis; add its analytic MODEL FLOPs (causal: T^2/2 valid pairs,
+    # 2 matmuls x 2d each in the forward, 2x that for the backward — the
+    # kernels' score recomputation is deliberately NOT counted)
+    head_dim = d_model // 8
+    attn_fwd = n_layers * batch * 8 * (seq_len ** 2 / 2) * head_dim * 4
+    return _measure(trainer, feed, batch, iters, warmup,
+                    extra_flops=3.0 * attn_fwd)
 
 
 def bench_flash_attention(batch: int = 4, seq_len: int = 4096, heads: int = 8,
@@ -367,7 +392,9 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, max_len: int = 544,
         samples.append((time.perf_counter() - t0) / iters)
     samples.sort()
     n_new = len(rows[0])
-    dt = _spread(samples)["ms"]  # median seconds-per-generate
+    mid = len(samples) // 2
+    dt = samples[mid] if len(samples) % 2 else \
+        (samples[mid - 1] + samples[mid]) / 2  # median seconds-per-generate
     return {"ms": round(dt / n_new * 1e3, 4),
             "min": round(samples[0] / n_new * 1e3, 4),
             "max": round(samples[-1] / n_new * 1e3, 4), "reps": N_REPS,

@@ -117,7 +117,10 @@ class TransformerDecoder:
     def _logits(self, p, x):
         n = self.name
         x = _ln(x, p[f"_{n}_lnf.w0"], p[f"_{n}_lnf.wbias"])
-        return x @ p[f"_{n}_head.w0"] + p[f"_{n}_head.wbias"]
+        logits = x @ p[f"_{n}_head.w0"]
+        if f"_{n}_head.wbias" in p:  # older checkpoints carried a bias
+            logits = logits + p[f"_{n}_head.wbias"]
+        return logits
 
     def _forward(self, p, ids, pos, caches, cache_pos, kv_len):
         """ids [b, t] -> (logits [b, t, V], caches')."""

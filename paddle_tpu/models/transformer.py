@@ -95,7 +95,10 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
     # forward); the softmax probs are a separate paramless SIDE branch:
     # Topology(spec.cost) does not contain it by design — build inference
     # topologies from spec.output (see ModelSpec docstring)
-    logits = layer.fc(xf, size=vocab_size, act=None,
+    # no bias on the vocab projection (the modern LM convention): a
+    # 32k-wide bias adds nothing measurable to the fit but costs a
+    # vocab-sized gradient reduction + optimizer slots every step
+    logits = layer.fc(xf, size=vocab_size, act=None, bias_attr=False,
                       name=f"{name}_head")
     probs = layer.addto([logits], act=act.Softmax(), name=f"{name}_probs")
     cost = layer.cross_entropy_cost(logits, nxt, from_logits=True,
