@@ -266,6 +266,32 @@ def img_conv(input, filter_size: int, num_filters: int, name=None,
 img_conv_layer = img_conv
 
 
+def conv_bn(input, filter_size: int, num_filters: int, name=None,
+            num_channels=None, act=None, stride: int = 1, padding: int = 0,
+            dilation: int = 1, param_attr=None, use_global_stats=None,
+            moving_average_fraction: float = 0.9, epsilon: float = 1e-5,
+            fuse_stats: bool = False, groups: int = 1,
+            **kw) -> LayerOutput:
+    """Conv + batch-norm in one node; semantically identical to
+    img_conv(bias_attr=False) -> batch_norm. fuse_stats=True opts
+    1x1/s1/p0 convs into the recompute-fused stats epilogue
+    (ops/fused.conv_bn_train) — measured SLOWER than XLA's own fusion
+    on current TPUs (docs/perf.md), kept for future revisits."""
+    assert groups == 1, \
+        "conv_bn does not support grouped convs — use img_conv + batch_norm"
+    return make_layer("conv_bn", name, [input], filter_size=filter_size,
+                      num_filters=num_filters, channels=num_channels,
+                      act=act_mod.to_name(act), stride=stride,
+                      padding=padding, dilation=dilation,
+                      param_attr=param_attr,
+                      use_global_stats=use_global_stats,
+                      moving_average_fraction=moving_average_fraction,
+                      epsilon=epsilon, fuse_stats=fuse_stats)
+
+
+conv_bn_layer = conv_bn
+
+
 def img_pool(input, pool_size: int, name=None, num_channels=None,
              pool_type=None, stride: int = 1, padding: int = 0,
              pool_size_x=None, ceil_mode: bool = True, **kw) -> LayerOutput:

@@ -206,6 +206,11 @@ _RESNET_BLOCKS = {
 
 def _conv_bn(name, x, k, nf, stride=1, padding=0, relu=True,
              num_channels=None):
+    # deliberately the PLAIN two-layer composition: the fused
+    # alternatives (layer.conv_bn with fuse_stats, ops/fused.py) all
+    # measured SLOWER end-to-end — XLA already fuses conv+BN optimally;
+    # see docs/perf.md "BN backward: the epilogue lever, measured and
+    # rejected"
     c = layer.img_conv(x, filter_size=k, num_filters=nf, stride=stride,
                        padding=padding, bias_attr=False, act=None,
                        num_channels=num_channels, name=f"{name}_conv")

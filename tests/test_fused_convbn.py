@@ -1,0 +1,170 @@
+"""Parity tests for the fused conv_bn layer (ops/fused.conv_bn_train):
+forward values, state updates, and end-to-end training gradients must
+match the two-layer img_conv(bias_attr=False) -> batch_norm composition
+exactly (f32 CPU) — the fusion is a schedule change, not a math change.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core import registry
+from paddle_tpu.core.topology import Topology
+
+
+def _build(fused, k=1, stride=1, padding=0, act=None):
+    registry.reset_name_counters()
+    h = w = 6
+    c = 4
+    img = paddle.layer.data("img",
+                            paddle.data_type.dense_vector(c * h * w),
+                            height=h, width=w)
+    if fused:
+        out = paddle.layer.conv_bn(img, filter_size=k, num_filters=5,
+                                   stride=stride, padding=padding, act=act,
+                                   num_channels=c, fuse_stats=True,
+                                   name="cb")
+    else:
+        conv = paddle.layer.img_conv(img, filter_size=k, num_filters=5,
+                                     stride=stride, padding=padding,
+                                     bias_attr=False, act=None,
+                                     num_channels=c, name="cb_conv")
+        out = paddle.layer.batch_norm(conv, act=act, name="cb_bn")
+    lbl = paddle.layer.data("y", paddle.data_type.integer_value(5))
+    pool = paddle.layer.img_pool(out, pool_size=out.meta.height, stride=1,
+                                 pool_type=paddle.pooling.Avg(),
+                                 name="cb_gap")
+    fc = paddle.layer.fc(pool, size=5, act=paddle.activation.Softmax(),
+                         name="cb_fc")
+    cost = paddle.layer.classification_cost(fc, lbl, name="cb_cost")
+    return cost
+
+
+def _train_once(fused, k=1, stride=1, padding=0, act=None):
+    paddle.init(seed=0)
+    cost = _build(fused, k, stride, padding, act)
+    topo = Topology(cost)
+    params = topo.init_params(jax.random.PRNGKey(7))
+
+    # canonical name shared by the fused and two-layer builds
+    def canon(name):
+        return {"_cb.w0": "conv_w", "_cb_conv.w0": "conv_w",
+                "_cb.wgamma": "gamma", "_cb_bn.w0": "gamma",
+                "_cb.wbeta": "beta", "_cb_bn.wbias": "beta"}.get(name, name)
+
+    aligned, vals = {}, {}
+    for name, v in sorted(params.items()):
+        key = canon(name)
+        aligned[name] = key, v.shape
+        if key not in vals:
+            # value depends only on the canonical key, never on the
+            # draw order (which differs between the two builds)
+            rng = np.random.RandomState(abs(hash(key)) % 100000)
+            if key == "gamma":
+                vals[key] = (np.ones(v.shape)
+                             + 0.1 * rng.randn(*v.shape)).astype(np.float32)
+            else:
+                vals[key] = rng.randn(*v.shape).astype(np.float32) * 0.3
+        assert vals[key].shape == v.shape, (name, key)
+        params[name] = jnp.asarray(vals[key])
+    state = topo.init_state()
+
+    feed_rng = np.random.RandomState(11)   # independent of param draws
+    x = feed_rng.randn(8, 4 * 6 * 6).astype(np.float32)
+    y = feed_rng.randint(0, 5, (8,)).astype(np.int32)
+    feed = {"img": jnp.asarray(x), "y": jnp.asarray(y)}
+
+    def loss_fn(p):
+        outs, new_state = topo.forward(p, state, feed, mode="train",
+                                       rng=jax.random.PRNGKey(0))
+        return jnp.mean(outs[cost.name]), new_state
+
+    (loss, new_state), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    return loss, grads, new_state, aligned
+
+
+class TestFusedConvBN:
+    def _compare(self, k=1, stride=1, padding=0, act=None):
+        loss_f, grads_f, state_f, names_f = _train_once(
+            True, k, stride, padding, act)
+        loss_r, grads_r, state_r, names_r = _train_once(
+            False, k, stride, padding, act)
+        np.testing.assert_allclose(float(loss_f), float(loss_r),
+                                   rtol=1e-5, atol=1e-6)
+        # grads keyed by the alignment key
+        by_key_f = {names_f[n][0]: g for n, g in grads_f.items()}
+        by_key_r = {names_r[n][0]: g for n, g in grads_r.items()}
+        assert set(by_key_f) == set(by_key_r)
+        for key in by_key_f:
+            np.testing.assert_allclose(
+                np.asarray(by_key_f[key]), np.asarray(by_key_r[key]),
+                rtol=2e-4, atol=1e-5, err_msg=key)
+        # moving-stat state updates match
+        sf = {n.split(".")[-1]: v for n, v in state_f.items()
+              if "moving" in n}
+        sr = {n.split(".")[-1]: v for n, v in state_r.items()
+              if "moving" in n}
+        for kk in sf:
+            np.testing.assert_allclose(np.asarray(sf[kk]),
+                                       np.asarray(sr[kk]),
+                                       rtol=1e-5, atol=1e-6, err_msg=kk)
+
+    def test_1x1_fused_path_matches_two_layers(self):
+        self._compare(k=1)
+
+    def test_1x1_with_relu(self):
+        self._compare(k=1, act=paddle.activation.Relu())
+
+    def test_3x3_fallback_path_matches_two_layers(self):
+        self._compare(k=3, stride=1, padding=1)
+
+    def test_strided_fallback(self):
+        self._compare(k=1, stride=2)
+
+    def test_infer_uses_moving_stats(self):
+        paddle.init(seed=0)
+        cost = _build(True)
+        topo = Topology(cost)
+        params = topo.init_params(jax.random.PRNGKey(0))
+        state = topo.init_state()
+        rng = np.random.RandomState(0)
+        feed = {"img": jnp.asarray(rng.randn(4, 4 * 6 * 6), jnp.float32),
+                "y": jnp.asarray(np.zeros(4, np.int32))}
+        outs_a, st_a = topo.forward(params, state, feed, mode="test")
+        # test mode must not touch the moving stats
+        for n, v in st_a.items():
+            np.testing.assert_allclose(np.asarray(v),
+                                       np.asarray(state[n]), err_msg=n)
+
+    def test_zero_gamma_gradient_matches_unfused(self):
+        """A pruned (exactly-zero) gamma channel must still get the TRUE
+        dgamma (so it can un-prune) — the gradients of the fused op must
+        match the unfused conv+BN composition even at gamma == 0."""
+        from paddle_tpu.ops import conv as conv_ops
+        from paddle_tpu.ops import fused as fused_ops
+        from paddle_tpu.ops import norm as norm_ops
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 3, 3, 4), jnp.float32)
+        w = jnp.asarray(rng.randn(1, 1, 4, 3), jnp.float32)
+        gamma = jnp.asarray([1.0, 0.0, -0.5], jnp.float32)
+        beta = jnp.asarray([0.1, 0.2, 0.3], jnp.float32)
+
+        def loss_fused(x, w, gamma, beta):
+            z, m, v = fused_ops.conv_bn_train(x, w, gamma, beta, 1e-5)
+            return jnp.sum(z ** 2) + jnp.sum(m) + jnp.sum(v)
+
+        def loss_ref(x, w, gamma, beta):
+            c = conv_ops.conv2d(x, w, stride=1, padding=0)
+            z, nm, nv = norm_ops.batch_norm_train(
+                c, gamma, beta, jnp.zeros_like(gamma),
+                jnp.ones_like(gamma), momentum=0.0, eps=1e-5)
+            return jnp.sum(z ** 2) + jnp.sum(nm) + jnp.sum(nv)
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+        for a, b, nm in zip(gf, gr, ("dx", "dw", "dgamma", "dbeta")):
+            assert np.isfinite(np.asarray(a)).all(), nm
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5, err_msg=nm)

@@ -147,6 +147,9 @@ CONFIGS = {
     "conv": lambda rng: (lambda x, f: (
         L.img_conv(x, filter_size=3, num_filters=3, padding=1,
                    act=paddle.activation.Tanh()), f))(*image(rng)),
+    "conv_bn": lambda rng: (lambda x, f: (
+        L.conv_bn(x, filter_size=1, num_filters=3, fuse_stats=True,
+                  act=paddle.activation.Tanh()), f))(*image(rng)),
     "pool": lambda rng: (lambda x, f: (
         L.img_pool(L.img_conv(x, filter_size=3, num_filters=2, padding=1),
                    pool_size=2, stride=2), f))(*image(rng, h=4, w=4)),
