@@ -168,8 +168,151 @@ def topology_stages(topology, stage_names):
             [params[name_matrix[i][j]] for i in range(n)])
             for j in range(len(slot_names))}
 
+    def unstack(stacked):
+        """{global param name: per-stage slice} from a stacked pytree —
+        the inverse of stack_params, used to merge per-stage gradients
+        back into the flat param-name space (1F1B path)."""
+        return {name_matrix[i][j]: stacked[slot_names[j]][i]
+                for i in range(n) for j in range(len(slot_names))}
+
+    stack_params.unstack = unstack
     body_names = [nm for st in stage_names for nm in st]
     return stage_fn, stack_params, body_names, x_src, stage_names[-1][-1]
+
+
+def pipeline_1f1b(stage_fn: Callable, stage_params, x: jnp.ndarray,
+                  tail_vjp: Callable, mesh: Mesh,
+                  num_microbatches: Optional[int] = None,
+                  axis_name: str = PP_AXIS, tail_args=()):
+    """One-forward-one-backward pipeline schedule (PipeDream-flush /
+    Megatron 1F1B), hand-scheduled because the backward interleaving
+    cannot be expressed through jax.grad of a forward scan.
+
+    stage_fn(params_i, x_mb) -> y_mb, shape-preserving.
+    stage_params: pytree with leading [n_stages] axis, sharded over pp.
+    tail_vjp(y_mb, j, *tail_args) -> (loss_j, dy_mb, dtail_pytree):
+      per-microbatch loss head — called at the LAST stage the moment
+      microbatch j's forward completes, so its cotangent enters the
+      backward ring in the same tick (the defining property of 1F1B).
+    tail_args: pytrees the tail differentiates (params, feed slices) —
+      threaded through the shard_map as replicated operands rather than
+      captured in the closure, because cotangents of closure-captured
+      committed arrays carry their Auto-mesh shardings into the Manual
+      context and fail sharding-in-types checks.
+
+    Returns (loss_sum, y [batch, ...], stage_grads stacked like
+    stage_params, dtail_sum).
+
+    Schedule: microbatch j runs forward at stage s on tick j+s and
+    backward on tick j + 2(n-1) - s; one scan over m + 2(n-1) ticks
+    carries a RING BUFFER of 2n-1 saved stage INPUTS (backward
+    recomputes the stage from its input, vjp'd immediately — residuals
+    never outlive a tick). Peak activation state is therefore O(n
+    stages), independent of the microbatch count m, where the
+    jax.grad-reversed GPipe scan must carry O(m + n) tick states: the
+    memory-for-schedule trade that lets m grow (and the bubble
+    (n-1)/(m+n-1) shrink) without OOM. Under SPMD every rank executes
+    every tick's masked F and B slots, so at small m the extra n-1
+    drain ticks cost wall-clock vs GPipe; the ratio (m+2n-2)/(m+n-1)
+    approaches 1 in exactly the large-m regime 1F1B exists for.
+    Reference analogue: ParallelNeuralNetwork's per-device compute
+    threads with async queues (ParallelNeuralNetwork.h:34), modernized.
+    """
+    n = mesh.shape[axis_name]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        assert leaf.shape[0] == n, \
+            f"stage_params leading axis {leaf.shape[0]} != pp={n}"
+    b = x.shape[0]
+    m = num_microbatches or n
+    assert b % m == 0, f"microbatches {m} must divide batch {b}"
+    mb = b // m
+    xm = x.reshape((m, mb) + x.shape[1:])
+    ring = 2 * n - 1
+
+    def local(params, xm_local, targs):
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        me = lax.axis_index(axis_name)
+        zero_mb = jnp.zeros_like(xm_local[0])
+
+        # probe shapes for the accumulators (abstract eval only)
+        y_shape = jax.eval_shape(stage_fn, params, zero_mb)
+        zero_y = jnp.zeros(y_shape.shape, y_shape.dtype)
+        _, dy_probe, dtail_probe = jax.eval_shape(
+            lambda y, ta: tail_vjp(y, jnp.int32(0), *ta), zero_y, targs)
+        g_zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+        dtail_zero = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), dtail_probe)
+
+        carry0 = (zero_mb,                       # x_state: incoming act
+                  jnp.zeros(dy_probe.shape, dy_probe.dtype),  # dy_state
+                  jnp.zeros((ring,) + zero_mb.shape, zero_mb.dtype),
+                  jnp.zeros((m,) + y_shape.shape, y_shape.dtype),
+                  g_zero, dtail_zero, jnp.float32(0.0))
+
+        def tick(carry, t):
+            x_state, dy_state, inbuf, youtbuf, g_acc, dtail_acc, \
+                loss_acc = carry
+            # ---- forward slot: mb fj = t - me
+            fj = t - me
+            f_active = jnp.logical_and(fj >= 0, fj < m)
+            fjc = jnp.clip(fj, 0, m - 1)
+            x_in = jnp.where(me == 0, xm_local[fjc], x_state)
+            y = stage_fn(params, x_in)
+            slot_f = fjc % ring
+            inbuf = lax.dynamic_update_index_in_dim(
+                inbuf, jnp.where(f_active, x_in, inbuf[slot_f]), slot_f, 0)
+            last = me == n - 1
+            take_y = jnp.logical_and(last, f_active)
+            youtbuf = lax.dynamic_update_index_in_dim(
+                youtbuf, jnp.where(take_y, y, youtbuf[fjc]), fjc, 0)
+            # ---- tail head (meaningful on the last stage only; SPMD
+            # executes it everywhere, masked)
+            loss_j, dy_tail, dtail_j = tail_vjp(y, fjc, *targs)
+            loss_acc = loss_acc + jnp.where(take_y, loss_j, 0.0)
+            dtail_acc = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(take_y, d, jnp.zeros_like(d)),
+                dtail_acc, dtail_j)
+            # ---- backward slot: mb bj = t - 2(n-1) + me
+            bj = t - 2 * (n - 1) + me
+            b_active = jnp.logical_and(bj >= 0, bj < m)
+            bjc = jnp.clip(bj, 0, m - 1)
+            dy_in = jnp.where(last, dy_tail, dy_state)
+            x_saved = inbuf[bjc % ring]
+            _, svjp = jax.vjp(stage_fn, params, x_saved)
+            dp_j, dx_j = svjp(dy_in)
+            g_acc = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(b_active, d, jnp.zeros_like(d)),
+                g_acc, dp_j)
+            # ---- hop: activations up, cotangents down
+            y_prev = lax.ppermute(y, axis_name,
+                                  [(i, i + 1) for i in range(n - 1)])
+            dx_next = lax.ppermute(dx_j, axis_name,
+                                   [(i, i - 1) for i in range(1, n)])
+            return (y_prev, dx_next, inbuf, youtbuf, g_acc, dtail_acc,
+                    loss_acc), None
+
+        (x_s, dy_s, inbuf, youtbuf, g_acc, dtail_acc, loss_acc), _ = \
+            lax.scan(tick, carry0, jnp.arange(m + 2 * (n - 1)))
+        youtbuf = jnp.where(me == n - 1, youtbuf,
+                            jnp.zeros_like(youtbuf))
+        youtbuf = lax.psum(youtbuf, axis_name)
+        loss_sum = lax.psum(jnp.where(me == n - 1, loss_acc, 0.0),
+                            axis_name)
+        dtail = jax.tree_util.tree_map(
+            lambda d: lax.psum(jnp.where(me == n - 1, d,
+                                         jnp.zeros_like(d)), axis_name),
+            dtail_acc)
+        g_out = jax.tree_util.tree_map(lambda g: g[None], g_acc)
+        return loss_sum, youtbuf, g_out, dtail
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+    gspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(pspec, P(), P()),
+                   out_specs=(P(), P(), gspec, P()),
+                   check=False)
+    loss_sum, ym, g_stacked, dtail = fn(stage_params, xm, tuple(tail_args))
+    return (loss_sum, ym.reshape((b,) + ym.shape[2:]), g_stacked, dtail)
 
 
 def pipeline_loss(stage_fn: Callable, loss_fn: Callable):

@@ -42,6 +42,8 @@ class SGD:
                  extra_layers: Optional[Sequence[LayerOutput]] = None,
                  is_local: bool = True, mesh=None, evaluators=None,
                  pipeline_stages=None, pipeline_remat: bool = False,
+                 pipeline_schedule: str = "gpipe",
+                 pipeline_microbatches: Optional[int] = None,
                  **kwargs):
         costs = cost if isinstance(cost, (list, tuple)) else [cost]
         self.costs = list(costs)
@@ -108,6 +110,12 @@ class SGD:
         # jax.checkpoint each pipeline stage: backward holds only stage
         # boundaries and recomputes interiors (FLOPs-for-memory trade)
         self.pipeline_remat = pipeline_remat
+        # "gpipe" (jax.grad-reversed scan) or "1f1b" (hand-scheduled
+        # one-forward-one-backward: O(stages) activation memory instead
+        # of O(microbatches + stages) — see parallel/pipeline.py)
+        assert pipeline_schedule in ("gpipe", "1f1b"), pipeline_schedule
+        self.pipeline_schedule = pipeline_schedule
+        self.pipeline_microbatches = pipeline_microbatches
         self._train_step = self._build_train_step()
         self._test_step = self._build_test_step()
 
@@ -144,21 +152,26 @@ class SGD:
         from paddle_tpu.parallel.mesh import data_parallel_mesh
         return data_parallel_mesh(tc)
 
+    @staticmethod
+    def _masked_cost(v, row0, n_real):
+        """Per-row cost reduction shared by the full-batch loss and the
+        1F1B per-microbatch objective: sum the cost rows whose GLOBAL
+        row index (row0 + local) is < n_real, divided by n_real — so
+        the microbatch contributions sum to exactly the full-batch
+        value."""
+        v = v.reshape(v.shape[0], -1).sum(axis=-1) if v.ndim > 1 else v
+        mask = ((row0 + jnp.arange(v.shape[0])) < n_real).astype(v.dtype)
+        return jnp.sum(v * mask) / jnp.maximum(n_real.astype(v.dtype), 1.0)
+
     def _loss_and_metrics(self, params, state, feed, rng, n_real, mode,
                           sparse_sub=None, injected=None, skip=()):
         outs, new_state = self.topology.forward(
             params, state, feed, mode=mode, rng=rng, sparse_sub=sparse_sub,
             injected=injected, skip=skip, mesh=self.mesh, n_real=n_real)
-        b = None
         total = 0.0
         metrics = {}
         for c in self.costs:
-            v = outs[c.name]
-            v = v.reshape(v.shape[0], -1).sum(axis=-1) if v.ndim > 1 else v
-            b = v.shape[0]
-            row_mask = (jnp.arange(b) < n_real).astype(v.dtype)
-            cost_val = jnp.sum(v * row_mask) / jnp.maximum(
-                n_real.astype(v.dtype), 1.0)
+            cost_val = self._masked_cost(outs[c.name], 0, n_real)
             total = total + cost_val
             metrics[c.name] = cost_val
         for e in self.extra_layers:
@@ -279,16 +292,98 @@ class SGD:
         (stage_fn, stack_params, body_names, x_src,
          body_end) = topology_stages(self.topology, self.pipeline_stages)
 
+        if self.pipeline_schedule == "1f1b":
+            return self._build_1f1b_train_step(
+                stage_fn, stack_params, body_names, x_src, body_end)
+
         def step(params, opt_state, state, feed, rng, n_real):
             def loss_fn(p):
                 y = pipeline(stage_fn, stack_params(p), feed[x_src], mesh,
-                             remat=self.pipeline_remat)
+                             remat=self.pipeline_remat,
+                             num_microbatches=self.pipeline_microbatches)
                 return self._loss_and_metrics(
                     p, state, feed, rng, n_real, "train",
                     injected={body_end: y}, skip=body_names)
 
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
             (loss, (metrics, new_state, eval_outs)), grads = grad_fn(params)
+            new_params, new_opt_state = self.optimizer.update(
+                params, grads, opt_state, n_real.astype(jnp.float32))
+            return (new_params, new_opt_state, new_state, loss, metrics,
+                    eval_outs)
+
+        return shard_train_step(step, mesh)
+
+    def _build_1f1b_train_step(self, stage_fn, stack_params, body_names,
+                               x_src, body_end):
+        """Hand-scheduled 1F1B: gradients come out of the schedule
+        itself (parallel/pipeline.pipeline_1f1b), not an outer
+        jax.grad; a cheap replicated tail pass afterwards produces the
+        reported loss / metrics / eval outputs / state update with math
+        identical to the GPipe path. Caveat (documented in
+        docs/parallelism.md): dropout in the TAIL would draw different
+        masks in the gradient pass (per-microbatch folded rng) than in
+        the metrics pass — keep dropout out of pipelined models' tails
+        (stages already reject it)."""
+        from paddle_tpu.parallel.data_parallel import shard_train_step
+        from paddle_tpu.parallel.pipeline import pipeline_1f1b
+        mesh = self.mesh
+        # the gradient pass folds the rng per microbatch while the
+        # metrics pass uses the unfolded rng — an rng-consuming tail
+        # layer would make the reported loss diverge from the trained
+        # objective, so reject it at build time (stages already do)
+        for lname, l in self.topology.by_name.items():
+            if lname not in body_names and l.type == "dropout":
+                raise AssertionError(
+                    f"dropout layer {lname!r} in the tail is unsupported "
+                    "with pipeline_schedule='1f1b' (per-microbatch rng "
+                    "would diverge from the metrics pass)")
+
+        def step(params, opt_state, state, feed, rng, n_real):
+            x = feed[x_src]
+            from paddle_tpu.parallel.mesh import PP_AXIS
+            m = self.pipeline_microbatches or mesh.shape[PP_AXIS]
+            b = x.shape[0]
+            assert b % m == 0, f"microbatches {m} must divide batch {b}"
+            mb = b // m
+            feed_m = jax.tree_util.tree_map(
+                lambda a: a.reshape((m, mb) + a.shape[1:]), feed)
+
+            def tail_cost(p, y_mb, j, fm):
+                feed_j = jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, j, 0, keepdims=False), fm)
+                outs, _ = self.topology.forward(
+                    p, state, feed_j, mode="train",
+                    rng=jax.random.fold_in(rng, j),
+                    injected={body_end: y_mb}, skip=body_names,
+                    mesh=None,  # runs INSIDE shard_map — no constraints
+                    n_real=n_real)
+                total = 0.0
+                for c in self.costs:
+                    total = total + self._masked_cost(outs[c.name],
+                                                      j * mb, n_real)
+                return total
+
+            def tail_vjp(y_mb, j, p, fm):
+                loss_j, vjp = jax.vjp(
+                    lambda p_, y_: tail_cost(p_, y_, j, fm), p, y_mb)
+                dtail, dy = vjp(jnp.float32(1.0))
+                return loss_j, dy, dtail
+
+            loss_sum, y, g_stacked, dtail = pipeline_1f1b(
+                stage_fn, stack_params(params), x, tail_vjp, mesh,
+                num_microbatches=m, tail_args=(params, feed_m))
+            grads = dict(dtail)
+            grads.update(stack_params.unstack(g_stacked))
+            # replicated tail pass for metrics/state; the scheduled
+            # loss_sum must equal its loss — the drift is EMITTED as a
+            # metric so an inconsistency between the trained objective
+            # and the reported loss is visible, not silent
+            loss, (metrics, new_state, eval_outs) = self._loss_and_metrics(
+                params, state, feed, rng, n_real, "train",
+                injected={body_end: y}, skip=body_names)
+            metrics["pipeline_loss_drift"] = loss_sum - loss
             new_params, new_opt_state = self.optimizer.update(
                 params, grads, opt_state, n_real.astype(jnp.float32))
             return (new_params, new_opt_state, new_state, loss, metrics,

@@ -38,7 +38,8 @@ def _reader(n_batches=3, b=8):
     return reader
 
 
-def _train(mesh=None, stages=None, remat=False):
+def _train(mesh=None, stages=None, remat=False, schedule="gpipe",
+           microbatches=None):
     paddle.init(seed=0)
     cost = _model()
     params = paddle.create_parameters(paddle.Topology(cost))
@@ -46,7 +47,8 @@ def _train(mesh=None, stages=None, remat=False):
                     update_equation=paddle.optimizer.Momentum(
                         learning_rate=0.1, momentum=0.9),
                     mesh=mesh, pipeline_stages=stages,
-                    pipeline_remat=remat)
+                    pipeline_remat=remat, pipeline_schedule=schedule,
+                    pipeline_microbatches=microbatches)
     losses = []
     tr.train(_reader(), num_passes=2,
              event_handler=lambda e: losses.append(e.cost)
@@ -88,6 +90,75 @@ class TestPipelineSGD:
         _, losses = _train(mesh, [[f"pfc{i}"] for i in range(4)])
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
+
+    def test_1f1b_pp2_matches_single_device(self):
+        """The hand-scheduled 1F1B backward must reproduce the plain
+        single-device numerics exactly — it is a schedule, not math."""
+        mesh = create_mesh([(PP_AXIS, 2)])
+        tr_pp, losses_pp = _train(mesh, [["pfc0", "pfc1"],
+                                         ["pfc2", "pfc3"]],
+                                  schedule="1f1b")
+        tr_ref, losses_ref = _train()
+        np.testing.assert_allclose(losses_pp, losses_ref,
+                                   rtol=1e-4, atol=1e-5)
+        for k in tr_ref.parameters.raw:
+            np.testing.assert_allclose(
+                np.asarray(tr_pp.parameters.raw[k]),
+                np.asarray(tr_ref.parameters.raw[k]),
+                rtol=1e-4, atol=1e-5, err_msg=k)
+
+    def test_1f1b_pp4_many_microbatches(self):
+        """m >> S (the regime 1F1B exists for: O(S) activation state)
+        still pins to the single-device numerics."""
+        mesh = create_mesh([(PP_AXIS, 4)])
+        tr_pp, losses_pp = _train(mesh, [[f"pfc{i}"] for i in range(4)],
+                                  schedule="1f1b", microbatches=8)
+        tr_ref, losses_ref = _train()
+        np.testing.assert_allclose(losses_pp, losses_ref,
+                                   rtol=1e-4, atol=1e-5)
+        for k in tr_ref.parameters.raw:
+            np.testing.assert_allclose(
+                np.asarray(tr_pp.parameters.raw[k]),
+                np.asarray(tr_ref.parameters.raw[k]),
+                rtol=1e-4, atol=1e-5, err_msg=k)
+
+    def test_1f1b_memory_flat_in_microbatches(self):
+        """The defining property: 1F1B's temp footprint is O(stages),
+        flat in m, where GPipe's reversed scan carries O(m + stages)."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.parallel.pipeline import pipeline, pipeline_1f1b
+
+        mesh = create_mesh([(PP_AXIS, 2)])
+        S, D, MB = 2, 64, 8
+
+        def stage_fn(params, x):
+            return jnp.tanh(x @ params["w"])
+
+        def temp_bytes(m, schedule):
+            sp = {"w": jnp.stack([jnp.eye(D)] * S)}
+            x = jnp.zeros((m * MB, D), jnp.float32)
+            if schedule == "gpipe":
+                fn = jax.jit(jax.grad(lambda sp, x: jnp.sum(pipeline(
+                    stage_fn, sp, x, mesh, num_microbatches=m,
+                    remat=True) ** 2)))
+            else:
+                def tail_vjp(y_mb, j):
+                    loss_j, vjp = jax.vjp(lambda y: jnp.sum(y * y), y_mb)
+                    return loss_j, vjp(jnp.float32(1.0))[0], {}
+
+                def grads(sp, x):
+                    return pipeline_1f1b(stage_fn, sp, x, tail_vjp, mesh,
+                                         num_microbatches=m)[2]
+                fn = jax.jit(grads)
+            mem = fn.lower(sp, x).compile().memory_analysis()
+            return getattr(mem, "temp_size_in_bytes", 0)
+
+        g4, g32 = temp_bytes(4, "gpipe"), temp_bytes(32, "gpipe")
+        f4, f32 = temp_bytes(4, "1f1b"), temp_bytes(32, "1f1b")
+        assert g32 > g4 * 2, (g4, g32)          # gpipe grows with m
+        assert f32 < f4 * 1.25, (f4, f32)       # 1f1b stays ~flat
+        assert f32 < g32 / 2, (f32, g32)        # and wins at large m
 
     def test_stage_validation(self):
         paddle.init(seed=0)

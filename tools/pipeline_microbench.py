@@ -1,0 +1,84 @@
+"""GPipe vs 1F1B microbench: peak temp memory + step time vs microbatch
+count, on the virtual CPU pp-mesh (run: python tools/pipeline_microbench.py).
+
+The point being measured: the jax.grad-reversed GPipe scan carries
+O(m + S) tick states through the backward, so its temp footprint grows
+with the microbatch count m; the hand-scheduled 1F1B ring holds O(S)
+stage inputs regardless of m. Throughput at small m favors GPipe (the
+1F1B timeline is m + 2S - 2 ticks vs m + S - 1, and SPMD pays every
+masked slot); the ratio approaches 1 as m grows — which is exactly the
+regime the O(S) memory enables. Numbers land in docs/parallelism.md.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.parallel import create_mesh
+from paddle_tpu.parallel.mesh import PP_AXIS
+from paddle_tpu.parallel.pipeline import pipeline, pipeline_1f1b
+
+S, D, MB = 4, 256, 16
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w"])
+
+
+def build(mesh, m, schedule):
+    sp = {"w": jnp.stack([jnp.eye(D) * 0.9 for _ in range(S)])}
+    x = jnp.asarray(np.random.RandomState(0).randn(m * MB, D), jnp.float32)
+
+    if schedule == "gpipe":
+        def loss(sp, x):
+            y = pipeline(stage_fn, sp, x, mesh, num_microbatches=m,
+                         remat=True)
+            return jnp.sum(y * y)
+        fn = jax.jit(jax.grad(loss))
+    else:
+        def tail_vjp(y_mb, j):
+            loss_j, vjp = jax.vjp(lambda y: jnp.sum(y * y), y_mb)
+            (dy,) = vjp(jnp.float32(1.0))
+            return loss_j, dy, {}
+
+        def grads(sp, x):
+            _, _, g, _ = pipeline_1f1b(stage_fn, sp, x, tail_vjp, mesh,
+                                       num_microbatches=m)
+            return g
+        fn = jax.jit(grads)
+
+    compiled = fn.lower(sp, x).compile()
+    mem = compiled.memory_analysis()
+    temp = getattr(mem, "temp_size_in_bytes", None)
+    out = compiled(sp, x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = compiled(sp, x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / 10 * 1e3
+    return temp, dt
+
+
+def main():
+    mesh = create_mesh([(PP_AXIS, S)])
+    print(f"{'m':>4} {'gpipe temp MB':>14} {'1f1b temp MB':>13} "
+          f"{'gpipe ms':>9} {'1f1b ms':>8}")
+    for m in (4, 8, 16, 32, 64):
+        tg, dg = build(mesh, m, "gpipe")
+        t1, d1 = build(mesh, m, "1f1b")
+        print(f"{m:>4} {tg / 1e6:>14.2f} {t1 / 1e6:>13.2f} "
+              f"{dg:>9.2f} {d1:>8.2f}")
+
+
+if __name__ == "__main__":
+    main()
