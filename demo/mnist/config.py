@@ -13,6 +13,7 @@ out = paddle.layer.fc(h2, size=10, act=paddle.activation.Softmax(),
                       name="output")
 lbl = paddle.layer.data("label", paddle.data_type.integer_value(10))
 cost = paddle.layer.classification_cost(out, lbl, name="cost")
+output = out            # inference head for `paddle_tpu merge`
 extra_layers = [paddle.layer.classification_error(out, lbl, name="error")]
 
 optimizer = paddle.optimizer.Momentum(

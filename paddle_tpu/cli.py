@@ -12,10 +12,18 @@ CONF is either
     contract) — enough for --job=time (synthetic feeds) and, with
     --init_model_path, --job=test over a config-provided reader.
 
-Jobs (Trainer::{train,test,time}, TrainerBenchmark.cpp --job=time):
-  train: SGD over train_reader, per-pass checkpoint under --save_dir.
-  test : load parameters, evaluate test_reader, print metrics.
-  time : timed fwd+bwd+update steps on synthetic data, one JSON line.
+Jobs (Trainer::{train,test,time,checkGradient}, TrainerBenchmark.cpp):
+  train    : SGD over train_reader, per-pass checkpoint under --save_dir.
+  test     : load parameters, evaluate test_reader, print metrics.
+  time     : timed fwd+bwd+update steps on synthetic data, one JSON line.
+  checkgrad: finite-difference audit of the config's gradients
+             (Trainer.h:43 checkGradient).
+
+Other subcommands:
+  merge : topology + params -> one deployable artifact
+          (paddle/trainer/MergeModel.cpp:23 parity).
+  infer : forward a merged artifact over `infer_reader` rows or
+          synthetic inputs (capi/gradient_machine.h:52's Python twin).
 """
 
 from __future__ import annotations
@@ -151,16 +159,91 @@ def _job_test(trainer, ns) -> int:
     return 0
 
 
+def _job_checkgrad(trainer, ns, args) -> int:
+    """Trainer::checkGradient parity (Trainer.h:43, --job=checkgrad):
+    central finite differences vs jax.grad over the config's whole
+    topology, on a batch from the config's reader if present, else a
+    synthetic one."""
+    from paddle_tpu.trainer.data_feeder import DataFeeder
+    from paddle_tpu.trainer.grad_check import check_topology_grads
+
+    reader = ns.get("train_reader")
+    if reader is not None:
+        batch = next(iter(reader()))
+        batch = batch[:min(len(batch), args.batch_size)]
+    else:
+        batch = _synthetic_batch(trainer, min(args.batch_size, 8),
+                                 args.seq_len)
+    feeder = DataFeeder(trainer.topology.data_type(), None)
+    feed = feeder(batch)
+    check_topology_grads(trainer.topology, feed,
+                         eps=args.checkgrad_eps, seed=args.seed)
+    n_params = len(trainer.topology.param_specs)
+    print(json.dumps({"job": "checkgrad", "status": "ok",
+                      "params_checked": n_params,
+                      "batch": len(batch), "eps": args.checkgrad_eps}))
+    return 0
+
+
+def _cmd_merge(args) -> int:
+    """MergeModel parity (paddle/trainer/MergeModel.cpp:23): one
+    deployable artifact = serialized inference topology + parameters,
+    loadable by load_inference_model and the C ABI
+    (paddle_gradient_machine_create_for_inference_with_parameters)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.trainer.inference import save_inference_model
+
+    ns = _load_config(args.config)
+    output = ns.get("output") or ns.get("outputs")
+    if output is None:
+        raise SystemExit(
+            "merge needs the config to define `output` (the inference "
+            "output LayerOutput) — the cost graph is a training artifact")
+    with open(args.init_model_path, "rb") as f:
+        parameters = paddle.Parameters.from_tar(f)
+    save_inference_model(args.out, output, parameters)
+    print(json.dumps({"job": "merge", "status": "ok", "out": args.out}))
+    return 0
+
+
+def _cmd_infer(args) -> int:
+    """Forward the merged artifact: rows from the config's
+    `infer_reader` if given, else synthetic inputs matching the data
+    contract. Prints one JSON line with the output shape + a sample."""
+    from paddle_tpu.trainer.inference import load_inference_model
+
+    inf = load_inference_model(args.model)
+    if args.config:
+        ns = _load_config(args.config)
+        if ns.get("infer_reader") is None:
+            raise SystemExit("--config for infer must define "
+                             "`infer_reader` (yields input rows)")
+        rows = list(ns["infer_reader"]())
+    else:
+        # _synthetic_batch only touches .topology.data_type(), which the
+        # loaded Inference provides too
+        rows = _synthetic_batch(inf, args.batch_size, args.seq_len)
+    out = inf.infer(rows, batch_size=args.batch_size)
+    arr = np.asarray(out)
+    print(json.dumps({"job": "infer", "status": "ok",
+                      "rows": len(rows), "output_shape": list(arr.shape),
+                      "row0": [round(float(v), 6)
+                               for v in arr.reshape(arr.shape[0], -1)[0][:8]]}))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="paddle_tpu",
         description="TPU-native trainer CLI (paddle train parity)")
     sub = ap.add_subparsers(dest="command", required=True)
-    tr = sub.add_parser("train", help="train / time / test a config")
+    tr = sub.add_parser("train", help="train / time / test / checkgrad")
     tr.add_argument("--config", required=True,
                     help=".py config script or serialized topology .json")
     tr.add_argument("--job", default="train",
-                    choices=["train", "time", "test"])
+                    choices=["train", "time", "test", "checkgrad"])
+    tr.add_argument("--checkgrad_eps", type=float, default=1e-3,
+                    help="--job=checkgrad finite-difference step")
     tr.add_argument("--use_tpu", action="store_true", default=None)
     tr.add_argument("--trainer_count", type=int, default=1)
     tr.add_argument("--num_passes", type=int, default=None)
@@ -178,7 +261,28 @@ def main(argv=None) -> int:
     tr.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
     tr.add_argument("--seed", type=int, default=0)
+    mg = sub.add_parser("merge", help="bundle topology + params into one "
+                        "deployable artifact (MergeModel parity)")
+    mg.add_argument("--config", required=True,
+                    help=".py config defining `output`")
+    mg.add_argument("--init_model_path", required=True,
+                    help="params.tar (e.g. a save_pass checkpoint)")
+    mg.add_argument("--out", required=True, help="output .tar path")
+
+    inf = sub.add_parser("infer", help="forward a merged artifact")
+    inf.add_argument("--model", required=True,
+                     help="merged .tar from `paddle_tpu merge`")
+    inf.add_argument("--config", default=None,
+                     help="optional .py config defining `infer_reader`")
+    inf.add_argument("--batch_size", type=int, default=8)
+    inf.add_argument("--seq_len", type=int, default=16,
+                     help="synthetic sequence length (no --config)")
     args = ap.parse_args(argv)
+
+    if args.command == "merge":
+        return _cmd_merge(args)
+    if args.command == "infer":
+        return _cmd_infer(args)
 
     import paddle_tpu as paddle
     paddle.init(use_tpu=args.use_tpu, trainer_count=args.trainer_count,
@@ -191,6 +295,8 @@ def main(argv=None) -> int:
                          args.seq_len)
     if args.job == "test":
         return _job_test(trainer, ns)
+    if args.job == "checkgrad":
+        return _job_checkgrad(trainer, ns, args)
     return _job_train(trainer, ns, args)
 
 

@@ -176,6 +176,7 @@ def topology_stages(topology, stage_names):
                 for i in range(n) for j in range(len(slot_names))}
 
     stack_params.unstack = unstack
+    stack_params.param_names = {nm for row in name_matrix for nm in row}
     body_names = [nm for st in stage_names for nm in st]
     return stage_fn, stack_params, body_names, x_src, stage_names[-1][-1]
 

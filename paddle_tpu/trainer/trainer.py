@@ -349,12 +349,24 @@ class SGD:
             feed_m = jax.tree_util.tree_map(
                 lambda a: a.reshape((m, mb) + a.shape[1:]), feed)
 
+            # the tail differentiates ONLY the non-stage params: vjp'ing
+            # the full dict would make the scan carry (and psum) a
+            # zero-gradient copy of every body parameter per tick,
+            # eroding the O(stages) memory win
+            stage_names_set = stack_params.param_names
+            tail_p0 = {k: v for k, v in params.items()
+                       if k not in stage_names_set}
+            stage_part = {k: v for k, v in params.items()
+                          if k in stage_names_set}
+
             def tail_cost(p, y_mb, j, fm):
                 feed_j = jax.tree_util.tree_map(
                     lambda a: jax.lax.dynamic_index_in_dim(
                         a, j, 0, keepdims=False), fm)
+                # stage params are never read (body layers are skipped);
+                # merge them back un-differentiated for the full dict
                 outs, _ = self.topology.forward(
-                    p, state, feed_j, mode="train",
+                    {**stage_part, **p}, state, feed_j, mode="train",
                     rng=jax.random.fold_in(rng, j),
                     injected={body_end: y_mb}, skip=body_names,
                     mesh=None,  # runs INSIDE shard_map — no constraints
@@ -373,7 +385,7 @@ class SGD:
 
             loss_sum, y, g_stacked, dtail = pipeline_1f1b(
                 stage_fn, stack_params(params), x, tail_vjp, mesh,
-                num_microbatches=m, tail_args=(params, feed_m))
+                num_microbatches=m, tail_args=(tail_p0, feed_m))
             grads = dict(dtail)
             grads.update(stack_params.unstack(g_stacked))
             # replicated tail pass for metrics/state; the scheduled
