@@ -381,6 +381,14 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, max_len: int = 544,
     if cdt != "float32":
         params = {k: v.astype(cdt) for k, v in params.items()}
     dec = models.TransformerDecoder(params, n_layers=n_layers, n_heads=8)
+    # HBM roofline for one decode step: every step must read ALL params
+    # (batch-independent) plus each sequence's KV cache (batch-linear).
+    # Worst-case cache length = max_len; bytes/elt from the cast dtype.
+    esize = 2 if cdt != "float32" else 4
+    param_bytes = sum(int(np.prod(v.shape)) for v in params.values()) * esize
+    cache_bytes = 2 * n_layers * max_len * d_model * esize * batch
+    hbm_gb = (param_bytes + cache_bytes) / 1e9
+    roofline_ms = hbm_gb / 819.0 * 1e3      # v5e ~819 GB/s
     prompt = np.random.RandomState(0).randint(
         0, 32000, (batch, prompt_len)).astype("int32")
     dec.generate(prompt, max_len=max_len)        # compile
@@ -395,11 +403,18 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, max_len: int = 544,
     mid = len(samples) // 2
     dt = samples[mid] if len(samples) % 2 else \
         (samples[mid - 1] + samples[mid]) / 2  # median seconds-per-generate
-    return {"ms": round(dt / n_new * 1e3, 4),
+    ms_tok = dt / n_new * 1e3
+    return {"ms": round(ms_tok, 4),
             "min": round(samples[0] / n_new * 1e3, 4),
             "max": round(samples[-1] / n_new * 1e3, 4), "reps": N_REPS,
             "tokens_per_sec": round(batch * n_new / dt, 1),
-            "new_tokens": n_new, "batch": batch}
+            "new_tokens": n_new, "batch": batch,
+            # anchor: a per-token step cannot beat reading params + KV
+            # cache once from HBM; regressions show as roofline_frac
+            # drifting up
+            "hbm_gb_per_step": round(hbm_gb, 4),
+            "roofline_ms": round(roofline_ms, 4),
+            "roofline_frac": round(ms_tok / roofline_ms, 2)}
 
 
 def main():
@@ -470,8 +485,14 @@ def main():
             "flash_attention_t4096", lambda: bench_flash_attention(iters=half))
         suite["transformer_lm_bs8_t1024"] = _row(
             "transformer_lm_bs8_t1024", lambda: bench_transformer(iters=half))
+        # batch sweep anchors the claim "throughput scales with batch
+        # until cache reads saturate HBM" (docs/perf.md)
+        suite["decode_bs1_512tok"] = _row(
+            "decode_bs1_512tok", lambda: bench_decode(batch=1))
         suite["decode_bs8_512tok"] = _row(
             "decode_bs8_512tok", lambda: bench_decode())
+        suite["decode_bs32_512tok"] = _row(
+            "decode_bs32_512tok", lambda: bench_decode(batch=32))
 
     head_name = "alexnet_bs128"
     head = suite[head_name]

@@ -45,6 +45,10 @@ def _collect(outputs: Sequence[LayerOutput]) -> List[LayerOutput]:
     return order
 
 
+#: declared-output names already warned about (once per head, not per build)
+_warned_orphan_outputs: set = set()
+
+
 class Topology:
     """The model: layers in topo order + parameter/state specs."""
 
@@ -59,6 +63,24 @@ class Topology:
         if dup:
             raise ValueError(f"duplicate layer names in topology: {sorted(dup)}")
         self.by_name = {l.name: l for l in self.layers}
+        # a ModelSpec's cost nodes carry the spec's declared inference
+        # head (ModelSpec.__post_init__); if that head is NOT in this
+        # graph the builder is holding a cost-only topology — warn so
+        # inference is built from spec.output, not discovered missing
+        # at serving time (the transformer's probs side branch)
+        for o in self.outputs:
+            declared = getattr(o, "declared_output", None)
+            if declared is not None and declared not in self.by_name \
+                    and declared not in _warned_orphan_outputs:
+                _warned_orphan_outputs.add(declared)  # once per head name
+                import warnings
+                warnings.warn(
+                    f"topology built from a cost graph that does NOT "
+                    f"contain the model's declared output "
+                    f"{declared!r} (a side branch): build inference "
+                    "topologies from spec.output, or pass "
+                    "extra_outputs=[spec.output] here", stacklevel=2)
+                break
         # merge param specs (shared params must agree on shape)
         self.param_specs: Dict[str, ParamSpec] = {}
         self.state_specs: Dict[str, StateSpec] = {}

@@ -61,3 +61,13 @@ def train100():
 
 def test100():
     return _synthetic_reader(1024, 100, 80)
+
+
+def convert(path):
+    """Emit cifar-10/100 train/test as RecordIO shards
+    (python/paddle/v2/dataset/cifar.py convert parity)."""
+    from paddle_tpu.dataset import common
+    common.convert(path, train100(), 1000, "cifar-100-train")
+    common.convert(path, test100(), 1000, "cifar-100-test")
+    common.convert(path, train10(), 1000, "cifar-10-train")
+    common.convert(path, test10(), 1000, "cifar-10-test")

@@ -24,3 +24,46 @@ def cache_path(module: str, filename: str) -> str:
 
 def has_cached(module: str, filename: str) -> bool:
     return os.path.exists(os.path.join(DATA_HOME, module, filename))
+
+
+def convert(output_path: str, reader, line_count: int,
+            name_prefix: str) -> list:
+    """Emit a reader's samples as RecordIO shards for cloud dispatch —
+    python/paddle/v2/dataset/common.py convert():143 parity. Each shard
+    holds up to `line_count` pickled samples; the coordinator then
+    partitions the shards' CHUNKS as tasks (go/master/service.go:106,
+    chunk-as-task contract: reader/recordio.chunk_descriptors) and
+    workers deserialize with `record_deserializer`.
+
+    Returns the list of shard paths ({name_prefix}-{i:05d})."""
+    import pickle
+
+    from paddle_tpu.reader import recordio
+
+    assert line_count >= 1
+    os.makedirs(output_path, exist_ok=True)
+    paths = []
+
+    def write_shard(idx, lines):
+        p = os.path.join(output_path, f"{name_prefix}-{idx:05d}")
+        recordio.write_records(
+            p, (pickle.dumps(l, protocol=pickle.HIGHEST_PROTOCOL)
+                for l in lines))
+        paths.append(p)
+
+    lines = []
+    for d in reader():
+        lines.append(d)
+        if len(lines) >= line_count:
+            write_shard(len(paths), lines)
+            lines = []
+    if lines:
+        write_shard(len(paths), lines)
+    return paths
+
+
+def record_deserializer(rec: bytes):
+    """Inverse of convert()'s per-record pickling (for
+    recordio.chunk_reader / coordinator task_reader)."""
+    import pickle
+    return pickle.loads(rec)

@@ -53,3 +53,11 @@ def train():
 
 def test():
     return _reader(_TEST_IMAGES, _TEST_LABELS, 1024, 4321)
+
+
+def convert(path):
+    """Emit train/test as RecordIO shards for the cloud data path
+    (python/paddle/v2/dataset/mnist.py:107 parity)."""
+    from paddle_tpu.dataset import common
+    common.convert(path, train(), 1000, "mnist-train")
+    common.convert(path, test(), 1000, "mnist-test")

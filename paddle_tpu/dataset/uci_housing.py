@@ -40,3 +40,11 @@ def test():
         for i in range(n, len(x)):
             yield x[i], y[i]
     return reader
+
+
+def convert(path):
+    """Emit train/test as RecordIO shards
+    (python/paddle/v2/dataset/uci_housing.py convert parity)."""
+    from paddle_tpu.dataset import common
+    common.convert(path, train(), 100, "uci_housing-train")
+    common.convert(path, test(), 100, "uci_housing-test")

@@ -199,6 +199,19 @@ class CTCLayer:
                         lab_pad, blank_id=blank)
 
 
+@register_layer("warp_ctc")
+class WarpCTCLayer(CTCLayer):
+    """WarpCTCLayer.cpp:22 registers a distinct type; configs naming it
+    must resolve AND get warp-ctc semantics even when the config blob
+    carries only the type name: raw-logits input, blank=0
+    (WarpCTCLayer.cpp:33) — not the ctc layer's probs/blank=last."""
+
+    @staticmethod
+    def apply(ctx, name, cfg, params, inputs):
+        cfg = {"from_logits": True, "blank": 0, **cfg}
+        return CTCLayer.apply(ctx, name, cfg, params, inputs)
+
+
 def crf(input, label, size=None, param_attr=None, name=None, **kw):
     return make_layer("crf", name, [input, label], size=size,
                       param_attr=param_attr)
@@ -233,5 +246,5 @@ ctc_layer = ctc
 def warp_ctc(input, label, size=None, blank=0, name=None, **kw):
     """warp_ctc parity — same XLA CTC under the hood; blank configurable,
     default 0 (WarpCTCLayer.cpp:33 / ModelConfig blank default)."""
-    return make_layer("ctc", name, [input, label], size=size, blank=blank,
-                      from_logits=True)
+    return make_layer("warp_ctc", name, [input, label], size=size,
+                      blank=blank, from_logits=True)

@@ -48,21 +48,22 @@ class TransformerDecoder:
 
     def __init__(self, params, *, n_layers: int, n_heads: int,
                  name: str = "tfm", moe_k: int = 2,
-                 moe_capacity_factor: float = 1.25):
+                 moe_capacity_factor: Optional[float] = None):
         prefix = f"_{name}"
         self.p = {k: jnp.asarray(v) for k, v in params.items()
                   if k.startswith(prefix)}
         self.n_layers = n_layers
         self.n_heads = n_heads
         self.name = name
-        # MoE blocks are auto-detected from the parameter table, but k
-        # is NOT recoverable from it: moe_k MUST match the training
-        # config or decode silently diverges. Routing capacity is
-        # computed from the tokens of each CALL (prefill = b*plen
-        # tokens, a decode step = b), so it differs from the training
-        # graph's full-sequence capacity — raise moe_capacity_factor
-        # enough that inference never drops tokens if you need
-        # decode == training-forward numerics.
+        # MoE blocks are auto-detected from the parameter table (and
+        # expert_num comes from the gate's shape), but k is NOT
+        # recoverable from it: moe_k MUST match the training config or
+        # decode silently diverges. moe_capacity_factor=None (the
+        # default) routes DROP-FREE at inference — capacity = each
+        # call's full token count, so decode matches the training
+        # forward whenever training itself dropped nothing (the
+        # capacity limit only buys memory/balance at training scale).
+        # Set a float to reproduce a training capacity limit exactly.
         self.moe_k = moe_k
         self.moe_capacity_factor = moe_capacity_factor
         self._jitted = {}
@@ -102,11 +103,31 @@ class TransformerDecoder:
         ln2 = _ln(x, p[f"_{n}_l{i}_ln2.w0"], p[f"_{n}_l{i}_ln2.wbias"])
         if f"_{n}_l{i}_moe.gate" in p:
             b_, t_, d_ = ln2.shape
+            cf = self.moe_capacity_factor
+            cap = None
+            if cf is None:
+                gate = p[f"_{n}_l{i}_moe.gate"]
+                cap = b_ * t_
+                # drop-free routing materializes [n, E, C=n] dispatch
+                # tensors — quadratic in tokens. Cheap for the per-step
+                # call (n = batch); for a LARGE prefill fall back to a
+                # generous factor instead of OOMing the chip.
+                if cap * cap * gate.shape[-1] > (1 << 27):
+                    import warnings
+                    warnings.warn(
+                        f"moe prefill with {cap} tokens: drop-free "
+                        "routing would need a "
+                        f"[{cap},{gate.shape[-1]},{cap}] dispatch "
+                        "tensor; falling back to capacity_factor=2.0 "
+                        "(set moe_capacity_factor explicitly to "
+                        "choose)", stacklevel=2)
+                    cap, cf = None, 2.0
             y2d, _ = moe_ops.moe_ffn(
                 ln2.reshape(b_ * t_, d_), None,
                 p[f"_{n}_l{i}_moe.gate"], p[f"_{n}_l{i}_moe.moe_up"],
                 p[f"_{n}_l{i}_moe.moe_down"], k=self.moe_k,
-                capacity_factor=self.moe_capacity_factor)
+                capacity_factor=cf if cf is not None else 1.25,
+                capacity=cap)
             x = x + y2d.reshape(b_, t_, d_)
         else:
             up = jax.nn.relu(ln2 @ p[f"_{n}_l{i}_up.w0"]

@@ -45,6 +45,16 @@ class ModelSpec:
     cost: LayerOutput
     error: Optional[LayerOutput] = None
 
+    def __post_init__(self):
+        # tag the cost node(s) with the declared inference head so
+        # Topology(spec.cost) can WARN when the head is a side branch
+        # the cost graph excludes (instead of relying on the builder
+        # remembering this docstring)
+        costs = self.cost if isinstance(self.cost, (list, tuple)) \
+            else [self.cost]
+        for c in costs:
+            c.declared_output = self.output.name
+
     @property
     def extra_layers(self):
         return [self.error] if self.error is not None else []

@@ -49,6 +49,15 @@ def moe_dispatch(gate_logits: jnp.ndarray, valid: Optional[jnp.ndarray],
     aux f32 scalar — the switch-transformer load-balance loss,
     E * sum_e mean(probs_e) * mean(assigned_e), which is 1.0 at a
     perfectly uniform router).
+
+    Normalization convention (k > 1): combine weights are divided by
+    the total of the KEPT slots — if one of a token's experts
+    overflows capacity, the surviving expert's weight renormalizes to
+    1.0. This deliberately differs from GShard, which normalizes over
+    the pre-drop top-k probability mass (leaving the survivor
+    underweighted); full-mass routing on the kept experts preserved
+    output scale better in our convergence tests. Pass
+    normalize=False for raw gate products.
     """
     n, num_experts = gate_logits.shape
     assert 1 <= k <= num_experts, (
@@ -99,6 +108,7 @@ def moe_dispatch(gate_logits: jnp.ndarray, valid: Optional[jnp.ndarray],
 def moe_ffn(x: jnp.ndarray, valid: Optional[jnp.ndarray],
             gate_w: jnp.ndarray, w_up: jnp.ndarray, w_down: jnp.ndarray,
             *, k: int = 2, capacity_factor: float = 1.25,
+            capacity: Optional[int] = None,
             act=jax.nn.relu, mesh=None, ep_axis: str = "ep"
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """x [n, d] -> (y [n, d], aux loss).
@@ -107,10 +117,15 @@ def moe_ffn(x: jnp.ndarray, valid: Optional[jnp.ndarray],
     `ep` axis the expert-major intermediates are constrained to it so
     GSPMD keeps each expert's FFN on its owning devices and inserts the
     token all-to-all at the dispatch/combine einsums.
+
+    `capacity` overrides the factor-derived per-expert buffer; pass
+    capacity=n at inference for drop-free routing (the capacity limit
+    only buys memory/balance at training scale — see models/decode.py).
     """
     n, d = x.shape
     num_experts = gate_w.shape[-1]
-    capacity = moe_capacity(n, num_experts, k, capacity_factor)
+    if capacity is None:
+        capacity = moe_capacity(n, num_experts, k, capacity_factor)
     logits = jnp.dot(x.astype(jnp.float32), gate_w.astype(jnp.float32))
     dispatch, combine, aux = moe_dispatch(logits, valid, k=k,
                                           capacity=capacity)

@@ -285,6 +285,7 @@ CONFIGS = {
     "hsigmoid": lambda rng: _hsig_cfg(rng),
     "crf": lambda rng: _crf_cfg(rng),
     "ctc": lambda rng: _ctc_cfg(rng),
+    "warp_ctc": lambda rng: _ctc_cfg(rng, warp=True),
     "multibox_loss": lambda rng: _multibox_cfg(rng),
     # --- attention / misc
     "dot_product_attention": lambda rng: _attn_cfg(rng),
@@ -390,13 +391,19 @@ def _crf_cfg(rng):
     return L.crf(emit, lbl, size=4), f
 
 
-def _ctc_cfg(rng):
+def _ctc_cfg(rng, warp=False):
     s, f = seq(rng, lens=(5, 6), d=6)
-    probs = L.fc(s, size=5, act=paddle.activation.Softmax())
     lbl = L.data("lab", paddle.data_type.integer_value_sequence(5))
     f["lab"] = pack_sequences(
+        [1 + rng.randint(0, 4, 2).astype(np.int32),
+         1 + rng.randint(0, 4, 3).astype(np.int32)]) if warp else \
+        pack_sequences(
         [rng.randint(0, 4, 2).astype(np.int32),
          rng.randint(0, 4, 3).astype(np.int32)])
+    if warp:   # raw activations, blank=0 (WarpCTCLayer.cpp:33)
+        acts = L.fc(s, size=5, act=None)
+        return L.warp_ctc(acts, lbl, size=5), f
+    probs = L.fc(s, size=5, act=paddle.activation.Softmax())
     return L.ctc(probs, lbl, size=5), f
 
 

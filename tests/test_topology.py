@@ -104,3 +104,81 @@ class TestTopology:
                                np.array([4, 5], np.int32)])
         outs, _ = topo.forward(params, {}, {"words": seqs}, mode="test")
         assert outs[out.name].shape == (2, 2)
+
+
+class TestDeclaredOutputWarning:
+    def test_cost_graph_missing_declared_output_warns(self):
+        """VERDICT r3 weak #6: Topology(spec.cost) must WARN when the
+        ModelSpec's declared inference head is a side branch the cost
+        graph excludes (the transformer's probs node)."""
+        import warnings
+        import paddle_tpu as paddle
+        from paddle_tpu.core import registry, topology as topo_mod
+        from paddle_tpu import models
+
+        registry.reset_name_counters()
+        spec = models.transformer_lm(vocab_size=32, d_model=16, n_heads=2,
+                                     n_layers=1, d_ff=32, max_len=8)
+        topo_mod._warned_orphan_outputs.clear()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            paddle.Topology(spec.cost)
+        assert any("declared output" in str(x.message) for x in w), \
+            [str(x.message) for x in w]
+        # building WITH the output (the documented fix) does not warn
+        topo_mod._warned_orphan_outputs.clear()
+        with warnings.catch_warnings(record=True) as w2:
+            warnings.simplefilter("always")
+            paddle.Topology(spec.cost, extra_outputs=[spec.output])
+        assert not any("declared output" in str(x.message) for x in w2)
+
+    def test_contained_output_does_not_warn(self):
+        import warnings
+        import paddle_tpu as paddle
+        from paddle_tpu.core import registry, topology as topo_mod
+        from paddle_tpu import models
+
+        registry.reset_name_counters()
+        spec = models.smallnet(height=8, width=8, num_classes=4)
+        topo_mod._warned_orphan_outputs.clear()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            paddle.Topology(spec.cost)
+        assert not any("declared output" in str(x.message) for x in w)
+
+
+class TestWarpCTCResolves:
+    def test_warp_ctc_layer_type_registered(self):
+        from paddle_tpu.core.registry import get_layer_impl
+        impl = get_layer_impl("warp_ctc")
+        assert impl is not None and "apply" in impl
+
+    def test_warp_ctc_topology_roundtrip(self):
+        """A serialized config naming warp_ctc must deserialize and run."""
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.core import registry
+        from paddle_tpu.core.sequence import pack_sequences
+
+        registry.reset_name_counters()
+        x = paddle.layer.data(
+            "x", paddle.data_type.dense_vector_sequence(6))
+        acts = paddle.layer.fc(x, size=5, act=None, name="wc_fc")
+        lbl = paddle.layer.data(
+            "lab", paddle.data_type.integer_value_sequence(5))
+        cost = paddle.layer.warp_ctc(acts, lbl, size=5, name="wc")
+        topo = paddle.Topology(cost)
+        assert topo.by_name["wc"].type == "warp_ctc"
+        topo2 = paddle.Topology.deserialize(topo.serialize())
+        params = topo2.init_params()
+        feed = {"x": pack_sequences(
+                    [np.random.RandomState(0).randn(5, 6).astype("f4"),
+                     np.random.RandomState(1).randn(4, 6).astype("f4")]),
+                "lab": pack_sequences(
+                    [np.array([1, 2], np.int32),
+                     np.array([3, 1, 2], np.int32)])}
+        outs, _ = topo2.forward(params, topo2.init_state(), feed,
+                                mode="train")
+        v = outs["wc"]
+        v = v.data if hasattr(v, "data") else v
+        assert np.isfinite(np.asarray(v)).all()
