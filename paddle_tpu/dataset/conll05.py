@@ -114,3 +114,9 @@ def train():
 
 def test():
     return _reader("test", 400, 6)
+
+
+def convert(path):
+    """RecordIO shards for cloud dispatch (v2/dataset/conll05.py parity)."""
+    from paddle_tpu.dataset import common
+    common.convert(path, test(), 1000, "conll05-test")

@@ -65,3 +65,11 @@ def valid():
 
 def test():
     return _reader("test", 306, 43)
+
+
+def convert(path):
+    """RecordIO shards for cloud dispatch (v2/dataset/flowers.py parity)."""
+    from paddle_tpu.dataset import common
+    common.convert(path, train(), 200, "flowers-train")
+    common.convert(path, valid(), 200, "flowers-valid")
+    common.convert(path, test(), 200, "flowers-test")

@@ -28,3 +28,11 @@ def train(word_idx=None):
 
 def test(word_idx=None):
     return _reader(512, 12)
+
+
+def convert(path):
+    """RecordIO shards for cloud dispatch (v2/dataset/imdb.py parity)."""
+    from paddle_tpu.dataset import common
+    w = word_dict()
+    common.convert(path, train(w), 1000, "imdb-train")
+    common.convert(path, test(w), 1000, "imdb-test")

@@ -35,3 +35,11 @@ def train(word_idx=None, n: int = 5):
 
 def test(word_idx=None, n: int = 5):
     return _ngram_reader(1024, n, 22)
+
+
+def convert(path):
+    """RecordIO shards for cloud dispatch (v2/dataset/imikolov.py parity)."""
+    from paddle_tpu.dataset import common
+    w = build_dict()
+    common.convert(path, train(w), 1000, "imikolov-train")
+    common.convert(path, test(w), 1000, "imikolov-test")

@@ -123,3 +123,10 @@ def test(seed: int = 0):
             if i % 10 == 1:
                 yield s
     return reader
+
+
+def convert(path):
+    """RecordIO shards for cloud dispatch (v2/dataset/movielens.py parity)."""
+    from paddle_tpu.dataset import common
+    common.convert(path, train(), 1000, "movielens-train")
+    common.convert(path, test(), 1000, "movielens-test")

@@ -97,3 +97,10 @@ def train(format: str = "pointwise"):
 
 def test(format: str = "pointwise"):
     return _reader("test", format, 30, 32)
+
+
+def convert(path):
+    """RecordIO shards for cloud dispatch."""
+    from paddle_tpu.dataset import common
+    common.convert(path, train(), 1000, "mq2007-train")
+    common.convert(path, test(), 1000, "mq2007-test")

@@ -62,3 +62,10 @@ def train():
 
 def test():
     return _reader("test", 400, 22)
+
+
+def convert(path):
+    """RecordIO shards for cloud dispatch (v2/dataset/sentiment.py parity)."""
+    from paddle_tpu.dataset import common
+    common.convert(path, train(), 1000, "sentiment-train")
+    common.convert(path, test(), 1000, "sentiment-test")

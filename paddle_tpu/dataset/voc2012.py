@@ -73,3 +73,10 @@ def val():
 
 
 test = val
+
+
+def convert(path):
+    """RecordIO shards for cloud dispatch (v2/dataset/voc2012.py parity)."""
+    from paddle_tpu.dataset import common
+    common.convert(path, train(), 200, "voc2012-train")
+    common.convert(path, val(), 200, "voc2012-val")

@@ -69,3 +69,10 @@ def train(dict_size: int = DEFAULT_DICT_SIZE):
 
 def test(dict_size: int = DEFAULT_DICT_SIZE):
     return _reader("test", 400, 15, dict_size)
+
+
+def convert(path, dict_size: int = DEFAULT_DICT_SIZE):
+    """RecordIO shards for cloud dispatch (v2/dataset/wmt14.py parity)."""
+    from paddle_tpu.dataset import common
+    common.convert(path, train(dict_size), 1000, "wmt14-train")
+    common.convert(path, test(dict_size), 1000, "wmt14-test")

@@ -505,8 +505,11 @@ def classification_cost(input, label, weight=None, name=None,
     return make_layer("multi-class-cross-entropy", name, nodes)
 
 
-def cross_entropy_cost(input, label, name=None, from_logits: bool = False,
+def cross_entropy_cost(input, label, name=None, weight=None,
+                       from_logits: bool = False,
                        label_smoothing: float = 0.0, **kw) -> LayerOutput:
+    # (name stays the 3rd positional — the v2 signature; weight is the
+    # per-sample or per-token scale, keyword-preferred)
     # non-default options only, so existing serialized topologies (and
     # the golden corpus) are byte-stable
     if not 0.0 <= label_smoothing < 1.0:
@@ -521,8 +524,8 @@ def cross_entropy_cost(input, label, name=None, from_logits: bool = False,
         opts["from_logits"] = True
     if label_smoothing > 0.0:
         opts["label_smoothing"] = label_smoothing
-    return make_layer("multi-class-cross-entropy", name, [input, label],
-                      **opts)
+    nodes = [input, label] + ([weight] if weight is not None else [])
+    return make_layer("multi-class-cross-entropy", name, nodes, **opts)
 
 
 def cross_entropy_with_selfnorm_cost(input, label, name=None,

@@ -54,13 +54,20 @@ class CrossEntropyCost:
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
         pred, label = inputs[0], inputs[1]
-        out = _seq_or_sample_cost(
-            lambda p, l: cost_ops.cross_entropy(
-                p, l, from_logits=cfg.get("from_logits", False),
-                label_smoothing=cfg.get("label_smoothing", 0.0)),
-            pred, label)
-        if len(inputs) > 2:  # weight input
-            out = out * _payload(inputs[2]).reshape(out.shape)
+        fn = lambda p, l: cost_ops.cross_entropy(  # noqa: E731
+            p, l, from_logits=cfg.get("from_logits", False),
+            label_smoothing=cfg.get("label_smoothing", 0.0))
+        w = inputs[2] if len(inputs) > 2 else None
+        if isinstance(pred, SequenceBatch) and isinstance(w, SequenceBatch):
+            # PER-TOKEN weights (the masked-LM objective: weight 1.0 on
+            # masked slots selects which positions contribute) — applied
+            # before the valid-position reduction
+            per_pos = fn(pred.data, _payload(label))
+            per_pos = per_pos * w.data.reshape(per_pos.shape)
+            return _flatten_seq_cost(per_pos, pred)
+        out = _seq_or_sample_cost(fn, pred, label)
+        if w is not None:  # per-sample weight (v2 weight_layer support)
+            out = out * _payload(w).reshape(out.shape)
         return out
 
 

@@ -109,3 +109,76 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
                      cost=[cost] + aux_costs if aux_costs else cost)
     spec.positions = pos
     return spec
+
+
+def transformer_encoder(vocab_size: int = 32000, d_model: int = 512,
+                        n_heads: int = 8, n_layers: int = 6,
+                        d_ff: int = 2048, max_len: int = 512,
+                        dropout: float = 0.0,
+                        name: str = "enc") -> ModelSpec:
+    """Bidirectional encoder trained on the masked-LM objective (the
+    BERT-family pretraining recipe) — same pre-norm blocks as
+    `transformer_lm` but with causal=False attention, so every token
+    attends to the whole (unpadded) sequence.
+
+    Feed contract: (masked_ids, position_ids, label_ids, mlm_weight) —
+    three integer sequences plus a FLOAT sequence that is 1.0 exactly
+    on the masked positions. The cost is cross entropy over the vocab
+    logits weighted PER TOKEN by mlm_weight: unmasked positions
+    contribute nothing, the standard MLM objective. The builder does
+    not pick the mask — the data pipeline does (mask ~15% of tokens,
+    feed the corrupted ids + original labels + the 0/1 weight), which
+    keeps the graph static and the masking policy user-owned.
+
+    spec.output is the probs side branch (same contract as the LM:
+    build inference topologies from it, Topology(spec.cost) warns).
+    """
+    toks = layer.data(f"{name}_tokens", integer_value_sequence(vocab_size))
+    pos = layer.data(f"{name}_positions", integer_value_sequence(max_len))
+    lbls = layer.data(f"{name}_labels", integer_value_sequence(vocab_size))
+    from paddle_tpu.core.data_type import dense_vector_sequence
+    mlm_w = layer.data(f"{name}_mlm_weight", dense_vector_sequence(1))
+
+    x = layer.addto([
+        layer.embedding(toks, size=d_model, name=f"{name}_tok_emb"),
+        layer.embedding(pos, size=d_model, name=f"{name}_pos_emb"),
+    ], name=f"{name}_emb")
+
+    for i in range(n_layers):
+        ln1 = layer.layer_norm(x, name=f"{name}_l{i}_ln1")
+        q = layer.fc(ln1, size=d_model, bias_attr=False,
+                     name=f"{name}_l{i}_q")
+        k = layer.fc(ln1, size=d_model, bias_attr=False,
+                     name=f"{name}_l{i}_k")
+        v = layer.fc(ln1, size=d_model, bias_attr=False,
+                     name=f"{name}_l{i}_v")
+        attn = layer.dot_product_attention(q, k, v, num_heads=n_heads,
+                                           causal=False,
+                                           name=f"{name}_l{i}_attn")
+        proj = layer.fc(attn, size=d_model, bias_attr=False,
+                        name=f"{name}_l{i}_proj")
+        if dropout > 0:
+            proj = layer.dropout(proj, dropout, name=f"{name}_l{i}_drop1")
+        x = layer.addto([x, proj], name=f"{name}_l{i}_res1")
+
+        ln2 = layer.layer_norm(x, name=f"{name}_l{i}_ln2")
+        up = layer.fc(ln2, size=d_ff, act=act.Relu(),
+                      name=f"{name}_l{i}_up")
+        ffn = layer.fc(up, size=d_model, bias_attr=False,
+                       name=f"{name}_l{i}_down")
+        if dropout > 0:
+            ffn = layer.dropout(ffn, dropout, name=f"{name}_l{i}_drop2")
+        x = layer.addto([x, ffn], name=f"{name}_l{i}_res2")
+
+    xf = layer.layer_norm(x, name=f"{name}_lnf")
+    logits = layer.fc(xf, size=vocab_size, act=None, bias_attr=False,
+                      name=f"{name}_head")
+    probs = layer.addto([logits], act=act.Softmax(), name=f"{name}_probs")
+    cost = layer.cross_entropy_cost(logits, lbls, weight=mlm_w,
+                                    from_logits=True,
+                                    name=f"{name}_cost")
+    spec = ModelSpec(name="transformer_encoder", data=toks, label=lbls,
+                     output=probs, cost=cost)
+    spec.positions = pos
+    spec.mlm_weight = mlm_w
+    return spec

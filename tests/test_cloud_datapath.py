@@ -54,7 +54,10 @@ class TestCloudDataPath:
                                "uci-train")
         descs = [d for p in paths for d in rio.chunk_descriptors(p)]
         assert len(descs) >= 10
-        coord = Coordinator(descs, chunks_per_task=1, timeout_s=60.0)
+        # timeout well above worst-case first-batch jit under a loaded
+        # host: a premature requeue would double-deliver a task and
+        # break the exactly-once assertion below
+        coord = Coordinator(descs, chunks_per_task=1, timeout_s=300.0)
 
         counts = [0, 0]
         losses = [[], []]
