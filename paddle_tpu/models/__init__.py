@@ -19,8 +19,10 @@ __all__ = [
     "resnet", "resnet50", "stacked_lstm_net", "bidi_lstm_net",
     "convolution_net", "ngram_lm", "nmt_attention", "nmt_generator",
     "wide_and_deep", "movielens_regression", "crf_tagger", "rnn_crf_tagger",
-    "transformer_lm", "transformer_encoder", "TransformerDecoder",
+    "transformer_lm", "transformer_encoder", "transformer_classifier",
+    "TransformerDecoder",
 ]
 from paddle_tpu.models.transformer import (transformer_lm,  # noqa: F401
+                                           transformer_classifier,
                                            transformer_encoder)
 from paddle_tpu.models.decode import TransformerDecoder  # noqa: F401

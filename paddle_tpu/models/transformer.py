@@ -17,7 +17,9 @@ from __future__ import annotations
 
 from paddle_tpu import activation as act
 from paddle_tpu import layers as layer
-from paddle_tpu.core.data_type import (integer_value_sequence)
+from paddle_tpu import pooling
+from paddle_tpu.core.data_type import (dense_vector_sequence, integer_value,
+                                       integer_value_sequence)
 from paddle_tpu.models.image import ModelSpec
 
 
@@ -111,39 +113,14 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
     return spec
 
 
-def transformer_encoder(vocab_size: int = 32000, d_model: int = 512,
-                        n_heads: int = 8, n_layers: int = 6,
-                        d_ff: int = 2048, max_len: int = 512,
-                        dropout: float = 0.0,
-                        name: str = "enc") -> ModelSpec:
-    """Bidirectional encoder trained on the masked-LM objective (the
-    BERT-family pretraining recipe) — same pre-norm blocks as
-    `transformer_lm` but with causal=False attention, so every token
-    attends to the whole (unpadded) sequence.
-
-    Feed contract: (masked_ids, position_ids, label_ids, mlm_weight) —
-    three integer sequences plus a FLOAT sequence that is 1.0 exactly
-    on the masked positions. The cost is cross entropy over the vocab
-    logits weighted PER TOKEN by mlm_weight: unmasked positions
-    contribute nothing, the standard MLM objective. The builder does
-    not pick the mask — the data pipeline does (mask ~15% of tokens,
-    feed the corrupted ids + original labels + the 0/1 weight), which
-    keeps the graph static and the masking policy user-owned.
-
-    spec.output is the probs side branch (same contract as the LM:
-    build inference topologies from it, Topology(spec.cost) warns).
-    """
-    toks = layer.data(f"{name}_tokens", integer_value_sequence(vocab_size))
-    pos = layer.data(f"{name}_positions", integer_value_sequence(max_len))
-    lbls = layer.data(f"{name}_labels", integer_value_sequence(vocab_size))
-    from paddle_tpu.core.data_type import dense_vector_sequence
-    mlm_w = layer.data(f"{name}_mlm_weight", dense_vector_sequence(1))
-
+def _encoder_trunk(toks, pos, *, name, d_model, n_heads, n_layers, d_ff,
+                   dropout):
+    """Embeddings + N bidirectional pre-norm blocks + final layer norm —
+    shared by the MLM encoder and the sequence classifier."""
     x = layer.addto([
         layer.embedding(toks, size=d_model, name=f"{name}_tok_emb"),
         layer.embedding(pos, size=d_model, name=f"{name}_pos_emb"),
     ], name=f"{name}_emb")
-
     for i in range(n_layers):
         ln1 = layer.layer_norm(x, name=f"{name}_l{i}_ln1")
         q = layer.fc(ln1, size=d_model, bias_attr=False,
@@ -169,8 +146,69 @@ def transformer_encoder(vocab_size: int = 32000, d_model: int = 512,
         if dropout > 0:
             ffn = layer.dropout(ffn, dropout, name=f"{name}_l{i}_drop2")
         x = layer.addto([x, ffn], name=f"{name}_l{i}_res2")
+    return layer.layer_norm(x, name=f"{name}_lnf")
 
-    xf = layer.layer_norm(x, name=f"{name}_lnf")
+
+def transformer_classifier(vocab_size: int = 32000, num_classes: int = 2,
+                           d_model: int = 512, n_heads: int = 8,
+                           n_layers: int = 6, d_ff: int = 2048,
+                           max_len: int = 512, dropout: float = 0.0,
+                           name: str = "enc") -> ModelSpec:
+    """Sequence classification over the bidirectional trunk (the
+    BERT-family fine-tune head): mean-pool the final hidden states over
+    valid positions, project to `num_classes`. The default name matches
+    `transformer_encoder`'s, so the trunk's parameter names are
+    identical and MLM-pretrained Parameters load directly (the head
+    params are fresh); param loading matches BY NAME, so keep the two
+    specs' `name` equal when fine-tuning."""
+    toks = layer.data(f"{name}_tokens", integer_value_sequence(vocab_size))
+    pos = layer.data(f"{name}_positions", integer_value_sequence(max_len))
+    lbl = layer.data(f"{name}_label", integer_value(num_classes))
+    xf = _encoder_trunk(toks, pos, name=name, d_model=d_model,
+                        n_heads=n_heads, n_layers=n_layers, d_ff=d_ff,
+                        dropout=dropout)
+    pooled = layer.pooling(xf, pooling_type=pooling.Avg(),
+                           name=f"{name}_pool")
+    out = layer.fc(pooled, size=num_classes, act=act.Softmax(),
+                   name=f"{name}_out")
+    cost = layer.classification_cost(out, lbl, name=f"{name}_cost")
+    err = layer.classification_error(out, lbl, name=f"{name}_error")
+    spec = ModelSpec(name="transformer_classifier", data=toks, label=lbl,
+                     output=out, cost=cost, error=err)
+    spec.positions = pos
+    return spec
+
+
+def transformer_encoder(vocab_size: int = 32000, d_model: int = 512,
+                        n_heads: int = 8, n_layers: int = 6,
+                        d_ff: int = 2048, max_len: int = 512,
+                        dropout: float = 0.0,
+                        name: str = "enc") -> ModelSpec:
+    """Bidirectional encoder trained on the masked-LM objective (the
+    BERT-family pretraining recipe) — same pre-norm blocks as
+    `transformer_lm` but with causal=False attention, so every token
+    attends to the whole (unpadded) sequence.
+
+    Feed contract: (masked_ids, position_ids, label_ids, mlm_weight) —
+    three integer sequences plus a FLOAT sequence that is 1.0 exactly
+    on the masked positions. The cost is cross entropy over the vocab
+    logits weighted PER TOKEN by mlm_weight: unmasked positions
+    contribute nothing, the standard MLM objective. The builder does
+    not pick the mask — the data pipeline does (mask ~15% of tokens,
+    feed the corrupted ids + original labels + the 0/1 weight), which
+    keeps the graph static and the masking policy user-owned.
+
+    spec.output is the probs side branch (same contract as the LM:
+    build inference topologies from it, Topology(spec.cost) warns).
+    """
+    toks = layer.data(f"{name}_tokens", integer_value_sequence(vocab_size))
+    pos = layer.data(f"{name}_positions", integer_value_sequence(max_len))
+    lbls = layer.data(f"{name}_labels", integer_value_sequence(vocab_size))
+    mlm_w = layer.data(f"{name}_mlm_weight", dense_vector_sequence(1))
+
+    xf = _encoder_trunk(toks, pos, name=name, d_model=d_model,
+                        n_heads=n_heads, n_layers=n_layers, d_ff=d_ff,
+                        dropout=dropout)
     logits = layer.fc(xf, size=vocab_size, act=None, bias_attr=False,
                       name=f"{name}_head")
     probs = layer.addto([logits], act=act.Softmax(), name=f"{name}_probs")

@@ -119,3 +119,48 @@ class TestMaskedLM:
                  if isinstance(e, paddle.event.EndIteration) else None)
         assert np.isfinite(losses).all()
         assert np.mean(losses[-4:]) < np.mean(losses[:4]), losses
+
+
+class TestClassifier:
+    def test_classifier_trains_and_loads_mlm_trunk(self):
+        """transformer_classifier: trains on sequence labels, and an
+        MLM-pretrained trunk loads directly (shared param names)."""
+        from paddle_tpu.models import transformer_classifier
+        registry.reset_name_counters()
+        paddle.init(seed=0)
+        spec = transformer_classifier(vocab_size=V, num_classes=3,
+                                      d_model=D, n_heads=H, n_layers=L,
+                                      d_ff=2 * D, max_len=T, name="enc")
+        params = paddle.create_parameters(paddle.Topology(spec.cost))
+        tr = paddle.SGD(cost=spec.cost, parameters=params,
+                        update_equation=paddle.optimizer.Adam(
+                            learning_rate=2e-3),
+                        extra_layers=spec.extra_layers)
+        rng = np.random.RandomState(0)
+
+        def reader():
+            for _ in range(10):
+                rows = []
+                for _ in range(B):
+                    ids = rng.randint(1, V, T).astype("int32")
+                    # learnable signal: class = first token mod 3
+                    rows.append((ids, np.arange(T, dtype="int32"),
+                                 int(ids[0] % 3)))
+                yield rows
+
+        losses = []
+        tr.train(reader, num_passes=3,
+                 event_handler=lambda e: losses.append(e.cost)
+                 if isinstance(e, paddle.event.EndIteration) else None)
+        assert np.isfinite(losses).all()
+        assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+        # the MLM spec's trunk params are a subset with identical names
+        registry.reset_name_counters()
+        mlm = transformer_encoder(vocab_size=V, d_model=D, n_heads=H,
+                                  n_layers=L, d_ff=2 * D, max_len=T,
+                                  name="enc")
+        mlm_names = set(paddle.Topology(mlm.cost).param_specs)
+        cls_names = set(paddle.Topology(spec.cost).param_specs)
+        trunk = {n for n in mlm_names if "_head" not in n}
+        assert trunk <= cls_names, sorted(trunk - cls_names)[:5]
