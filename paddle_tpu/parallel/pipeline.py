@@ -27,12 +27,75 @@ from paddle_tpu.parallel._compat import shard_map
 from paddle_tpu.parallel.mesh import PP_AXIS
 
 
-def pipeline(stage_fn: Callable, stage_params, x: jnp.ndarray, mesh: Mesh,
+def _microbatch_codec(x, m):
+    """Split a boundary pytree into (carried float leaves, static int
+    leaves) reshaped to [m, mb, ...].
+
+    The boundary between stages may be a pytree (a SequenceBatch's
+    data + lengths): only INEXACT leaves ride the scan carry and the
+    ppermute ring — integer leaves (lengths) are identical for every
+    stage's output of a given microbatch, so they are closed over and
+    re-attached by microbatch index. This keeps integers out of the
+    reverse-mode scan/ppermute path entirely.
+
+    Returns (dyn [list of [m, mb, ...] arrays], rebuild(dyn_mb, j),
+             collect(dyn_m), b).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    b = leaves[0].shape[0]
+    assert b % m == 0, f"microbatches {m} must divide batch {b}"
+    mb = b // m
+    shaped = [a.reshape((m, mb) + a.shape[1:]) for a in leaves]
+    is_dyn = [jnp.issubdtype(a.dtype, jnp.inexact) for a in leaves]
+    dyn = [a for a, d in zip(shaped, is_dyn) if d]
+    static = [a for a, d in zip(shaped, is_dyn) if not d]
+
+    def rebuild(dyn_mb, j):
+        """Boundary pytree of microbatch j from carried leaves."""
+        di, si, out = 0, 0, []
+        for d in is_dyn:
+            if d:
+                out.append(dyn_mb[di])
+                di += 1
+            else:
+                out.append(static[si][j])
+                si += 1
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def collect(dyn_m):
+        """Full-batch pytree from [m, mb, ...] carried leaves."""
+        di, si, out = 0, 0, []
+        for d in is_dyn:
+            if d:
+                a = dyn_m[di]
+                di += 1
+            else:
+                a = static[si]
+                si += 1
+            out.append(a.reshape((b,) + a.shape[2:]))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return dyn, rebuild, collect, b
+
+
+def _strip_static(y):
+    """The carried form of a stage output: its inexact leaves only."""
+    return [a for a in jax.tree_util.tree_leaves(y)
+            if jnp.issubdtype(a.dtype, jnp.inexact)]
+
+
+def _tree_where(cond, a, b):
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(cond, x, y), a, b)
+
+
+def pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
              num_microbatches: Optional[int] = None,
-             axis_name: str = PP_AXIS, remat: bool = False) -> jnp.ndarray:
+             axis_name: str = PP_AXIS, remat: bool = False):
     """Run `stage_fn` as an n-stage pipeline.
 
-    stage_fn(params_i, x_mb) -> y_mb, shape-preserving ([mb, ...] in/out).
+    stage_fn(params_i, x_mb) -> y_mb, shape-preserving ([mb, ...] in/out;
+      a pytree boundary — e.g. a SequenceBatch — is supported, with its
+      integer leaves treated as per-microbatch constants).
     stage_params: pytree whose leaves have a leading `n_stages` axis
       (stage i's slice lives on chip i — sharded over `pp`).
     x: [batch, ...] global input; split into `num_microbatches` equal
@@ -51,48 +114,50 @@ def pipeline(stage_fn: Callable, stage_params, x: jnp.ndarray, mesh: Mesh,
     for leaf in jax.tree_util.tree_leaves(stage_params):
         assert leaf.shape[0] == n, \
             f"stage_params leading axis {leaf.shape[0]} != pp={n}"
-    b = x.shape[0]
     m = num_microbatches or n
-    assert b % m == 0, f"microbatches {m} must divide batch {b}"
-    mb = b // m
-    xm = x.reshape((m, mb) + x.shape[1:])
+    dyn, rebuild, collect, b = _microbatch_codec(x, m)
 
-    def local(params, xm_local):
-        # params: stage slice [1, ...] -> squeeze; xm_local: full [m, mb,...]
+    def local(params, *dyn_local):
+        # params: stage slice [1, ...] -> squeeze; dyn_local: [m, mb,...]
         params = jax.tree_util.tree_map(lambda p: p[0], params)
         me = lax.axis_index(axis_name)
         ticks = m + n - 1
 
-        state0 = jnp.where(me == 0, xm_local[0], jnp.zeros_like(xm_local[0]))
-        outbuf0 = jnp.zeros_like(xm_local)
+        state0 = _tree_where(me == 0, [a[0] for a in dyn_local],
+                             [jnp.zeros_like(a[0]) for a in dyn_local])
+        outbuf0 = [jnp.zeros_like(a) for a in dyn_local]
 
         def tick(carry, t):
             state, outbuf = carry
-            y = stage_fn(params, state)
+            xi_now = jnp.clip(t - me, 0, m - 1)   # this tick's mb index
+            y = stage_fn(params, rebuild(state, xi_now))
+            yd = _strip_static(y)
             # collect on the last stage: tick t finishes microbatch t-(n-1)
             oi = jnp.clip(t - (n - 1), 0, m - 1)
             take = jnp.logical_and(me == n - 1, t >= n - 1)
-            outbuf = lax.dynamic_update_index_in_dim(
-                outbuf, jnp.where(take, y, outbuf[oi]), oi, 0)
+            outbuf = [lax.dynamic_update_index_in_dim(
+                buf, jnp.where(take, v, buf[oi]), oi, 0)
+                for buf, v in zip(outbuf, yd)]
             # hop activations forward one stage
-            y_prev = lax.ppermute(y, axis_name,
+            y_prev = lax.ppermute(yd, axis_name,
                                   [(i, i + 1) for i in range(n - 1)])
             xi = jnp.clip(t + 1, 0, m - 1)
-            nxt = jnp.where(me == 0, xm_local[xi], y_prev)
+            nxt = _tree_where(me == 0, [a[xi] for a in dyn_local], y_prev)
             return (nxt, outbuf), None
 
         (_, outbuf), _ = lax.scan(tick, (state0, outbuf0),
                                   jnp.arange(ticks))
         # only the last stage holds real outputs; psum replicates them
-        outbuf = jnp.where(me == n - 1, outbuf, jnp.zeros_like(outbuf))
-        return lax.psum(outbuf, axis_name)
+        outbuf = _tree_where(me == n - 1, outbuf,
+                             [jnp.zeros_like(a) for a in outbuf])
+        return tuple(lax.psum(a, axis_name) for a in outbuf)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
     fn = shard_map(local, mesh=mesh,
-                   in_specs=(pspec, P()), out_specs=P(),
+                   in_specs=(pspec,) + (P(),) * len(dyn), out_specs=P(),
                    check=False)
-    out = fn(stage_params, xm)
-    return out.reshape((b,) + out.shape[2:])
+    out = fn(stage_params, *dyn)
+    return collect(list(out))
 
 
 def topology_stages(topology, stage_names):
@@ -102,17 +167,22 @@ def topology_stages(topology, stage_names):
     the explicit stage map, the TPU-native form of ParallelNeuralNetwork's
     per-layer `deviceId` pinning (ParallelNeuralNetwork.h:34, config
     `device=` attribute). Constraints (asserted): stages must be
-    structurally identical (same layer types + param shapes — GPipe over
-    a repeated block), each stage a linear chain whose first layer feeds
-    from the previous stage's last (stage 0 from a data layer), and
-    stateless (no batch-norm stats inside the body).
+    structurally identical (same layer types + param shapes + internal
+    wiring — GPipe over a repeated block); each stage is a DAG whose
+    layers consume either in-stage values or the single BOUNDARY input
+    (the previous stage's last layer; stage 0's boundary is a data layer
+    OR any computed layer outside the stages — an embedding prologue the
+    trainer evaluates first) — residual blocks like a transformer's are
+    fine; the stage output is its LAST listed layer; stateless (no
+    batch-norm stats inside the body).
 
     Returns (stage_fn, stack_params, body_names, x_src, body_end):
-      stage_fn(slot_params, x) — replays stage 0's layers with
-        substituted params (all stages share its structure);
+      stage_fn(slot_params, x) — replays stage 0's DAG with substituted
+        params (all stages share its structure);
       stack_params(params) — {stage0 param name: [n_stages, ...] stack};
       body_names — every pipelined layer (to skip in the tail forward);
-      x_src — the data layer feeding the pipeline;
+      x_src — the boundary layer feeding the pipeline (a data layer, or
+        a computed prologue layer the trainer forwards first);
       body_end — the final stage's last layer name (inject its value).
     """
     from paddle_tpu.core.registry import ApplyContext, get_layer_impl
@@ -121,6 +191,8 @@ def topology_stages(topology, stage_names):
     n = len(stage_names)
     sigs = []
     for si, st in enumerate(stage_names):
+        boundary = stage_names[si - 1][-1] if si > 0 else None
+        in_stage = {nm: k for k, nm in enumerate(st)}
         sig = []
         for li, nm in enumerate(st):
             l = by_name[nm]
@@ -130,38 +202,49 @@ def topology_stages(topology, stage_names):
                 f"dropout ({nm!r}) unsupported inside a pipeline stage — " \
                 "the stage context has no per-step rng (put dropout in " \
                 "the tail, or between body and head)"
-            assert len(l.parents) == 1, \
-                f"pipeline stages must be linear chains; {nm!r} has " \
-                f"{len(l.parents)} inputs"
-            expect = st[li - 1] if li > 0 else (
-                stage_names[si - 1][-1] if si > 0 else None)
-            if expect is not None:
-                assert l.parents[0].name == expect, \
-                    f"{nm!r} must consume {expect!r}, got " \
-                    f"{l.parents[0].name!r}"
-            sig.append((l.type,
+            wiring = []
+            for p in l.parents:
+                if p.name in in_stage:
+                    assert in_stage[p.name] < li, \
+                        f"{nm!r} consumes {p.name!r} before it is " \
+                        "computed — list stage layers in topo order"
+                    wiring.append(in_stage[p.name])
+                else:
+                    if boundary is None:
+                        # stage 0's boundary: a data layer, or ANY layer
+                        # outside the stages (an embedding prologue the
+                        # trainer computes before the pipeline)
+                        boundary = p.name
+                    assert p.name == boundary, \
+                        f"{nm!r} consumes {p.name!r} from outside the " \
+                        f"stage; the only allowed external input is the " \
+                        f"boundary {boundary!r}"
+                    wiring.append(-1)
+            sig.append((l.type, tuple(wiring),
                         tuple(tuple(ps.shape) for ps in l.params)))
         sigs.append(tuple(sig))
+        if si == 0:
+            x_src = boundary
     assert all(s == sigs[0] for s in sigs), \
         "pipeline stages must be structurally identical"
-    first = by_name[stage_names[0][0]]
-    assert first.parents[0].type == "data", \
-        "the pipeline body must start right after a data layer"
-    x_src = first.parents[0].name
+    assert x_src not in {nm for st in stage_names for nm in st}, \
+        f"the stage-0 boundary {x_src!r} cannot itself be a stage layer"
 
     name_matrix = [[ps.name for nm in st for ps in by_name[nm].params]
                    for st in stage_names]
     slot_names = name_matrix[0]
     stage0 = [by_name[nm] for nm in stage_names[0]]
+    wiring0 = [w for (_, w, _) in sigs[0]]
 
     def stage_fn(slot_params, x):
         ctx = ApplyContext("train", None, {})
-        prev = x
-        for l in stage0:
+        vals = []
+        for l, wires in zip(stage0, wiring0):
             impl = get_layer_impl(l.type)
             lp = {ps.name: slot_params[ps.name] for ps in l.params}
-            prev = impl["apply"](ctx, l.name, l.config, lp, [prev])
-        return prev
+            ins = [x if w < 0 else vals[w] for w in wires]
+            vals.append(impl["apply"](ctx, l.name, l.config, lp, ins))
+        return vals[-1]
 
     def stack_params(params):
         return {slot_names[j]: jnp.stack(
@@ -181,7 +264,7 @@ def topology_stages(topology, stage_names):
     return stage_fn, stack_params, body_names, x_src, stage_names[-1][-1]
 
 
-def pipeline_1f1b(stage_fn: Callable, stage_params, x: jnp.ndarray,
+def pipeline_1f1b(stage_fn: Callable, stage_params, x,
                   tail_vjp: Callable, mesh: Mesh,
                   num_microbatches: Optional[int] = None,
                   axis_name: str = PP_AXIS, tail_args=()):
@@ -202,7 +285,9 @@ def pipeline_1f1b(stage_fn: Callable, stage_params, x: jnp.ndarray,
       context and fail sharding-in-types checks.
 
     Returns (loss_sum, y [batch, ...], stage_grads stacked like
-    stage_params, dtail_sum).
+    stage_params, dtail_sum, dx) — dx is the cotangent of x's float
+    leaves ([batch, ...] list), i.e. the PROLOGUE gradient when the
+    pipeline input was computed by earlier layers (embeddings).
 
     Schedule: microbatch j runs forward at stage s on tick j+s and
     backward on tick j + 2(n-1) - s; one scan over m + 2(n-1) ticks
@@ -223,52 +308,66 @@ def pipeline_1f1b(stage_fn: Callable, stage_params, x: jnp.ndarray,
     for leaf in jax.tree_util.tree_leaves(stage_params):
         assert leaf.shape[0] == n, \
             f"stage_params leading axis {leaf.shape[0]} != pp={n}"
-    b = x.shape[0]
     m = num_microbatches or n
-    assert b % m == 0, f"microbatches {m} must divide batch {b}"
-    mb = b // m
-    xm = x.reshape((m, mb) + x.shape[1:])
+    dyn, rebuild, collect, b = _microbatch_codec(x, m)
     ring = 2 * n - 1
 
-    def local(params, xm_local, targs):
+    def local(params, targs, *dyn_local):
         params = jax.tree_util.tree_map(lambda p: p[0], params)
         me = lax.axis_index(axis_name)
-        zero_mb = jnp.zeros_like(xm_local[0])
+
+        def stage_dyn(p, d, j):
+            """Stage over CARRIED (float) leaves only: statics attach by
+            microbatch index via the closure, so vjp cotangents stay
+            float (no float0 riding the ring)."""
+            return _strip_static(stage_fn(p, rebuild(list(d), j)))
+
+        zero_mb = [jnp.zeros_like(a[0]) for a in dyn_local]
 
         # probe shapes for the accumulators (abstract eval only)
-        y_shape = jax.eval_shape(stage_fn, params, zero_mb)
-        zero_y = jnp.zeros(y_shape.shape, y_shape.dtype)
+        y_shapes = jax.eval_shape(stage_dyn, params, tuple(zero_mb),
+                                  jnp.int32(0))
+        zero_y = [jnp.zeros(s.shape, s.dtype) for s in y_shapes]
         _, dy_probe, dtail_probe = jax.eval_shape(
-            lambda y, ta: tail_vjp(y, jnp.int32(0), *ta), zero_y, targs)
+            lambda y, ta: tail_vjp(rebuild(y, jnp.int32(0)), jnp.int32(0),
+                                   *ta), list(zero_y), targs)
         g_zero = jax.tree_util.tree_map(jnp.zeros_like, params)
         dtail_zero = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), dtail_probe)
 
+        del zero_y  # (probe only)
         carry0 = (zero_mb,                       # x_state: incoming act
-                  jnp.zeros(dy_probe.shape, dy_probe.dtype),  # dy_state
-                  jnp.zeros((ring,) + zero_mb.shape, zero_mb.dtype),
-                  jnp.zeros((m,) + y_shape.shape, y_shape.dtype),
+                  [jnp.zeros(s.shape, s.dtype)
+                   for s in _strip_static(dy_probe)],     # dy_state
+                  [jnp.zeros((ring,) + a.shape, a.dtype) for a in zero_mb],
+                  [jnp.zeros((m, ) + s.shape, s.dtype) for s in y_shapes],
+                  [jnp.zeros((m, ) + a.shape, a.dtype) for a in zero_mb],
                   g_zero, dtail_zero, jnp.float32(0.0))
 
         def tick(carry, t):
-            x_state, dy_state, inbuf, youtbuf, g_acc, dtail_acc, \
+            x_state, dy_state, inbuf, youtbuf, dxbuf, g_acc, dtail_acc, \
                 loss_acc = carry
             # ---- forward slot: mb fj = t - me
             fj = t - me
             f_active = jnp.logical_and(fj >= 0, fj < m)
             fjc = jnp.clip(fj, 0, m - 1)
-            x_in = jnp.where(me == 0, xm_local[fjc], x_state)
-            y = stage_fn(params, x_in)
+            x_in = _tree_where(me == 0, [a[fjc] for a in dyn_local],
+                               x_state)
+            y = stage_dyn(params, tuple(x_in), fjc)
             slot_f = fjc % ring
-            inbuf = lax.dynamic_update_index_in_dim(
-                inbuf, jnp.where(f_active, x_in, inbuf[slot_f]), slot_f, 0)
+            inbuf = [lax.dynamic_update_index_in_dim(
+                buf, jnp.where(f_active, v, buf[slot_f]), slot_f, 0)
+                for buf, v in zip(inbuf, x_in)]
             last = me == n - 1
             take_y = jnp.logical_and(last, f_active)
-            youtbuf = lax.dynamic_update_index_in_dim(
-                youtbuf, jnp.where(take_y, y, youtbuf[fjc]), fjc, 0)
+            youtbuf = [lax.dynamic_update_index_in_dim(
+                buf, jnp.where(take_y, v, buf[fjc]), fjc, 0)
+                for buf, v in zip(youtbuf, y)]
             # ---- tail head (meaningful on the last stage only; SPMD
             # executes it everywhere, masked)
-            loss_j, dy_tail, dtail_j = tail_vjp(y, fjc, *targs)
+            loss_j, dy_tail_t, dtail_j = tail_vjp(rebuild(y, fjc), fjc,
+                                                  *targs)
+            dy_tail = _strip_static(dy_tail_t)
             loss_acc = loss_acc + jnp.where(take_y, loss_j, 0.0)
             dtail_acc = jax.tree_util.tree_map(
                 lambda a, d: a + jnp.where(take_y, d, jnp.zeros_like(d)),
@@ -277,26 +376,37 @@ def pipeline_1f1b(stage_fn: Callable, stage_params, x: jnp.ndarray,
             bj = t - 2 * (n - 1) + me
             b_active = jnp.logical_and(bj >= 0, bj < m)
             bjc = jnp.clip(bj, 0, m - 1)
-            dy_in = jnp.where(last, dy_tail, dy_state)
-            x_saved = inbuf[bjc % ring]
-            _, svjp = jax.vjp(stage_fn, params, x_saved)
+            dy_in = _tree_where(last, dy_tail, dy_state)
+            x_saved = tuple(buf[bjc % ring] for buf in inbuf)
+            _, svjp = jax.vjp(
+                lambda p, d: stage_dyn(p, d, bjc), params, x_saved)
             dp_j, dx_j = svjp(dy_in)
             g_acc = jax.tree_util.tree_map(
                 lambda a, d: a + jnp.where(b_active, d, jnp.zeros_like(d)),
                 g_acc, dp_j)
+            # stage 0's dx is the PROLOGUE's cotangent (embeddings etc.
+            # computed before the pipeline): collect it per microbatch
+            take_dx = jnp.logical_and(me == 0, b_active)
+            dxbuf = [lax.dynamic_update_index_in_dim(
+                buf, jnp.where(take_dx, v, buf[bjc]), bjc, 0)
+                for buf, v in zip(dxbuf, dx_j)]
             # ---- hop: activations up, cotangents down
             y_prev = lax.ppermute(y, axis_name,
                                   [(i, i + 1) for i in range(n - 1)])
-            dx_next = lax.ppermute(dx_j, axis_name,
+            dx_next = lax.ppermute(list(dx_j), axis_name,
                                    [(i, i - 1) for i in range(1, n)])
-            return (y_prev, dx_next, inbuf, youtbuf, g_acc, dtail_acc,
-                    loss_acc), None
+            return (y_prev, dx_next, inbuf, youtbuf, dxbuf, g_acc,
+                    dtail_acc, loss_acc), None
 
-        (x_s, dy_s, inbuf, youtbuf, g_acc, dtail_acc, loss_acc), _ = \
+        (x_s, dy_s, inbuf, youtbuf, dxbuf, g_acc, dtail_acc,
+         loss_acc), _ = \
             lax.scan(tick, carry0, jnp.arange(m + 2 * (n - 1)))
-        youtbuf = jnp.where(me == n - 1, youtbuf,
-                            jnp.zeros_like(youtbuf))
-        youtbuf = lax.psum(youtbuf, axis_name)
+        youtbuf = _tree_where(me == n - 1, youtbuf,
+                              [jnp.zeros_like(a) for a in youtbuf])
+        youtbuf = [lax.psum(a, axis_name) for a in youtbuf]
+        dxbuf = _tree_where(me == 0, dxbuf,
+                            [jnp.zeros_like(a) for a in dxbuf])
+        dxbuf = [lax.psum(a, axis_name) for a in dxbuf]
         loss_sum = lax.psum(jnp.where(me == n - 1, loss_acc, 0.0),
                             axis_name)
         dtail = jax.tree_util.tree_map(
@@ -304,16 +414,19 @@ def pipeline_1f1b(stage_fn: Callable, stage_params, x: jnp.ndarray,
                                          jnp.zeros_like(d)), axis_name),
             dtail_acc)
         g_out = jax.tree_util.tree_map(lambda g: g[None], g_acc)
-        return loss_sum, youtbuf, g_out, dtail
+        return loss_sum, tuple(youtbuf), g_out, dtail, tuple(dxbuf)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
     gspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
     fn = shard_map(local, mesh=mesh,
-                   in_specs=(pspec, P(), P()),
-                   out_specs=(P(), P(), gspec, P()),
+                   in_specs=(pspec, P()) + (P(),) * len(dyn),
+                   out_specs=(P(), P(), gspec, P(), P()),
                    check=False)
-    loss_sum, ym, g_stacked, dtail = fn(stage_params, xm, tuple(tail_args))
-    return (loss_sum, ym.reshape((b,) + ym.shape[2:]), g_stacked, dtail)
+    loss_sum, ym, g_stacked, dtail, dxm = fn(stage_params,
+                                             tuple(tail_args), *dyn)
+    # dx leaves flattened back to [batch, ...] (the prologue cotangent)
+    dx = [a.reshape((b,) + a.shape[2:]) for a in dxm]
+    return (loss_sum, collect(list(ym)), g_stacked, dtail, dx)
 
 
 def pipeline_loss(stage_fn: Callable, loss_fn: Callable):

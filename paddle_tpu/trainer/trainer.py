@@ -292,13 +292,25 @@ class SGD:
         (stage_fn, stack_params, body_names, x_src,
          body_end) = topology_stages(self.topology, self.pipeline_stages)
 
+        prologue_skip = self._pipeline_prologue_skip(x_src)
+
         if self.pipeline_schedule == "1f1b":
             return self._build_1f1b_train_step(
-                stage_fn, stack_params, body_names, x_src, body_end)
+                stage_fn, stack_params, body_names, x_src, body_end,
+                prologue_skip)
 
         def step(params, opt_state, state, feed, rng, n_real):
             def loss_fn(p):
-                y = pipeline(stage_fn, stack_params(p), feed[x_src], mesh,
+                if prologue_skip is None:
+                    xv = feed[x_src]
+                else:
+                    # boundary computed by earlier layers (embeddings):
+                    # run just its ancestor slice; jax.grad flows the
+                    # pipeline's dx back through it automatically
+                    xv = self._prologue_forward(p, state, feed, rng,
+                                                n_real, x_src,
+                                                prologue_skip)
+                y = pipeline(stage_fn, stack_params(p), xv, mesh,
                              remat=self.pipeline_remat,
                              num_microbatches=self.pipeline_microbatches)
                 return self._loss_and_metrics(
@@ -314,8 +326,34 @@ class SGD:
 
         return shard_train_step(step, mesh)
 
+    def _prologue_forward(self, params, state, feed, rng, n_real, x_src,
+                          prologue_skip):
+        """The boundary's ancestor slice (embeddings etc.) — ONE shared
+        implementation so the GPipe and 1F1B schedules cannot drift."""
+        pouts, _ = self.topology.forward(
+            params, state, feed, mode="train", rng=rng,
+            output_names=[x_src], skip=prologue_skip, mesh=self.mesh,
+            n_real=n_real)
+        return pouts[x_src]
+
+    def _pipeline_prologue_skip(self, x_src):
+        """None when the pipeline boundary is a data layer (fed
+        directly); otherwise the layer names to SKIP so a forward
+        computes exactly the boundary's ancestor slice."""
+        if self.topology.by_name[x_src].type == "data":
+            return None
+        anc = set()
+        stack = [self.topology.by_name[x_src]]
+        while stack:
+            l = stack.pop()
+            if l.name in anc:
+                continue
+            anc.add(l.name)
+            stack.extend(l.parents)
+        return [l.name for l in self.topology.layers if l.name not in anc]
+
     def _build_1f1b_train_step(self, stage_fn, stack_params, body_names,
-                               x_src, body_end):
+                               x_src, body_end, prologue_skip=None):
         """Hand-scheduled 1F1B: gradients come out of the schedule
         itself (parallel/pipeline.pipeline_1f1b), not an outer
         jax.grad; a cheap replicated tail pass afterwards produces the
@@ -340,10 +378,41 @@ class SGD:
                     "would diverge from the metrics pass)")
 
         def step(params, opt_state, state, feed, rng, n_real):
-            x = feed[x_src]
+            if prologue_skip is None:
+                x = feed[x_src]
+                pvjp = None
+            else:
+                def prologue(p):
+                    return self._prologue_forward(p, state, feed, rng,
+                                                  n_real, x_src,
+                                                  prologue_skip)
+
+                # ONE differentiated trace: float leaves are the vjp'd
+                # output, integer leaves ride out as aux
+                shape = jax.eval_shape(prologue, params)
+                leaves_s, treedef = jax.tree_util.tree_flatten(shape)
+                is_dyn = [jnp.issubdtype(s.dtype, jnp.inexact)
+                          for s in leaves_s]
+
+                def prologue_split(p):
+                    lv = jax.tree_util.tree_leaves(prologue(p))
+                    return ([a for a, d in zip(lv, is_dyn) if d],
+                            [a for a, d in zip(lv, is_dyn) if not d])
+
+                x_dyn, pvjp, x_static = jax.vjp(prologue_split, params,
+                                                has_aux=True)
+                di, si, lv = 0, 0, []
+                for d in is_dyn:
+                    if d:
+                        lv.append(x_dyn[di])
+                        di += 1
+                    else:
+                        lv.append(x_static[si])
+                        si += 1
+                x = jax.tree_util.tree_unflatten(treedef, lv)
             from paddle_tpu.parallel.mesh import PP_AXIS
             m = self.pipeline_microbatches or mesh.shape[PP_AXIS]
-            b = x.shape[0]
+            b = jax.tree_util.tree_leaves(x)[0].shape[0]
             assert b % m == 0, f"microbatches {m} must divide batch {b}"
             mb = b // m
             feed_m = jax.tree_util.tree_map(
@@ -383,10 +452,16 @@ class SGD:
                 dtail, dy = vjp(jnp.float32(1.0))
                 return loss_j, dy, dtail
 
-            loss_sum, y, g_stacked, dtail = pipeline_1f1b(
+            loss_sum, y, g_stacked, dtail, dx = pipeline_1f1b(
                 stage_fn, stack_params(params), x, tail_vjp, mesh,
                 num_microbatches=m, tail_args=(tail_p0, feed_m))
             grads = dict(dtail)
+            if pvjp is not None:
+                # route the pipeline's input cotangent back through the
+                # prologue (embedding grads)
+                (dp_pro,) = pvjp(dx)
+                grads = {k: grads[k] + dp_pro[k] if k in grads
+                         else dp_pro[k] for k in dp_pro}
             grads.update(stack_params.unstack(g_stacked))
             # replicated tail pass for metrics/state; the scheduled
             # loss_sum must equal its loss — the drift is EMITTED as a

@@ -179,3 +179,75 @@ class TestPipelineSGD:
                        update_equation=paddle.optimizer.Momentum(
                            learning_rate=0.1),
                        mesh=mesh)
+
+
+class TestTransformerPipeline:
+    """The flagship actually pipelines: transformer blocks (residual
+    DAG stages, SequenceBatch boundary, embedding prologue) over pp,
+    matching single-device numerics under BOTH schedules."""
+
+    def _run(self, schedule=None, microbatches=None):
+        import jax
+        from paddle_tpu import models
+        from paddle_tpu.core import registry
+        from paddle_tpu.core.sequence import SequenceBatch
+
+        registry.reset_name_counters()
+        paddle.init(seed=0)
+        L_, T_, V_, B_ = 2, 8, 40, 8
+        spec = models.transformer_lm(vocab_size=V_, d_model=16,
+                                     n_heads=2, n_layers=L_, d_ff=32,
+                                     max_len=T_)
+        params = paddle.create_parameters(paddle.Topology(spec.cost))
+        stages = None
+        mesh = None
+        if schedule is not None:
+            mesh = create_mesh([(PP_AXIS, 2)])
+            stages = [[f"tfm_l{i}_{s}" for s in
+                       ("ln1", "q", "k", "v", "attn", "proj", "res1",
+                        "ln2", "up", "down", "res2")]
+                      for i in range(L_)]
+        tr = paddle.SGD(cost=spec.cost, parameters=params,
+                        update_equation=paddle.optimizer.Adam(
+                            learning_rate=1e-3),
+                        mesh=mesh, pipeline_stages=stages,
+                        pipeline_schedule=schedule or "gpipe",
+                        pipeline_microbatches=microbatches)
+        rng = np.random.RandomState(0)
+        batches = []
+        for _ in range(3):
+            rows = []
+            for _ in range(B_):
+                ids = rng.randint(0, V_, T_ + 1)
+                rows.append(([int(v) for v in ids[:T_]],
+                             list(range(T_)),
+                             [int(v) for v in ids[1:]]))
+            batches.append(rows)
+
+        losses = []
+        tr.train(lambda: iter(batches), num_passes=2,
+                 event_handler=lambda e: losses.append(e.cost)
+                 if isinstance(e, paddle.event.EndIteration) else None)
+        return tr, losses
+
+    def test_gpipe_matches_single_device(self):
+        tr_pp, losses_pp = self._run("gpipe")
+        tr_ref, losses_ref = self._run()
+        np.testing.assert_allclose(losses_pp, losses_ref,
+                                   rtol=2e-4, atol=1e-5)
+        for k in tr_ref.parameters.raw:
+            np.testing.assert_allclose(
+                np.asarray(tr_pp.parameters.raw[k]),
+                np.asarray(tr_ref.parameters.raw[k]),
+                rtol=2e-4, atol=2e-5, err_msg=k)
+
+    def test_1f1b_matches_single_device(self):
+        tr_pp, losses_pp = self._run("1f1b", microbatches=4)
+        tr_ref, losses_ref = self._run()
+        np.testing.assert_allclose(losses_pp, losses_ref,
+                                   rtol=2e-4, atol=1e-5)
+        for k in tr_ref.parameters.raw:
+            np.testing.assert_allclose(
+                np.asarray(tr_pp.parameters.raw[k]),
+                np.asarray(tr_ref.parameters.raw[k]),
+                rtol=2e-4, atol=2e-5, err_msg=k)

@@ -51,8 +51,8 @@ def build(mesh, m, schedule):
             return loss_j, dy, {}
 
         def grads(sp, x):
-            _, _, g, _ = pipeline_1f1b(stage_fn, sp, x, tail_vjp, mesh,
-                                       num_microbatches=m)
+            _, _, g, _, _ = pipeline_1f1b(stage_fn, sp, x, tail_vjp, mesh,
+                                          num_microbatches=m)
             return g
         fn = jax.jit(grads)
 
