@@ -60,11 +60,13 @@ data_layer = data
 
 
 def fc(input, size: int, act=None, name: Optional[str] = None,
-       param_attr=None, bias_attr=None, layer_attr=None, **kw) -> LayerOutput:
+       param_attr=None, bias_attr=None, layer_attr=None,
+       tied_transpose: bool = False, **kw) -> LayerOutput:
     inputs = _listify(input)
+    opts = {"tied_transpose": True} if tied_transpose else {}
     node = make_layer("fc", name, inputs, size=size,
                       act=act_mod.to_name(act), param_attr=param_attr,
-                      bias_attr=bias_attr)
+                      bias_attr=bias_attr, **opts)
     return _maybe_dropout(node, layer_attr)
 
 

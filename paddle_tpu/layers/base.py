@@ -110,8 +110,17 @@ class FCLayer:
         specs = []
         for i, (m, a) in enumerate(zip(input_metas, attrs)):
             pname = a.name or (f"_{name}.w{i}" if i else f"_{name}.w0")
-            specs.append(ParamSpec(pname, (m.size, size),
-                                   default_weight_init(a, (0,)), a))
+            # tied_transpose stores the weight [out, in] — the shape of
+            # an embedding table — so an LM head can SHARE the token
+            # embedding parameter (weight tying: same name, same shape,
+            # the fc applies it transposed)
+            shape = (size, m.size) if cfg.get("tied_transpose") \
+                else (m.size, size)
+            # fan-in axis follows the storage layout: [out, in] when
+            # transposed, so init scale still derives from the INPUT dim
+            fan_in = (1,) if cfg.get("tied_transpose") else (0,)
+            specs.append(ParamSpec(pname, shape,
+                                   default_weight_init(a, fan_in), a))
         battr = ParamAttr.of(cfg.get("bias_attr")) if not isinstance(
             cfg.get("bias_attr"), bool) else ParamAttr()
         if cfg.get("bias_attr") is not False:
@@ -137,7 +146,8 @@ class FCLayer:
             x = _payload(val)
             if not isinstance(val, SequenceBatch) and x.ndim > 2:
                 x = x.reshape(x.shape[0], -1)   # flatten image NHWC -> [b, hwc]
-            y = linear_ops.matmul(x, w)
+            y = linear_ops.matmul(x, w.T if cfg.get("tied_transpose")
+                                  else w)
             out = y if out is None else out + y
             if isinstance(val, SequenceBatch):
                 ref = val

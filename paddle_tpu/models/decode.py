@@ -138,7 +138,10 @@ class TransformerDecoder:
     def _logits(self, p, x):
         n = self.name
         x = _ln(x, p[f"_{n}_lnf.w0"], p[f"_{n}_lnf.wbias"])
-        logits = x @ p[f"_{n}_head.w0"]
+        if f"_{n}_head.w0" in p:
+            logits = x @ p[f"_{n}_head.w0"]
+        else:  # tie_embeddings: the head IS the token table, transposed
+            logits = x @ p[f"_{n}_tok_emb.w0"].T
         if f"_{n}_head.wbias" in p:  # older checkpoints carried a bias
             logits = logits + p[f"_{n}_head.wbias"]
         return logits
@@ -215,7 +218,8 @@ class TransformerDecoder:
 
         def run(p, prompt):
             b = prompt.shape[0]
-            V = p[f"_{n}_head.w0"].shape[1]
+            V = p[f"_{n}_head.w0"].shape[1] if f"_{n}_head.w0" in p \
+                else p[f"_{n}_tok_emb.w0"].shape[0]
             logits, caches = self._prefill(p, prompt, plen, max_len)
             lp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
             # seed K lanes with the top-K first tokens

@@ -30,6 +30,7 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
                    moe_aux_coeff: float = 0.01,
                    moe_capacity_factor: float = 1.25,
                    dropout: float = 0.0, label_smoothing: float = 0.0,
+                   tie_embeddings: bool = False,
                    name: str = "tfm") -> ModelSpec:
     """tokens + positions -> N pre-norm blocks -> next-token CE.
 
@@ -99,8 +100,16 @@ def transformer_lm(vocab_size: int = 32000, d_model: int = 512,
     # topologies from spec.output (see ModelSpec docstring)
     # no bias on the vocab projection (the modern LM convention): a
     # 32k-wide bias adds nothing measurable to the fit but costs a
-    # vocab-sized gradient reduction + optimizer slots every step
+    # vocab-sized gradient reduction + optimizer slots every step.
+    # tie_embeddings shares the token embedding table as the head
+    # weight (applied transposed — fc(tied_transpose=True)): halves
+    # the vocab-sized parameters and their optimizer state/update.
+    from paddle_tpu.core.registry import ParamAttr
+    head_attr = ParamAttr(name=f"_{name}_tok_emb.w0") \
+        if tie_embeddings else None
     logits = layer.fc(xf, size=vocab_size, act=None, bias_attr=False,
+                      param_attr=head_attr,
+                      tied_transpose=tie_embeddings,
                       name=f"{name}_head")
     probs = layer.addto([logits], act=act.Softmax(), name=f"{name}_probs")
     cost = layer.cross_entropy_cost(logits, nxt, from_logits=True,

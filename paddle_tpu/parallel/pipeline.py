@@ -46,42 +46,48 @@ def _microbatch_codec(x, m):
     assert b % m == 0, f"microbatches {m} must divide batch {b}"
     mb = b // m
     shaped = [a.reshape((m, mb) + a.shape[1:]) for a in leaves]
-    is_dyn = [jnp.issubdtype(a.dtype, jnp.inexact) for a in leaves]
+    is_dyn = [is_dynamic_leaf(a) for a in leaves]
     dyn = [a for a, d in zip(shaped, is_dyn) if d]
     static = [a for a, d in zip(shaped, is_dyn) if not d]
 
     def rebuild(dyn_mb, j):
         """Boundary pytree of microbatch j from carried leaves."""
-        di, si, out = 0, 0, []
-        for d in is_dyn:
-            if d:
-                out.append(dyn_mb[di])
-                di += 1
-            else:
-                out.append(static[si][j])
-                si += 1
+        out = interleave_leaves(dyn_mb, [s[j] for s in static], is_dyn)
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def collect(dyn_m):
         """Full-batch pytree from [m, mb, ...] carried leaves."""
-        di, si, out = 0, 0, []
-        for d in is_dyn:
-            if d:
-                a = dyn_m[di]
-                di += 1
-            else:
-                a = static[si]
-                si += 1
-            out.append(a.reshape((b,) + a.shape[2:]))
+        out = [a.reshape((b,) + a.shape[2:])
+               for a in interleave_leaves(dyn_m, static, is_dyn)]
         return jax.tree_util.tree_unflatten(treedef, out)
 
     return dyn, rebuild, collect, b
 
 
+def is_dynamic_leaf(a):
+    """THE predicate for what rides the pipeline's scan/ppermute ring
+    (and is differentiated): inexact leaves. Integer leaves (lengths)
+    are per-microbatch constants. One definition — the codec, the
+    strip, and the trainer's prologue vjp all share it."""
+    return jnp.issubdtype(a.dtype, jnp.inexact)
+
+
+def interleave_leaves(dyn, static, is_dyn):
+    """Re-zip split leaves back into flat leaf order."""
+    di, si, out = 0, 0, []
+    for d in is_dyn:
+        if d:
+            out.append(dyn[di])
+            di += 1
+        else:
+            out.append(static[si])
+            si += 1
+    return out
+
+
 def _strip_static(y):
     """The carried form of a stage output: its inexact leaves only."""
-    return [a for a in jax.tree_util.tree_leaves(y)
-            if jnp.issubdtype(a.dtype, jnp.inexact)]
+    return [a for a in jax.tree_util.tree_leaves(y) if is_dynamic_leaf(a)]
 
 
 def _tree_where(cond, a, b):

@@ -388,11 +388,15 @@ class SGD:
                                                   prologue_skip)
 
                 # ONE differentiated trace: float leaves are the vjp'd
-                # output, integer leaves ride out as aux
+                # output, integer leaves ride out as aux (the dyn/static
+                # predicate and interleave are pipeline.py's — the
+                # prologue cotangent ordering and the schedule's dx
+                # ordering share one definition)
+                from paddle_tpu.parallel.pipeline import (
+                    interleave_leaves, is_dynamic_leaf)
                 shape = jax.eval_shape(prologue, params)
                 leaves_s, treedef = jax.tree_util.tree_flatten(shape)
-                is_dyn = [jnp.issubdtype(s.dtype, jnp.inexact)
-                          for s in leaves_s]
+                is_dyn = [is_dynamic_leaf(s) for s in leaves_s]
 
                 def prologue_split(p):
                     lv = jax.tree_util.tree_leaves(prologue(p))
@@ -401,15 +405,9 @@ class SGD:
 
                 x_dyn, pvjp, x_static = jax.vjp(prologue_split, params,
                                                 has_aux=True)
-                di, si, lv = 0, 0, []
-                for d in is_dyn:
-                    if d:
-                        lv.append(x_dyn[di])
-                        di += 1
-                    else:
-                        lv.append(x_static[si])
-                        si += 1
-                x = jax.tree_util.tree_unflatten(treedef, lv)
+                x = jax.tree_util.tree_unflatten(
+                    treedef, interleave_leaves(list(x_dyn), list(x_static),
+                                               is_dyn))
             from paddle_tpu.parallel.mesh import PP_AXIS
             m = self.pipeline_microbatches or mesh.shape[PP_AXIS]
             b = jax.tree_util.tree_leaves(x)[0].shape[0]

@@ -235,3 +235,48 @@ class TestLengthPenalty:
                 s, raw_map[tuple(r)] / max(len(r), 1), rtol=1e-6)
         scores = [s for s, _ in norm[0]]
         assert scores == sorted(scores, reverse=True)
+
+
+class TestTiedEmbeddings:
+    def test_tied_lm_trains_and_decodes_in_parity(self):
+        """tie_embeddings=True: one vocab-sized table serves both the
+        embedding and the (transposed) head; training works and greedy
+        decode still follows the training graph's argmax chain."""
+        spec, topo, params = _model(tie_embeddings=True)
+        assert "_tfm_head.w0" not in params        # the table is shared
+        assert "_tfm_tok_emb.w0" in params
+
+        dec = models.TransformerDecoder(params, n_layers=CFG["n_layers"],
+                                        n_heads=CFG["n_heads"])
+        rng = np.random.RandomState(0)
+        b, plen, max_len = 2, 3, 8
+        prompt = rng.randint(0, CFG["vocab_size"],
+                             (b, plen)).astype("int32")
+        got = dec.generate(prompt, max_len=max_len)
+        prefix = prompt.copy()
+        for step in range(max_len - plen):
+            want = _graph_argmax(topo, spec, params, prefix)
+            for row in range(b):
+                assert got[row][step] == int(want[row])
+            prefix = np.concatenate(
+                [prefix, want[:, None].astype("int32")], axis=1)
+
+        # one SGD step moves the shared table with grads from BOTH uses
+        ps = paddle.create_parameters(paddle.Topology(spec.cost))
+        tr = paddle.SGD(cost=spec.cost, parameters=ps,
+                        update_equation=paddle.optimizer.Adam(
+                            learning_rate=1e-3))
+        T = 8
+        rows = []
+        for _ in range(4):
+            ids = rng.randint(0, CFG["vocab_size"], T + 1)
+            rows.append(([int(v) for v in ids[:T]], list(range(T)),
+                         [int(v) for v in ids[1:]]))
+        w0 = np.asarray(ps.raw["_tfm_tok_emb.w0"]).copy()
+        losses = []
+        tr.train(lambda: iter([rows]), num_passes=2,
+                 event_handler=lambda e: losses.append(e.cost)
+                 if isinstance(e, paddle.event.EndIteration) else None)
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+        assert np.abs(np.asarray(
+            tr.parameters.raw["_tfm_tok_emb.w0"]) - w0).max() > 0
