@@ -38,11 +38,18 @@ class DotProductAttentionLayer:
         q, k, v = input_metas
         assert q.seq_level >= 1 and k.seq_level >= 1 and v.seq_level >= 1, \
             "attention inputs must be sequences"
-        assert q.size == k.size, "query/key feature sizes must match"
         h = cfg.get("num_heads", 1)
-        assert q.size % h == 0 and v.size % h == 0, \
-            f"num_heads={h} must divide q/v sizes ({q.size}, {v.size})"
-        return LayerMeta(size=v.size, seq_level=1), [], []
+        kv_h = cfg.get("num_kv_heads") or h
+        assert h % kv_h == 0, \
+            f"num_heads={h} must be a multiple of num_kv_heads={kv_h}"
+        assert q.size % h == 0 and k.size % kv_h == 0 \
+            and v.size % kv_h == 0, \
+            f"head counts ({h}, kv {kv_h}) must divide q/k/v sizes " \
+            f"({q.size}, {k.size}, {v.size})"
+        assert q.size // h == k.size // kv_h, \
+            "q and k head dims must match (grouped-query attention " \
+            "shares each k/v head across num_heads/num_kv_heads queries)"
+        return LayerMeta(size=(v.size // kv_h) * h, seq_level=1), [], []
 
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
@@ -50,10 +57,17 @@ class DotProductAttentionLayer:
         from paddle_tpu.parallel.mesh import SP_AXIS
         qs, ks, vs = inputs
         h = cfg.get("num_heads", 1)
+        kv_h = cfg.get("num_kv_heads") or h
         causal = cfg.get("causal", False)
         q = _split_heads(qs.data, h)
-        k = _split_heads(ks.data, h)
-        v = _split_heads(vs.data, h)
+        k = _split_heads(ks.data, kv_h)
+        v = _split_heads(vs.data, kv_h)
+        if kv_h != h:
+            # grouped-query attention: each k/v head serves h/kv_h query
+            # heads — repeat to full width for the fused kernels (the
+            # decode-time win is the kv_h-sized CACHE, models/decode.py)
+            k = jnp.repeat(k, h // kv_h, axis=2)
+            v = jnp.repeat(v, h // kv_h, axis=2)
         mesh = getattr(ctx, "mesh", None)
         if mesh is not None and SP_AXIS in mesh.shape and \
                 mesh.shape[SP_AXIS] > 1:
@@ -82,16 +96,20 @@ class DotProductAttentionLayer:
 
 
 def dot_product_attention(query, key=None, value=None, num_heads: int = 1,
-                          causal: bool = False, name=None, **kw):
+                          num_kv_heads=None, causal: bool = False,
+                          name=None, **kw):
     """Multi-head scaled-dot-product attention over sequences.
 
     query/key/value: sequence layers [b, T, d] (key/value default to
     query — self-attention). Runs ring attention over the mesh `sp` axis
-    when one exists; plain attention otherwise."""
+    when one exists; plain attention otherwise. num_kv_heads < num_heads
+    is grouped-query attention (each k/v head shared by
+    num_heads/num_kv_heads query heads — MQA at num_kv_heads=1)."""
     key = key if key is not None else query
     value = value if value is not None else key
+    opts = {"num_kv_heads": num_kv_heads} if num_kv_heads else {}
     return make_layer("dot_product_attention", name, [query, key, value],
-                      num_heads=num_heads, causal=causal)
+                      num_heads=num_heads, causal=causal, **opts)
 
 
 multi_head_attention = dot_product_attention

@@ -80,24 +80,31 @@ class TransformerDecoder:
         n, h = self.name, self.n_heads
         ln1 = _ln(x, p[f"_{n}_l{i}_ln1.w0"], p[f"_{n}_l{i}_ln1.wbias"])
         q = _heads(ln1 @ p[f"_{n}_l{i}_q.w0"], h)
-        k = _heads(ln1 @ p[f"_{n}_l{i}_k.w0"], h)
-        v = _heads(ln1 @ p[f"_{n}_l{i}_v.w0"], h)
+        dh = q.shape[-1]
+        kv_h = k_cache.shape[2]
+        k = _heads(ln1 @ p[f"_{n}_l{i}_k.w0"], kv_h)
+        v = _heads(ln1 @ p[f"_{n}_l{i}_v.w0"], kv_h)
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
         t = x.shape[1]
         T = k_cache.shape[1]
-        scale = q.shape[-1] ** -0.5
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q,
+        scale = dh ** -0.5
+        # grouped-query: q [b,t,(kv_h, rep),dh] against kv_h-head caches
+        # — the cache is read at its stored width, never repeated
+        rep = h // kv_h
+        q5 = q.reshape(q.shape[0], t, kv_h, rep, dh)
+        logits = jnp.einsum("bqgrd,bkgd->bgrqk", q5,
                             k_cache.astype(q.dtype)) * scale
         # causal against absolute positions: query row j sits at pos + j
         qpos = pos + jnp.arange(t)[:, None]
         kpos = jnp.arange(T)[None, :]
         mask = (kpos <= qpos) & (kpos < kv_len)
-        logits = jnp.where(mask[None, None], logits, -1e30)
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
         w = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", w, v_cache.astype(q.dtype))
+        attn = jnp.einsum("bgrqk,bkgd->bqgrd", w,
+                          v_cache.astype(q.dtype))
         attn = attn.reshape(x.shape)
         x = x + attn @ p[f"_{n}_l{i}_proj.w0"]
         ln2 = _ln(x, p[f"_{n}_l{i}_ln2.w0"], p[f"_{n}_l{i}_ln2.wbias"])
@@ -162,8 +169,12 @@ class TransformerDecoder:
         b = prompt.shape[0]
         d = p[f"_{n}_tok_emb.w0"].shape[1]
         dtype = p[f"_{n}_tok_emb.w0"].dtype
-        caches = [(jnp.zeros((b, max_len, h, d // h), dtype),
-                   jnp.zeros((b, max_len, h, d // h), dtype))
+        # kv head count from the k projection's width (grouped-query
+        # attention stores kv_h-sized caches — THE decode win of GQA)
+        dh = d // h
+        kv_h = p[f"_{n}_l0_k.w0"].shape[1] // dh
+        caches = [(jnp.zeros((b, max_len, kv_h, dh), dtype),
+                   jnp.zeros((b, max_len, kv_h, dh), dtype))
                   for _ in range(self.n_layers)]
         pos = jnp.arange(plen)[None, :].repeat(b, 0)
         return self._forward(p, prompt, pos, caches, 0, plen)
