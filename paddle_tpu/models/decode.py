@@ -222,6 +222,89 @@ class TransformerDecoder:
         return jax.jit(run)
 
     # ---------------------------------------------------------- beam search
+    def _build_beam_gnmt(self, plen: int, max_len: int, beam_size: int,
+                         eos_id: int, alpha: float):
+        """Full GNMT beam semantics: a hypothesis that emits EOS leaves
+        the beam and is BANKED with its length-penalized score
+        (raw / len^alpha) inside the scan, freeing its lane for live
+        continuations — a short high-scoring hypothesis can therefore
+        never be pruned mid-search by longer raw-sum rivals (the
+        limitation of the raw-sum path below, which length_penalty=0
+        keeps). Returns (tokens [b,K,L], penalized scores [b,K]),
+        best first."""
+        n = self.name
+        K = beam_size
+        L = max_len - plen
+
+        def run(p, prompt):
+            b = prompt.shape[0]
+            V = p[f"_{n}_head.w0"].shape[1] if f"_{n}_head.w0" in p \
+                else p[f"_{n}_tok_emb.w0"].shape[0]
+            # live lanes exclude EOS, so K live continuations need K
+            # non-EOS tokens to exist (the raw-sum path has no such
+            # restriction — its EOS lanes freeze in place)
+            assert K < V, \
+                f"gnmt beam needs beam_size={K} < vocab_size={V}"
+            vmask = jnp.arange(V) == eos_id
+            logits, caches = self._prefill(p, prompt, plen, max_len)
+            lp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+            # the bank: top-K finished hypotheses, penalized scores
+            bank_s = jnp.full((b, K), -1e30, jnp.float32)
+            bank_t = jnp.full((b, K, L), eos_id, jnp.int32)
+            # immediate-EOS is the first banked candidate (length 1)
+            bank_s = bank_s.at[:, 0].set(lp0[:, eos_id] / 1.0 ** alpha)
+            # live lanes seed from the top-K NON-eos first tokens
+            lp0m = jnp.where(vmask[None], -1e30, lp0)
+            scores, tok0 = jax.lax.top_k(lp0m, K)
+            caches = [(jnp.repeat(kc, K, axis=0), jnp.repeat(vc, K, axis=0))
+                      for kc, vc in caches]
+            tokens = jnp.full((b, K, L), eos_id, jnp.int32)
+            tokens = tokens.at[:, :, 0].set(tok0)
+
+            def merge_bank(bank_s, bank_t, cand_s, cand_t):
+                all_s = jnp.concatenate([bank_s, cand_s], axis=1)
+                all_t = jnp.concatenate([bank_t, cand_t], axis=1)
+                top_s, idx = jax.lax.top_k(all_s, K)
+                top_t = jnp.take_along_axis(all_t, idx[:, :, None], axis=1)
+                return top_s, top_t
+
+            def step(carry, t):
+                caches, tokens, scores, bank_s, bank_t = carry
+                last = tokens[:, :, t - 1].reshape(b * K)
+                lg, caches2 = self._forward(
+                    p, last[:, None],
+                    jnp.full((b * K, 1), plen + t - 1, jnp.int32),
+                    caches, plen + t - 1, plen + t)
+                lp = jax.nn.log_softmax(
+                    lg[:, -1].astype(jnp.float32)).reshape(b, K, V)
+                # bank each lane's EOS continuation (length t+1 with eos)
+                eos_raw = scores + lp[:, :, eos_id]
+                eos_pen = eos_raw / (t + 1.0) ** alpha
+                cand_t = tokens.at[:, :, t].set(eos_id)
+                bank_s, bank_t = merge_bank(bank_s, bank_t, eos_pen,
+                                            cand_t)
+                # live lanes continue over non-EOS tokens only
+                lp = jnp.where(vmask[None, None], -1e30, lp)
+                total = scores[:, :, None] + lp
+                scores2, flat = jax.lax.top_k(total.reshape(b, K * V), K)
+                parent = flat // V
+                tok = (flat % V).astype(jnp.int32)
+                tokens2 = jnp.take_along_axis(
+                    tokens, parent[:, :, None], axis=1).at[:, :, t].set(tok)
+                pflat = (jnp.arange(b)[:, None] * K + parent).reshape(-1)
+                caches2 = [(kc[pflat], vc[pflat]) for kc, vc in caches2]
+                return (caches2, tokens2, scores2, bank_s, bank_t), 0
+
+            (caches, tokens, scores, bank_s, bank_t), _ = jax.lax.scan(
+                step, (caches, tokens, scores, bank_s, bank_t),
+                jnp.arange(1, L))
+            # drain: still-live lanes compete at their full length L
+            bank_s, bank_t = merge_bank(bank_s, bank_t,
+                                        scores / float(L) ** alpha, tokens)
+            return bank_t, bank_s
+
+        return jax.jit(run)
+
     def _build_beam(self, plen: int, max_len: int, beam_size: int,
                     eos_id: int):
         n = self.name
@@ -284,9 +367,12 @@ class TransformerDecoder:
         `beam_search` layer (scores are summed token log-probs; finished
         beams freeze at their EOS). Rows are trimmed at the first EOS.
 
-        length_penalty alpha > 0 re-ranks by score / len(tokens)^alpha
-        (GNMT-style normalization, applied host-side over the K lanes;
-        the in-device search itself stays raw-log-prob greedy-by-sum)."""
+        length_penalty alpha > 0 runs FULL GNMT semantics in-device
+        (_build_beam_gnmt): a hypothesis that emits EOS is banked with
+        its penalized score score/len^alpha inside the search, freeing
+        its lane — so short high-scoring hypotheses survive the beam,
+        and the returned scores are the penalized ones. alpha = 0 keeps
+        the raw-sum search."""
         import numpy as np
         prompt = jnp.asarray(prompt, jnp.int32)
         plen = self._validate(prompt, max_len)
@@ -294,10 +380,16 @@ class TransformerDecoder:
         assert 1 <= n_keep <= beam_size, (
             f"num_results={num_results} must be in [1, beam_size]")
         assert length_penalty >= 0.0, length_penalty
-        key = ("beam", plen, int(max_len), beam_size, eos_id)
+        key = ("beam", plen, int(max_len), beam_size, eos_id,
+               float(length_penalty))
         if key not in self._jitted:
-            self._jitted[key] = self._build_beam(plen, int(max_len),
-                                                 beam_size, eos_id)
+            if length_penalty > 0.0:
+                self._jitted[key] = self._build_beam_gnmt(
+                    plen, int(max_len), beam_size, eos_id,
+                    float(length_penalty))
+            else:
+                self._jitted[key] = self._build_beam(plen, int(max_len),
+                                                     beam_size, eos_id)
         toks, scores = self._jitted[key](self.p, prompt)
         toks, scores = np.asarray(toks), np.asarray(scores)
         out = []
@@ -307,12 +399,8 @@ class TransformerDecoder:
                 row = list(map(int, toks[bi, ki]))
                 if eos_id in row:
                     row = row[:row.index(eos_id) + 1]
-                s = float(scores[bi, ki])
-                if length_penalty > 0.0:
-                    s = s / (max(len(row), 1) ** length_penalty)
-                rows.append((s, row))
-            if length_penalty > 0.0:
-                rows.sort(key=lambda sr: -sr[0])
+                # gnmt path returns penalized scores already
+                rows.append((float(scores[bi, ki]), row))
             out.append(rows[:n_keep])
         return out
 

@@ -218,23 +218,65 @@ class TestBeamSearch:
         assert len(set(rows)) == len(rows)
 
 
+def _teacher_forced_logprob(spec, topo, params, prompt_row, row, eid):
+    """Raw summed log-prob of `row` continuing `prompt_row`, through the
+    training graph (stops after eos)."""
+    full = np.concatenate([prompt_row, np.array(row, "int32")])
+    plen = len(prompt_row)
+    want = 0.0
+    for t in range(len(row)):
+        pre = full[None, :plen + t]
+        lens = jnp.full((1,), pre.shape[1], jnp.int32)
+        sb = lambda a: SequenceBatch(jnp.asarray(a), lens)
+        pos = np.arange(pre.shape[1], dtype="int32")[None]
+        feed = {spec.data.name: sb(pre), spec.positions.name: sb(pos),
+                spec.label.name: sb(pre)}
+        outs, _ = topo.forward(params, topo.init_state(), feed,
+                               mode="test",
+                               output_names=[spec.output.name])
+        probs = np.asarray(outs[spec.output.name].data[0, -1])
+        want += float(np.log(max(probs[row[t]], 1e-30)))
+        if row[t] == eid:
+            break
+    return want
+
+
 class TestLengthPenalty:
-    def test_normalized_rerank(self):
+    def test_gnmt_scores_match_graph(self):
+        """length_penalty > 0 runs the in-scan GNMT bank: every returned
+        score must equal the teacher-forced raw log-prob / len^alpha,
+        and results arrive sorted. (No superiority assertion vs the
+        raw-sum search: both beams are greedy approximations exploring
+        different live sets, so neither dominates in general.)"""
         spec, topo, params = _model()
         dec = models.TransformerDecoder(params, n_layers=CFG["n_layers"],
                                         n_heads=CFG["n_heads"])
         prompt = np.zeros((1, 2), "int32")
         eid = CFG["vocab_size"] - 1
-        raw = dec.beam_search(prompt, max_len=9, beam_size=4, eos_id=eid)
+        alpha = 1.0
         norm = dec.beam_search(prompt, max_len=9, beam_size=4, eos_id=eid,
-                               length_penalty=1.0)
-        # same candidate set, scores divided by row length, re-sorted
-        raw_map = {tuple(r): s for s, r in raw[0]}
-        for s, r in norm[0]:
-            np.testing.assert_allclose(
-                s, raw_map[tuple(r)] / max(len(r), 1), rtol=1e-6)
+                               length_penalty=alpha)
         scores = [s for s, _ in norm[0]]
         assert scores == sorted(scores, reverse=True)
+        for s, r in norm[0]:
+            want = _teacher_forced_logprob(spec, topo, params, prompt[0],
+                                           r, eid)
+            np.testing.assert_allclose(
+                s, want / max(len(r), 1) ** alpha, rtol=1e-3, atol=1e-3)
+
+    def test_gnmt_results_distinct_and_trimmed(self):
+        spec, topo, params = _model()
+        dec = models.TransformerDecoder(params, n_layers=CFG["n_layers"],
+                                        n_heads=CFG["n_heads"])
+        prompt = np.zeros((2, 2), "int32")
+        eid = CFG["vocab_size"] - 1
+        res = dec.beam_search(prompt, max_len=8, beam_size=4, eos_id=eid,
+                              length_penalty=0.6)
+        for bi in range(2):
+            rows = [tuple(r) for _, r in res[bi]]
+            assert len(set(rows)) == len(rows)
+            for _, r in res[bi]:
+                assert eid not in r[:-1]   # trimmed at first eos
 
 
 class TestTiedEmbeddings:
