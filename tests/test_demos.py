@@ -99,3 +99,21 @@ class TestModelZoo:
     def test_save_reload_extract_features(self):
         mz = _load("model_zoo", "feature_extract")
         assert mz.main() == 0
+
+
+class TestMaskedLM:
+    def test_pretrain_then_finetune_transfers_trunk(self):
+        """The BERT workflow demo: MLM loss drops, all trunk params
+        transfer into the classifier, fine-tune error collapses (the
+        stride class is derivable from what the trunk learned)."""
+        mlm = _load("masked_lm", "train")
+        mlm_losses, cls_metrics, loaded, n_pre = mlm.main(
+            ["--pretrain_passes", "4", "--finetune_passes", "3"])
+        mlm_losses = np.asarray(mlm_losses)
+        assert np.isfinite(mlm_losses).all()
+        assert np.mean(mlm_losses[-4:]) < 0.75 * np.mean(mlm_losses[:4])
+        # EVERY trunk param (all but the vocab head) must transfer —
+        # a partial match would silently fine-tune from random init
+        assert loaded == n_pre - 1, (loaded, n_pre)
+        errs = [float(m) for _, m in cls_metrics if m is not None]
+        assert np.mean(errs[-4:]) < 0.3          # chance is 2/3
