@@ -1,0 +1,94 @@
+/* Multi-threaded serving from C over SHARED weights
+ * (capi/examples/model_inference/multi_thread parity): the main thread
+ * loads the model once, each worker thread gets a shared-param clone
+ * (paddle_gradient_machine_create_shared_param, capi/gradient_machine.h:88)
+ * and serves inference concurrently. The GIL serializes dispatch; XLA
+ * execution releases it, so threads genuinely overlap on device time.
+ *
+ * Usage: multi_thread_infer <model.tar> <in_dim> [n_threads] [iters]
+ * Prints "threads_ok" iff every thread's every result matches the main
+ * thread's reference output bit-for-tolerance.
+ */
+
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern int paddle_tpu_init(void);
+extern long paddle_tpu_create(const char *model_path);
+extern long paddle_tpu_create_shared(long handle);
+extern int paddle_tpu_forward(long handle, const float *in, int batch,
+                              int dim, float *out, int out_cap);
+extern void paddle_tpu_destroy(long handle);
+
+#define BATCH 2
+#define OUT_CAP 4096
+
+static int g_dim;
+static float *g_in;
+static float g_ref[OUT_CAP];
+static int g_od;
+
+typedef struct {
+    long handle;
+    int iters;
+    int failed;
+} worker_t;
+
+static void *serve(void *argp) {
+    worker_t *w = (worker_t *)argp;
+    float out[OUT_CAP];
+    for (int it = 0; it < w->iters; it++) {
+        int od = paddle_tpu_forward(w->handle, g_in, BATCH, g_dim, out,
+                                    OUT_CAP);
+        if (od != g_od) { w->failed = 1; return NULL; }
+        for (int i = 0; i < BATCH * od; i++) {
+            float d = out[i] - g_ref[i];
+            if (d < 0) d = -d;
+            if (d > 1e-6f) { w->failed = 1; return NULL; }
+        }
+    }
+    return NULL;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <model.tar> <in_dim> [threads] [iters]\n",
+                argv[0]);
+        return 2;
+    }
+    g_dim = atoi(argv[2]);
+    int n_threads = argc > 3 ? atoi(argv[3]) : 2;
+    int iters = argc > 4 ? atoi(argv[4]) : 8;
+
+    if (paddle_tpu_init() != 0) return 1;
+    long h = paddle_tpu_create(argv[1]);
+    if (h < 0) { fprintf(stderr, "create failed\n"); return 1; }
+
+    g_in = malloc(sizeof(float) * BATCH * g_dim);
+    for (int i = 0; i < BATCH * g_dim; i++)
+        g_in[i] = 0.001f * (float)(i % 1000);
+    g_od = paddle_tpu_forward(h, g_in, BATCH, g_dim, g_ref, OUT_CAP);
+    if (g_od < 0) { fprintf(stderr, "reference forward failed\n"); return 1; }
+
+    pthread_t *tids = malloc(sizeof(pthread_t) * n_threads);
+    worker_t *ws = calloc(n_threads, sizeof(worker_t));
+    for (int t = 0; t < n_threads; t++) {
+        ws[t].handle = paddle_tpu_create_shared(h);
+        ws[t].iters = iters;
+        if (ws[t].handle < 0) { fprintf(stderr, "clone failed\n"); return 1; }
+    }
+    for (int t = 0; t < n_threads; t++)
+        pthread_create(&tids[t], NULL, serve, &ws[t]);
+    int failed = 0;
+    for (int t = 0; t < n_threads; t++) {
+        pthread_join(tids[t], NULL);
+        failed |= ws[t].failed;
+        paddle_tpu_destroy(ws[t].handle);
+    }
+    paddle_tpu_destroy(h);
+    if (failed) { fprintf(stderr, "thread results diverged\n"); return 1; }
+    printf("threads_ok n=%d iters=%d out_dim=%d\n", n_threads, iters, g_od);
+    free(g_in); free(tids); free(ws);
+    return 0;
+}

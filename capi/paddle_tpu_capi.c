@@ -115,3 +115,134 @@ void paddle_tpu_destroy(long handle) {
     PyGILState_Release(g);
     call_long("destroy", args);
 }
+
+/* ------------------------------------------------------------------ */
+/* Typed arguments — capi/arguments.h parity. The reference C API binds
+ * per-slot payloads (dense value, integer ids, sequence start positions,
+ * sparse rows) to the model's input layers by index; so do we. */
+
+long paddle_tpu_args_create(void) {
+    return call_long("args_create", NULL);
+}
+
+void paddle_tpu_args_destroy(long args_h) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue("(l)", args_h);
+    PyGILState_Release(g);
+    call_long("args_destroy", args);
+}
+
+/* Dense float matrix [rows, dim] for slot. */
+int paddle_tpu_arg_set_value(long args_h, int slot, const float *data,
+                             int rows, int dim) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue(
+        "(liy#ii)", args_h, slot, (const char *)data,
+        (Py_ssize_t)((Py_ssize_t)rows * dim * sizeof(float)), rows, dim);
+    PyGILState_Release(g);
+    return (int)call_long("arg_set_value", args);
+}
+
+/* Flat int32 ids [n] for slot (paddle_arguments_set_ids). */
+int paddle_tpu_arg_set_ids(long args_h, int slot, const int *ids, int n) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue(
+        "(liy#i)", args_h, slot, (const char *)ids,
+        (Py_ssize_t)((Py_ssize_t)n * sizeof(int)), n);
+    PyGILState_Release(g);
+    return (int)call_long("arg_set_ids", args);
+}
+
+/* Sequence start offsets [num_seqs+1] into the slot's flat rows
+ * (paddle_arguments_set_sequence_start_pos). */
+int paddle_tpu_arg_set_seq_starts(long args_h, int slot, const int *starts,
+                                  int n) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue(
+        "(liy#i)", args_h, slot, (const char *)starts,
+        (Py_ssize_t)((Py_ssize_t)n * sizeof(int)), n);
+    PyGILState_Release(g);
+    return (int)call_long("arg_set_seq_starts", args);
+}
+
+/* CSR sparse rows: offsets [rows+1], cols [nnz], vals [nnz] or NULL for
+ * sparse-binary (paddle_matrix_create_sparse, capi/matrix.h:44-114). */
+int paddle_tpu_arg_set_sparse(long args_h, int slot, int rows, int dim,
+                              const int *row_offsets, const int *cols,
+                              const float *vals, int nnz) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *args;
+    if (vals != NULL) {
+        args = Py_BuildValue(
+            "(liiiy#y#y#i)", args_h, slot, rows, dim,
+            (const char *)row_offsets,
+            (Py_ssize_t)((Py_ssize_t)(rows + 1) * sizeof(int)),
+            (const char *)cols,
+            (Py_ssize_t)((Py_ssize_t)nnz * sizeof(int)),
+            (const char *)vals,
+            (Py_ssize_t)((Py_ssize_t)nnz * sizeof(float)), nnz);
+    } else {
+        args = Py_BuildValue(
+            "(liiiy#y#Oi)", args_h, slot, rows, dim,
+            (const char *)row_offsets,
+            (Py_ssize_t)((Py_ssize_t)(rows + 1) * sizeof(int)),
+            (const char *)cols,
+            (Py_ssize_t)((Py_ssize_t)nnz * sizeof(int)), Py_None, nnz);
+    }
+    PyGILState_Release(g);
+    return (int)call_long("arg_set_sparse", args);
+}
+
+/* Typed forward. Writes out_rows*out_dim floats into out; for sequence
+ * outputs also writes [num_seqs+1] int32 offsets into seq_starts (pass
+ * NULL/0 to skip). Returns 0 on success, -1 on error or insufficient
+ * capacity. */
+int paddle_tpu_forward_args(long handle, long args_h, float *out,
+                            long out_cap, int *out_rows, int *out_dim,
+                            int *seq_starts, int starts_cap) {
+    int rc = -1;
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *m = host();
+    if (m != NULL) {
+        PyObject *fn = PyObject_GetAttrString(m, "forward_args");
+        if (fn != NULL) {
+            PyObject *res = PyObject_CallFunction(fn, "ll", handle, args_h);
+            if (res != NULL) {
+                PyObject *out_obj = PyTuple_GetItem(res, 0);
+                long rows = PyLong_AsLong(PyTuple_GetItem(res, 1));
+                long dim = PyLong_AsLong(PyTuple_GetItem(res, 2));
+                PyObject *starts_obj = PyTuple_GetItem(res, 3);
+                char *buf = NULL;
+                Py_ssize_t n = 0;
+                if (PyBytes_AsStringAndSize(out_obj, &buf, &n) == 0 &&
+                    n <= (Py_ssize_t)(out_cap * (long)sizeof(float))) {
+                    char *sbuf = NULL;
+                    Py_ssize_t sn = 0;
+                    if (PyBytes_AsStringAndSize(starts_obj, &sbuf,
+                                                &sn) == 0) {
+                        /* a sequence output (sn > 0) REQUIRES a large
+                         * enough seq_starts buffer — truncating offsets
+                         * silently would hand the caller garbage row
+                         * boundaries */
+                        if (sn == 0 ||
+                            (seq_starts != NULL &&
+                             sn <= (Py_ssize_t)(starts_cap *
+                                                (long)sizeof(int)))) {
+                            memcpy(out, buf, n);
+                            if (sn > 0) memcpy(seq_starts, sbuf, sn);
+                            if (out_rows != NULL) *out_rows = (int)rows;
+                            if (out_dim != NULL) *out_dim = (int)dim;
+                            rc = 0;
+                        }
+                    }
+                }
+                Py_DECREF(res);
+            }
+            Py_DECREF(fn);
+        }
+        Py_DECREF(m);
+    }
+    if (PyErr_Occurred()) { PyErr_Print(); rc = -1; }
+    PyGILState_Release(g);
+    return rc;
+}

@@ -12,6 +12,19 @@ Functions (C symbol -> here):
   paddle_tpu_create_shared        -> create_shared(handle)   # shared weights
   paddle_tpu_forward              -> forward(handle, bytes, batch, dim)
   paddle_tpu_destroy              -> destroy(handle)
+
+Typed arguments (capi/arguments.h parity — the reference serves integer-id,
+sequence and sparse inputs from C, not just dense float):
+  paddle_tpu_args_create          -> args_create()
+  paddle_tpu_arg_set_value        -> arg_set_value(a, slot, bytes, rows, dim)
+  paddle_tpu_arg_set_ids          -> arg_set_ids(a, slot, bytes, n)
+      (paddle_arguments_set_ids, capi/arguments.h:110)
+  paddle_tpu_arg_set_seq_starts   -> arg_set_seq_starts(a, slot, bytes, n)
+      (paddle_arguments_set_sequence_start_pos, capi/arguments.h:137)
+  paddle_tpu_arg_set_sparse       -> arg_set_sparse(...)   # CSR rows
+      (paddle_matrix_create_sparse / sparse_binary, capi/matrix.h:44-114)
+  paddle_tpu_forward_args         -> forward_args(handle, a)
+  paddle_tpu_args_destroy         -> args_destroy(a)
 """
 
 from __future__ import annotations
@@ -22,6 +35,7 @@ from typing import Dict
 import numpy as np
 
 _handles: Dict[int, object] = {}
+_args: Dict[int, dict] = {}
 _next_id = itertools.count(1)
 
 
@@ -63,3 +77,141 @@ def forward(handle: int, data: bytes, batch: int, dim: int):
 def destroy(handle: int) -> int:
     _handles.pop(handle, None)
     return 0
+
+
+# ---------------------------------------------------------------------------
+# typed arguments (capi/arguments.h parity)
+
+
+def args_create() -> int:
+    """An arguments bundle: slot index -> typed payload. Slots feed the
+    model's data layers in Topology.data_type() order, exactly as the
+    reference binds `paddle_arguments` slots to input layers by index."""
+    a = next(_next_id)
+    _args[a] = {}
+    return a
+
+
+def args_destroy(a: int) -> int:
+    _args.pop(a, None)
+    return 0
+
+
+def _slot(a: int, slot: int) -> dict:
+    return _args[a].setdefault(slot, {})
+
+
+def arg_set_value(a: int, slot: int, data: bytes, rows: int,
+                  dim: int) -> int:
+    """Dense float matrix [rows, dim] (paddle_arguments_set_value)."""
+    _slot(a, slot)["value"] = np.frombuffer(
+        data, np.float32, count=rows * dim).reshape(rows, dim)
+    return 0
+
+
+def arg_set_ids(a: int, slot: int, data: bytes, n: int) -> int:
+    """Integer ids, flat [n] (paddle_arguments_set_ids,
+    capi/arguments.h:110). Without seq starts: one id per sample; with
+    seq starts: the concatenated token stream of all sequences."""
+    _slot(a, slot)["ids"] = np.frombuffer(data, np.int32, count=n).copy()
+    return 0
+
+
+def arg_set_seq_starts(a: int, slot: int, data: bytes, n: int) -> int:
+    """Sequence start offsets [num_seqs + 1] into this slot's flat
+    ids/value rows (paddle_arguments_set_sequence_start_pos,
+    capi/arguments.h:137)."""
+    _slot(a, slot)["starts"] = np.frombuffer(data, np.int32, count=n).copy()
+    return 0
+
+
+def arg_set_sparse(a: int, slot: int, rows: int, dim: int,
+                   offsets: bytes, cols: bytes, vals, nnz: int) -> int:
+    """CSR sparse rows: offsets [rows+1], cols [nnz], vals [nnz] floats or
+    None for sparse-binary (capi/matrix.h:44-114)."""
+    offs = np.frombuffer(offsets, np.int32, count=rows + 1)
+    c = np.frombuffer(cols, np.int32, count=nnz)
+    v = None if vals is None else np.frombuffer(vals, np.float32, count=nnz)
+    _slot(a, slot)["sparse"] = (offs.copy(), c.copy(),
+                                None if v is None else v.copy(), dim)
+    return 0
+
+
+def _slot_samples(payload: dict, itype):
+    """One slot's payload -> the per-sample column DataFeeder expects."""
+    from paddle_tpu.core.data_type import SeqType
+    starts = payload.get("starts")
+    if "sparse" in payload:
+        offs, cols, vals, _dim = payload["sparse"]
+        rows = []
+        for i in range(len(offs) - 1):
+            c = cols[offs[i]:offs[i + 1]]
+            if vals is None:
+                rows.append(c.tolist())
+            else:
+                rows.append((c.tolist(),
+                             vals[offs[i]:offs[i + 1]].tolist()))
+        if itype.seq_type == SeqType.NO_SEQUENCE:
+            return rows
+        # sequence-typed sparse slot: CSR rows are timesteps; seq starts
+        # group them into sequences (sample = list of per-step id lists)
+        if starts is None:
+            raise ValueError("sequence slot needs seq starts")
+        return [rows[starts[i]:starts[i + 1]]
+                for i in range(len(starts) - 1)]
+    if "ids" in payload:
+        ids = payload["ids"]
+        if itype.seq_type == SeqType.NO_SEQUENCE:
+            return [int(v) for v in ids]
+        if starts is None:
+            raise ValueError("sequence slot needs seq starts")
+        return [ids[starts[i]:starts[i + 1]]
+                for i in range(len(starts) - 1)]
+    if "value" in payload:
+        val = payload["value"]
+        if itype.seq_type == SeqType.NO_SEQUENCE:
+            return [val[i] for i in range(val.shape[0])]
+        if starts is None:
+            raise ValueError("sequence slot needs seq starts")
+        return [val[starts[i]:starts[i + 1]]
+                for i in range(len(starts) - 1)]
+    raise ValueError("slot has no payload")
+
+
+def forward_args(handle: int, a: int):
+    """Typed forward. Returns (out_bytes, out_rows, out_dim, starts_bytes):
+    dense outputs give out_rows == batch and empty starts; sequence outputs
+    give one row per valid token plus [num_seqs+1] int32 offsets — the
+    mirror of paddle_arguments_get_sequence_start_pos on the output side."""
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.trainer.data_feeder import DataFeeder
+    inf = _handles[handle]
+    data_types = inf.topology.data_type()
+    payloads = _args[a]
+    columns = []
+    for slot, (_name, itype) in enumerate(data_types):
+        if slot not in payloads:
+            raise ValueError(f"slot {slot} not set")
+        columns.append(_slot_samples(payloads[slot], itype))
+    batch = len(columns[0])
+    if any(len(c) != batch for c in columns):
+        raise ValueError("slots disagree on batch size")
+    samples = [tuple(c[i] for c in columns) for i in range(batch)]
+
+    feed = DataFeeder(data_types)(samples)
+    feed.pop("__batch_size__", None)
+    outs = inf._fwd(inf.parameters.raw, inf.parameters.state, feed)
+    o = outs[0]
+    if isinstance(o, SequenceBatch):
+        dat = np.asarray(o.data, np.float32)
+        lens = np.asarray(o.lengths)[:batch]
+        rows = np.concatenate(
+            [dat[i, :lens[i]].reshape(lens[i], -1) for i in range(batch)],
+            axis=0)
+        starts = np.concatenate(
+            [[0], np.cumsum(lens)]).astype(np.int32)
+        return (np.ascontiguousarray(rows).tobytes(), int(rows.shape[0]),
+                int(rows.shape[1]), starts.tobytes())
+    arr = np.asarray(o, np.float32)[:batch].reshape(batch, -1)
+    return (np.ascontiguousarray(arr).tobytes(), batch,
+            int(arr.shape[1]), b"")

@@ -54,26 +54,48 @@ class TestMergedArtifact:
         np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
+def _c_env():
+    site = sysconfig.get_path("purelib")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, site, env.get("PYTHONPATH", "")])
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+@pytest.fixture(scope="session")
+def capi_lib(tmp_path_factory):
+    """The shim .so is invariant across tests — build it once."""
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION")
+    d = tmp_path_factory.mktemp("capi_lib")
+    lib = str(d / "libpaddle_tpu_capi.so")
+    subprocess.run(
+        [cc, "-shared", "-fPIC", os.path.join(REPO, "capi",
+                                              "paddle_tpu_capi.c"),
+         f"-I{inc}", f"-L{libdir}", f"-lpython{ver}",
+         f"-Wl,-rpath,{libdir}", "-o", lib], check=True)
+    return str(d)
+
+
 class TestCABI:
-    def _build(self, tmp_path):
+    @pytest.fixture(autouse=True)
+    def _lib(self, capi_lib):
+        self.libdir = capi_lib
+
+    def _build(self, tmp_path, example="dense_infer"):
         cc = shutil.which("gcc") or shutil.which("cc")
-        if cc is None:
-            pytest.skip("no C compiler")
-        inc = sysconfig.get_path("include")
-        libdir = sysconfig.get_config_var("LIBDIR")
-        ver = sysconfig.get_config_var("LDVERSION")
-        lib = str(tmp_path / "libpaddle_tpu_capi.so")
-        exe = str(tmp_path / "dense_infer")
+        pylibdir = sysconfig.get_config_var("LIBDIR")
+        exe = str(tmp_path / example)
         subprocess.run(
-            [cc, "-shared", "-fPIC", os.path.join(REPO, "capi",
-                                                  "paddle_tpu_capi.c"),
-             f"-I{inc}", f"-L{libdir}", f"-lpython{ver}",
-             f"-Wl,-rpath,{libdir}", "-o", lib], check=True)
-        subprocess.run(
-            [cc, os.path.join(REPO, "capi", "examples", "dense_infer.c"),
-             f"-L{tmp_path}", "-lpaddle_tpu_capi",
-             f"-Wl,-rpath,{tmp_path}", f"-Wl,-rpath,{libdir}", "-o", exe],
-            check=True)
+            [cc, os.path.join(REPO, "capi", "examples", f"{example}.c"),
+             f"-L{self.libdir}", "-lpaddle_tpu_capi", "-lpthread",
+             f"-Wl,-rpath,{self.libdir}", f"-Wl,-rpath,{pylibdir}",
+             "-o", exe], check=True)
         return exe
 
     def test_c_program_runs_mnist_inference(self, tmp_path):
@@ -82,11 +104,7 @@ class TestCABI:
         model = str(tmp_path / "model.tar")
         save_inference_model(model, out, params)
 
-        site = sysconfig.get_path("purelib")
-        env = dict(os.environ)
-        env["PYTHONPATH"] = os.pathsep.join(
-            [REPO, site, env.get("PYTHONPATH", "")])
-        env["JAX_PLATFORMS"] = "cpu"
+        env = _c_env()
         r = subprocess.run([exe, model, "784"], capture_output=True,
                            text=True, timeout=600, env=env)
         assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
@@ -101,3 +119,80 @@ class TestCABI:
         want = paddle.infer(output_layer=out, parameters=params,
                             input=[(x,)])[0]
         np.testing.assert_allclose(row0, want, rtol=1e-4, atol=1e-5)
+
+    def test_c_sequence_serving(self, tmp_path):
+        """A C program serves the LSTM tagger: integer ids + sequence
+        start positions in, per-token softmax rows + output offsets back
+        (capi/arguments.h:110,137; examples/model_inference/sequence)."""
+        exe = self._build(tmp_path, "sequence_infer")
+        registry.reset_name_counters()
+        paddle.init(seed=11)
+        toks = paddle.layer.data(
+            "toks", paddle.data_type.integer_value_sequence(10))
+        emb = paddle.layer.embedding(toks, size=8)
+        rec = paddle.layer.lstmemory(emb)
+        out = paddle.layer.fc(rec, size=3,
+                              act=paddle.activation.Softmax(), name="tag")
+        params = paddle.create_parameters(paddle.Topology(out))
+        model = str(tmp_path / "seq_model.tar")
+        save_inference_model(model, out, params)
+
+        r = subprocess.run([exe, model], capture_output=True, text=True,
+                           timeout=600, env=_c_env())
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+        lines = [l for l in r.stdout.splitlines() if l.strip()]
+        assert lines[0] == "rows=8 dim=3"
+        assert lines[1] == "starts: 0 5 8"
+        got = np.array([[float(v) for v in l.split(":")[1].split()]
+                        for l in lines[2:10]])
+
+        ids = np.array([2, 3, 5, 7, 1, 4, 6, 8], np.int32)
+        want = np.asarray(paddle.infer(
+            output_layer=out, parameters=params,
+            input=[(ids[:5],), (ids[5:],)]))
+        np.testing.assert_allclose(got[:5], want[0, :5], rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(got[5:8], want[1, :3], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_c_sparse_serving(self, tmp_path):
+        """A C program serves a sparse-binary-input ranker via CSR rows
+        (capi/matrix.h:44-114; examples/model_inference/sparse_binary)."""
+        exe = self._build(tmp_path, "sparse_infer")
+        registry.reset_name_counters()
+        paddle.init(seed=12)
+        x = paddle.layer.data(
+            "x", paddle.data_type.sparse_binary_vector(16))
+        h = paddle.layer.fc(x, size=8, act=paddle.activation.Relu())
+        out = paddle.layer.fc(h, size=4, act=paddle.activation.Softmax())
+        params = paddle.create_parameters(paddle.Topology(out))
+        model = str(tmp_path / "sparse_model.tar")
+        save_inference_model(model, out, params)
+
+        r = subprocess.run([exe, model, "16"], capture_output=True,
+                           text=True, timeout=600, env=_c_env())
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+        lines = [l for l in r.stdout.splitlines() if l.strip()]
+        assert lines[0] == "rows=2 dim=4"
+        got = np.array([[float(v) for v in l.split(":")[1].split()]
+                        for l in lines[1:3]])
+        want = np.asarray(paddle.infer(
+            output_layer=out, parameters=params,
+            input=[([1, 5, 9],), ([0, 7],)]))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_c_multi_thread_serving(self, tmp_path):
+        """A pthreads C client serves concurrently over shared weights
+        (capi/gradient_machine.h:88; examples/model_inference/multi_thread):
+        every thread's every forward must match the main thread's
+        reference output."""
+        exe = self._build(tmp_path, "multi_thread_infer")
+        out, params = _train_small_mnist()
+        model = str(tmp_path / "model.tar")
+        save_inference_model(model, out, params)
+
+        r = subprocess.run([exe, model, "784", "4", "6"],
+                           capture_output=True, text=True, timeout=600,
+                           env=_c_env())
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+        assert "threads_ok n=4 iters=6" in r.stdout
