@@ -64,6 +64,15 @@ def convert(output_path: str, reader, line_count: int,
 
 def record_deserializer(rec: bytes):
     """Inverse of convert()'s per-record pickling (for
-    recordio.chunk_reader / coordinator task_reader)."""
+    recordio.chunk_reader / coordinator task_reader).
+
+    TRUST BOUNDARY: pickle executes arbitrary code on load, so shards and
+    the coordinator handing them out must be as trusted as the training
+    code itself — the same assumption the reference's cloud data path
+    makes (its RecordIO chunks carry cPickle records too,
+    python/paddle/v2/dataset/common.py:143). Do NOT point task_reader at
+    shards from an untrusted writer; for data crossing a trust boundary,
+    serialize samples yourself (npz/arrow/flat bytes) and hand convert()
+    a reader that yields those."""
     import pickle
     return pickle.loads(rec)

@@ -11,6 +11,63 @@ from __future__ import annotations
 import numpy as np
 
 
+def batch_images_from_tar(data_file: str, dataset_name: str, img2label,
+                          num_per_batch: int = 1024) -> str:
+    """Read images out of a tar archive and shard them into pickled batch
+    files of `num_per_batch` samples each, plus a meta file listing the
+    shard paths — the flowers-scale ingestion path
+    (python/paddle/v2/image.py:33). Returns the meta-file path. Each shard
+    is a pickle of {"label": [...], "data": [raw image bytes, ...]}."""
+    import os
+    import pickle
+    import tarfile
+
+    batch_dir = data_file + "_batch"
+    out_path = os.path.join(batch_dir, dataset_name)
+    meta_file = os.path.join(batch_dir, dataset_name + ".txt")
+    # out_path appears only via the final rename below, so its existence
+    # certifies a COMPLETE ingestion — a crash mid-run leaves only the
+    # .tmp workdir, and the rerun redoes the work instead of silently
+    # serving a partial shard set
+    if os.path.exists(out_path):
+        return meta_file
+    work = out_path + ".tmp"
+    if os.path.exists(work):
+        import shutil
+        shutil.rmtree(work)
+    os.makedirs(work)
+
+    data, labels, file_id = [], [], 0
+
+    def _flush():
+        nonlocal file_id, data, labels
+        with open(os.path.join(work, f"batch_{file_id}"), "wb") as f:
+            pickle.dump({"label": labels, "data": data}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        file_id += 1
+        data, labels = [], []
+
+    with tarfile.open(data_file) as tf:
+        for mem in tf.getmembers():
+            if mem.name in img2label:
+                data.append(tf.extractfile(mem).read())
+                labels.append(img2label[mem.name])
+                if len(data) == num_per_batch:
+                    _flush()
+    if data:
+        _flush()
+
+    with open(meta_file + ".tmp", "w") as meta:
+        for i in range(file_id):
+            meta.write(os.path.abspath(
+                os.path.join(out_path, f"batch_{i}")) + "\n")
+    # meta first: if we crash between the two renames, out_path is still
+    # absent, so the rerun redoes the work and rewrites the meta
+    os.replace(meta_file + ".tmp", meta_file)
+    os.rename(work, out_path)
+    return meta_file
+
+
 def load_image_bytes(data: bytes, is_color: bool = True) -> np.ndarray:
     """Decode an encoded image buffer to HWC uint8 (needs PIL)."""
     import io

@@ -90,6 +90,31 @@ def _strip_static(y):
     return [a for a in jax.tree_util.tree_leaves(y) if is_dynamic_leaf(a)]
 
 
+def _assert_boundary_preserving(stage_fn, stage_params, x, m):
+    """The codec re-attaches the INPUT boundary's integer leaves to every
+    stage's output (rebuild/collect index them by microbatch), which is
+    only sound if stage_fn preserves the boundary pytree: same treedef,
+    same leaf shapes/dtypes at microbatch size. Checked abstractly once
+    per build — a stage that altered lengths or emitted different static
+    leaves would otherwise produce a silently wrong output pytree."""
+    params0 = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    mb = leaves[0].shape[0] // m
+    x_mb = jax.tree_util.tree_unflatten(treedef, [a[:mb] for a in leaves])
+    out = jax.eval_shape(stage_fn, params0, x_mb)
+    out_flat, out_def = jax.tree_util.tree_flatten(out)
+    assert out_def == treedef, (
+        f"stage_fn must preserve the boundary pytree structure: "
+        f"in {treedef}, out {out_def}")
+    in_flat = [a[:mb] for a in leaves]
+    for i, (a, o) in enumerate(zip(in_flat, out_flat)):
+        assert (o.shape, jnp.dtype(o.dtype)) == \
+            (a.shape, jnp.dtype(a.dtype)), (
+            f"stage_fn boundary leaf {i} changed "
+            f"{a.shape}/{a.dtype} -> {o.shape}/{o.dtype}; the pipeline "
+            f"boundary must be shape- and dtype-preserving")
+
+
 def _tree_where(cond, a, b):
     return jax.tree_util.tree_map(lambda x, y: jnp.where(cond, x, y), a, b)
 
@@ -121,6 +146,7 @@ def pipeline(stage_fn: Callable, stage_params, x, mesh: Mesh,
         assert leaf.shape[0] == n, \
             f"stage_params leading axis {leaf.shape[0]} != pp={n}"
     m = num_microbatches or n
+    _assert_boundary_preserving(stage_fn, stage_params, x, m)
     dyn, rebuild, collect, b = _microbatch_codec(x, m)
 
     def local(params, *dyn_local):
@@ -306,7 +332,10 @@ def pipeline_1f1b(stage_fn: Callable, stage_params, x,
     (n-1)/(m+n-1) shrink) without OOM. Under SPMD every rank executes
     every tick's masked F and B slots, so at small m the extra n-1
     drain ticks cost wall-clock vs GPipe; the ratio (m+2n-2)/(m+n-1)
-    approaches 1 in exactly the large-m regime 1F1B exists for.
+    approaches 1 in exactly the large-m regime 1F1B exists for. The
+    TAIL, however, is not masked-redundant: it runs under a real
+    per-device lax.cond, so a vocab-sized LM head executes exactly m
+    times on the last rank — not n*(m+2n-2) times everywhere.
     Reference analogue: ParallelNeuralNetwork's per-device compute
     threads with async queues (ParallelNeuralNetwork.h:34), modernized.
     """
@@ -315,6 +344,7 @@ def pipeline_1f1b(stage_fn: Callable, stage_params, x,
         assert leaf.shape[0] == n, \
             f"stage_params leading axis {leaf.shape[0]} != pp={n}"
     m = num_microbatches or n
+    _assert_boundary_preserving(stage_fn, stage_params, x, m)
     dyn, rebuild, collect, b = _microbatch_codec(x, m)
     ring = 2 * n - 1
 
@@ -334,7 +364,7 @@ def pipeline_1f1b(stage_fn: Callable, stage_params, x,
         y_shapes = jax.eval_shape(stage_dyn, params, tuple(zero_mb),
                                   jnp.int32(0))
         zero_y = [jnp.zeros(s.shape, s.dtype) for s in y_shapes]
-        _, dy_probe, dtail_probe = jax.eval_shape(
+        loss_probe, dy_probe, dtail_probe = jax.eval_shape(
             lambda y, ta: tail_vjp(rebuild(y, jnp.int32(0)), jnp.int32(0),
                                    *ta), list(zero_y), targs)
         g_zero = jax.tree_util.tree_map(jnp.zeros_like, params)
@@ -369,15 +399,30 @@ def pipeline_1f1b(stage_fn: Callable, stage_params, x,
             youtbuf = [lax.dynamic_update_index_in_dim(
                 buf, jnp.where(take_y, v, buf[fjc]), fjc, 0)
                 for buf, v in zip(youtbuf, y)]
-            # ---- tail head (meaningful on the last stage only; SPMD
-            # executes it everywhere, masked)
-            loss_j, dy_tail_t, dtail_j = tail_vjp(rebuild(y, fjc), fjc,
-                                                  *targs)
-            dy_tail = _strip_static(dy_tail_t)
-            loss_acc = loss_acc + jnp.where(take_y, loss_j, 0.0)
+            # ---- tail head: lives on the last stage only. Under manual
+            # SPMD lax.cond is a real per-device conditional, so the
+            # (potentially vocab-sized) head fwd+bwd runs ONLY on rank
+            # n-1's m active ticks — not n*(m+2n-2) times masked, which
+            # for a big-vocab LM tail would dwarf the 1F1B win.
+            def _tail_live(op):
+                y_, j_ = op
+                l_, dy_t_, dt_ = tail_vjp(rebuild(list(y_), j_), j_,
+                                          *targs)
+                return (jnp.asarray(l_, loss_probe.dtype),
+                        _strip_static(dy_t_), dt_)
+
+            def _tail_skip(op):
+                return (jnp.zeros(loss_probe.shape, loss_probe.dtype),
+                        [jnp.zeros(s.shape, s.dtype)
+                         for s in _strip_static(dy_probe)],
+                        dtail_zero)
+
+            loss_j, dy_tail, dtail_j = lax.cond(
+                take_y, _tail_live, _tail_skip, (list(y), fjc))
+            # cond's skip branch returns exact zeros — no re-mask needed
+            loss_acc = loss_acc + loss_j
             dtail_acc = jax.tree_util.tree_map(
-                lambda a, d: a + jnp.where(take_y, d, jnp.zeros_like(d)),
-                dtail_acc, dtail_j)
+                lambda a, d: a + d, dtail_acc, dtail_j)
             # ---- backward slot: mb bj = t - 2(n-1) + me
             bj = t - 2 * (n - 1) + me
             b_active = jnp.logical_and(bj >= 0, bj < m)

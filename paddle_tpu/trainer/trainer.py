@@ -94,6 +94,26 @@ class SGD:
             fresh = self.topology.init_params(
                 jax.random.PRNGKey(global_config().seed), only=missing)
             parameters.raw.update(fresh)
+        # a loaded table can carry a bias for a layer this topology builds
+        # bias-FREE (e.g. a pre-round-4 transformer_lm head). Training
+        # would silently ignore it while raw-table consumers
+        # (TransformerDecoder._logits) still apply it — numerics diverge
+        # with no error. Surface it. (Params for layers absent from the
+        # topology entirely stay silent: that's the normal transfer-
+        # learning shape, e.g. an MLM head alongside a classifier.)
+        stale_bias = [
+            n for n in parameters.raw
+            if n.endswith(".wbias") and n not in self.topology.param_specs
+            and n[:-len("wbias")] + "w0" in self.topology.param_specs]
+        if stale_bias:
+            import warnings
+            warnings.warn(
+                f"parameter table carries bias entries {stale_bias} for "
+                "layers this topology builds WITHOUT bias: training "
+                "ignores them, but inference paths reading the raw table "
+                "may still apply them. Re-save the checkpoint (or delete "
+                "the entries) to keep train and decode numerics aligned.",
+                stacklevel=2)
         self.optimizer = update_equation.bind(
             self.topology.param_specs,
             sparse_params=self.topology.sparse_tables().keys())

@@ -140,6 +140,37 @@ class TestImage:
         im = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
         np.testing.assert_allclose(img.left_right_flip(im)[:, 0], im[:, 1])
 
+    def test_batch_images_from_tar(self, tmp_path):
+        """python/paddle/v2/image.py:33 parity: tar -> pickled shards of
+        num_per_batch samples + a meta file listing shard paths."""
+        import pickle
+        import tarfile
+
+        from paddle_tpu import image as img
+        tar_path = str(tmp_path / "imgs.tar")
+        with tarfile.open(tar_path, "w") as tf:
+            for i in range(5):
+                p = tmp_path / f"im{i}.bin"
+                p.write_bytes(bytes([i]) * 8)
+                tf.add(str(p), arcname=f"im{i}.bin")
+        img2label = {f"im{i}.bin": i % 2 for i in range(5)}
+
+        meta = img.batch_images_from_tar(tar_path, "train", img2label,
+                                         num_per_batch=2)
+        shards = [l.strip() for l in open(meta) if l.strip()]
+        assert len(shards) == 3  # 2+2+1
+        seen = {}
+        for s in shards:
+            with open(s, "rb") as f:
+                d = pickle.load(f)
+            assert len(d["label"]) == len(d["data"]) <= 2
+            for lbl, raw in zip(d["label"], d["data"]):
+                seen[raw[0]] = lbl
+        assert seen == {i: i % 2 for i in range(5)}
+        # idempotent: existing batch dir short-circuits
+        assert img.batch_images_from_tar(tar_path, "train",
+                                         img2label) == meta
+
 
 class TestLogging:
     def test_glog_format_and_version(self, capsys):

@@ -169,3 +169,32 @@ def test_reader_exception_propagates_through_prefetch():
 
     with pytest.raises(RuntimeError, match="reader blew up"):
         tr.train(bad_reader, num_passes=1, event_handler=lambda e: None)
+
+
+def test_stale_bias_in_loaded_table_warns():
+    """A checkpoint carrying X.wbias for a layer the topology builds
+    bias-free must warn at SGD bind time: training ignores the entry but
+    raw-table inference paths may still apply it (silent divergence)."""
+    paddle.init(use_tpu=False, seed=0)
+    from paddle_tpu.core import registry
+    registry.reset_name_counters()
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+    y = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    logits = paddle.layer.fc(x, size=2, bias_attr=False, name="hd")
+    cost = paddle.layer.classification_cost(
+        paddle.layer.addto([logits], act=paddle.activation.Softmax()), y)
+    params = paddle.create_parameters(paddle.Topology(cost))
+    import jax.numpy as jnp
+    params.raw["_hd.wbias"] = jnp.zeros((2,), jnp.float32)
+    with pytest.warns(UserWarning, match="bias entries.*_hd.wbias"):
+        paddle.SGD(cost=cost, parameters=params,
+                   update_equation=paddle.optimizer.Adam(1e-3))
+    # params for layers absent from the topology entirely stay silent
+    registry.reset_name_counters()
+    params2 = paddle.create_parameters(paddle.Topology(cost))
+    params2.raw["_other_layer.wbias"] = jnp.zeros((2,), jnp.float32)
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        paddle.SGD(cost=cost, parameters=params2,
+                   update_equation=paddle.optimizer.Adam(1e-3))
