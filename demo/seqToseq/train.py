@@ -64,6 +64,24 @@ def main():
         print(f"source {i}:")
         for score, ids in paths:
             print(f"  [{score:8.3f}] {' '.join(str(t) for t in ids)}")
+
+    # seq_text_printer (seqtext_printer_evaluator parity,
+    # Evaluator.cpp:1319): render the best beam path per source as TEXT,
+    # ids mapped through the target dictionary — the reference's
+    # gen.paths + seqtext printer workflow. The synthetic fallback data
+    # has no word list, so ids render as "w<i>".
+    import numpy as np
+    trg_dict = {i: f"w{i}" for i in range(args.dict_size)}
+    printer = paddle.evaluator.seq_text_printer(beam, dict_data=trg_dict)
+    printer.start()
+    best = [paths[0][1] if paths else [] for paths in res.to_list()]
+    T = max(1, max(len(b) for b in best))
+    ids = np.zeros((len(best), T), np.int32)
+    for i, b in enumerate(best):
+        ids[i, :len(b)] = b
+    lengths = np.array([len(b) for b in best], np.int32)
+    print("translations (best beam, seq_text_printer):")
+    printer.eval_batch([(ids, lengths)], len(best))
     return 0
 
 

@@ -126,7 +126,8 @@ class Topology:
                 output_names: Optional[Sequence[str]] = None,
                 sparse_sub: Optional[Dict[str, Any]] = None,
                 injected: Optional[Dict[str, Any]] = None,
-                skip: Sequence[str] = (), mesh=None, n_real=None):
+                skip: Sequence[str] = (), mesh=None, n_real=None,
+                taps: Optional[Dict[str, Any]] = None):
         """Pure forward pass.
 
         Returns (outputs_dict, new_state). `outputs_dict` maps layer name ->
@@ -137,6 +138,9 @@ class Topology:
         `injected`/`skip`: pre-computed values (e.g. the pipelined body's
         boundary activation) and layer names NOT to execute here — a
         skipped, un-injected value consumed downstream raises KeyError.
+        `taps`: {layer name: zero array added to that layer's output} —
+        differentiating the caller's loss w.r.t. a tap yields the
+        activation cotangent d(loss)/d(output) (gradient_printer support).
         """
         ctx = ApplyContext(mode, rng, state)
         ctx.sparse_sub = sparse_sub
@@ -165,6 +169,15 @@ class Topology:
                 values[layer.name] = impl["apply"](ctx, layer.name,
                                                    layer.config, lparams,
                                                    inputs)
+            if taps and layer.name in taps:
+                v, t = values[layer.name], taps[layer.name]
+                from paddle_tpu.core.sequence import SequenceBatch
+                if isinstance(v, SequenceBatch):
+                    v = SequenceBatch(v.data + t, v.lengths,
+                                      v.segment_ids, v.num_segments)
+                else:
+                    v = v + t
+                values[layer.name] = v
         new_state = dict(state)
         new_state.update(ctx.state_updates)
         outs = {n: values[n] for n in wanted if n in values}

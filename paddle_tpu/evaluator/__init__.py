@@ -31,7 +31,8 @@ from paddle_tpu.core.registry import LayerOutput
 __all__ = [
     "Evaluator", "auc", "classification_error", "precision_recall",
     "chunk", "ctc_error", "pnpair", "rank_auc", "sum_evaluator",
-    "column_sum", "maxid_printer", "value_printer",
+    "column_sum", "maxid_printer", "value_printer", "seq_text_printer",
+    "max_frame_printer", "gradient_printer",
 ]
 
 
@@ -545,6 +546,139 @@ class PrinterEvaluator(Evaluator):
         return {}
 
 
+class SeqTextPrinterEvaluator(Evaluator):
+    """Prints decoded token sequences during eval — SequenceTextPrinter
+    (Evaluator.cpp:1319; config api seqtext_printer_evaluator), the
+    natural companion of the beam decoder: each sequence prints as
+    `sample_id \\t tokens`, ids mapped through a dictionary.
+
+    input: SequenceBatch of ids [b, T] (a maxid/generation output), or
+    per-frame scores [b, T, C] (argmax-decoded here); dict_data: list of
+    tokens (id -> token) or {id: token}; dict_file: one token per line
+    (the reference's dict_file). Without a dictionary, raw ids print.
+    delimited=False joins tokens without spaces (char models)."""
+
+    expensive_result = False
+    wants_gradient = False
+
+    def __init__(self, input: LayerOutput, dict_data=None,
+                 dict_file: Optional[str] = None, delimited: bool = True,
+                 name: str = "seq_text_printer", stream=None):
+        self.name = name
+        self.inputs = [input]
+        self.stream = stream
+        self.delimited = delimited
+        if dict_file is not None:
+            with open(dict_file) as f:
+                dict_data = [ln.rstrip("\n") for ln in f]
+        if isinstance(dict_data, dict):
+            self._dict = dict(dict_data)
+        elif dict_data is not None:
+            self._dict = {i: t for i, t in enumerate(dict_data)}
+        else:
+            self._dict = None
+        self._sample_id = 0
+
+    def start(self):
+        self._sample_id = 0
+
+    def _decode(self, ids) -> str:
+        toks = [self._dict.get(int(i), f"<unk:{int(i)}>")
+                if self._dict is not None else str(int(i)) for i in ids]
+        return (" " if self.delimited else "").join(toks)
+
+    def eval_batch(self, values, n_real):
+        import sys
+        v = _rows(values[0], n_real)
+        out = self.stream or sys.stdout
+        if isinstance(v, tuple):            # SequenceBatch (data, lengths)
+            data, lengths = v
+            if data.ndim >= 3:              # scores -> ids
+                data = data.argmax(-1)
+            for i in range(len(lengths)):
+                ids = data[i, :int(lengths[i])]
+                print(f"{self._sample_id}\t{self._decode(ids)}", file=out)
+                self._sample_id += 1
+        else:                               # dense [b, T] id rows
+            arr = np.asarray(v)
+            if arr.ndim >= 3:
+                arr = arr.argmax(-1)
+            for row in arr.reshape(arr.shape[0], -1):
+                print(f"{self._sample_id}\t{self._decode(row)}", file=out)
+                self._sample_id += 1
+
+    def result(self):
+        return {}
+
+
+class MaxFramePrinterEvaluator(Evaluator):
+    """Per sequence, prints the frame (timestep) holding the max value —
+    MaxFramePrinter (Evaluator.cpp:1142; config api
+    maxframe_printer_evaluator). input: SequenceBatch of width-1 scores
+    [b, T] or [b, T, 1]."""
+
+    def __init__(self, input: LayerOutput, name: str = "max_frame_printer",
+                 stream=None):
+        self.name = name
+        self.inputs = [input]
+        self.stream = stream
+
+    def start(self):
+        pass
+
+    def eval_batch(self, values, n_real):
+        import sys
+        v = _rows(values[0], n_real)
+        out = self.stream or sys.stdout
+        if not isinstance(v, tuple):
+            raise ValueError(f"{self.name}: input must be a sequence layer")
+        data, lengths = v
+        data = np.asarray(data).reshape(data.shape[0], data.shape[1], -1)
+        if data.shape[-1] != 1:
+            raise ValueError(
+                f"{self.name}: width-1 sequences required, got width "
+                f"{data.shape[-1]}")
+        for i in range(len(lengths)):
+            t = int(lengths[i])
+            frames = data[i, :t, 0]
+            j = int(frames.argmax()) if t else 0
+            print(f"[{self.name}] seq{i}: frame {j} : "
+                  f"{float(frames[j]) if t else float('nan'):.6g}, "
+                  f"total {t} frames", file=out)
+
+    def result(self):
+        return {}
+
+
+class GradientPrinterEvaluator(Evaluator):
+    """Prints d(cost)/d(activation) of the input layer each batch —
+    GradientPrinter (Evaluator.cpp:1046; config api
+    gradient_printer_evaluator). The trainer sees `wants_gradient` and
+    adds a zero-valued tap on the layer's output to the differentiated
+    function, so the activation cotangent falls out of the same backward
+    pass that produces the parameter gradients (no extra forward)."""
+
+    wants_gradient = True
+
+    def __init__(self, input: LayerOutput, name: str = "gradient_printer",
+                 stream=None):
+        self.name = name
+        self.inputs = [input]
+        self.stream = stream
+
+    def start(self):
+        pass
+
+    def eval_batch(self, values, n_real):
+        import sys
+        g = _rows(values[0], n_real)
+        arr = np.asarray(g[0] if isinstance(g, tuple) else g)
+        print(f"[{self.name}] grad {arr}", file=self.stream or sys.stdout)
+
+    def result(self):
+        return {}
+
+
 class DetectionMAPEvaluator(Evaluator):
     """Mean average precision over detection outputs
     (Evaluator.cpp REGISTER_EVALUATOR detection_map, DetectionMAPEvaluator.cpp).
@@ -714,3 +848,18 @@ def maxid_printer(input, **kw):
 
 def value_printer(input, **kw):
     return PrinterEvaluator(input, mode="value", **kw)
+
+
+def seq_text_printer(input, **kw):
+    """seqtext_printer_evaluator parity (Evaluator.cpp:1319)."""
+    return SeqTextPrinterEvaluator(input, **kw)
+
+
+def max_frame_printer(input, **kw):
+    """maxframe_printer_evaluator parity (Evaluator.cpp:1142)."""
+    return MaxFramePrinterEvaluator(input, **kw)
+
+
+def gradient_printer(input, **kw):
+    """gradient_printer_evaluator parity (Evaluator.cpp:1046)."""
+    return GradientPrinterEvaluator(input, **kw)

@@ -9,9 +9,9 @@ Reference parity map:
     :107 loadMeta, :126 LoadCheckpoint; optimizer state serialization via
     paddle/optimizer/serialization.h). This module is that capability:
     one artifact holding params + optimizer slots + step counters, crc
-    meta, atomic rename, keep-last-N, optional async writer thread
+    meta, atomic rename, keep-last-N, async writer thread BY DEFAULT
     (orbax-style: the device->host copy happens synchronously, the disk
-    write in the background).
+    write in the background off the step path).
 
 Layout: <dir>/ckpt-<step>/state.npz + meta.json; latest resolved by
 highest step with an intact checksum.
@@ -79,14 +79,27 @@ def _to_host(tree):
 
 
 class CheckpointManager:
-    """Save/restore {params, opt_state, state, meta} with integrity meta."""
+    """Save/restore {params, opt_state, state, meta} with integrity meta.
+
+    Async by default (the Go pserver checkpoints off the serving path on
+    a ticker, go/pserver/service.go:272; Orbax makes the same split):
+    save() snapshots device arrays to host synchronously — the only part
+    that must see a consistent step — and hands serialization + disk IO
+    to a background thread, so the training loop never stalls on the
+    write. Atomicity is by rename: a checkpoint directory appears only
+    complete (state.npz + md5 meta written under .tmp, then os.replace),
+    so a kill at ANY point during the write leaves the previous
+    checkpoint as the newest intact one — never a torn artifact.
+    save() joins any previous in-flight write first (at most one writer),
+    and restore()/SGD.train-exit call wait()."""
 
     def __init__(self, directory: str, keep: int = 3,
-                 async_write: bool = False):
+                 async_write: bool = True):
         self.dir = directory
         self.keep = keep
         self.async_write = async_write
         self._writer: Optional[threading.Thread] = None
+        self._write_error: Optional[BaseException] = None
         os.makedirs(directory, exist_ok=True)
 
     # ----------------------------------------------------------------- save
@@ -120,19 +133,34 @@ class CheckpointManager:
             os.replace(tmp, path)
             self._gc()
 
+        def write_guarded():
+            try:
+                write()
+            except BaseException as e:   # surfaced by wait()/next save()
+                self._write_error = e
+
         self.wait()
         if self.async_write:
-            self._writer = threading.Thread(target=write, daemon=True)
+            # non-daemon: a clean interpreter exit joins the thread, so a
+            # caller that saves and returns cannot silently lose the write
+            self._writer = threading.Thread(target=write_guarded,
+                                            daemon=False)
             self._writer.start()
         else:
             write()
         return path
 
     def wait(self):
-        """Join any in-flight async write (call before exit/restore)."""
+        """Join any in-flight async write (call before exit/restore).
+        Re-raises a background write failure (ENOSPC, permissions...) —
+        async must not convert a lost checkpoint into silence."""
         if self._writer is not None:
             self._writer.join()
             self._writer = None
+        if self._write_error is not None:
+            err, self._write_error = self._write_error, None
+            raise RuntimeError(
+                "background checkpoint write failed") from err
 
     def _gc(self):
         kept = self.all_steps()

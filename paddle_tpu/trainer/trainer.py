@@ -52,6 +52,12 @@ class SGD:
         # layers become extra topology outputs; per-batch values feed the
         # host-side streaming accumulators (see paddle_tpu/evaluator).
         self.evaluators = list(evaluators or [])
+        # gradient-printer evaluators need d(cost)/d(activation) of their
+        # input layers: the train step adds a zero tap on those outputs
+        # and differentiates w.r.t. it alongside the params (one backward)
+        self._grad_tap_names = sorted({
+            li.name for ev in self.evaluators
+            if getattr(ev, "wants_gradient", False) for li in ev.inputs})
         eval_inputs: List[LayerOutput] = []
         seen = {c.name for c in self.costs} | \
             {e.name for e in self.extra_layers}
@@ -184,10 +190,12 @@ class SGD:
         return jnp.sum(v * mask) / jnp.maximum(n_real.astype(v.dtype), 1.0)
 
     def _loss_and_metrics(self, params, state, feed, rng, n_real, mode,
-                          sparse_sub=None, injected=None, skip=()):
+                          sparse_sub=None, injected=None, skip=(),
+                          taps=None):
         outs, new_state = self.topology.forward(
             params, state, feed, mode=mode, rng=rng, sparse_sub=sparse_sub,
-            injected=injected, skip=skip, mesh=self.mesh, n_real=n_real)
+            injected=injected, skip=skip, mesh=self.mesh, n_real=n_real,
+            taps=taps)
         total = 0.0
         metrics = {}
         for c in self.costs:
@@ -223,7 +231,15 @@ class SGD:
         from paddle_tpu.parallel.mesh import PP_AXIS
         if self.mesh is not None and PP_AXIS in self.mesh.shape and \
                 self.mesh.shape[PP_AXIS] > 1:
+            if self._grad_tap_names:
+                raise NotImplementedError(
+                    "gradient_printer is not supported with a pipelined "
+                    "train step; use it on the plain path")
             return self._build_pipelined_train_step()
+        if sparse_map and self._grad_tap_names:
+            raise NotImplementedError(
+                "gradient_printer is not supported together with "
+                "row-sparse embedding tables")
 
         def step(params, opt_state, state, feed, rng, n_real):
             if sparse_map:
@@ -267,10 +283,38 @@ class SGD:
                     sparse_rows=sparse_rows)
                 return (new_params, new_opt_state, new_state, loss, metrics,
                         eval_outs)
-            grad_fn = jax.value_and_grad(
-                lambda p: self._loss_and_metrics(p, state, feed, rng, n_real,
-                                                 "train"), has_aux=True)
-            (loss, (metrics, new_state, eval_outs)), grads = grad_fn(params)
+            if self._grad_tap_names:
+                # activation gradients for gradient_printer evaluators:
+                # tap each target layer's output with zeros and take the
+                # cotangent w.r.t. the tap in the SAME backward pass
+                from paddle_tpu.core.sequence import SequenceBatch
+
+                def _tap_zero(o):
+                    s = o.data if isinstance(o, SequenceBatch) else o
+                    return jnp.zeros(s.shape, s.dtype)
+
+                tap_structs = jax.eval_shape(
+                    lambda p: self.topology.forward(
+                        p, state, feed, mode="train", rng=rng,
+                        mesh=self.mesh, n_real=n_real,
+                        output_names=self._grad_tap_names)[0], params)
+                taps0 = {n: _tap_zero(o) for n, o in tap_structs.items()}
+                grad_fn = jax.value_and_grad(
+                    lambda p, t: self._loss_and_metrics(
+                        p, state, feed, rng, n_real, "train", taps=t),
+                    argnums=(0, 1), has_aux=True)
+                ((loss, (metrics, new_state, eval_outs)),
+                 (grads, tap_grads)) = grad_fn(params, taps0)
+                eval_outs = dict(eval_outs)
+                for n, g in tap_grads.items():
+                    eval_outs["__grad__" + n] = g
+            else:
+                grad_fn = jax.value_and_grad(
+                    lambda p: self._loss_and_metrics(p, state, feed, rng,
+                                                     n_real, "train"),
+                    has_aux=True)
+                ((loss, (metrics, new_state, eval_outs)),
+                 grads) = grad_fn(params)
             new_params, new_opt_state = self.optimizer.update(
                 params, grads, opt_state, n_real.astype(jnp.float32))
             return (new_params, new_opt_state, new_state, loss, metrics,
@@ -540,30 +584,41 @@ class SGD:
             if checkpoint_manager is not None:
                 self.restore_checkpoint(checkpoint_manager)
 
-            while coordinator_epoch(coordinator) < num_passes:
-                pass_id = coordinator_epoch(coordinator)
-                self._run_pass(pass_id, rdr, feeder, event_handler,
+            try:
+                while coordinator_epoch(coordinator) < num_passes:
+                    pass_id = coordinator_epoch(coordinator)
+                    self._run_pass(pass_id, rdr, feeder, event_handler,
+                                   num_batches_per_pass, checkpoint_manager,
+                                   checkpoint_period)
+                    if checkpoint_manager is not None:
+                        self.save_checkpoint(checkpoint_manager)
+                    if coordinator_epoch(coordinator) == pass_id:
+                        # the reader gave up without the epoch turning
+                        # (every task dropped, or idle_timeout hit) —
+                        # surfaced by task_reader's warning; don't spin
+                        import warnings
+                        warnings.warn(
+                            f"elastic training stopped at epoch {pass_id} "
+                            f"of {num_passes}: the pass never completed")
+                        break
+            finally:
+                # saves run off the step path (async writer); never leave
+                # train() — even via an exception — with a checkpoint
+                # still in flight (and surface any background write error)
+                if checkpoint_manager is not None:
+                    checkpoint_manager.wait()
+            return
+
+        try:
+            for pass_id in range(num_passes):
+                self._run_pass(pass_id, reader, feeder, event_handler,
                                num_batches_per_pass, checkpoint_manager,
                                checkpoint_period)
                 if checkpoint_manager is not None:
                     self.save_checkpoint(checkpoint_manager)
-                if coordinator_epoch(coordinator) == pass_id:
-                    # the reader gave up without the epoch turning (every
-                    # task dropped, or idle_timeout hit) — surfaced by
-                    # task_reader's warning; don't spin
-                    import warnings
-                    warnings.warn(
-                        f"elastic training stopped at epoch {pass_id} of "
-                        f"{num_passes}: the pass never completed")
-                    break
-            return
-
-        for pass_id in range(num_passes):
-            self._run_pass(pass_id, reader, feeder, event_handler,
-                           num_batches_per_pass, checkpoint_manager,
-                           checkpoint_period)
+        finally:
             if checkpoint_manager is not None:
-                self.save_checkpoint(checkpoint_manager)
+                checkpoint_manager.wait()
 
     def _own_params(self):
         """This topology's parameter subset. Parameters may be SHARED
@@ -711,7 +766,13 @@ class SGD:
         host = {k: _to_np(v) for k, v in eval_outs.items()}
         results: Dict[str, float] = {}
         for ev in self.evaluators:
-            ev.eval_batch([host[li.name] for li in ev.inputs], n_real)
+            if getattr(ev, "wants_gradient", False):
+                keys = ["__grad__" + li.name for li in ev.inputs]
+                if any(k not in host for k in keys):
+                    continue    # no backward ran (test sweep) — skip
+                ev.eval_batch([host[k] for k in keys], n_real)
+            else:
+                ev.eval_batch([host[li.name] for li in ev.inputs], n_real)
             if not getattr(ev, "expensive_result", False):
                 results.update(ev.result())   # running pass-so-far display
         return results

@@ -124,6 +124,14 @@ class TestCoordinator:
             srv.stop()
 
 
+def _cpu_env():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 def _trainer(seed=0):
     from paddle_tpu.core import registry
     registry.reset_name_counters()
@@ -262,4 +270,93 @@ class TestCheckpointResume:
         for i in range(4):
             tr.train(_reader(0), num_passes=1)
             tr.save_checkpoint(mgr)
+        mgr.wait()
         assert len(mgr.all_steps()) == 2
+
+    def test_save_does_not_block_steps(self, tmp_path, monkeypatch):
+        """The write must run OFF the step path: hold the writer thread
+        open and prove (a) save() returns immediately, (b) training
+        steps complete while the write is still in flight."""
+        import threading
+        import paddle_tpu.trainer.checkpoint as ck
+
+        gate = threading.Event()
+        real_savez = ck.np.savez
+
+        def slow_savez(f, **kw):
+            real_savez(f, **kw)
+            gate.wait(timeout=60)  # pin the writer thread open
+
+        monkeypatch.setattr(ck.np, "savez", slow_savez)
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        tr = _trainer()
+        tr.train(_reader(0), num_passes=1)
+        tr.save_checkpoint(mgr)
+        # save returned while the writer is still held open
+        assert mgr._writer is not None and mgr._writer.is_alive()
+        # a full training pass completes with the write in flight
+        tr.train(_reader(0), num_passes=1)
+        assert mgr._writer.is_alive()
+        gate.set()
+        mgr.wait()
+        assert mgr.latest_step() is not None
+
+    def test_background_write_failure_surfaces(self, tmp_path,
+                                               monkeypatch):
+        """An async write that fails (ENOSPC, permissions) must raise at
+        wait()/next-save — not vanish into the writer thread."""
+        import paddle_tpu.trainer.checkpoint as ck
+
+        def boom(f, **kw):
+            raise OSError(28, "No space left on device")
+
+        monkeypatch.setattr(ck.np, "savez", boom)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, {"w": np.ones((2, 2), np.float32)})
+        with pytest.raises(RuntimeError, match="checkpoint write failed"):
+            mgr.wait()
+        # the manager recovers: the error does not re-raise forever
+        mgr.wait()
+
+    def test_kill_during_write_leaves_no_torn_checkpoint(self, tmp_path):
+        """SIGKILL the process while a (large) checkpoint write is in
+        flight: the newest INTACT checkpoint must be the previous one —
+        atomic rename means a torn artifact can never be selected."""
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        code = (
+            "import sys, numpy as np\n"
+            "from paddle_tpu.trainer.checkpoint import CheckpointManager\n"
+            "mgr = CheckpointManager(sys.argv[1], keep=5,"
+            " async_write=False)\n"
+            "mgr.save(1, {'w': np.ones((8, 8), np.float32)})\n"
+            "print('SAVED1', flush=True)\n"
+            "big = {'w': np.random.RandomState(0).randn(96, 1 << 20)"
+            ".astype(np.float32)}\n"
+            "mgr.save(2, big)\n"
+            "print('SAVED2', flush=True)\n")
+        p = subprocess.Popen([sys.executable, "-c", code, str(tmp_path)],
+                             stdout=subprocess.PIPE, text=True,
+                             env=_cpu_env())
+        assert p.stdout.readline().strip() == "SAVED1"
+        # kill the moment the step-2 write directory appears
+        tmp_dir = tmp_path / "ckpt-0000000002.tmp"
+        deadline = time.time() + 120
+        while time.time() < deadline and not tmp_dir.exists() \
+                and p.poll() is None:
+            time.sleep(0.001)
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=60)
+
+        mgr = CheckpointManager(str(tmp_path))
+        latest = mgr.latest_step()
+        # the kill races the write's completion: either the old intact
+        # checkpoint or a FULLY completed new one — never torn, never None
+        assert latest in (1, 2)
+        step, tree = mgr.restore(latest)
+        assert step == latest
+        w = tree["params"]["w"]
+        assert np.isfinite(np.asarray(w)).all()

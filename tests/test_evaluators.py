@@ -268,3 +268,99 @@ def test_typod_evaluator_input_fails_at_construction():
         paddle.SGD(cost=cost, parameters=params,
                    update_equation=paddle.optimizer.Adam(1e-3),
                    evaluators=[ev])
+
+
+class TestPrinterFamily:
+    """seq_text / max_frame / gradient printers (Evaluator.cpp:1319,
+    1142, 1046)."""
+
+    def test_seq_text_printer_decodes_ids(self):
+        import io
+        buf = io.StringIO()
+        ev = E.seq_text_printer(
+            _FakeLayer("ids"),
+            dict_data=["the", "cat", "sat", "on", "mat"], stream=buf)
+        ev.start()
+        data = np.array([[1, 2, 3, 0], [4, 0, 0, 0]])
+        lengths = np.array([3, 1])
+        ev.eval_batch([(data, lengths)], 2)
+        lines = buf.getvalue().splitlines()
+        assert lines == ["0\tcat sat on", "1\tmat"]
+        assert ev.result() == {}
+        # sample ids keep counting across batches within a pass
+        ev.eval_batch([(data[:1], lengths[:1])], 1)
+        assert buf.getvalue().splitlines()[-1] == "2\tcat sat on"
+
+    def test_seq_text_printer_argmax_and_dict_file(self, tmp_path):
+        import io
+        d = tmp_path / "dict.txt"
+        d.write_text("a\nb\nc\n")
+        buf = io.StringIO()
+        ev = E.seq_text_printer(_FakeLayer("scores"), dict_file=str(d),
+                                delimited=False, stream=buf)
+        ev.start()
+        # [b=1, T=3, C=3] scores -> argmax ids 2,0,1 -> "cab"
+        scores = np.array([[[0, 0, 9], [9, 0, 0], [0, 9, 0]]], np.float32)
+        ev.eval_batch([(scores, np.array([3]))], 1)
+        assert buf.getvalue().splitlines() == ["0\tcab"]
+
+    def test_max_frame_printer(self):
+        import io
+        buf = io.StringIO()
+        ev = E.max_frame_printer(_FakeLayer("s"), stream=buf)
+        ev.start()
+        data = np.array([[[0.1], [0.9], [0.3]],
+                         [[0.5], [0.2], [0.8]]], np.float32)
+        lengths = np.array([3, 2])   # seq1's frame 2 is PADDING
+        ev.eval_batch([(data, lengths)], 2)
+        lines = buf.getvalue().splitlines()
+        assert "seq0: frame 1 : 0.9" in lines[0]
+        assert "seq1: frame 0 : 0.5" in lines[1]   # 0.8 is past length 2
+        with pytest.raises(ValueError):
+            ev.eval_batch([np.zeros((2, 3))], 2)   # non-sequence input
+
+    def test_gradient_printer_prints_activation_grad(self):
+        """End to end through SGD: for cost = 0.5*sum((xW-y)^2)/n, the
+        activation gradient of the output layer is (xW - y)/n."""
+        import io
+        from paddle_tpu.core import registry
+        registry.reset_name_counters()
+        paddle.init(use_tpu=False, seed=0)
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(3))
+        y = paddle.layer.data("y", paddle.data_type.dense_vector(1))
+        out = paddle.layer.fc(x, size=1, act=None, bias_attr=False,
+                              name="out")
+        cost = paddle.layer.mse_cost(out, y)
+        buf = io.StringIO()
+        ev = E.gradient_printer(out, stream=buf)
+        params = paddle.create_parameters(paddle.Topology(cost))
+        W = np.array([[0.5], [-1.0], [2.0]], np.float32)
+        import jax.numpy as jnp
+        params.raw["_out.w0"] = jnp.asarray(W)
+        tr = paddle.SGD(cost=cost, parameters=params,
+                        update_equation=paddle.optimizer.Momentum(
+                            learning_rate=0.0),   # keep W fixed
+                        evaluators=[ev])
+        rng = np.random.RandomState(0)
+        xs = rng.randn(4, 3).astype("float32")
+        ys = rng.randn(4, 1).astype("float32")
+
+        def reader():
+            yield [(xs[i], ys[i]) for i in range(4)]
+
+        tr.train(reader, num_passes=1, event_handler=lambda e: None)
+        txt = buf.getvalue()
+        assert "[gradient_printer] grad" in txt
+        want = (xs @ W - ys) / 4.0
+        got = np.array([float(v) for v in
+                        txt.replace("[", " ").replace("]", " ").split()
+                        if _is_float(v)][-4:]).reshape(4, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def _is_float(s):
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False
