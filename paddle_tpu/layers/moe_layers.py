@@ -89,7 +89,8 @@ class MoELayer:
             x2d, valid, params[cfg["_gate"]], params[cfg["_up"]],
             params[cfg["_down"]], k=cfg.get("k", 2),
             capacity_factor=cfg.get("capacity_factor", 1.25),
-            mesh=getattr(ctx, "mesh", None))
+            mesh=getattr(ctx, "mesh", None),
+            dispatch_mode=cfg.get("dispatch_mode", "auto"))
         return restore(y)
 
 
@@ -132,12 +133,16 @@ class MoEAuxCostLayer:
 
 def moe(input, expert_num: int, expert_hidden=None, k: int = 2,
         capacity_factor: float = 1.25, name=None, param_attr=None,
-        **kw):
-    """Mixture-of-experts FFN layer (see MoELayer)."""
+        dispatch_mode: str = "auto", **kw):
+    """Mixture-of-experts FFN layer (see MoELayer). dispatch_mode:
+    'auto' (default: sort single-host, einsum under an ep mesh),
+    'einsum' (ep-shardable dispatch tensors), or 'sort'
+    (argsort+scatter — faster at every measured single-host size and
+    the only option past ~100k tokens; see ops/moe.py + docs/perf.md)."""
     return make_layer("moe", name, [input], expert_num=expert_num,
                       expert_hidden=expert_hidden, k=k,
                       capacity_factor=capacity_factor,
-                      param_attr=param_attr)
+                      param_attr=param_attr, dispatch_mode=dispatch_mode)
 
 
 def moe_aux_cost(input, moe_layer, coeff: float = 0.01, name=None, **kw):

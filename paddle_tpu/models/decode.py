@@ -134,7 +134,7 @@ class TransformerDecoder:
                 p[f"_{n}_l{i}_moe.gate"], p[f"_{n}_l{i}_moe.moe_up"],
                 p[f"_{n}_l{i}_moe.moe_down"], k=self.moe_k,
                 capacity_factor=cf if cf is not None else 1.25,
-                capacity=cap)
+                capacity=cap, dispatch_mode="auto")
             x = x + y2d.reshape(b_, t_, d_)
         else:
             up = jax.nn.relu(ln2 @ p[f"_{n}_l{i}_up.w0"]

@@ -14,8 +14,10 @@ GShard/Mesh-TF way, which is also the XLA-friendly way:
 
 The dispatch tensor is [n, E, C] — fine for the token counts a single
 chip sees (the ep axis divides E, dp divides n), but it is the textbook
-memory trade-off of einsum routing; a sort-based dispatch would replace
-it if single-host token counts grow past ~100k.
+memory trade-off of einsum routing. For single-host token counts past
+~100k, `dispatch_mode='sort'` (moe_sorted_ffn) replaces it with an
+argsort + gather/scatter that never materializes [n,E,C] — measured
+crossover and numbers in docs/perf.md.
 
 Both dispatch and combine are built in f32 (routing decisions must not
 depend on the compute dtype), then cast so the big einsums run on the
@@ -105,11 +107,104 @@ def moe_dispatch(gate_logits: jnp.ndarray, valid: Optional[jnp.ndarray],
     return dispatch, combine, aux
 
 
+def moe_sorted_ffn(x: jnp.ndarray, valid: Optional[jnp.ndarray],
+                   gate_w: jnp.ndarray, w_up: jnp.ndarray,
+                   w_down: jnp.ndarray, *, k: int = 2,
+                   capacity_factor: float = 1.25,
+                   capacity: Optional[int] = None,
+                   act=jax.nn.relu, normalize: bool = True
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sort-based dispatch: the einsum path's O(n*E*C) dispatch/combine
+    tensors replaced by an argsort + gather/scatter — the Megablocks-style
+    formulation for LARGE single-host token counts (>~100k), where
+    [n,E,C] no longer fits and the dispatch einsum's n*E*C*d FLOPs dwarf
+    the expert FFN itself.
+
+    Numerics match moe_ffn exactly: (token, choice) pairs are ranked in
+    choice-major token order per expert (a stable argsort on expert id),
+    which reproduces the einsum path's fill discipline — einsum positions
+    are fill(prev rounds' KEPT) + within-round rank, and fill saturates
+    at capacity exactly when total prior entries do, so keep decisions
+    and kept slots agree (see tests/test_sparse.py parity test).
+
+    Single-host by design (the scatter/gather does not ride an ep
+    all-to-all the way the dispatch einsum does); for the ep-sharded
+    multi-chip path keep dispatch_mode='einsum'.
+    """
+    n, d = x.shape
+    num_experts = gate_w.shape[-1]
+    if capacity is None:
+        capacity = moe_capacity(n, num_experts, k, capacity_factor)
+    logits = jnp.dot(x.astype(jnp.float32), gate_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    if valid is None:
+        valid = jnp.ones((n,), jnp.float32)
+    valid = valid.astype(jnp.float32)
+    probs = probs * valid[:, None]
+
+    remaining = probs
+    idx_rounds, gate_rounds = [], []
+    first_choice = None
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)                      # [n]
+        onehot = jax.nn.one_hot(idx, num_experts,
+                                dtype=jnp.float32) * valid[:, None]
+        if first_choice is None:
+            first_choice = onehot
+        gate_rounds.append(jnp.sum(probs * onehot, axis=-1))
+        # invalid tokens route to the E sentinel: they sort past every
+        # real expert and never consume capacity (einsum path: onehot
+        # masked by valid)
+        idx_rounds.append(jnp.where(valid > 0, idx, num_experts))
+        remaining = remaining * (1.0 - onehot)
+
+    kn = k * n
+    ek = jnp.concatenate(idx_rounds).astype(jnp.int32)            # [kn]
+    gk = jnp.concatenate(gate_rounds)                             # [kn]
+    order = jnp.argsort(ek, stable=True)     # choice-major within expert
+    es = ek[order]
+    gs = gk[order]
+    tok = (order % n).astype(jnp.int32)      # flat entry j*n+i -> token i
+    # rank within the expert's segment = global rank - segment start
+    starts = jnp.searchsorted(es, jnp.arange(num_experts + 1,
+                                             dtype=es.dtype))
+    pos = jnp.arange(kn, dtype=jnp.int32) - starts[es].astype(jnp.int32)
+    keep = ((pos < capacity) & (es < num_experts) &
+            (gs > 0)).astype(jnp.float32)
+    dump = num_experts * capacity            # scratch row for drops
+    dest = jnp.where(keep > 0, es * capacity + pos, dump)
+
+    cdt = x.dtype
+    xs = x[tok] * keep.astype(cdt)[:, None]                       # [kn, d]
+    buf = jnp.zeros((num_experts * capacity + 1, d), cdt)
+    expert_in = buf.at[dest].add(xs)[:-1].reshape(
+        num_experts, capacity, d)
+    h = act(jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(cdt)))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(cdt))
+
+    w = gs * keep
+    if normalize and k > 1:
+        tot = jnp.zeros((n,), jnp.float32).at[tok].add(w)
+        w = w / jnp.maximum(tot, 1e-9)[tok]
+    flat_out = jnp.concatenate(
+        [expert_out.reshape(num_experts * capacity, d),
+         jnp.zeros((1, d), cdt)])
+    contrib = flat_out[dest] * w.astype(cdt)[:, None]
+    y = jnp.zeros((n, d), cdt).at[tok].add(contrib)
+
+    n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+    me = jnp.sum(probs, axis=0) / n_valid
+    ce = jnp.sum(first_choice, axis=0) / n_valid
+    aux = num_experts * jnp.sum(me * ce)
+    return y, aux
+
+
 def moe_ffn(x: jnp.ndarray, valid: Optional[jnp.ndarray],
             gate_w: jnp.ndarray, w_up: jnp.ndarray, w_down: jnp.ndarray,
             *, k: int = 2, capacity_factor: float = 1.25,
             capacity: Optional[int] = None,
-            act=jax.nn.relu, mesh=None, ep_axis: str = "ep"
+            act=jax.nn.relu, mesh=None, ep_axis: str = "ep",
+            dispatch_mode: str = "einsum"
             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """x [n, d] -> (y [n, d], aux loss).
 
@@ -122,6 +217,23 @@ def moe_ffn(x: jnp.ndarray, valid: Optional[jnp.ndarray],
     capacity=n at inference for drop-free routing (the capacity limit
     only buys memory/balance at training scale — see models/decode.py).
     """
+    if dispatch_mode == "auto":
+        # measured (tools/moe_dispatch_bench.py, v5e, bf16, d=512 f=2048
+        # E=8 k=2): sort beats einsum at every single-host size — 1.8x at
+        # 8k tokens, 5.4x at 32k — and is the only path that compiles at
+        # >=131k. einsum remains for ep meshes, where the dispatch einsum
+        # carries the token all-to-all.
+        ep_sharded = mesh is not None and ep_axis in mesh.axis_names \
+            and mesh.shape.get(ep_axis, 1) > 1
+        dispatch_mode = "einsum" if ep_sharded else "sort"
+    if dispatch_mode == "sort":
+        assert mesh is None or ep_axis not in mesh.axis_names or \
+            mesh.shape.get(ep_axis, 1) == 1, \
+            "dispatch_mode='sort' is single-host; use 'einsum' under ep"
+        return moe_sorted_ffn(x, valid, gate_w, w_up, w_down, k=k,
+                              capacity_factor=capacity_factor,
+                              capacity=capacity, act=act)
+    assert dispatch_mode == "einsum", dispatch_mode
     n, d = x.shape
     num_experts = gate_w.shape[-1]
     if capacity is None:

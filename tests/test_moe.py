@@ -203,3 +203,96 @@ class TestMoETransformer:
         # gate stays replicated
         assert spec_for("_tfm_l0_moe.gate", (16, 4), mesh) == \
             jax.sharding.PartitionSpec()
+
+
+class TestSortedDispatch:
+    """moe_sorted_ffn must reproduce the einsum path's numerics exactly:
+    same keep decisions, same slots, same combine weights — argsort
+    ranking in choice-major token order IS the einsum fill discipline."""
+
+    def _both(self, n, d, E, f, k, capacity, seed, valid=None):
+        rng = np.random.RandomState(seed)
+        x = jnp.asarray(rng.randn(n, d).astype(np.float32))
+        gate_w = jnp.asarray(rng.randn(d, E).astype(np.float32))
+        w_up = jnp.asarray(0.1 * rng.randn(E, d, f).astype(np.float32))
+        w_down = jnp.asarray(0.1 * rng.randn(E, f, d).astype(np.float32))
+        ein = moe_ops.moe_ffn(x, valid, gate_w, w_up, w_down, k=k,
+                              capacity=capacity)
+        srt = moe_ops.moe_ffn(x, valid, gate_w, w_up, w_down, k=k,
+                              capacity=capacity, dispatch_mode="sort")
+        return ein, srt
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_matches_einsum_no_overflow(self, k):
+        (y0, a0), (y1, a1) = self._both(24, 8, 4, 16, k,
+                                        capacity=24, seed=0)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(a1), float(a0), rtol=1e-6)
+
+    @pytest.mark.parametrize("k", [1, 2, 3])
+    def test_matches_einsum_with_overflow_drops(self, k):
+        # capacity 3 over 24 tokens / 4 experts forces real drops; the
+        # two paths must drop the SAME (token, choice) pairs
+        (y0, a0), (y1, a1) = self._both(24, 8, 4, 16, k,
+                                        capacity=3, seed=1)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(a1), float(a0), rtol=1e-6)
+
+    def test_matches_einsum_with_invalid_rows(self):
+        valid = jnp.asarray(
+            np.array([1] * 10 + [0] * 6, np.float32))
+        (y0, a0), (y1, a1) = self._both(16, 8, 4, 16, 2, capacity=4,
+                                        seed=2, valid=valid)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(a1), float(a0), rtol=1e-6)
+        # padding rows produce zero output on both paths
+        assert np.abs(np.asarray(y1)[10:]).max() == 0.0
+
+    def test_grads_match_einsum(self):
+        rng = np.random.RandomState(3)
+        n, d, E, f, k = 16, 6, 4, 12, 2
+        x = jnp.asarray(rng.randn(n, d).astype(np.float32))
+        gate_w = jnp.asarray(rng.randn(d, E).astype(np.float32))
+        w_up = jnp.asarray(0.1 * rng.randn(E, d, f).astype(np.float32))
+        w_down = jnp.asarray(0.1 * rng.randn(E, f, d).astype(np.float32))
+
+        def loss(mode, gw, wu, wd):
+            y, aux = moe_ops.moe_ffn(x, None, gw, wu, wd, k=k,
+                                     capacity=5, dispatch_mode=mode)
+            return jnp.sum(y * y) + 0.01 * aux
+
+        g0 = jax.grad(lambda *a: loss("einsum", *a),
+                      argnums=(0, 1, 2))(gate_w, w_up, w_down)
+        g1 = jax.grad(lambda *a: loss("sort", *a),
+                      argnums=(0, 1, 2))(gate_w, w_up, w_down)
+        for a, b in zip(g0, g1):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_layer_flag_reaches_op(self):
+        from paddle_tpu.core import registry
+        registry.reset_name_counters()
+        paddle.init(use_tpu=False, seed=0)
+        x = L.data("x", paddle.data_type.dense_vector(8))
+        m = L.moe(x, expert_num=4, expert_hidden=16,
+                  dispatch_mode="sort", name="m")
+        assert m.config["dispatch_mode"] == "sort"
+        topo = paddle.Topology(m)
+        params = topo.init_params(jax.random.PRNGKey(0))
+        xs = np.random.RandomState(0).randn(8, 8).astype("float32")
+        outs, _ = topo.forward(params, {}, {"x": xs}, mode="test")
+        assert np.isfinite(np.asarray(outs["m"])).all()
+
+    def test_sort_rejects_ep_mesh(self):
+        devs = jax.devices()[:2]
+        mesh = create_mesh([("ep", 2)], devs)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+        with pytest.raises(AssertionError, match="single-host"):
+            moe_ops.moe_ffn(
+                x, None, jnp.zeros((4, 2)), jnp.zeros((2, 4, 8)),
+                jnp.zeros((2, 8, 4)), k=1, dispatch_mode="sort",
+                mesh=mesh)

@@ -1,0 +1,83 @@
+"""einsum vs sort MoE dispatch on device — the ops/moe.py crossover.
+
+Forward+backward step time for moe_ffn under both dispatch modes across
+single-host token counts; slope timing (T_2N - T_N over chained steps)
+cancels dispatch/readback constants. Run on an IDLE host.
+
+    python tools/moe_dispatch_bench.py [--dtype bfloat16]
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import moe as moe_ops
+
+
+def bench(mode, n, d, f, E, k, dtype, reps=5):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n, d), dtype)
+    gate_w = jnp.asarray(rng.randn(d, E), jnp.float32)
+    w_up = jnp.asarray(0.1 * rng.randn(E, d, f), dtype)
+    w_down = jnp.asarray(0.1 * rng.randn(E, f, d), dtype)
+
+    def loss(gw, wu, wd):
+        y, aux = moe_ops.moe_ffn(x, None, gw, wu, wd, k=k,
+                                 dispatch_mode=mode)
+        return (jnp.sum(y.astype(jnp.float32) ** 2) +
+                0.01 * aux).astype(jnp.float32)
+
+    grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def chain(steps):
+        gw = gate_w
+        for _ in range(steps):
+            g = grad(gw, w_up, w_down)
+            gw = gw - 1e-6 * g[0]
+        jax.block_until_ready(gw)
+
+    chain(2)  # compile + warm
+    best = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); chain(4); t1 = time.perf_counter()
+        chain(8)
+        t2 = time.perf_counter()
+        best.append((t2 - t1 - (t1 - t0)) / 4 * 1e3)
+    best.sort()
+    return best[len(best) // 2], best[0], best[-1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--d", type=int, default=512)
+    ap.add_argument("--f", type=int, default=2048)
+    ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--k", type=int, default=2)
+    args = ap.parse_args()
+    dtype = jnp.dtype(args.dtype)
+    print(f"device={jax.devices()[0].device_kind} dtype={args.dtype} "
+          f"d={args.d} f={args.f} E={args.experts} k={args.k}")
+    for n in (8192, 32768, 131072, 262144):
+        row = {}
+        for mode in ("einsum", "sort"):
+            try:
+                med, lo, hi = bench(mode, n, args.d, args.f,
+                                    args.experts, args.k, dtype)
+                row[mode] = (med, lo, hi)
+            except Exception as e:   # OOM at large n for einsum
+                row[mode] = e
+        for mode, v in row.items():
+            if isinstance(v, tuple):
+                print(f"n={n:7d} {mode:6s} {v[0]:8.2f} ms "
+                      f"[{v[1]:.2f}, {v[2]:.2f}]")
+            else:
+                print(f"n={n:7d} {mode:6s} FAILED: "
+                      f"{type(v).__name__}: {str(v)[:120]}")
+
+
+if __name__ == "__main__":
+    main()
