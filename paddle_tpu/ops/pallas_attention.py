@@ -15,9 +15,10 @@ are ignored, q rows at/past their length return 0. Training is fused
 both directions (FlashAttention-2 style): the forward saves only the
 per-row logsumexp; the backward kernels recompute each block's softmax
 from it while streaming dq per q-block and dk/dv per k-block, so HBM
-stays linear in T in BOTH passes (2.4x XLA on the T=4096 train step;
-the round-2 version fell back to the quadratic XLA vjp). Beyond one
-chip, ring attention over the `sp` mesh axis shards the same math.
+stays linear in T in BOTH passes (~2.8x XLA on the T=4096 train step
+with the round-5 exp2 softmax — see docs/perf.md; the round-2 version
+fell back to the quadratic XLA vjp). Beyond one chip, ring attention
+over the `sp` mesh axis shards the same math.
 
 Used automatically by the attention layer on TPU for tile-friendly
 shapes (head_dim % 8 == 0); `interpret=True` runs on CPU for tests.
@@ -34,6 +35,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LOG2E = 1.4426950408889634     # log2(e): fold into the dot scale so the
+LN2 = 0.6931471805599453       # online softmax runs in exp2 (one fewer
+                               # VPU pass per tile than exp)
 
 
 def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *refs,
@@ -72,16 +76,24 @@ def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *refs,
     if causal:
         interior = interior & (kk * block_k + block_k - 1 <= j * block_q)
 
-    def _online_update(s, p_mask, prec, v):
+    def _online_update(s2, p_mask, prec, v):
+        """s2 is in BASE-2 units (the dot scale carries log2(e)), so the
+        softmax runs on exp2 — the multiply by log2e rides the matmul
+        epilogue instead of costing a VPU pass over every [bq, bk] tile.
+        m/l scratches hold base-2 running max / exp2-sum; _finish
+        converts the logsumexp back to natural units for the backward.
+        (A deferred any-row-changed rescale was also tried here and
+        REJECTED: the per-tile scalar branch costs more than the two
+        rescale passes it saves — numbers in docs/perf.md.)"""
         m_old = m_scr[:]                              # [bq, 128] (bcast)
-        s_max = jnp.max(s, axis=-1, keepdims=True)    # [bq, 1]
+        s_max = jnp.max(s2, axis=-1, keepdims=True)   # [bq, 1]
         m_new = jnp.maximum(m_old, s_max)             # [bq, 128]
-        alpha = jnp.exp(m_old[:, 0:1] - m_new[:, 0:1])
-        p = jnp.exp(s - m_new[:, 0:1])                # [bq, bk]
+        alpha = jnp.exp2(m_old[:, 0:1] - m_new[:, 0:1])
+        p = jnp.exp2(s2 - m_new[:, 0:1])              # [bq, bk]
         if p_mask is not None:
             # explicit zero on masked entries: with a finite NEG_INF, a
             # row masked in EVERY block would otherwise see
-            # exp(s - m) == 1 junk
+            # exp2(s - m) == 1 junk
             p = jnp.where(p_mask, p, 0.0)
         l_new = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
@@ -96,10 +108,10 @@ def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *refs,
         k = k_ref[0]                                  # [bk, d]
         v = v_ref[0]                                  # [bk, d]
         prec = jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else None
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32,
-                                precision=prec) * scale
-        _online_update(s, None, prec, v)
+        s2 = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=prec) * (scale * LOG2E)
+        _online_update(s2, None, prec, v)
 
     @pl.when(needed & ~interior)
     def _masked_block():
@@ -111,9 +123,9 @@ def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *refs,
         # (ops/linear convention — default truncates even f32 operands)
         # but is only legal on f32 operands
         prec = jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else None
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32,
-                                precision=prec) * scale
+        s2 = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=prec) * (scale * LOG2E)
 
         # in-kernel mask from lengths (+causal) — nothing quadratic in HBM
         rows = j * block_q + jax.lax.broadcasted_iota(
@@ -123,8 +135,8 @@ def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *refs,
         valid = (rows < q_len) & (cols < kv_len)
         if causal:
             valid = valid & (cols <= rows)
-        s = jnp.where(valid, s, NEG_INF)              # [bq, bk]
-        _online_update(s, valid, prec, v)
+        s2 = jnp.where(valid, s2, NEG_INF)            # [bq, bk]
+        _online_update(s2, valid, prec, v)
 
     @pl.when(kk == nk - 1)
     def _finish():
@@ -132,9 +144,11 @@ def _flash_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *refs,
         out_ref[0] = jnp.where(l > 0.0, acc_scr[:] / jnp.maximum(l, 1e-30),
                                0.0).astype(out_ref.dtype)
         if save_lse:
-            # logsumexp per row — the backward's softmax residual
+            # logsumexp per row in NATURAL units (the backward contract):
+            # m is base-2, l is an exp2 sum -> lse = m*ln2 + ln(l)
             m = m_scr[:][:, 0:1]
-            lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)),
+            lse = jnp.where(l > 0.0,
+                            m * LN2 + jnp.log(jnp.maximum(l, 1e-30)),
                             NEG_INF)
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
@@ -189,11 +203,14 @@ def _flash_call(q3, k3, v3, lens2, *, scale, block_q, block_k, causal,
 
 def _recompute_p(q, k, lens_row, lse, jq, kk, *, scale, block_q, block_k,
                  causal):
-    """exp(S - lse) for one (q block, k block) tile, fully masked."""
+    """exp(S - lse) for one (q block, k block) tile, fully masked.
+    Computed as exp2((S - lse) * log2e) with log2e folded into the dot
+    scale — the same VPU-pass saving as the forward; lse (natural units)
+    scales by log2e on its cheap [bq, 1] column only."""
     prec = jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else None
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32,
-                            precision=prec) * scale
+    s2 = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=prec) * (scale * LOG2E)
     rows = jq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols = kk * block_k + jax.lax.broadcasted_iota(
@@ -201,7 +218,7 @@ def _recompute_p(q, k, lens_row, lse, jq, kk, *, scale, block_q, block_k,
     valid = (rows < lens_row[0]) & (cols < lens_row[1])
     if causal:
         valid = valid & (cols <= rows)
-    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    p = jnp.where(valid, jnp.exp2(s2 - lse * LOG2E), 0.0)
     return p, valid, prec
 
 
