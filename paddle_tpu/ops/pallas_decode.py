@@ -1,0 +1,105 @@
+"""Pallas decode attention — a RECORDED EXPERIMENT, not the live path.
+
+Round-5 verdict: measured and REJECTED. The decode trace (docs/perf.md,
+"the decode gap, traced") showed XLA lowering the per-step attention
+(q [b,h,dh] against cached K/V over T positions) to VPU multiply-reduce
+fusions at ~160 GB/s effective — the hypothesis was that a Pallas
+kernel, which dictates its own block tiling, could stream the cache
+with T on the lane axis at full width. Two grid shapes were measured on
+device against the einsum path inside the real decode scan (bs32,
+T=544, 6 layers):
+
+  - grid (b, h) — one step per row/head: 1.86 ms/step vs 0.92 einsum.
+    TPU Pallas grids run SEQUENTIALLY on the core; b*h tiny DMAs
+    serialize.
+  - grid (g,) — this kernel: whole-batch [b, dh, T] K/V blocks per kv
+    group, all GQA query heads inside the step: 1.50 ms/step. Fewer,
+    larger DMAs, still loses: Mosaic loops the leading batch dim and
+    the per-b [dh, T] reductions pipeline worse than XLA's fused
+    lowering of the same math.
+
+The einsum formulation in models/decode.py remains the measured
+optimum (two cache-layout variants of it also lost — see perf.md). The
+kernel stays here, correct and parity-tested
+(tests/test_decode.py::TestPallasDecodeAttention), as the starting
+point if a future round wants to hand-tune the Mosaic lowering.
+
+Cache layout contract: [b, g, dh, T]."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LOG2E = 1.4426950408889634
+
+# per-block VMEM budget for K+V (+ double buffering headroom): beyond
+# this the caller falls back to XLA rather than risk a VMEM OOM
+_VMEM_BYTES = 8 * 1024 * 1024
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *, scale,
+                   rep):
+    kv_len = lens_ref[0]
+    k = k_ref[...]                                    # [b, 1, dh, T]
+    v = v_ref[...]
+    b, _, dh, t = k.shape
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (b, 1, 1, t), 3)
+    live = cols < kv_len
+    for r in range(rep):
+        q = q_ref[:, r:r + 1].astype(jnp.float32)     # [b, 1, dh, 1]
+        s2 = jnp.sum(q * kf, axis=2, keepdims=True) * (scale * LOG2E)
+        s2 = jnp.where(live, s2, NEG_INF)             # [b, 1, 1, T]
+        m = jnp.max(s2, axis=3, keepdims=True)
+        p = jnp.exp2(s2 - m)                          # [b, 1, 1, T]
+        l = jnp.sum(p, axis=3, keepdims=True)
+        acc = jnp.sum(vf * p, axis=3, keepdims=True)  # [b, 1, dh, 1]
+        out_ref[:, r:r + 1] = (acc / l).astype(out_ref.dtype)
+
+
+def decode_supported(q, k_cache) -> bool:
+    """Tile-friendly and VMEM-sized? dh a sublane multiple; whole-batch
+    K+V group blocks within the VMEM budget."""
+    b, g, dh, t = k_cache.shape
+    esize = jnp.dtype(k_cache.dtype).itemsize
+    return dh % 8 == 0 and 2 * b * dh * t * esize <= _VMEM_BYTES
+
+
+def decode_attention(q, k_cache, v_cache, kv_len, *, scale=None,
+                     interpret=False):
+    """q [b, h, dh]; k_cache/v_cache [b, g, dh, T] with h % g == 0
+    (GQA: h == g*rep); kv_len: traced scalar — positions >= kv_len are
+    masked (decode calls always have the query at position kv_len-1, so
+    this IS the causal mask). Returns [b, h, dh]."""
+    b, h, dh = q.shape
+    g = k_cache.shape[1]
+    t = k_cache.shape[-1]
+    assert h % g == 0, (h, g)
+    rep = h // g
+    if scale is None:
+        scale = dh ** -0.5
+    q4 = q.reshape(b, h, dh, 1)
+    lens = jnp.asarray(kv_len, jnp.int32).reshape(1)
+
+    kernel = functools.partial(_decode_kernel, scale=scale, rep=rep)
+    out = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),            # lens [1]
+            pl.BlockSpec((b, rep, dh, 1), lambda j: (0, j, 0, 0)),
+            pl.BlockSpec((b, 1, dh, t), lambda j: (0, j, 0, 0)),
+            pl.BlockSpec((b, 1, dh, t), lambda j: (0, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((b, rep, dh, 1), lambda j: (0, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, dh, 1), q.dtype),
+        interpret=interpret,
+    )(lens, q4, k_cache, v_cache)
+    return out.reshape(b, h, dh)
