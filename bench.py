@@ -50,14 +50,32 @@ _PEAK_FLOPS = [
 ]
 
 
-def _device_peak_flops(dev) -> float | None:
+# Peak HBM GB/s by device_kind substring (same matching as _PEAK_FLOPS).
+_PEAK_HBM_GBPS = [
+    ("v6", 1640.0), ("trillium", 1640.0),
+    ("v5p", 2765.0), ("v5 lite", 819.0), ("v5e", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+]
+
+
+def _device_lookup(dev, table) -> float | None:
     kind = getattr(dev, "device_kind", "").lower()
     if "tpu" not in kind:
         return None
-    for key, peak in _PEAK_FLOPS:
+    for key, val in table:
         if key in kind:
-            return peak
+            return val
     return None
+
+
+def _device_peak_flops(dev) -> float | None:
+    return _device_lookup(dev, _PEAK_FLOPS)
+
+
+def _device_hbm_gbps(dev) -> float | None:
+    return _device_lookup(dev, _PEAK_HBM_GBPS)
 
 
 def _compiled_flops(compiled) -> float | None:
@@ -70,6 +88,45 @@ def _compiled_flops(compiled) -> float | None:
         return f if f > 0 else None
     except Exception:
         return None
+
+
+def _compiled_bytes(compiled) -> float | None:
+    """HBM bytes per step from the compiler's post-fusion cost analysis.
+    Pallas custom calls count at their operand/result boundary (their
+    internal streaming is invisible — same caveat as flops)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        b = float(ca.get("bytes accessed", 0.0))
+        return b if b > 0 else None
+    except Exception:
+        return None
+
+
+def _add_roofline(res, bytes_acc, flops, dev):
+    """The decode-row discipline generalized to every row: a step cannot
+    beat its HBM traffic at peak bandwidth NOR its model FLOPs at peak
+    MXU, so the BINDING bound (max of the two) is a hard per-row floor —
+    `roofline_frac` drifting up is a regression, and `roofline_bound`
+    says which resource certifies the row's ceiling."""
+    ms = res["ms"]
+    bounds = {}
+    bw = _device_hbm_gbps(dev)
+    if bytes_acc and bw:
+        bounds["hbm"] = bytes_acc / (bw * 1e9) * 1e3
+        res["hbm_gb_per_step"] = round(bytes_acc / 1e9, 4)
+        res["hbm_gbps_assumed"] = bw
+    peak = _device_peak_flops(dev)
+    from paddle_tpu.config import global_config
+    if flops and peak and global_config().compute_dtype == "bfloat16":
+        bounds["mxu"] = flops / peak * 1e3
+    if bounds:
+        binding = max(bounds, key=bounds.get)
+        res["roofline_ms"] = round(bounds[binding], 4)
+        res["roofline_bound"] = binding
+        res["roofline_frac"] = round(ms / bounds[binding], 2)
+    return res
 
 
 #: repetitions per bench row; the recorded ms is the MEDIAN of this many
@@ -187,6 +244,7 @@ def _measure(trainer, feed, batch, iters, warmup, extra_flops=0.0):
             # the peak table is dense-bf16; an f32 run has a different
             # (pass-count-dependent) ceiling, so report tflops only there
             res["mfu"] = round(tflops * 1e12 / peak, 4)
+    _add_roofline(res, _compiled_bytes(step), flops, jax.devices()[0])
     return res
 
 
@@ -363,20 +421,24 @@ def bench_flash_attention(batch: int = 4, seq_len: int = 4096, heads: int = 8,
 
 
 def bench_decode(batch: int = 8, prompt_len: int = 32, max_len: int = 544,
-                 d_model: int = 512, n_layers: int = 6, iters: int = 3):
+                 d_model: int = 512, n_layers: int = 6, iters: int = 3,
+                 n_kv_heads: int = None):
     """KV-cache autoregressive decoding throughput (tokens/sec across the
     batch) on the transformer LM. No 2017 baseline; the RNN era's
-    generation analogue is beam_search. `ms` is per-token latency."""
+    generation analogue is beam_search. `ms` is per-token latency.
+    n_kv_heads < 8 benches the GQA decoder (kv-sized caches)."""
     import time
 
     import jax
     import paddle_tpu as paddle
     from paddle_tpu import models
 
+    kv_h = n_kv_heads or 8
     spec = models.transformer_lm(vocab_size=32000, d_model=d_model,
                                  n_heads=8, n_layers=n_layers,
-                                 d_ff=4 * d_model, max_len=max_len)
-    topo = paddle.Topology(spec.cost)
+                                 d_ff=4 * d_model, max_len=max_len,
+                                 n_kv_heads=n_kv_heads)
+    topo = paddle.Topology(spec.cost, extra_outputs=[spec.output])
     params = topo.init_params(jax.random.PRNGKey(0))
     # the decoder computes in the params' dtype; cast so this row matches
     # the suite's mixed-precision mode instead of silently running f32
@@ -386,13 +448,16 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, max_len: int = 544,
         params = {k: v.astype(cdt) for k, v in params.items()}
     dec = models.TransformerDecoder(params, n_layers=n_layers, n_heads=8)
     # HBM roofline for one decode step: every step must read ALL params
-    # (batch-independent) plus each sequence's KV cache (batch-linear).
-    # Worst-case cache length = max_len; bytes/elt from the cast dtype.
+    # (batch-independent) plus each sequence's KV cache (batch-linear,
+    # kv-head-sized under GQA). Worst-case cache length = max_len;
+    # bytes/elt from the cast dtype; bandwidth from the device kind.
     esize = 2 if cdt != "float32" else 4
     param_bytes = sum(int(np.prod(v.shape)) for v in params.values()) * esize
-    cache_bytes = 2 * n_layers * max_len * d_model * esize * batch
+    cache_bytes = (2 * n_layers * max_len * (d_model * kv_h // 8)
+                   * esize * batch)
     hbm_gb = (param_bytes + cache_bytes) / 1e9
-    roofline_ms = hbm_gb / 819.0 * 1e3      # v5e ~819 GB/s
+    hbm_gbps = _device_hbm_gbps(jax.devices()[0]) or 819.0
+    roofline_ms = hbm_gb / hbm_gbps * 1e3
     prompt = np.random.RandomState(0).randint(
         0, 32000, (batch, prompt_len)).astype("int32")
     dec.generate(prompt, max_len=max_len)        # compile
@@ -417,8 +482,54 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, max_len: int = 544,
             # cache once from HBM; regressions show as roofline_frac
             # drifting up
             "hbm_gb_per_step": round(hbm_gb, 4),
+            "hbm_gbps_assumed": hbm_gbps,
             "roofline_ms": round(roofline_ms, 4),
+            "roofline_bound": "hbm",
             "roofline_frac": round(ms_tok / roofline_ms, 2)}
+
+
+def bench_moe_lm(batch: int = 8, seq_len: int = 1024, d_model: int = 512,
+                 n_layers: int = 6, experts: int = 8, iters: int = 10,
+                 warmup: int = 3):
+    """MoE transformer LM train step (sort-dispatch single-host path) —
+    the beyond-parity expert-parallel leg's regression row. Same shape
+    as transformer_lm_bs8_t1024 with every FFN an 8-expert top-2 MoE."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+    from paddle_tpu.core.sequence import SequenceBatch
+
+    spec = models.transformer_lm(vocab_size=32000, d_model=d_model,
+                                 n_heads=8, n_layers=n_layers,
+                                 d_ff=4 * d_model, max_len=seq_len,
+                                 tie_embeddings=True, moe_experts=experts)
+    params = paddle.create_parameters(
+        paddle.Topology(spec.cost, extra_outputs=[spec.output]))
+    # NOTE: no extra_layers — SGD computes extra layers INSIDE the timed
+    # step, and spec.output is the [b, T, 32000] softmax probs side
+    # branch the training forward deliberately never materializes; the
+    # dense transformer row omits it too, so adding it here would skew
+    # the comparison by ~2 GB/step of softmax traffic
+    trainer = paddle.SGD(cost=spec.cost, parameters=params,
+                         update_equation=paddle.optimizer.Adam(
+                             learning_rate=1e-4))
+    rng = np.random.RandomState(0)
+    lens = np.full((batch,), seq_len, np.int32)
+
+    def seq_feed(arr):
+        return SequenceBatch(jax.device_put(jnp.asarray(arr)),
+                             jax.device_put(jnp.asarray(lens)))
+
+    ids = rng.randint(0, 32000, (batch, seq_len + 1))
+    feed = {spec.data.name: seq_feed(ids[:, :-1].astype("int32")),
+            "tfm_positions": seq_feed(
+                np.tile(np.arange(seq_len, dtype="int32"), (batch, 1))),
+            spec.label.name: seq_feed(ids[:, 1:].astype("int32"))}
+    head_dim = d_model // 8
+    attn_fwd = n_layers * batch * 8 * (seq_len ** 2 / 2) * head_dim * 4
+    return _measure(trainer, feed, batch, iters, warmup,
+                    extra_flops=3.0 * attn_fwd)
 
 
 def main():
@@ -434,11 +545,25 @@ def main():
     paddle.init(compute_dtype=args.dtype)
     dev = jax.devices()[0]
 
+    # rows whose device step is faster than the tunnel can dispatch:
+    # the recorded ms is a DISPATCH floor, not a device number
+    # (docs/perf.md "Small-model floors" — smallnet ~0.30 ms on-device,
+    # lstm h256 ~0.25 ms; the tunnel reads 1.6-4.5 / ~2 ms)
+    FLOOR_ROWS = {"smallnet_bs128", "lstm_bs64_h256"}
+
     def _emit(name, res):
         b = BASELINES_MS.get(name)
         res = dict(res)
+        if name in FLOOR_ROWS:
+            res["floor"] = True
         if b and res["ms"] > 0:
             res["vs_baseline"] = round(b / res["ms"], 3)
+            lo, hi = res.get("min"), res.get("max")
+            if lo and hi and (hi - lo) > 0.4 * res["ms"]:
+                # spread past +-20%: self-describe the range so a
+                # downstream reader never quotes the scalar alone
+                res["vs_baseline_range"] = [round(b / hi, 3),
+                                            round(b / lo, 3)]
         print(json.dumps({"bench": name, **res}), file=sys.stderr)
         return res
 
@@ -497,6 +622,13 @@ def main():
             "decode_bs8_512tok", lambda: bench_decode())
         suite["decode_bs32_512tok"] = _row(
             "decode_bs32_512tok", lambda: bench_decode(batch=32))
+        # beyond-parity rows, driver-captured so regressions are visible
+        # (VERDICT r4: the GQA/MoE claims lived only in dev captures)
+        suite["decode_bs32_gqa"] = _row(
+            "decode_bs32_gqa",
+            lambda: bench_decode(batch=32, n_kv_heads=2))
+        suite["moe_lm_bs8_t1024"] = _row(
+            "moe_lm_bs8_t1024", lambda: bench_moe_lm(iters=half))
 
     head_name = "alexnet_bs128"
     head = suite[head_name]

@@ -63,38 +63,53 @@ def _c_env():
     return env
 
 
-@pytest.fixture(scope="session")
-def capi_lib(tmp_path_factory):
-    """The shim .so is invariant across tests — build it once."""
+_LIB_CACHE = {}
+
+
+def _build_lib(into_dir: str) -> str:
+    """Build the shim .so once per process (it is invariant across
+    tests); returns the directory holding libpaddle_tpu_capi.so."""
+    if "dir" in _LIB_CACHE:
+        return _LIB_CACHE["dir"]
     cc = shutil.which("gcc") or shutil.which("cc")
     if cc is None:
         pytest.skip("no C compiler")
     inc = sysconfig.get_path("include")
     libdir = sysconfig.get_config_var("LIBDIR")
     ver = sysconfig.get_config_var("LDVERSION")
-    d = tmp_path_factory.mktemp("capi_lib")
-    lib = str(d / "libpaddle_tpu_capi.so")
+    lib = os.path.join(into_dir, "libpaddle_tpu_capi.so")
     subprocess.run(
         [cc, "-shared", "-fPIC", os.path.join(REPO, "capi",
                                               "paddle_tpu_capi.c"),
          f"-I{inc}", f"-L{libdir}", f"-lpython{ver}",
          f"-Wl,-rpath,{libdir}", "-o", lib], check=True)
-    return str(d)
+    _LIB_CACHE["dir"] = into_dir
+    return into_dir
+
+
+@pytest.fixture(scope="session")
+def capi_lib(tmp_path_factory):
+    return _build_lib(str(tmp_path_factory.mktemp("capi_lib")))
 
 
 class TestCABI:
+    libdir = None
+
     @pytest.fixture(autouse=True)
     def _lib(self, capi_lib):
         self.libdir = capi_lib
 
     def _build(self, tmp_path, example="dense_infer"):
+        # callable standalone too (tests/test_cli.py reuses it outside
+        # the fixture machinery): build the lib on demand
+        libdir = self.libdir or _build_lib(str(tmp_path))
         cc = shutil.which("gcc") or shutil.which("cc")
         pylibdir = sysconfig.get_config_var("LIBDIR")
         exe = str(tmp_path / example)
         subprocess.run(
             [cc, os.path.join(REPO, "capi", "examples", f"{example}.c"),
-             f"-L{self.libdir}", "-lpaddle_tpu_capi", "-lpthread",
-             f"-Wl,-rpath,{self.libdir}", f"-Wl,-rpath,{pylibdir}",
+             f"-L{libdir}", "-lpaddle_tpu_capi", "-lpthread",
+             f"-Wl,-rpath,{libdir}", f"-Wl,-rpath,{pylibdir}",
              "-o", exe], check=True)
         return exe
 
