@@ -69,6 +69,18 @@ class TransformerDecoder:
         self._jitted = {}
 
     # ---------------------------------------------------------------- core
+    @staticmethod
+    def _use_flash_prefill(t, pos, dh) -> bool:
+        """Flash-prefill gate: a long (>=256) prompt on TPU with a
+        tile-friendly head dim, and the cache empty before this call
+        (pos is the static int 0 at prefill; decode steps pass traced
+        scalars and fall through to the einsum path)."""
+        from paddle_tpu.config import global_config
+        return (isinstance(pos, int) and pos == 0 and t >= 256
+                and dh % 8 == 0
+                and global_config().use_flash_attention
+                and jax.default_backend() not in ("cpu",))
+
     def _embed(self, p, ids, pos):
         n = self.name
         return (p[f"_{n}_tok_emb.w0"][ids]
@@ -91,21 +103,41 @@ class TransformerDecoder:
         t = x.shape[1]
         T = k_cache.shape[1]
         scale = dh ** -0.5
-        # grouped-query: q [b,t,(kv_h, rep),dh] against kv_h-head caches
-        # — the cache is read at its stored width, never repeated
         rep = h // kv_h
-        q5 = q.reshape(q.shape[0], t, kv_h, rep, dh)
-        logits = jnp.einsum("bqgrd,bkgd->bgrqk", q5,
-                            k_cache.astype(q.dtype)) * scale
-        # causal against absolute positions: query row j sits at pos + j
-        qpos = pos + jnp.arange(t)[:, None]
-        kpos = jnp.arange(T)[None, :]
-        mask = (kpos <= qpos) & (kpos < kv_len)
-        logits = jnp.where(mask[None, None, None], logits, -1e30)
-        w = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bgrqk,bkgd->bqgrd", w,
-                          v_cache.astype(q.dtype))
-        attn = attn.reshape(x.shape)
+        if self._use_flash_prefill(t, pos, dh):
+            # LONG-prompt prefill: the einsum path materializes a
+            # [b,g,rep,t,t] score tensor (quadratic HBM); the flash
+            # kernel streams K/V blocks instead. Only valid when the
+            # cache holds nothing before this call (pos == 0), i.e.
+            # attention is causal over exactly these t positions. GQA
+            # repeats K/V here — a one-time prefill cost, never paid
+            # per decode step.
+            from paddle_tpu.ops import pallas_attention as flash
+            kq = k if rep == 1 else jnp.repeat(k, rep, axis=2)
+            vq = v if rep == 1 else jnp.repeat(v, rep, axis=2)
+            lens = jnp.minimum(jnp.full((x.shape[0],), t, jnp.int32),
+                               kv_len)
+            attn = flash.flash_attention(
+                q.astype(x.dtype), kq.astype(x.dtype),
+                vq.astype(x.dtype), q_lens=lens, kv_lens=lens,
+                causal=True, scale=scale,
+                interpret=jax.default_backend() == "cpu")
+            attn = attn.reshape(x.shape)
+        else:
+            # grouped-query: q [b,t,(kv_h, rep),dh] against kv_h-head
+            # caches — the cache is read at stored width, never repeated
+            q5 = q.reshape(q.shape[0], t, kv_h, rep, dh)
+            logits = jnp.einsum("bqgrd,bkgd->bgrqk", q5,
+                                k_cache.astype(q.dtype)) * scale
+            # causal against absolute positions: query row j is at pos+j
+            qpos = pos + jnp.arange(t)[:, None]
+            kpos = jnp.arange(T)[None, :]
+            mask = (kpos <= qpos) & (kpos < kv_len)
+            logits = jnp.where(mask[None, None, None], logits, -1e30)
+            w = jax.nn.softmax(logits, axis=-1)
+            attn = jnp.einsum("bgrqk,bkgd->bqgrd", w,
+                              v_cache.astype(q.dtype))
+            attn = attn.reshape(x.shape)
         x = x + attn @ p[f"_{n}_l{i}_proj.w0"]
         ln2 = _ln(x, p[f"_{n}_l{i}_ln2.w0"], p[f"_{n}_l{i}_ln2.wbias"])
         if f"_{n}_l{i}_moe.gate" in p:

@@ -412,3 +412,57 @@ class TestPallasDecodeAttention:
         want = jnp.einsum("bgrk,bgdk->bgrd", w, vc).reshape(b, h, dh)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-5)
+
+
+class TestFlashPrefill:
+    """Long-prompt prefill through the flash kernel must match the
+    quadratic einsum path (models/decode.py _use_flash_prefill gate)."""
+
+    def test_prefill_logits_match_einsum(self, monkeypatch):
+        import jax.numpy as jnp
+        from paddle_tpu import models
+        paddle.init(seed=0)
+        plen, max_len, d, L = 256, 272, 64, 2
+        spec = models.transformer_lm(vocab_size=97, d_model=d, n_heads=4,
+                                     n_layers=L, d_ff=2 * d,
+                                     max_len=max_len)
+        topo = paddle.Topology(spec.cost, extra_outputs=[spec.output])
+        params = topo.init_params(jax.random.PRNGKey(0))
+        prompt = jnp.asarray(np.random.RandomState(0).randint(
+            0, 97, (2, plen)).astype("int32"))
+
+        dec = models.TransformerDecoder(params, n_layers=L, n_heads=4)
+        lg_e, _ = dec._prefill(dec.p, prompt, plen, max_len)
+
+        # force the flash gate on (CPU runs the kernel in interpret mode)
+        monkeypatch.setattr(models.TransformerDecoder,
+                            "_use_flash_prefill",
+                            staticmethod(lambda t, pos, dh:
+                                         isinstance(pos, int) and pos == 0
+                                         and t > 1))
+        lg_f, _ = dec._prefill(dec.p, prompt, plen, max_len)
+        np.testing.assert_allclose(np.asarray(lg_f), np.asarray(lg_e),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_gqa_prefill_logits_match_einsum(self, monkeypatch):
+        import jax.numpy as jnp
+        from paddle_tpu import models
+        paddle.init(seed=0)
+        plen, max_len, d, L = 256, 272, 64, 1
+        spec = models.transformer_lm(vocab_size=61, d_model=d, n_heads=4,
+                                     n_layers=L, d_ff=2 * d,
+                                     max_len=max_len, n_kv_heads=2)
+        topo = paddle.Topology(spec.cost, extra_outputs=[spec.output])
+        params = topo.init_params(jax.random.PRNGKey(1))
+        prompt = jnp.asarray(np.random.RandomState(1).randint(
+            0, 61, (2, plen)).astype("int32"))
+        dec = models.TransformerDecoder(params, n_layers=L, n_heads=4)
+        lg_e, _ = dec._prefill(dec.p, prompt, plen, max_len)
+        monkeypatch.setattr(models.TransformerDecoder,
+                            "_use_flash_prefill",
+                            staticmethod(lambda t, pos, dh:
+                                         isinstance(pos, int) and pos == 0
+                                         and t > 1))
+        lg_f, _ = dec._prefill(dec.p, prompt, plen, max_len)
+        np.testing.assert_allclose(np.asarray(lg_f), np.asarray(lg_e),
+                                   rtol=2e-4, atol=2e-4)
