@@ -76,10 +76,12 @@ class TransformerDecoder:
         (pos is the static int 0 at prefill; decode steps pass traced
         scalars and fall through to the einsum path)."""
         from paddle_tpu.config import global_config
+        from paddle_tpu.ops import pallas_attention as flash
+        probe = jax.ShapeDtypeStruct((1, t, 1, dh), jnp.float32)
         return (isinstance(pos, int) and pos == 0 and t >= 256
-                and dh % 8 == 0
+                and flash.flash_supported(probe, probe)
                 and global_config().use_flash_attention
-                and jax.default_backend() not in ("cpu",))
+                and jax.default_backend() == "tpu")
 
     def _embed(self, p, ids, pos):
         n = self.name
