@@ -43,11 +43,18 @@ def _ce_from_logits(x: jnp.ndarray, labels: jnp.ndarray,
 
 
 def _ce_logits_fwd(x, labels, a):
-    xf = x.astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(xf, axis=-1)
-    nll = lse - _gather_label(xf, labels)
+    # gather/mean read the BF16 logits and upcast after: astype commutes
+    # exactly with both, and keeping x.astype(f32) out of multi-use
+    # scope stops XLA from materializing the full-vocab f32 tensor once
+    # to share it (1 GB at [8,1024,32000] — seen in the round-5 trace);
+    # logsumexp's internal upcast fuses into its own reduction
+    lse = jax.scipy.special.logsumexp(x.astype(jnp.float32), axis=-1)
+    nll = lse - _gather_label(x, labels).astype(jnp.float32)
     if a > 0.0:
-        nll = (1.0 - a) * nll + a * (lse - jnp.mean(xf, axis=-1))
+        # single-use f32 cast: fuses into the mean's own reduction
+        # (a bf16 accumulator over 32k terms would lose precision)
+        nll = (1.0 - a) * nll + a * (
+            lse - jnp.mean(x.astype(jnp.float32), axis=-1))
     return nll, (x, labels, lse)
 
 
