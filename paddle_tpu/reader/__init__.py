@@ -132,3 +132,43 @@ class creator:
                 for line in f:
                     yield line.rstrip("\n")
         return reader
+
+    @staticmethod
+    def recordio(paths, buf_size: int = 100) -> Reader:
+        """Samples from RecordIO shard file(s) — the output of
+        dataset.*.convert() (reader.creator.recordio parity,
+        python/paddle/v2/reader/creator.py:60: buffered like the
+        reference, background-prefetching buf_size samples). `paths` is
+        a path, a comma-separated string, or a list. Records
+        deserialize with the convert() pickling; see dataset/common.py
+        for the trust note."""
+        from paddle_tpu.dataset.common import record_deserializer
+        from paddle_tpu.reader import recordio as rio
+        if isinstance(paths, str):
+            paths = paths.split(",")
+        read = rio.chunk_reader(record_deserializer)
+
+        def reader():
+            for p in paths:
+                for desc in rio.chunk_descriptors(p):
+                    yield from read(desc)
+        return buffered(reader, buf_size)
+
+    @staticmethod
+    def cloud_reader(host: str, port: int,
+                     timeout_sec: float = 600.0) -> Reader:
+        """Coordinator-dispatched samples (creator.cloud_reader parity,
+        creator.py:91 — the etcd master endpoints become the coordinator
+        address; the server side holds the shard chunk list). Chunks are
+        handed out as fault-tolerant tasks; a crashed consumer's chunk
+        re-queues on timeout."""
+        from paddle_tpu.dataset.common import record_deserializer
+        from paddle_tpu.reader import recordio as rio
+        from paddle_tpu.trainer.coordinator import connect, task_reader
+
+        def reader():
+            coord = connect(host, port)
+            yield from task_reader(
+                coord, rio.chunk_reader(record_deserializer),
+                idle_timeout=timeout_sec)()
+        return reader

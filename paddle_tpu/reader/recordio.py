@@ -132,7 +132,19 @@ def write_records(path: str, records: Iterable[bytes],
 # --------------------------------------------------------------- reading
 
 
+#: path -> ((mtime_ns, size), index) — reading chunk k re-walked every
+#: chunk header before it (O(chunks^2) over a full shard sweep); shards
+#: are immutable once written, so cache the index per file identity
+_INDEX_CACHE: dict = {}
+
+
 def _py_index(path: str) -> List[tuple]:
+    import os
+    st = os.stat(path)
+    ident = (st.st_mtime_ns, st.st_size)
+    hit = _INDEX_CACHE.get(path)
+    if hit is not None and hit[0] == ident:
+        return hit[1]
     chunks = []
     with open(path, "rb") as f:
         while True:
@@ -145,6 +157,9 @@ def _py_index(path: str) -> List[tuple]:
                 raise ValueError(f"{path}: bad chunk magic at {off}")
             chunks.append((off, n, plen, crc))
             f.seek(plen, 1)
+    if len(_INDEX_CACHE) > 256:      # bound the cache
+        _INDEX_CACHE.clear()
+    _INDEX_CACHE[path] = (ident, chunks)
     return chunks
 
 

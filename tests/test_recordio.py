@@ -104,3 +104,47 @@ class TestCoordinatorIntegration:
             idle_timeout=10.0)
         seen = sorted(reader())
         assert seen == list(range(50))
+
+
+class TestCreatorReaders:
+    """reader.creator.recordio / cloud_reader parity
+    (python/paddle/v2/reader/creator.py:60,91)."""
+
+    def test_recordio_creator_roundtrip(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.dataset import common
+
+        def src():
+            for i in range(57):
+                yield (i, [i, i + 1], float(i) / 2)
+
+        paths = common.convert(str(tmp_path), src, 10, "mini")
+        got = list(paddle.reader.creator.recordio(paths)())
+        assert sorted(got) == [(i, [i, i + 1], float(i) / 2)
+                               for i in range(57)]
+        # comma-joined string form too
+        got2 = list(paddle.reader.creator.recordio(",".join(paths))())
+        assert sorted(got2) == sorted(got)
+
+    def test_cloud_reader_drains_coordinator(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.dataset import common
+        from paddle_tpu.reader import recordio as rio
+        from paddle_tpu.trainer.coordinator import (Coordinator,
+                                                    CoordinatorServer)
+
+        def src():
+            for i in range(40):
+                yield (i,)
+
+        paths = common.convert(str(tmp_path), src, 8, "cloud")
+        descs = [d for p in paths for d in rio.chunk_descriptors(p)]
+        coord = Coordinator(descs, chunks_per_task=1, timeout_s=60.0)
+        srv = CoordinatorServer(coord).start()
+        try:
+            rdr = paddle.reader.creator.cloud_reader(
+                "127.0.0.1", srv.port, timeout_sec=30.0)
+            got = sorted(r[0] for r in rdr())
+            assert got == list(range(40))
+        finally:
+            srv.stop()
