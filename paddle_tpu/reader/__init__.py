@@ -103,6 +103,77 @@ def buffered(reader: Reader, size: int) -> Reader:
     return buffered_reader
 
 
+def xmap_readers(mapper, reader: Reader, process_num: int,
+                 buffer_size: int, order: bool = False) -> Reader:
+    """Apply `mapper` to samples with `process_num` worker threads
+    (reader.decorator.xmap_readers parity, decorator.py:233 — the
+    reference's "processes" are threads too). order=True preserves the
+    input order; otherwise samples come out as workers finish. Worker
+    exceptions re-raise in the consumer."""
+    import queue
+    import threading
+
+    end = object()
+
+    def xreader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+        errors: List[BaseException] = []
+
+        def feed():
+            try:
+                for i, s in enumerate(reader()):
+                    in_q.put((i, s))
+            except BaseException as e:   # surfaced below
+                errors.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, s = item
+                try:
+                    out_q.put((i, mapper(s)))
+                except BaseException as e:
+                    errors.append(e)
+                    out_q.put(end)
+                    return
+
+        threads = [threading.Thread(target=feed, daemon=True)] + \
+            [threading.Thread(target=work, daemon=True)
+             for _ in range(process_num)]
+        for t in threads:
+            t.start()
+
+        finished = 0
+        pending = {}
+        next_i = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            i, v = item
+            if not order:
+                yield v
+            else:
+                pending[i] = v
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+        if errors:
+            raise errors[0]
+        # order mode: indices are dense, so nothing can remain pending
+        assert not pending, "xmap_readers lost samples"
+
+    return xreader
+
+
 def cache(reader: Reader) -> Reader:
     data: List[Any] = []
     filled = [False]

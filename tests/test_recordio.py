@@ -148,3 +148,43 @@ class TestCreatorReaders:
             assert got == list(range(40))
         finally:
             srv.stop()
+
+
+class TestXmapReaders:
+    """reader.decorator.xmap_readers parity (decorator.py:233)."""
+
+    def test_unordered_maps_everything(self):
+        import paddle_tpu as paddle
+        rdr = paddle.reader.xmap_readers(lambda x: x * 2,
+                                         lambda: iter(range(50)),
+                                         process_num=4, buffer_size=8)
+        assert sorted(rdr()) == [2 * i for i in range(50)]
+
+    def test_ordered_preserves_order(self):
+        import random
+        import time
+
+        import paddle_tpu as paddle
+
+        def jitter(x):
+            time.sleep(random.random() * 0.002)   # scramble completion
+            return x + 100
+
+        rdr = paddle.reader.xmap_readers(jitter, lambda: iter(range(40)),
+                                         process_num=4, buffer_size=4,
+                                         order=True)
+        assert list(rdr()) == [i + 100 for i in range(40)]
+
+    def test_mapper_error_surfaces(self):
+        import paddle_tpu as paddle
+        import pytest as _pytest
+
+        def boom(x):
+            if x == 7:
+                raise RuntimeError("mapper blew up")
+            return x
+
+        rdr = paddle.reader.xmap_readers(boom, lambda: iter(range(20)),
+                                         process_num=2, buffer_size=4)
+        with _pytest.raises(RuntimeError, match="mapper blew up"):
+            list(rdr())
