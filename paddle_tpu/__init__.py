@@ -36,6 +36,8 @@ from paddle_tpu import activation
 from paddle_tpu import attr
 from paddle_tpu import pooling
 from paddle_tpu import evaluator
+from paddle_tpu import op            # also installs LayerOutput operators
+from paddle_tpu import model
 
 __all__ = [
     "init",
@@ -55,4 +57,6 @@ __all__ = [
     "activation",
     "attr",
     "pooling",
+    "op",
+    "model",
 ]

@@ -1,0 +1,68 @@
+"""paddle.v2.model — cloud-aware model save/load.
+
+Reference: python/paddle/v2/model.py. ``save_model`` has two modes:
+
+  - local: write ``parameters.to_tar`` to the given path (creating parent
+    directories, model.py:26 mkdir_p);
+  - cloud: every trainer calls in, but exactly one wins the coordinator's
+    save election (model.py:53 request_save_model against the Go master;
+    here trainer/coordinator.py request_save_model, service.go:474 parity)
+    and writes to ``<path>/<trainer_id>/model.tar``.
+
+The reference detects cloud mode via KUBERNETES_SERVICE_HOST + MASTER_IP
+env vars; here the coordinator endpoint comes from
+``PADDLE_TPU_COORDINATOR`` (``host:port``, the address a
+`trainer.coordinator.CoordinatorServer` prints) so the path works in any
+cluster, not just k8s.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+__all__ = ["save_model", "load_model"]
+
+# one id per trainer process, as the reference (model.py:23)
+trainer_id = str(uuid.uuid4())
+
+
+def _coordinator_endpoint():
+    ep = os.environ.get("PADDLE_TPU_COORDINATOR")
+    if not ep:
+        return None
+    host, _, port = ep.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def save_model(parameters, path: str, epoch: int = None) -> bool:
+    """Save ``parameters`` to ``path``; under a coordinator, only the
+    election winner writes. Returns True if this process saved.
+
+    ``epoch`` keys the election (one winner per epoch). The reference's
+    save_model takes no epoch — callers save once per pass — so when it
+    is omitted we key on the coordinator's current pass counter, which
+    advances as the task queue drains; a fixed default would win the
+    election once and then silently never save again."""
+    ep = _coordinator_endpoint()
+    if ep is not None:
+        from paddle_tpu.trainer.coordinator import connect
+        client = connect(*ep)
+        if epoch is None:
+            epoch = client.epoch()
+        if not client.request_save_model(epoch):
+            return False
+        path = os.path.join(path, trainer_id, "model.tar")
+
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "wb") as f:
+        parameters.to_tar(f)
+    return True
+
+
+def load_model(parameters, path: str) -> None:
+    """In-place load into an existing Parameters (model.py:71)."""
+    with open(path, "rb") as f:
+        parameters.init_from_tar(f)

@@ -1,0 +1,158 @@
+"""paddle.v2.op operator sugar + paddle.v2.model save/load parity.
+
+Reference: python/paddle/v2/op.py (unary math ops, LayerOutput operator
+overloads) and python/paddle/v2/model.py (cloud-aware save_model with the
+master's save election, local load_model).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.topology import Topology
+
+L = paddle.layer
+op = paddle.op
+
+
+def run(out, feed, seed=0):
+    topo = Topology(out)
+    params = topo.init_params(jax.random.PRNGKey(seed))
+    outs, _ = topo.forward(params, topo.init_state(), feed, mode="test",
+                           rng=jax.random.PRNGKey(seed + 1))
+    return np.asarray(outs[out.name])
+
+
+def dense(name, width):
+    return L.data(name, paddle.data_type.dense_vector(width))
+
+
+class TestUnaryMathOps:
+    @pytest.mark.parametrize("fn,ref", [
+        ("exp", np.exp), ("log", np.log), ("abs", np.abs),
+        ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+        ("tanh", np.tanh), ("square", np.square), ("sqrt", np.sqrt),
+        ("relu", lambda v: np.maximum(v, 0)),
+        ("reciprocal", lambda v: 1 / v),
+    ])
+    def test_elementwise(self, fn, ref):
+        v = np.array([[0.5, 1.0, 2.0, 3.5]], np.float32)
+        got = run(getattr(op, fn)(dense("x", 4)), {"x": v})
+        np.testing.assert_allclose(got, ref(v), rtol=1e-5, atol=1e-6)
+
+    def test_softmax(self):
+        v = np.array([[0.0, 1.0, 2.0, 3.0]], np.float32)
+        got = run(op.softmax(dense("x", 4)), {"x": v})
+        e = np.exp(v - v.max())
+        np.testing.assert_allclose(got, e / e.sum(), rtol=1e-5)
+
+
+class TestLayerOperators:
+    def setup_method(self):
+        self.av = np.array([[1.0, -2.0, 3.0]], np.float32)
+        self.bv = np.array([[0.5, 4.0, -1.0]], np.float32)
+
+    def test_add_layers(self):
+        got = run(dense("a", 3) + dense("b", 3),
+                  {"a": self.av, "b": self.bv})
+        np.testing.assert_allclose(got, self.av + self.bv, rtol=1e-6)
+
+    def test_add_scalar_both_sides(self):
+        a = dense("a", 3)
+        np.testing.assert_allclose(run(a + 2.5, {"a": self.av}),
+                                   self.av + 2.5, rtol=1e-6)
+        np.testing.assert_allclose(run(1.5 + dense("a2", 3),
+                                       {"a2": self.av}),
+                                   self.av + 1.5, rtol=1e-6)
+
+    def test_sub_scalar_is_corrected(self):
+        # the reference ADDS the constant here (op.py:89); we subtract
+        got = run(dense("a", 3) - 2.0, {"a": self.av})
+        np.testing.assert_allclose(got, self.av - 2.0, rtol=1e-6)
+
+    def test_sub_and_rsub(self):
+        np.testing.assert_allclose(
+            run(dense("a", 3) - dense("b", 3), {"a": self.av, "b": self.bv}),
+            self.av - self.bv, rtol=1e-6)
+        np.testing.assert_allclose(
+            run(3.0 - dense("a", 3), {"a": self.av}),
+            3.0 - self.av, rtol=1e-6)
+
+    def test_neg(self):
+        np.testing.assert_allclose(run(-dense("a", 3), {"a": self.av}),
+                                   -self.av, rtol=1e-6)
+
+    def test_mul_scalar(self):
+        np.testing.assert_allclose(run(dense("a", 3) * 0.5, {"a": self.av}),
+                                   self.av * 0.5, rtol=1e-6)
+        np.testing.assert_allclose(run(-2.0 * dense("a2", 3),
+                                       {"a2": self.av}),
+                                   self.av * -2.0, rtol=1e-6)
+
+    def test_mul_by_size1_layer(self):
+        w = np.array([[3.0]], np.float32)
+        got = run(dense("a", 3) * dense("w", 1),
+                  {"a": self.av, "w": w})
+        np.testing.assert_allclose(got, self.av * 3.0, rtol=1e-6)
+
+    def test_broadcast_add_size1(self):
+        w = np.array([[0.25]], np.float32)
+        got = run(dense("a", 3) + dense("w", 1),
+                  {"a": self.av, "w": w})
+        np.testing.assert_allclose(got, self.av + 0.25, rtol=1e-6)
+
+    def test_mismatched_sizes_raise(self):
+        with pytest.raises(TypeError):
+            dense("a", 3) + dense("b", 4)
+        with pytest.raises(TypeError):
+            dense("a2", 3) * dense("b2", 4)
+        with pytest.raises(TypeError):
+            dense("a3", 3) + "nope"
+
+
+def _tiny_params(seed=0):
+    from paddle_tpu.core.registry import reset_name_counters
+    reset_name_counters()
+    x = dense("x", 4)
+    out = L.fc(x, size=2, name="fc_out")
+    topo = Topology(out)
+    return paddle.Parameters(topo.init_params(jax.random.PRNGKey(seed)))
+
+
+class TestModelSaveLoad:
+    def test_local_round_trip(self, tmp_path):
+        params = _tiny_params(seed=0)
+        path = str(tmp_path / "sub" / "model.tar")
+        assert paddle.model.save_model(params, path) is True
+        fresh = _tiny_params(seed=7)   # different init: load must change it
+        name = sorted(params.names())[0]
+        before = np.asarray(fresh[name]).copy()
+        paddle.model.load_model(fresh, path)
+        assert not np.array_equal(before, np.asarray(fresh[name]))
+        np.testing.assert_array_equal(np.asarray(fresh[name]),
+                                      np.asarray(params[name]))
+        assert sorted(fresh.names()) == sorted(params.names())
+
+    def test_save_election_single_winner(self, tmp_path, monkeypatch):
+        from paddle_tpu.trainer.coordinator import (Coordinator,
+                                                    CoordinatorServer)
+        coord = Coordinator(chunks=["c0"])
+        server = CoordinatorServer(coord).start()
+        monkeypatch.setenv("PADDLE_TPU_COORDINATOR",
+                           f"127.0.0.1:{server.port}")
+        try:
+            params = _tiny_params()
+            wins = [paddle.model.save_model(params, str(tmp_path), epoch=1)
+                    for _ in range(3)]
+            assert wins.count(True) == 1
+            # the winner wrote under <path>/<trainer_id>/model.tar
+            saved = [os.path.join(r, f) for r, _, fs in os.walk(tmp_path)
+                     for f in fs]
+            assert len(saved) == 1 and saved[0].endswith("model.tar")
+            fresh = _tiny_params()
+            paddle.model.load_model(fresh, saved[0])
+        finally:
+            server.stop()
