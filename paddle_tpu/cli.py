@@ -54,11 +54,19 @@ def _load_config(path: str) -> Dict[str, Any]:
     return {"cost": list(topo.outputs)}
 
 
+def _topo_from_ns(ns: Dict[str, Any]):
+    """Topology from a config namespace: cost node(s) + extra layers."""
+    import paddle_tpu as paddle
+    cost = ns["cost"]
+    return paddle.Topology(
+        cost if isinstance(cost, (list, tuple)) else [cost],
+        extra_outputs=list(ns.get("extra_layers") or []))
+
+
 def _build_trainer(ns: Dict[str, Any], init_model_path: Optional[str]):
     import paddle_tpu as paddle
     cost = ns["cost"]
-    topo = paddle.Topology(cost if isinstance(cost, (list, tuple)) else [cost],
-                           extra_outputs=list(ns.get("extra_layers") or []))
+    topo = _topo_from_ns(ns)
     if init_model_path:
         with open(init_model_path, "rb") as f:
             parameters = paddle.Parameters.from_tar(f)
@@ -232,6 +240,13 @@ def _cmd_infer(args) -> int:
     return 0
 
 
+def _cmd_diagram(args) -> int:
+    from paddle_tpu.utils.diagram import make_diagram
+    make_diagram(_topo_from_ns(_load_config(args.config)), args.out)
+    print(json.dumps({"job": "diagram", "status": "ok", "out": args.out}))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="paddle_tpu",
@@ -241,7 +256,8 @@ def main(argv=None) -> int:
     tr.add_argument("--config", required=True,
                     help=".py config script or serialized topology .json")
     tr.add_argument("--job", default="train",
-                    choices=["train", "time", "test", "checkgrad"])
+                    choices=["train", "time", "test", "checkgrad",
+                             "dump_config"])
     tr.add_argument("--checkgrad_eps", type=float, default=1e-3,
                     help="--job=checkgrad finite-difference step")
     tr.add_argument("--use_tpu", action="store_true", default=None)
@@ -277,14 +293,27 @@ def main(argv=None) -> int:
     inf.add_argument("--batch_size", type=int, default=8)
     inf.add_argument("--seq_len", type=int, default=16,
                      help="synthetic sequence length (no --config)")
+
+    dg = sub.add_parser("diagram", help="emit a Graphviz .dot of the model "
+                        "(python/paddle/utils/make_model_diagram.py parity)")
+    dg.add_argument("--config", required=True,
+                    help=".py config script or serialized topology .json")
+    dg.add_argument("--out", required=True, help="output .dot path")
     args = ap.parse_args(argv)
 
     if args.command == "merge":
         return _cmd_merge(args)
     if args.command == "infer":
         return _cmd_infer(args)
+    if args.command == "diagram":
+        return _cmd_diagram(args)
 
     import paddle_tpu as paddle
+    if args.job == "dump_config":
+        # dump_config.py/show_pb.py parity: print the normalized topology
+        # (the JSON twin of the protobuf text dump) without training
+        print(_topo_from_ns(_load_config(args.config)).serialize())
+        return 0
     paddle.init(use_tpu=args.use_tpu, trainer_count=args.trainer_count,
                 seed=args.seed, compute_dtype=args.dtype,
                 log_period=args.log_period)

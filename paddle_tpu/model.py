@@ -39,18 +39,17 @@ def save_model(parameters, path: str, epoch: int = None) -> bool:
     """Save ``parameters`` to ``path``; under a coordinator, only the
     election winner writes. Returns True if this process saved.
 
-    ``epoch`` keys the election (one winner per epoch). The reference's
-    save_model takes no epoch — callers save once per pass — so when it
-    is omitted we key on the coordinator's current pass counter, which
-    advances as the task queue drains; a fixed default would win the
-    election once and then silently never save again."""
+    ``epoch`` keys the election (one winner per epoch). Omitted — the
+    reference's save_model takes no epoch; callers save once per pass —
+    the coordinator grants one winner per time window, resolved
+    server-side under its save lock (the Go master's
+    RequestSaveModel-with-duration semantics, service.go:474); keying on
+    a separately-read pass counter would let two trainers straddling a
+    pass turnover both win."""
     ep = _coordinator_endpoint()
     if ep is not None:
         from paddle_tpu.trainer.coordinator import connect
-        client = connect(*ep)
-        if epoch is None:
-            epoch = client.epoch()
-        if not client.request_save_model(epoch):
+        if not connect(*ep).request_save_model(epoch):
             return False
         path = os.path.join(path, trainer_id, "model.tar")
 

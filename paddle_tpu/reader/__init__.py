@@ -55,13 +55,34 @@ def map_readers(func, *readers: Reader) -> Reader:
     return reader
 
 
+class ComposeNotAligned(ValueError):
+    """Raised when composed readers yield different sample counts
+    (python/paddle/v2/reader/decorator.py:90)."""
+
+
 def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
-    """Zip several readers into tuple samples (reader.compose parity)."""
+    """Zip several readers into tuple samples (reader.compose parity).
+
+    With ``check_alignment`` (the default, as the reference), readers of
+    unequal length raise ComposeNotAligned instead of silently truncating
+    to the shortest (decorator.py:98 _check_input_not_empty zip)."""
     def make_tuple(x):
         return x if isinstance(x, tuple) else (x,)
 
+    _end = object()
+
     def reader():
-        for items in zip(*[r() for r in readers]):
+        its = [r() for r in readers]
+        if not check_alignment:
+            for items in zip(*its):
+                yield sum((make_tuple(i) for i in items), ())
+            return
+        for items in itertools.zip_longest(*its, fillvalue=_end):
+            if any(i is _end for i in items):
+                if not all(i is _end for i in items):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                return
             yield sum((make_tuple(i) for i in items), ())
     return reader
 

@@ -108,6 +108,7 @@ class Coordinator:
         self._lock = threading.Lock()
         self._save_lock = threading.Lock()
         self._saving_for_epoch = -1
+        self._last_save_grant = float("-inf")
         self._todo: List[Task] = []
         self._pending: Dict[int, Dict[str, Any]] = {}   # id -> {task, deadline}
         self._done: List[Task] = []
@@ -260,13 +261,28 @@ class Coordinator:
         return True
 
     # ------------------------------------------------------- save election
-    def request_save_model(self, epoch: int) -> bool:
-        """RequestSaveModel parity (service.go:474): exactly ONE caller per
-        epoch gets True and performs the save."""
+    def request_save_model(self, epoch: int = None,
+                           window_s: float = 30.0) -> bool:
+        """RequestSaveModel parity (service.go:474): exactly ONE caller
+        wins True and performs the save.
+
+        With an explicit ``epoch``, one winner per epoch. Without one, the
+        election is a time window exactly like the Go master's
+        (service.go RequestSaveModel dedups within the client-passed
+        duration): the first caller in a ``window_s`` span wins. The
+        window is resolved server-side under the save lock, so
+        concurrent end-of-pass callers cannot both win by observing a
+        pass counter mid-turnover."""
         with self._save_lock:
-            if self._saving_for_epoch >= epoch:
+            if epoch is not None:
+                if self._saving_for_epoch >= epoch:
+                    return False
+                self._saving_for_epoch = epoch
+                return True
+            now = time.monotonic()
+            if now - self._last_save_grant < window_s:
                 return False
-            self._saving_for_epoch = epoch
+            self._last_save_grant = now
             return True
 
 

@@ -148,10 +148,17 @@ class TestModelSaveLoad:
             wins = [paddle.model.save_model(params, str(tmp_path), epoch=1)
                     for _ in range(3)]
             assert wins.count(True) == 1
-            # the winner wrote under <path>/<trainer_id>/model.tar
+            # reference-style call with NO epoch: the server-side time
+            # window (service.go RequestSaveModel duration) dedups —
+            # still exactly one winner, resolved under the save lock
+            wins = [paddle.model.save_model(params, str(tmp_path / "w"))
+                    for _ in range(3)]
+            assert wins.count(True) == 1
+            # each winner wrote under <path>/<trainer_id>/model.tar
             saved = [os.path.join(r, f) for r, _, fs in os.walk(tmp_path)
                      for f in fs]
-            assert len(saved) == 1 and saved[0].endswith("model.tar")
+            assert len(saved) == 2 and all(p.endswith("model.tar")
+                                           for p in saved)
             fresh = _tiny_params()
             paddle.model.load_model(fresh, saved[0])
         finally:

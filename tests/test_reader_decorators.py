@@ -1,0 +1,64 @@
+"""Reader decorator parity (python/paddle/v2/reader/decorator.py).
+
+The reference's test file is python/paddle/v2/reader/tests/decorator_test.py;
+these mirror its cases: compose alignment (incl. ComposeNotAligned), chain,
+map_readers, buffered order preservation, firstn, shuffle buffering.
+"""
+
+import pytest
+
+import paddle_tpu as paddle
+
+R = paddle.reader
+
+
+def counts(n):
+    def reader():
+        return iter(range(n))
+    return reader
+
+
+class TestCompose:
+    def test_tuples_flattened(self):
+        rdr = R.compose(counts(3), lambda: iter([(10, 11), (20, 21),
+                                                 (30, 31)]))
+        assert list(rdr()) == [(0, 10, 11), (1, 20, 21), (2, 30, 31)]
+
+    def test_misaligned_raises(self):
+        rdr = R.compose(counts(3), counts(5))
+        with pytest.raises(R.ComposeNotAligned):
+            list(rdr())
+
+    def test_unchecked_truncates(self):
+        rdr = R.compose(counts(3), counts(5), check_alignment=False)
+        assert list(rdr()) == [(0, 0), (1, 1), (2, 2)]
+
+
+class TestDecorators:
+    def test_chain(self):
+        assert list(R.chain(counts(2), counts(3))()) == [0, 1, 0, 1, 2]
+
+    def test_map_readers(self):
+        got = list(R.map_readers(lambda a, b: a + b, counts(4), counts(4))())
+        assert got == [0, 2, 4, 6]
+
+    def test_buffered_preserves_order(self):
+        assert list(R.buffered(counts(100), 10)()) == list(range(100))
+
+    def test_firstn(self):
+        assert list(R.firstn(counts(100), 5)()) == [0, 1, 2, 3, 4]
+
+    def test_shuffle_is_permutation(self):
+        got = list(R.shuffle(counts(50), buf_size=16, seed=0)())
+        assert sorted(got) == list(range(50)) and got != list(range(50))
+
+    def test_cache_replays(self):
+        calls = []
+
+        def once():
+            calls.append(1)
+            return iter(range(4))
+
+        rdr = R.cache(once)
+        assert list(rdr()) == list(rdr()) == [0, 1, 2, 3]
+        assert len(calls) == 1

@@ -1,0 +1,172 @@
+"""User-tool parity: model diagram (make_model_diagram.py), torch weight
+import (torch2paddle.py), plotcurve (plotcurve.py), and the CLI
+dump_config job (dump_config.py / show_pb.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.topology import Topology
+from paddle_tpu.utils.diagram import make_diagram, topology_to_dot
+from paddle_tpu.utils.torch_import import import_torch_state_dict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+L = paddle.layer
+
+
+def small_topo():
+    x = L.data("pixel", paddle.data_type.dense_vector(8))
+    h = L.fc(x, size=4, act=paddle.activation.Relu(), name="hidden")
+    out = L.fc(h, size=2, act=paddle.activation.Softmax(), name="prob")
+    lbl = L.data("label", paddle.data_type.integer_value(2))
+    cost = L.classification_cost(out, lbl, name="cost")
+    return Topology(cost)
+
+
+class TestDiagram:
+    def test_dot_structure(self):
+        dot = topology_to_dot(small_topo(), "net")
+        assert dot.startswith('digraph "net"')
+        for node in ("pixel", "hidden", "prob", "cost"):
+            assert f'"{node}"' in dot
+        assert '"pixel" -> "hidden"' in dot
+        assert '"prob" -> "cost"' in dot
+        assert "shape=oval" in dot            # data layers
+        assert "peripheries=2" in dot         # output head
+
+    def test_roundtrip_through_serialized_json(self, tmp_path):
+        topo = small_topo()
+        cfg = tmp_path / "model.json"
+        cfg.write_text(topo.serialize())
+        dot = make_diagram(str(cfg), str(tmp_path / "m.dot"))
+        assert (tmp_path / "m.dot").read_text() == dot
+        assert '"hidden"' in dot
+
+
+class TestTorchImport:
+    def _params(self):
+        from paddle_tpu.core.registry import reset_name_counters
+        reset_name_counters()
+        return paddle.create_parameters(small_topo())
+
+    def test_positional_import_transposes_linear(self):
+        torch = pytest.importorskip("torch")
+        params = self._params()
+        names = list(params.names())
+        sd = {}
+        mapping = {}
+        for i, n in enumerate(names):
+            shape = params.get_shape(n)
+            t = torch.randn(*(tuple(reversed(shape)) if len(shape) == 2
+                              else shape))
+            sd[f"t{i}"] = t
+            mapping[n] = f"t{i}"
+        count = import_torch_state_dict(params, sd, name_map=mapping)
+        assert count == len(names)
+        for i, n in enumerate(names):
+            src = sd[f"t{i}"].numpy()
+            got = np.asarray(params[n])
+            want = src.T if src.ndim == 2 else src
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_shape_mismatch_raises(self):
+        torch = pytest.importorskip("torch")
+        params = self._params()
+        name = list(params.names())[0]
+        with pytest.raises(ValueError):
+            import_torch_state_dict(params, {"w": torch.randn(3, 5, 7)},
+                                    name_map={name: "w"})
+
+    def test_positional_count_mismatch(self):
+        params = self._params()
+        with pytest.raises(ValueError):
+            import_torch_state_dict(params, {"only_one": np.zeros((2, 2))})
+
+    def test_square_matrix_warns_and_transpose_true_forces(self):
+        # a square Linear weight is layout-ambiguous under 'auto': the
+        # exact-match branch keeps it as-is but must warn; transpose=True
+        # is the explicit escape hatch
+        from paddle_tpu.core.registry import reset_name_counters
+        reset_name_counters()
+        x = L.data("x", paddle.data_type.dense_vector(4))
+        out = L.fc(x, size=4, bias_attr=False, name="sq")
+        params = paddle.create_parameters(Topology(out))
+        name = list(params.names())[0]
+        src = np.arange(16, dtype=np.float32).reshape(4, 4)
+        with pytest.warns(UserWarning, match="square"):
+            import_torch_state_dict(params, {"w": src},
+                                    name_map={name: "w"})
+        np.testing.assert_array_equal(np.asarray(params[name]), src)
+        import_torch_state_dict(params, {"w": src}, name_map={name: "w"},
+                                transpose=True)
+        np.testing.assert_array_equal(np.asarray(params[name]), src.T)
+
+    def test_transpose_false_requires_exact(self):
+        params = self._params()
+        fc_w = [n for n in params.names()
+                if params.get_shape(n) == (8, 4)][0]
+        with pytest.raises(ValueError):
+            import_torch_state_dict(params, {"w": np.zeros((4, 8),
+                                                           np.float32)},
+                                    name_map={fc_w: "w"}, transpose=False)
+
+
+class TestPlotcurve:
+    def test_parses_cli_and_demo_formats(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import plotcurve
+        finally:
+            sys.path.pop(0)
+        log = [
+            "Pass 0, Batch 0, Cost 2.400000, {}",
+            "Pass 0, Batch 100, Cost 1.600000, {}",
+            "pass 1 batch 16 cost 0.3622 cost=0.362165 error=0",
+            "noise line",
+        ]
+        pts = plotcurve.parse(log)
+        assert pts == [(0, 2.4), (0, 1.6), (1, 0.362165)]
+        curve = plotcurve.per_pass_avg(pts)
+        assert curve[0] == (0, 2.0) and curve[1][0] == 1
+        csv = tmp_path / "c.csv"
+        logf = tmp_path / "train.log"
+        logf.write_text("\n".join(log))
+        assert plotcurve.main([str(logf), "--csv", str(csv)]) == 0
+        body = csv.read_text().splitlines()
+        assert body[0] == "pass,avg_cost" and body[1] == "0,2.000000"
+
+
+class TestDumpConfig:
+    def test_dump_config_prints_topology_json(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        cfg = os.path.join(REPO, "demo", "mnist", "config.py")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.cli", "train",
+             "--config", cfg, "--job", "dump_config"],
+            capture_output=True, text=True, timeout=600, env=env)
+        assert r.returncode == 0, r.stderr[-2000:]
+        blob = json.loads(r.stdout)
+        assert "layers" in blob and len(blob["layers"]) >= 3
+
+    def test_diagram_subcommand(self, tmp_path):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        cfg = os.path.join(REPO, "demo", "mnist", "config.py")
+        out = str(tmp_path / "mnist.dot")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.cli", "diagram",
+             "--config", cfg, "--out", out],
+            capture_output=True, text=True, timeout=600, env=env)
+        assert r.returncode == 0, r.stderr[-2000:]
+        dot = open(out).read()
+        assert dot.startswith("digraph") and "->" in dot

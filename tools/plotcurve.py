@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Plot the training cost curve from trainer logs
+(python/paddle/utils/plotcurve.py).
+
+The reference greps ``AvgCost=...`` out of `paddle train` stdout and plots
+passes x cost with matplotlib. The CLI here prints
+``Pass P, Batch B, Cost C, ...`` lines (cli.py _job_train) and demo scripts
+print ``pass P ... cost=C``; both forms are parsed. Usage:
+
+    python tools/plotcurve.py [-o curve.png] [--csv curve.csv] [log ...]
+
+Reads stdin when no log file is given, exactly like the reference
+(plotcurve.py: "cat train.log | python plotcurve.py"). Without matplotlib
+(not in the TPU image) it falls back to --csv / stdout so the data is
+still usable.
+"""
+
+import argparse
+import re
+import sys
+
+# "Pass 3, Batch 120, Cost 0.482911, ..." (cli) / "... cost=0.4829 ..." (demos)
+_PAT = re.compile(
+    r"[Pp]ass\s+(\d+).*?(?:Cost\s+|cost=)([0-9]+(?:\.[0-9]+)?(?:[eE][+-]?\d+)?)")
+
+
+def parse(lines):
+    """-> list of (pass_id, cost) in log order."""
+    points = []
+    for line in lines:
+        m = _PAT.search(line)
+        if m:
+            points.append((int(m.group(1)), float(m.group(2))))
+    return points
+
+
+def per_pass_avg(points):
+    sums = {}
+    for p, c in points:
+        sums.setdefault(p, []).append(c)
+    return sorted((p, sum(cs) / len(cs)) for p, cs in sums.items())
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logs", nargs="*", help="log files (default: stdin)")
+    ap.add_argument("-o", "--output", help="output image (needs matplotlib)")
+    ap.add_argument("--csv", help="write pass,avg_cost CSV here")
+    args = ap.parse_args(argv)
+
+    lines = []
+    if args.logs:
+        for path in args.logs:
+            with open(path) as f:
+                lines.extend(f)
+    else:
+        lines = sys.stdin.readlines()
+
+    points = parse(lines)
+    if not points:
+        print("no cost lines found", file=sys.stderr)
+        return 1
+    curve = per_pass_avg(points)
+
+    if args.csv:
+        with open(args.csv, "w") as f:
+            f.write("pass,avg_cost\n")
+            for p, c in curve:
+                f.write(f"{p},{c:.6f}\n")
+    if args.output:
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except ImportError:
+            print("matplotlib unavailable; use --csv instead",
+                  file=sys.stderr)
+            return 1
+        xs, ys = zip(*curve)
+        plt.plot(xs, ys, marker="o")
+        plt.xlabel("pass")
+        plt.ylabel("avg cost")
+        plt.savefig(args.output)
+    if not args.output and not args.csv:
+        for p, c in curve:
+            print(f"pass {p}: avg cost {c:.6f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
