@@ -213,6 +213,14 @@ class Topology:
         mirrors Topology.data_layers in v2/topology.py)."""
         return {l.name: l for l in self.layers if l.type == "data"}
 
+    def get_layer(self, name: str) -> LayerOutput:
+        """The layer node by name (v2/topology.py Topology.get_layer;
+        pinned by the reference's test_topology.py test_get_layer)."""
+        if name not in self.by_name:
+            raise ValueError(f"layer {name!r} not in topology; have "
+                             f"{sorted(self.by_name)}")
+        return self.by_name[name]
+
     def data_type(self):
         """[(name, InputType)] — v2 API compatibility for DataFeeder."""
         from paddle_tpu.core import data_type as dt

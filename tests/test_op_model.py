@@ -113,6 +113,53 @@ class TestLayerOperators:
             dense("a3", 3) + "nope"
 
 
+class TestReferenceOpChain:
+    def test_full_chain_builds_and_runs(self):
+        """The reference's OpTest.test_op chain (v2/tests/test_op.py:22)
+        — every unary op and operator form in one expression graph; the
+        reference only parses it, here it also executes."""
+        xv = np.array([[0.3, 1.2, 2.1, 0.7]], np.float32)
+        zv = np.array([[2.0]], np.float32)
+        x = dense("data", 4)
+        for fn in (op.exp, op.sqrt, op.reciprocal, op.log, op.abs,
+                   op.sigmoid, op.tanh, op.square, op.relu):
+            x = fn(x)
+        y = 1 + x
+        y = y + 1
+        y = x + y
+        y = y - x
+        y = y - 2
+        y = 2 - y
+        y = 2 * y
+        y = y * 3
+        z = dense("data_2", 1)
+        y = y * z
+        y = z * y
+        y = y + z
+        y = z + y
+        got = run(y, {"data": xv, "data_2": zv})
+
+        v = xv
+        for f in (np.exp, np.sqrt, lambda a: 1 / a, np.log, np.abs,
+                  lambda a: 1 / (1 + np.exp(-a)), np.tanh, np.square,
+                  lambda a: np.maximum(a, 0)):
+            v = f(v)
+        w = 1 + v
+        w = w + 1
+        w = v + w
+        w = w - v
+        w = w - 2
+        w = 2 - w
+        w = 2 * w
+        w = w * 3
+        w = w * zv
+        w = zv * w
+        w = w + zv
+        w = zv + w
+        assert got.shape == (1, 4)
+        np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
 def _tiny_params(seed=0):
     from paddle_tpu.core.registry import reset_name_counters
     reset_name_counters()

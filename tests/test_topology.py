@@ -25,6 +25,25 @@ def _mlp():
 
 
 class TestTopology:
+    def test_get_layer(self):
+        # test_topology.py test_get_layer parity: lookup returns the very
+        # node the DSL call produced; unknown names raise
+        cost, out = _mlp()
+        topo = Topology(cost)
+        assert topo.get_layer("hidden") is topo.by_name["hidden"]
+        assert topo.get_layer("output") is out
+        import pytest
+        with pytest.raises(ValueError):
+            topo.get_layer("nope")
+
+    def test_data_type_contract(self):
+        # test_topology.py test_data_type parity: two data layers with
+        # kind + dim preserved in feeding order
+        cost, _ = _mlp()
+        types = dict(Topology(cost).data_type())
+        assert types["pixel"].kind == "dense" and types["pixel"].dim == 16
+        assert types["label"].kind == "integer" and types["label"].dim == 4
+
     def test_build_and_forward(self, rng):
         cost, out = _mlp()
         topo = Topology(cost)
