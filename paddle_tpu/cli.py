@@ -134,6 +134,53 @@ def _job_time(trainer, batch_size: int, iters: int,
     return 0
 
 
+def _job_profile(trainer, args) -> int:
+    """Profile train steps into an xplane trace (--job=profile).
+
+    The reference's profiling loop is Stat.h timers printed at pass end
+    (SURVEY §5 tracing); the TPU-native loop is jax.profiler -> .xplane.pb
+    -> tools/xplane_top.py kernel summary. This verb runs warmup + traced
+    steps on synthetic data shaped by the config and prints where the
+    trace landed (plus the top-op summary when the xplane reader is
+    importable)."""
+    import jax
+    batch = _synthetic_batch(trainer, args.batch_size, args.seq_len)
+
+    def reader():
+        while True:
+            yield batch
+
+    out = args.profile_dir or os.path.join(".", "profile_out")
+    os.makedirs(out, exist_ok=True)
+    # warmup pass outside the trace so compile time doesn't pollute it
+    trainer.train(reader, num_passes=1, event_handler=lambda e: None,
+                  num_batches_per_pass=2)
+    with jax.profiler.trace(out):
+        trainer.train(reader, num_passes=1, event_handler=lambda e: None,
+                      num_batches_per_pass=args.iters)
+    import glob as _glob
+    # lexicographic sort, matching tools/xplane_top.load(), so the path
+    # reported here IS the file the summary below reads
+    xs = sorted(_glob.glob(os.path.join(out, "**", "*.xplane.pb"),
+                           recursive=True))
+    print(json.dumps({"job": "profile", "status": "ok",
+                      "trace_dir": out,
+                      "xplane": xs[-1] if xs else None,
+                      "iters": args.iters}))
+    if xs:
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))), "tools"))
+            import xplane_top
+            xplane_top.top_ops(xplane_top.load(out), 15)
+        except Exception as e:      # tf/tsl absent: the trace still stands
+            print(f"(xplane summary unavailable: {e})", file=sys.stderr)
+        finally:
+            if sys.path and sys.path[0].endswith("tools"):
+                sys.path.pop(0)
+    return 0
+
+
 def _job_train(trainer, ns, args) -> int:
     import paddle_tpu as paddle
     reader = ns.get("train_reader")
@@ -257,7 +304,7 @@ def main(argv=None) -> int:
                     help=".py config script or serialized topology .json")
     tr.add_argument("--job", default="train",
                     choices=["train", "time", "test", "checkgrad",
-                             "dump_config"])
+                             "dump_config", "profile"])
     tr.add_argument("--checkgrad_eps", type=float, default=1e-3,
                     help="--job=checkgrad finite-difference step")
     tr.add_argument("--use_tpu", action="store_true", default=None)
@@ -274,6 +321,9 @@ def main(argv=None) -> int:
     tr.add_argument("--init_model_path", default=None,
                     help="params.tar to start from")
     tr.add_argument("--log_period", type=int, default=100)
+    tr.add_argument("--profile_dir", default=None,
+                    help="--job=profile trace output dir "
+                         "(default ./profile_out)")
     tr.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
     tr.add_argument("--seed", type=int, default=0)
@@ -326,6 +376,8 @@ def main(argv=None) -> int:
         return _job_test(trainer, ns)
     if args.job == "checkgrad":
         return _job_checkgrad(trainer, ns, args)
+    if args.job == "profile":
+        return _job_profile(trainer, args)
     return _job_train(trainer, ns, args)
 
 

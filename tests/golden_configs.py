@@ -334,6 +334,22 @@ def moe_block():
     return [L.cross_entropy_cost(head, lbl, name="moe_ce"), aux]
 
 
+def op_sugar_net():
+    """paddle.op operator overloads (v2/op.py parity): the graphs
+    `a+b`, `a*w`, `2-x`, `op.tanh` lower to — pinned so the sugar's
+    auto-named slope_intercept/featmap_expand/scaling/addto chain
+    can't drift silently."""
+    from paddle_tpu import op
+    a = L.data("a", D.dense_vector(6))
+    b = L.data("b", D.dense_vector(6))
+    w = L.data("w", D.dense_vector(1))
+    y = op.tanh(a) + b          # addto of equal sizes
+    y = 2.0 - y                 # single slope_intercept (slope+intercept)
+    y = y * w                   # scaling by the size-1 layer
+    y = y + w                   # featmap_expand broadcast + addto
+    return L.fc(y, size=3, name="op_head")
+
+
 def tpu_stem_net():
     """space_to_depth stem (resnet tpu_stem variant's shape chain)."""
     img = L.data("im", D.dense_vector(3 * 8 * 8), height=8, width=8)
@@ -374,5 +390,6 @@ CONFIGS = {
     "switch_order_net": switch_order_net,
     "beam_cost_net": beam_cost_net,
     "moe_block": moe_block,
+    "op_sugar_net": op_sugar_net,
     "tpu_stem_net": tpu_stem_net,
 }

@@ -41,6 +41,19 @@ class TestCLI:
         assert rec["metric"] == "train_ms_per_batch"
         assert rec["value"] > 0
 
+    def test_job_profile_writes_xplane(self, tmp_path):
+        prof = str(tmp_path / "prof")
+        r = _run_cli(["train", "--config", CONFIG, "--job", "profile",
+                      "--batch_size", "16", "--iters", "3",
+                      "--profile_dir", prof])
+        assert r.returncode == 0, r.stderr[-2000:]
+        line = [l for l in r.stdout.splitlines() if l.startswith("{")][0]
+        rec = json.loads(line)
+        assert rec["job"] == "profile" and rec["status"] == "ok"
+        # the CPU backend also emits xplane traces, so the artifact must
+        # exist even in the virtual-device test lane
+        assert rec["xplane"] and os.path.exists(rec["xplane"])
+
     def test_job_train_saves_and_test_restores(self, tmp_path):
         save = str(tmp_path / "out")
         r = _run_cli(["train", "--config", CONFIG, "--job", "train",
