@@ -74,6 +74,7 @@ def main(argv=None):
     params = paddle.create_parameters(
         paddle.Topology(enc.cost, extra_outputs=[enc.output]))
     pre = paddle.SGD(cost=enc.cost, parameters=params,
+                     extra_layers=[enc.output],
                      update_equation=paddle.optimizer.Adam(
                          learning_rate=3e-3))
     mlm_losses = []
