@@ -181,8 +181,10 @@ class TestTransformerLM:
         paddle.init(seed=0)
         spec = models.transformer_lm(vocab_size=50, d_model=32, n_heads=4,
                                      n_layers=2, d_ff=64, max_len=32)
-        params = paddle.create_parameters(paddle.Topology(spec.cost))
+        params = paddle.create_parameters(
+            paddle.Topology(spec.cost, extra_outputs=[spec.output]))
         tr = paddle.SGD(cost=spec.cost, parameters=params,
+                        extra_layers=[spec.output],
                         update_equation=paddle.optimizer.Adam(
                             learning_rate=3e-3))
         rng = np.random.RandomState(0)
@@ -208,7 +210,7 @@ class TestTransformerOptions:
         spec = M.transformer_lm(vocab_size=40, d_model=16, n_heads=2,
                                 n_layers=1, d_ff=32, max_len=16,
                                 dropout=0.2)
-        topo = paddle.Topology(spec.cost)
+        topo = paddle.Topology(spec.cost, extra_outputs=[spec.output])
         params = topo.init_params()
         from paddle_tpu.core.sequence import SequenceBatch
         import jax.numpy as jnp
