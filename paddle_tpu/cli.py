@@ -287,6 +287,49 @@ def _cmd_infer(args) -> int:
     return 0
 
 
+def _cmd_coordinator(args) -> int:
+    """Run the elastic-training coordinator as a daemon — the
+    `paddle_master` binary's role (go/cmd/master/master.go): partition
+    RecordIO chunks into tasks, serve GetTask/TaskFinished/TaskFailed +
+    the save election over RPC, snapshot state for crash recovery."""
+    import glob as _glob
+    import signal
+
+    from paddle_tpu.reader import recordio as rio
+    from paddle_tpu.trainer.coordinator import (Coordinator,
+                                                CoordinatorServer,
+                                                FileStore)
+    # de-dup: overlapping globs must not serve the same chunk twice
+    paths = sorted({p for pat in args.data for p in _glob.glob(pat)})
+    if not paths:
+        raise SystemExit(f"no files match --data {args.data}")
+    chunks = [d for p in paths for d in rio.chunk_descriptors(p)]
+    store = FileStore(args.snapshot) if args.snapshot else None
+    coord = Coordinator(chunks, chunks_per_task=args.chunks_per_task,
+                        timeout_s=args.task_timeout,
+                        failure_max=args.failure_max, store=store)
+    server = CoordinatorServer(coord, host=args.host, port=args.port)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    server.start()
+    # report the coordinator's ACTUAL state: after snapshot recovery it
+    # serves the recovered chunk list, not this invocation's --data
+    recovered = coord._chunks != chunks or \
+        coord._chunks_per_task != args.chunks_per_task
+    print(json.dumps({"job": "coordinator", "status": "serving",
+                      "host": args.host, "port": server.port,
+                      "files": len(paths), "chunks": len(coord._chunks),
+                      "chunks_per_task": coord._chunks_per_task,
+                      "recovered": recovered}), flush=True)
+    while not stop:
+        time.sleep(0.2)
+    server.stop()
+    print(json.dumps({"job": "coordinator", "status": "stopped"}))
+    return 0
+
+
 def _cmd_diagram(args) -> int:
     from paddle_tpu.utils.diagram import make_diagram
     make_diagram(_topo_from_ns(_load_config(args.config)), args.out)
@@ -344,6 +387,21 @@ def main(argv=None) -> int:
     inf.add_argument("--seq_len", type=int, default=16,
                      help="synthetic sequence length (no --config)")
 
+    sub.add_parser("version", help="print version (paddle version parity)")
+
+    co = sub.add_parser("coordinator", help="run the elastic-training "
+                        "coordinator daemon (go/cmd/master parity)")
+    co.add_argument("--data", nargs="+", required=True,
+                    help="RecordIO file paths or globs to partition")
+    co.add_argument("--chunks_per_task", type=int, default=1)
+    co.add_argument("--host", default="127.0.0.1")
+    co.add_argument("--port", type=int, default=0,
+                    help="0 picks a free port (printed as JSON)")
+    co.add_argument("--task_timeout", type=float, default=60.0)
+    co.add_argument("--failure_max", type=int, default=3)
+    co.add_argument("--snapshot", default=None,
+                    help="dir for crash-recovery snapshots (FileStore)")
+
     dg = sub.add_parser("diagram", help="emit a Graphviz .dot of the model "
                         "(python/paddle/utils/make_model_diagram.py parity)")
     dg.add_argument("--config", required=True,
@@ -357,6 +415,13 @@ def main(argv=None) -> int:
         return _cmd_infer(args)
     if args.command == "diagram":
         return _cmd_diagram(args)
+    if args.command == "coordinator":
+        return _cmd_coordinator(args)
+    if args.command == "version":
+        import paddle_tpu
+        print(json.dumps({"version": paddle_tpu.__version__,
+                          "framework": "paddle_tpu"}))
+        return 0
 
     import paddle_tpu as paddle
     if args.job == "dump_config":

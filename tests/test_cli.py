@@ -54,6 +54,73 @@ class TestCLI:
         # exist even in the virtual-device test lane
         assert rec["xplane"] and os.path.exists(rec["xplane"])
 
+    def test_version(self):
+        r = _run_cli(["version"])
+        assert r.returncode == 0, r.stderr[-500:]
+        rec = json.loads(r.stdout.strip().splitlines()[-1])
+        assert rec["framework"] == "paddle_tpu" and rec["version"]
+
+    def test_coordinator_daemon(self, tmp_path):
+        """`paddle_tpu coordinator` is the paddle_master binary's role
+        (go/cmd/master): partition a RecordIO file, serve tasks over
+        RPC, stop cleanly on SIGTERM."""
+        import signal
+        import time as _time
+
+        from paddle_tpu.reader import recordio as rio
+        from paddle_tpu.trainer.coordinator import connect
+
+        data = str(tmp_path / "train.ptr")
+        rio.write_records(data, [f"r{i}".encode() for i in range(64)],
+                          max_chunk_bytes=256)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.cli", "coordinator",
+             "--data", data, "--chunks_per_task", "2",
+             "--snapshot", str(tmp_path / "snap")],
+            stdout=subprocess.PIPE, text=True, env=env)
+        try:
+            line = proc.stdout.readline()
+            rec = json.loads(line)
+            assert rec["status"] == "serving" and rec["chunks"] >= 2
+            client = connect("127.0.0.1", rec["port"])
+            task = client.get_task(0)     # epoch-0 task request
+            assert task and task["chunks"]
+            client.task_finished(task["task_id"])
+            assert os.path.isdir(str(tmp_path / "snap"))
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise
+        assert proc.returncode == 0
+
+        # restart against the same snapshot: the daemon must recover the
+        # dispatched-task state (service.go recover:166) and SAY so
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.cli", "coordinator",
+             "--data", data, "--chunks_per_task", "4",
+             "--snapshot", str(tmp_path / "snap")],
+            stdout=subprocess.PIPE, text=True, env=env)
+        try:
+            rec2 = json.loads(proc.stdout.readline())
+            assert rec2["status"] == "serving"
+            assert rec2["recovered"] is True
+            # the snapshot's partitioning wins over the new CLI args
+            assert rec2["chunks_per_task"] == 2
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise
+        assert proc.returncode == 0
+
     def test_job_train_saves_and_test_restores(self, tmp_path):
         save = str(tmp_path / "out")
         r = _run_cli(["train", "--config", CONFIG, "--job", "train",
