@@ -342,7 +342,8 @@ def main(argv=None) -> int:
         prog="paddle_tpu",
         description="TPU-native trainer CLI (paddle train parity)")
     sub = ap.add_subparsers(dest="command", required=True)
-    tr = sub.add_parser("train", help="train / time / test / checkgrad")
+    tr = sub.add_parser("train", help="train / time / test / checkgrad / "
+                        "dump_config / profile")
     tr.add_argument("--config", required=True,
                     help=".py config script or serialized topology .json")
     tr.add_argument("--job", default="train",

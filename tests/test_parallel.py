@@ -142,8 +142,10 @@ class TestThreeAxisMesh:
             spec = models.transformer_lm(vocab_size=64, d_model=32,
                                          n_heads=4, n_layers=2, d_ff=64,
                                          max_len=32)
-            params = paddle.create_parameters(paddle.Topology(spec.cost))
+            params = paddle.create_parameters(
+                paddle.Topology(spec.cost, extra_outputs=[spec.output]))
             tr = paddle.SGD(cost=spec.cost, parameters=params,
+                            extra_layers=[spec.output],
                             update_equation=paddle.optimizer.Adam(
                                 learning_rate=1e-3),
                             mesh=mesh)
