@@ -17,7 +17,7 @@ Reference parity map (reference file:line cites live in each module):
   - python/paddle/v2 API             -> paddle_tpu (this package's top level)
 """
 
-__version__ = "0.3.0"
+__version__ = "0.5.0"
 
 from paddle_tpu import config as _config
 from paddle_tpu.config import init
