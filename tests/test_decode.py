@@ -304,8 +304,10 @@ class TestTiedEmbeddings:
                 [prefix, want[:, None].astype("int32")], axis=1)
 
         # one SGD step moves the shared table with grads from BOTH uses
-        ps = paddle.create_parameters(paddle.Topology(spec.cost))
+        ps = paddle.create_parameters(
+            paddle.Topology(spec.cost, extra_outputs=[spec.output]))
         tr = paddle.SGD(cost=spec.cost, parameters=ps,
+                        extra_layers=[spec.output],
                         update_equation=paddle.optimizer.Adam(
                             learning_rate=1e-3))
         T = 8
@@ -349,8 +351,10 @@ class TestGroupedQueryAttention:
 
     def test_gqa_trains(self):
         spec, topo, params = _model(n_kv_heads=1)
-        ps = paddle.create_parameters(paddle.Topology(spec.cost))
+        ps = paddle.create_parameters(
+            paddle.Topology(spec.cost, extra_outputs=[spec.output]))
         tr = paddle.SGD(cost=spec.cost, parameters=ps,
+                        extra_layers=[spec.output],
                         update_equation=paddle.optimizer.Adam(
                             learning_rate=1e-3))
         rng = np.random.RandomState(0)
