@@ -139,8 +139,10 @@ def _train(mesh, steps=3, experts=4):
     spec = models.transformer_lm(vocab_size=50, d_model=16, n_heads=2,
                                  n_layers=2, d_ff=32, max_len=32,
                                  moe_experts=experts)
-    params = paddle.create_parameters(paddle.Topology(spec.cost))
+    params = paddle.create_parameters(
+        paddle.Topology(spec.cost, extra_outputs=[spec.output]))
     tr = paddle.SGD(cost=spec.cost, parameters=params,
+                    extra_layers=[spec.output],
                     update_equation=paddle.optimizer.Adam(
                         learning_rate=1e-3),
                     mesh=mesh)
@@ -161,8 +163,10 @@ class TestMoETransformer:
                                      n_layers=1, d_ff=32, max_len=32,
                                      moe_experts=4, moe_aux_coeff=0.5)
         assert isinstance(spec.cost, list) and len(spec.cost) == 2
-        params = paddle.create_parameters(paddle.Topology(spec.cost))
+        params = paddle.create_parameters(
+            paddle.Topology(spec.cost, extra_outputs=[spec.output]))
         tr = paddle.SGD(cost=spec.cost, parameters=params,
+                        extra_layers=[spec.output],
                         update_equation=paddle.optimizer.Adam(
                             learning_rate=1e-3))
         loss, metrics = tr.train_batch(_lm_batch(np.random.RandomState(0)))
@@ -185,8 +189,10 @@ class TestMoETransformer:
         spec = models.transformer_lm(vocab_size=50, d_model=16, n_heads=2,
                                      n_layers=1, d_ff=32, max_len=32,
                                      moe_experts=4)
-        params = paddle.create_parameters(paddle.Topology(spec.cost))
+        params = paddle.create_parameters(
+            paddle.Topology(spec.cost, extra_outputs=[spec.output]))
         tr = paddle.SGD(cost=spec.cost, parameters=params,
+                        extra_layers=[spec.output],
                         update_equation=paddle.optimizer.Adam(
                             learning_rate=1e-3),
                         mesh=create_mesh([("dp", 2), ("ep", 4)]))
