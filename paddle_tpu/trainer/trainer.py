@@ -653,7 +653,21 @@ class SGD:
         self._merge_params(new_params)
         self.parameters.state = new_state
         self._step_count += 1
-        return float(loss), {k: float(v) for k, v in metrics.items()}
+        loss_np, metrics_np, _ = self._fetch_host(loss, metrics)
+        return loss_np, metrics_np
+
+    @staticmethod
+    def _fetch_host(loss, metrics, eval_outs=None):
+        """ONE device->host transfer for a step's scalars + evaluator
+        outputs. Keep every per-step read inside this call: a separate
+        float(x)/int(x) on a device array costs a full round-trip, which
+        a remote/tunneled device turns into the step-time floor
+        (docs/perf.md 'One host sync per step': 434.9 -> 120.6 ms)."""
+        loss_np, metrics_host, eval_host = jax.device_get(
+            (loss, metrics, {} if eval_outs is None else eval_outs))
+        return (float(loss_np),
+                {k: float(v) for k, v in metrics_host.items()},
+                eval_host)
 
     @staticmethod
     def _prefetched(reader, feeder, depth: int = 2):
@@ -699,7 +713,8 @@ class SGD:
                     batch_id >= num_batches_per_pass:
                 break
             event_handler(evt.BeginIteration(pass_id, batch_id))
-            n_real = jnp.asarray(feed.pop("__batch_size__"), jnp.int32)
+            n_real_host = int(feed.pop("__batch_size__"))
+            n_real = jnp.asarray(n_real_host, jnp.int32)
             self._rng, sub = jax.random.split(self._rng)
             with stat_timer("train_step"):
                 (new_params, self.opt_state, new_state, loss,
@@ -709,14 +724,15 @@ class SGD:
             self._merge_params(new_params)
             self.parameters.state = new_state
             self._step_count += 1
-            metrics_np = {k: float(v) for k, v in metrics.items()}
+            loss_np, metrics_np, eval_host = self._fetch_host(
+                loss, metrics, eval_outs)
             for k, v in metrics_np.items():
                 pass_metrics[k] = pass_metrics.get(k, 0.0) + v
             n_batches += 1
             metrics_np.update(
-                self._feed_evaluators(eval_outs, int(n_real)))
+                self._feed_evaluators(eval_host, n_real_host))
             event_handler(evt.EndIteration(pass_id, batch_id,
-                                           float(loss), metrics_np))
+                                           loss_np, metrics_np))
             if checkpoint_manager is not None and checkpoint_period and \
                     self._step_count % checkpoint_period == 0:
                 self.save_checkpoint(checkpoint_manager)
@@ -741,13 +757,16 @@ class SGD:
         for ev in self.evaluators:
             ev.start()
         for feed in self._prefetched(reader, feeder):
-            n_real = jnp.asarray(feed.pop("__batch_size__"), jnp.int32)
+            n_real_host = int(feed.pop("__batch_size__"))
+            n_real = jnp.asarray(n_real_host, jnp.int32)
             loss, metrics, eval_outs = self._test_step(
                 params, self.parameters.state, feed, n_real)
-            total_loss += float(loss)
-            for k, v in metrics.items():
-                totals[k] = totals.get(k, 0.0) + float(v)
-            self._feed_evaluators(eval_outs, int(n_real))
+            loss_np, metrics_np, eval_host = self._fetch_host(
+                loss, metrics, eval_outs)
+            total_loss += loss_np
+            for k, v in metrics_np.items():
+                totals[k] = totals.get(k, 0.0) + v
+            self._feed_evaluators(eval_host, n_real_host)
             n += 1
         n = max(n, 1)
         avg = {k: v / n for k, v in totals.items()}
