@@ -198,7 +198,8 @@ class TestTransformerPipeline:
         spec = models.transformer_lm(vocab_size=V_, d_model=16,
                                      n_heads=2, n_layers=L_, d_ff=32,
                                      max_len=T_)
-        params = paddle.create_parameters(paddle.Topology(spec.cost))
+        params = paddle.create_parameters(
+            paddle.Topology(spec.cost, extra_outputs=[spec.output]))
         stages = None
         mesh = None
         if schedule is not None:
@@ -208,6 +209,7 @@ class TestTransformerPipeline:
                         "ln2", "up", "down", "res2")]
                       for i in range(L_)]
         tr = paddle.SGD(cost=spec.cost, parameters=params,
+                        extra_layers=[spec.output],
                         update_equation=paddle.optimizer.Adam(
                             learning_rate=1e-3),
                         mesh=mesh, pipeline_stages=stages,

@@ -122,7 +122,8 @@ class TestTransformerOverSpMesh:
             spec = models.transformer_lm(vocab_size=50, d_model=32,
                                          n_heads=4, n_layers=2, d_ff=64,
                                          max_len=16)
-            params = paddle.create_parameters(paddle.Topology(spec.cost))
+            params = paddle.create_parameters(
+                paddle.Topology(spec.cost, extra_outputs=[spec.output]))
             return spec, params
 
         rng = np.random.RandomState(0)
@@ -142,6 +143,7 @@ class TestTransformerOverSpMesh:
                                                    (SP_AXIS, 2)]))]:
             spec, params = build()
             tr = paddle.SGD(cost=spec.cost, parameters=params,
+                            extra_layers=[spec.output],
                             update_equation=paddle.optimizer.Adam(
                                 learning_rate=1e-3), mesh=mesh)
             loss, _ = tr.train_batch(list(data))
