@@ -231,8 +231,15 @@ def _job_checkgrad(trainer, ns, args) -> int:
                                  args.seq_len)
     feeder = DataFeeder(trainer.topology.data_type(), None)
     feed = feeder(batch)
-    check_topology_grads(trainer.topology, feed,
-                         eps=args.checkgrad_eps, seed=args.seed)
+    # the audit runs on the CPU backend even from a TPU process: central
+    # differences at eps=1e-3 need deterministic f32 accumulation, and a
+    # TPU batch-sum's roundoff (~1e-2 absolute on a 128-row cost) swamps
+    # the 2e-3 probe. The analytic graph being checked is device-
+    # independent; CPU is the universal fake device (tests/conftest.py).
+    import jax
+    with jax.default_device(jax.devices("cpu")[0]):
+        check_topology_grads(trainer.topology, feed,
+                             eps=args.checkgrad_eps, seed=args.seed)
     n_params = len(trainer.topology.param_specs)
     print(json.dumps({"job": "checkgrad", "status": "ok",
                       "params_checked": n_params,
