@@ -121,6 +121,9 @@ def _job_time(trainer, batch_size: int, iters: int,
         if isinstance(e, paddle.event.BeginIteration):
             t_last[0] = time.perf_counter()
         elif isinstance(e, paddle.event.EndIteration):
+            e.cost                   # force the device sync: this verb
+            # times COMPLETED steps (TrainerBenchmark semantics), not
+            # async dispatch
             times.append(time.perf_counter() - t_last[0])
 
     trainer.train(reader, num_passes=1, event_handler=handler,
@@ -230,14 +233,16 @@ def _job_checkgrad(trainer, ns, args) -> int:
         batch = _synthetic_batch(trainer, min(args.batch_size, 8),
                                  args.seq_len)
     feeder = DataFeeder(trainer.topology.data_type(), None)
-    feed = feeder(batch)
     # the audit runs on the CPU backend even from a TPU process: central
     # differences at eps=1e-3 need deterministic f32 accumulation, and a
     # TPU batch-sum's roundoff (~1e-2 absolute on a 128-row cost) swamps
     # the 2e-3 probe. The analytic graph being checked is device-
     # independent; CPU is the universal fake device (tests/conftest.py).
+    # The feed conversion happens INSIDE the context so inputs are
+    # placed on CPU directly instead of TPU-then-migrated.
     import jax
     with jax.default_device(jax.devices("cpu")[0]):
+        feed = feeder(batch)
         check_topology_grads(trainer.topology, feed,
                              eps=args.checkgrad_eps, seed=args.seed)
     n_params = len(trainer.topology.param_specs)

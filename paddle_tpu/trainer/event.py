@@ -54,6 +54,36 @@ class EndIteration(WithMetric):
         self.cost = cost
 
 
+class LazyEndIteration(EndIteration):
+    """EndIteration whose cost/metrics sync with the device only when
+    ACCESSED. In an evaluator-free train loop nothing else needs per-step
+    host data, so a handler that reads `e.cost` every `log_period` steps
+    (the CLI's discipline) pays one device round-trip per log_period
+    instead of per step — through a remote/tunneled device that is the
+    difference between RTT-bound and device-bound throughput
+    (docs/perf.md 'One host sync per step'). Accessing cost on EVERY
+    event reproduces the eager behavior exactly."""
+
+    def __init__(self, pass_id: int, batch_id: int, fetch):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self._fetch = fetch
+        self._got = None
+
+    def _resolve(self):
+        if self._got is None:
+            self._got = self._fetch()
+        return self._got
+
+    @property
+    def cost(self):
+        return self._resolve()[0]
+
+    @property
+    def metrics(self):
+        return self._resolve()[1]
+
+
 class EndForwardBackward:
     def __init__(self, pass_id: int, batch_id: int):
         self.pass_id = pass_id

@@ -705,9 +705,16 @@ class SGD:
                   checkpoint_period: int = 0):
         event_handler(evt.BeginPass(pass_id))
         pass_metrics: Dict[str, float] = {}
+        metrics_dev = None         # lazy path: running on-device sums
         n_batches = 0
         for ev in self.evaluators:
             ev.start()
+        # With host-side evaluators attached, their streaming update needs
+        # eval_outs on the host EVERY step. Without them, nothing in the
+        # loop needs per-step host data, so events go out lazy and the
+        # dispatch queue runs ahead of the device (the JAX async idiom) —
+        # a handler reading e.cost still syncs, on ITS schedule.
+        lazy = not self.evaluators
         for batch_id, feed in enumerate(self._prefetched(reader, feeder)):
             if num_batches_per_pass is not None and \
                     batch_id >= num_batches_per_pass:
@@ -724,18 +731,33 @@ class SGD:
             self._merge_params(new_params)
             self.parameters.state = new_state
             self._step_count += 1
-            loss_np, metrics_np, eval_host = self._fetch_host(
-                loss, metrics, eval_outs)
-            for k, v in metrics_np.items():
-                pass_metrics[k] = pass_metrics.get(k, 0.0) + v
             n_batches += 1
-            metrics_np.update(
-                self._feed_evaluators(eval_host, n_real_host))
-            event_handler(evt.EndIteration(pass_id, batch_id,
-                                           loss_np, metrics_np))
+            if lazy:
+                # running on-device sum: O(1) live buffers, still async
+                metrics_dev = metrics if metrics_dev is None else {
+                    k: metrics_dev[k] + v for k, v in metrics.items()}
+                fetch_host = self._fetch_host   # plain function — the
+                # event closure must not pin the trainer alive
+                event_handler(evt.LazyEndIteration(
+                    pass_id, batch_id,
+                    lambda loss=loss, metrics=metrics, fh=fetch_host:
+                        fh(loss, metrics)[:2]))
+            else:
+                loss_np, metrics_np, eval_host = self._fetch_host(
+                    loss, metrics, eval_outs)
+                for k, v in metrics_np.items():
+                    pass_metrics[k] = pass_metrics.get(k, 0.0) + v
+                metrics_np.update(
+                    self._feed_evaluators(eval_host, n_real_host))
+                event_handler(evt.EndIteration(pass_id, batch_id,
+                                               loss_np, metrics_np))
             if checkpoint_manager is not None and checkpoint_period and \
                     self._step_count % checkpoint_period == 0:
                 self.save_checkpoint(checkpoint_manager)
+        if metrics_dev is not None:
+            # one transfer fetches the whole pass's sums
+            for k, v in jax.device_get(metrics_dev).items():
+                pass_metrics[k] = pass_metrics.get(k, 0.0) + float(v)
         avg = {k: v / max(n_batches, 1) for k, v in pass_metrics.items()}
         for ev in self.evaluators:
             avg.update(ev.result())
