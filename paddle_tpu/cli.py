@@ -199,9 +199,18 @@ def _job_train(trainer, ns, args) -> int:
             print(f"Pass {e.pass_id} done. {e.evaluator}")
             if args.save_dir:
                 trainer.save_pass(args.save_dir, e.pass_id)
+        elif isinstance(e, paddle.event.FaultEvent):
+            print(f"FAULT {e!r}", file=sys.stderr)
 
+    fault_policy = None
+    if args.fault_max_bad_steps:
+        from paddle_tpu.trainer.fault import FaultPolicy
+        fault_policy = FaultPolicy(max_bad_steps=args.fault_max_bad_steps)
     num_passes = args.num_passes or int(ns.get("num_passes", 1))
-    trainer.train(reader, num_passes=num_passes, event_handler=handler)
+    trainer.train(reader, num_passes=num_passes, event_handler=handler,
+                  checkpoint_dir=args.checkpoint_dir,
+                  checkpoint_period=args.checkpoint_period,
+                  auto_resume=args.auto_resume, fault_policy=fault_policy)
     if ns.get("test_reader") is not None:
         res = trainer.test(ns["test_reader"])
         print(f"Test: cost={res.cost:.6f} {res.evaluator}")
@@ -328,13 +337,11 @@ def _cmd_coordinator(args) -> int:
     server.start()
     # report the coordinator's ACTUAL state: after snapshot recovery it
     # serves the recovered chunk list, not this invocation's --data
-    recovered = coord._chunks != chunks or \
-        coord._chunks_per_task != args.chunks_per_task
     print(json.dumps({"job": "coordinator", "status": "serving",
                       "host": args.host, "port": server.port,
-                      "files": len(paths), "chunks": len(coord._chunks),
-                      "chunks_per_task": coord._chunks_per_task,
-                      "recovered": recovered}), flush=True)
+                      "files": len(paths), "chunks": len(coord.chunks),
+                      "chunks_per_task": coord.chunks_per_task,
+                      "recovered": coord.recovered}), flush=True)
     while not stop:
         time.sleep(0.2)
     server.stop()
@@ -374,6 +381,20 @@ def main(argv=None) -> int:
     tr.add_argument("--iters", type=int, default=20,
                     help="--job=time timed steps")
     tr.add_argument("--save_dir", default=None)
+    tr.add_argument("--checkpoint_dir", default=None,
+                    help="full-state checkpoint dir (params + optimizer "
+                         "slots + counters, md5-verified; "
+                         "docs/robustness.md)")
+    tr.add_argument("--checkpoint_period", type=int, default=0,
+                    help="checkpoint every N steps (0: pass ends only)")
+    tr.add_argument("--auto_resume", action="store_true",
+                    help="resume from the newest intact checkpoint in "
+                         "--checkpoint_dir: a killed run relaunched with "
+                         "the same flags continues where it died")
+    tr.add_argument("--fault_max_bad_steps", type=int, default=0,
+                    help="enable the guarded train step: skip non-finite "
+                         "updates, roll back after N consecutive bad "
+                         "steps (0 disables)")
     tr.add_argument("--init_model_path", default=None,
                     help="params.tar to start from")
     tr.add_argument("--log_period", type=int, default=100)

@@ -35,7 +35,8 @@ def _coordinator_endpoint():
     return host or "127.0.0.1", int(port)
 
 
-def save_model(parameters, path: str, epoch: int = None) -> bool:
+def save_model(parameters, path: str, epoch: int = None,
+               window_s: float = 30.0) -> bool:
     """Save ``parameters`` to ``path``; under a coordinator, only the
     election winner writes. Returns True if this process saved.
 
@@ -45,11 +46,18 @@ def save_model(parameters, path: str, epoch: int = None) -> bool:
     server-side under its save lock (the Go master's
     RequestSaveModel-with-duration semantics, service.go:474); keying on
     a separately-read pass counter would let two trainers straddling a
-    pass turnover both win."""
+    pass turnover both win.
+
+    ``window_s`` is forwarded as the election window (the Go client's
+    BlockDur), and this process's ``trainer_id`` rides along so the
+    CURRENT winner re-requesting is re-granted (service.go:474
+    TrainerID==savingTrainer) — a single trainer saving faster than the
+    window never silently skips a save."""
     ep = _coordinator_endpoint()
     if ep is not None:
         from paddle_tpu.trainer.coordinator import connect
-        if not connect(*ep).request_save_model(epoch):
+        if not connect(*ep).request_save_model(epoch, window_s,
+                                               trainer_id):
             return False
         path = os.path.join(path, trainer_id, "model.tar")
 

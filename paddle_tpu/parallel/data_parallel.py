@@ -31,19 +31,23 @@ def _feed_shardings(feed, mesh: Mesh):
 
 
 def shard_train_step(step_fn: Callable, mesh: Mesh,
-                     param_shardings=None, opt_shardings=None) -> Callable:
+                     param_shardings=None, opt_shardings=None,
+                     n_extra: int = 0) -> Callable:
     """Wrap a train step (params, opt_state, state, feed, rng, n_real) so the
     feed is dp-sharded over the mesh. Params/opt-state are replicated by
     default; pass `param_shardings` (name -> NamedSharding, from
     parallel.tensor_parallel) and matching `opt_shardings` for dp x mp runs
     — XLA then partitions the matmuls over `mp` and all-reduces grads over
-    `dp`, replacing both MultiGradientMachine's ring and the pserver."""
+    `dp`, replacing both MultiGradientMachine's ring and the pserver.
+
+    n_extra: replicated scalar carries appended to both the argument and
+    result lists (the guarded step's bad-step streak counter)."""
     repl = NamedSharding(mesh, P())
 
-    def sharded(params, opt_state, state, feed, rng, n_real):
+    def sharded(params, opt_state, state, feed, rng, n_real, *extra):
         feed = jax.lax.with_sharding_constraint(
             feed, _feed_shardings(feed, mesh))
-        return step_fn(params, opt_state, state, feed, rng, n_real)
+        return step_fn(params, opt_state, state, feed, rng, n_real, *extra)
 
     # out_shardings must pin the params/opt outputs to the SAME shardings as
     # the inputs: otherwise XLA's propagated output shardings (e.g. a bias
@@ -53,9 +57,9 @@ def shard_train_step(step_fn: Callable, mesh: Mesh,
     return jax.jit(
         sharded,
         in_shardings=(param_shardings or repl, opt_shardings or repl,
-                      repl, None, repl, repl),
+                      repl, None, repl, repl) + (repl,) * n_extra,
         out_shardings=(param_shardings or repl, opt_shardings or repl,
-                       repl, repl, repl, repl),
+                       repl, repl, repl, repl) + (repl,) * n_extra,
         donate_argnums=(0, 1, 2),
     )
 

@@ -29,6 +29,8 @@ def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
                      local_device_ids: Optional[Sequence[int]] = None,
+                     connect_attempts: int = 3,
+                     connect_backoff_s: float = 2.0,
                      **kw) -> Tuple[int, int]:
     """Join (or form) the multi-host process group.
 
@@ -38,6 +40,11 @@ def init_distributed(coordinator_address: Optional[str] = None,
     schedulers that set the environment (GKE/Borg metadata), matching the
     reference's cloud auto-discovery. Returns (process_index,
     process_count) and records them in the global config.
+
+    An explicitly-requested cluster whose coordinator is not up YET (a
+    scheduler starting N processes in arbitrary order) is retried
+    ``connect_attempts`` times with ``connect_backoff_s * 2^i`` waits
+    before the connection error propagates.
     """
     # IMPORTANT: nothing may touch the XLA backend (jax.devices/
     # process_count) before jax.distributed.initialize, or it raises.
@@ -48,31 +55,45 @@ def init_distributed(coordinator_address: Optional[str] = None,
         already = getattr(getattr(jax._src.distributed, "global_state", None),
                           "client", None) is not None
     if not already:
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-                local_device_ids=local_device_ids, **kw)
-        except ValueError:
-            # ValueError is jax's arg-validation signal ("coordinator_
-            # address should be defined") — i.e. auto-detect found NO
-            # cluster environment. Only that case may fall back to a
-            # standalone single-process run, and only when the caller
-            # passed no explicit cluster args.
-            if coordinator_address or num_processes:
-                raise
-        except RuntimeError as e:
-            # "must be called before any JAX calls" = the backend is
-            # already warm in a standalone process; same no-cluster
-            # fallback, but an explicit cluster request must still fail
-            if coordinator_address or num_processes or \
-                    "before" not in str(e):
-                raise
-        # anything else (RuntimeError, grpc connect/timeout failures) is a
-        # REAL cluster error: a scheduler environment was detected but the
-        # coordinator is unreachable. Re-raise rather than silently train
-        # this process on 1/N of the data.
+        for attempt in range(max(connect_attempts, 1)):
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    local_device_ids=local_device_ids, **kw)
+                break
+            except ValueError:
+                # ValueError is jax's arg-validation signal ("coordinator_
+                # address should be defined") — i.e. auto-detect found NO
+                # cluster environment. Only that case may fall back to a
+                # standalone single-process run, and only when the caller
+                # passed no explicit cluster args.
+                if coordinator_address or num_processes:
+                    raise
+                break
+            except RuntimeError as e:
+                # "must be called before any JAX calls" = the backend is
+                # already warm in a standalone process; same no-cluster
+                # fallback, but an explicit cluster request must still fail
+                if not (coordinator_address or num_processes) and \
+                        "before" in str(e):
+                    break
+                # a REAL cluster error: a scheduler environment was
+                # detected but the coordinator is unreachable. Startup
+                # races (coordinator pod not up yet) get bounded
+                # exponential-backoff retries; a coordinator that never
+                # appears re-raises rather than silently training this
+                # process on 1/N of the data.
+                if attempt + 1 >= max(connect_attempts, 1):
+                    raise
+                import time
+                import warnings
+                wait = connect_backoff_s * (2.0 ** attempt)
+                warnings.warn(
+                    f"jax.distributed.initialize failed ({e}); retry "
+                    f"{attempt + 1}/{connect_attempts} in {wait:.1f}s")
+                time.sleep(wait)
     g = config_mod.global_config()
     g.process_index = jax.process_index()
     g.process_count = jax.process_count()

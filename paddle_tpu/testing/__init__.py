@@ -1,0 +1,6 @@
+"""Testing utilities — deterministic fault injection for chaos tests
+(docs/robustness.md)."""
+
+from paddle_tpu.testing.faults import FaultPlan
+
+__all__ = ["FaultPlan"]

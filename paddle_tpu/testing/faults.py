@@ -1,0 +1,219 @@
+"""Deterministic fault injection for chaos-testing the training loop.
+
+The reference stack's fault tolerance was proven by hope: the Go master
+re-queued tasks and the pserver checkpointed, but nothing in the tree
+could *inject* a disk-full mid-checkpoint or a dropped RPC on demand.
+This module is that missing harness: a seedable :class:`FaultPlan` that
+can
+
+  (a) raise ``OSError`` (ENOSPC by default) inside a checkpoint write at
+      a chosen save index and byte offset — including TORN writes that
+      leave a truncated artifact on disk;
+  (b) drop or delay chosen coordinator RPCs (by method name and 0-based
+      call index, or at a seeded random rate);
+  (c) poison chosen training batches so the loss goes NaN/Inf at exact
+      step indices;
+  (d) SIGKILL a subprocess trainer when its stdout reaches a chosen
+      step marker.
+
+Everything is deterministic given the seed and the schedule, so a chaos
+test that fails replays exactly. See ``tests/test_faults.py`` for the
+tests that drive all four against the real loop, and
+``docs/robustness.md`` for the recipe.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import os
+import random
+import re
+import signal
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional, Sequence, Set
+
+import numpy as np
+
+__all__ = ["FaultPlan", "FlakyCoordinator"]
+
+
+class FlakyCoordinator:
+    """Proxy over a coordinator (in-process or RPC) that injects
+    transport faults on chosen calls.
+
+    drop: {method: iterable of 0-based call indices} — those calls raise
+        ConnectionError WITHOUT reaching the target (the request is
+        lost on the wire).
+    delay: {method: {call index: seconds}} — those calls sleep first,
+        then go through (a slow network / GC-paused server).
+    drop_rate: additionally drop each call with this seeded probability.
+
+    Counters are per method name. Attributes that aren't callable (an
+    in-process Coordinator's `epoch` property) pass straight through."""
+
+    def __init__(self, target, drop: Optional[Dict[str, Iterable[int]]] = None,
+                 delay: Optional[Dict[str, Dict[int, float]]] = None,
+                 drop_rate: float = 0.0, seed: int = 0):
+        self._target = target
+        self._drop = {m: set(v) for m, v in (drop or {}).items()}
+        self._delay = {m: dict(v) for m, v in (delay or {}).items()}
+        self._drop_rate = drop_rate
+        self._rng = random.Random(seed)
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.faults_injected = 0
+
+    def __getattr__(self, name):
+        val = getattr(self._target, name)
+        if not callable(val):
+            return val
+
+        def call(*args, **kw):
+            with self._lock:
+                i = self._counts.get(name, 0)
+                self._counts[name] = i + 1
+                dropped = i in self._drop.get(name, ()) or (
+                    self._drop_rate and
+                    self._rng.random() < self._drop_rate)
+                wait = self._delay.get(name, {}).get(i, 0.0)
+                if dropped or wait:
+                    self.faults_injected += 1
+            if wait:
+                time.sleep(wait)
+            if dropped:
+                raise ConnectionError(
+                    f"injected drop: {name}() call #{i}")
+            return val(*args, **kw)
+        return call
+
+
+class FaultPlan:
+    """A seedable schedule of faults to drive against the real loop."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+
+    # ------------------------------------------------- (a) checkpoint IO
+    @contextlib.contextmanager
+    def checkpoint_write_failure(self, at_save: int = 0,
+                                 at_byte: Optional[int] = None,
+                                 errnum: int = errno.ENOSPC):
+        """Within the context, the ``at_save``-th checkpoint state write
+        (0-based, counting every CheckpointManager.save in the process)
+        raises OSError(errnum). With ``at_byte``, that many bytes are
+        written FIRST — the torn artifact stays in the .tmp directory,
+        exactly what a crash mid-write leaves; the atomic-rename design
+        must keep the previous checkpoint as the newest intact one."""
+        from paddle_tpu.trainer import checkpoint as ck
+        real = ck._savez
+        count = [0]
+
+        def savez(path, flat):
+            i = count[0]
+            count[0] += 1
+            if i != at_save:
+                return real(path, flat)
+            if at_byte is None:
+                raise OSError(errnum, os.strerror(errnum))
+            # serialize fully in memory, land only the first at_byte
+            # bytes on disk — the torn artifact a crash mid-write leaves
+            import io
+            buf = io.BytesIO()
+            np.savez(buf, **flat)
+            with open(path, "wb") as f:
+                f.write(buf.getvalue()[:at_byte])
+            raise OSError(errnum, os.strerror(errnum))
+
+        ck._savez = savez
+        try:
+            yield count
+        finally:
+            ck._savez = real
+
+    @staticmethod
+    def corrupt_newest_checkpoint(directory: str,
+                                  payload: bytes = b"garbage") -> int:
+        """Overwrite the newest checkpoint's state file (bit-rot / a
+        torn copy), returning its step — restore must fall back to the
+        one before it via the md5 check."""
+        from paddle_tpu.trainer.checkpoint import CheckpointManager
+        mgr = CheckpointManager(directory)
+        steps = mgr.all_steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+        newest = steps[-1]
+        with open(os.path.join(directory, f"ckpt-{newest:010d}",
+                               "state.npz"), "wb") as f:
+            f.write(payload)
+        return newest
+
+    # -------------------------------------------------- (b) RPC faults
+    def flaky_coordinator(self, target,
+                          drop: Optional[Dict[str, Iterable[int]]] = None,
+                          delay: Optional[Dict[str, Dict[int, float]]] = None,
+                          drop_rate: float = 0.0) -> FlakyCoordinator:
+        """Wrap a coordinator (in-process or connect() proxy) so chosen
+        RPCs are dropped (ConnectionError) or delayed — see
+        FlakyCoordinator. Randomized drops use this plan's seed."""
+        return FlakyCoordinator(target, drop=drop, delay=delay,
+                                drop_rate=drop_rate, seed=self.seed)
+
+    # ------------------------------------------------ (c) NaN injection
+    def poison_batches(self, reader: Callable, steps: Sequence[int],
+                       value: float = float("nan"),
+                       column: int = 0) -> Callable:
+        """Wrap a BATCH reader (yields lists of sample tuples): at the
+        given 0-based batch indices, the ``column``-th field of every
+        sample is replaced with ``value`` (NaN or Inf) — the loss and
+        gradients of that step go non-finite, which is what the guarded
+        train step must absorb. Other batches pass through untouched, so
+        a comparison run that simply skips the poisoned indices defines
+        the expected parameters bit-for-bit."""
+        bad: Set[int] = set(int(s) for s in steps)
+
+        def poisoned():
+            for i, batch in enumerate(reader()):
+                if i in bad:
+                    batch = [
+                        tuple(np.full_like(
+                            np.asarray(f, np.float32), value)
+                            if j == column else f
+                            for j, f in enumerate(sample))
+                        for sample in batch]
+                yield batch
+        return poisoned
+
+    # --------------------------------------------- (d) process murder
+    @staticmethod
+    def kill_at_marker(proc, step: int, pattern: str = r"STEP (\d+)",
+                       timeout: float = 120.0,
+                       sig: int = signal.SIGKILL) -> int:
+        """Read ``proc.stdout`` lines until the marker regex reports a
+        step >= ``step``, then deliver ``sig`` (SIGKILL: the TPU
+        preemption / OOM-killer case — no cleanup handlers run). The
+        worker prints markers like 'STEP 7'. Returns the step it died
+        at; raises TimeoutError if the marker never appears (after
+        killing the process so no orphan survives the test)."""
+        rx = re.compile(pattern)
+        deadline = time.time() + timeout
+        try:
+            for line in proc.stdout:
+                if isinstance(line, bytes):
+                    line = line.decode("utf-8", "replace")
+                m = rx.search(line)
+                if m and int(m.group(1)) >= step:
+                    proc.send_signal(sig)
+                    proc.wait(timeout=30)
+                    return int(m.group(1))
+                if time.time() > deadline:
+                    break
+        except ValueError:            # stream closed under us
+            pass
+        proc.kill()
+        proc.wait(timeout=30)
+        raise TimeoutError(
+            f"marker {pattern!r} never reached step {step} "
+            f"within {timeout}s")

@@ -1,6 +1,8 @@
 from paddle_tpu.trainer import event
+from paddle_tpu.trainer.fault import FaultPolicy
 from paddle_tpu.trainer.parameters import Parameters, create
 from paddle_tpu.trainer.trainer import SGD
 from paddle_tpu.trainer.inference import infer, Inference
 
-__all__ = ["event", "Parameters", "create", "SGD", "infer", "Inference"]
+__all__ = ["event", "FaultPolicy", "Parameters", "create", "SGD", "infer",
+           "Inference"]

@@ -78,6 +78,14 @@ def _to_host(tree):
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
 
 
+def _savez(path: str, flat: Dict[str, np.ndarray]) -> None:
+    """The one place checkpoint bytes hit disk — the fault-injection
+    harness (paddle_tpu/testing/faults.py) patches THIS to simulate
+    ENOSPC / torn writes at a chosen save or byte offset."""
+    with open(path, "wb") as f:
+        np.savez(f, **flat)
+
+
 class CheckpointManager:
     """Save/restore {params, opt_state, state, meta} with integrity meta.
 
@@ -120,8 +128,7 @@ class CheckpointManager:
             tmp = path + ".tmp"
             os.makedirs(tmp, exist_ok=True)
             npz = os.path.join(tmp, "state.npz")
-            with open(npz, "wb") as f:
-                np.savez(f, **flat)
+            _savez(npz, flat)
             with open(npz, "rb") as f:
                 digest = hashlib.md5(f.read()).hexdigest()
             m = {"step": step, "md5": digest, "meta": user_meta,

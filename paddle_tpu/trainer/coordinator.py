@@ -92,11 +92,17 @@ _SNAPSHOT_KEY = "coordinator/state"
 
 
 class Coordinator:
-    """Task dispatch with timeout re-queue and bounded failures.
+    """Task dispatch with lease re-queue and bounded failures.
 
     Mirrors go/master/service.go taskQueues {todo, pending, done, failed}:
     partition (:106), GetTask (:368), TaskFinished (:410), TaskFailed
     (:448), checkTimeoutFunc (:341), snapshot (:207), recover (:166).
+
+    ``timeout_s`` is a renewable LEASE, not a wall-clock budget: a served
+    task must finish (or heartbeat) within it. A slow-but-alive trainer
+    calls :meth:`heartbeat` to extend its lease; a dead trainer stops
+    heartbeating and its task is re-served to someone else — the server
+    distinguishes slow from dead instead of guessing a global timeout.
     """
 
     def __init__(self, chunks: Sequence[Any], chunks_per_task: int = 1,
@@ -108,6 +114,7 @@ class Coordinator:
         self._lock = threading.Lock()
         self._save_lock = threading.Lock()
         self._saving_for_epoch = -1
+        self._saving_trainer: Optional[str] = None
         self._last_save_grant = float("-inf")
         self._todo: List[Task] = []
         self._pending: Dict[int, Dict[str, Any]] = {}   # id -> {task, deadline}
@@ -117,7 +124,8 @@ class Coordinator:
         self._next_id = 0
         self._chunks = list(chunks)
         self._chunks_per_task = chunks_per_task
-        if not self._recover():
+        self._recovered = self._recover()
+        if not self._recovered:
             self._partition()
             self._snapshot()
 
@@ -159,6 +167,25 @@ class Coordinator:
             if not self._todo and not self._pending:
                 self._turn_epoch()
             self._snapshot()
+            return True
+
+    def heartbeat(self, task_id: int) -> bool:
+        """Renew the lease on a pending task (the client-side reader
+        beats every lease/3 while it processes the task's records).
+        Returns False when the lease is already gone — the task was
+        finished, failed, or re-served to another trainer; the caller
+        should treat its work as superseded."""
+        with self._lock:
+            ent = self._pending.get(task_id)
+            if ent is None:
+                return False
+            if ent["deadline"] <= time.time():
+                # the lease already lapsed — the task belongs to the
+                # queue again (a late heartbeat must not resurrect it
+                # after another trainer may have been promised it)
+                self._requeue_timed_out()
+                return False
+            ent["deadline"] = time.time() + self.timeout_s
             return True
 
     def task_failed(self, task_id: int) -> bool:
@@ -223,6 +250,25 @@ class Coordinator:
         with self._lock:
             return len(self._failed_dropped)
 
+    # ------------------------------------------------- read-only status
+    @property
+    def chunks(self) -> tuple:
+        """The chunk list being served (after snapshot recovery this is
+        the RECOVERED list, which may differ from the constructor's)."""
+        with self._lock:
+            return tuple(self._chunks)
+
+    @property
+    def chunks_per_task(self) -> int:
+        with self._lock:
+            return self._chunks_per_task
+
+    @property
+    def recovered(self) -> bool:
+        """True when this coordinator restored its queues from a
+        snapshot store instead of partitioning the constructor args."""
+        return self._recovered
+
     # --------------------------------------------------------- snapshots
     def _snapshot(self):
         """Gob-snapshot parity (service.go:207) — called under _lock."""
@@ -262,7 +308,8 @@ class Coordinator:
 
     # ------------------------------------------------------- save election
     def request_save_model(self, epoch: int = None,
-                           window_s: float = 30.0) -> bool:
+                           window_s: float = 30.0,
+                           trainer_id: Optional[str] = None) -> bool:
         """RequestSaveModel parity (service.go:474): exactly ONE caller
         wins True and performs the save.
 
@@ -272,17 +319,31 @@ class Coordinator:
         duration): the first caller in a ``window_s`` span wins. The
         window is resolved server-side under the save lock, so
         concurrent end-of-pass callers cannot both win by observing a
-        pass counter mid-turnover."""
+        pass counter mid-turnover.
+
+        ``trainer_id`` mirrors the Go master's TrainerID re-grant: the
+        CURRENT saving trainer asking again (same epoch, or within the
+        window) gets need=true again instead of a denial — a single
+        trainer saving faster than the window never silently skips a
+        save. Anonymous callers (trainer_id None) are never re-granted."""
         with self._save_lock:
+            regrant = trainer_id is not None and \
+                trainer_id == self._saving_trainer
             if epoch is not None:
+                if self._saving_for_epoch == epoch and regrant:
+                    return True
                 if self._saving_for_epoch >= epoch:
                     return False
                 self._saving_for_epoch = epoch
+                self._saving_trainer = trainer_id
                 return True
             now = time.monotonic()
             if now - self._last_save_grant < window_s:
-                return False
+                # the winner re-requesting keeps the grant; the window is
+                # NOT refreshed (Go master: saveModelStarted unchanged)
+                return regrant
             self._last_save_grant = now
+            self._saving_trainer = trainer_id
             return True
 
 
@@ -301,7 +362,7 @@ class CoordinatorServer:
                                          logRequests=False)
         self.port = self.server.server_address[1]
         for name in ("get_task", "task_finished", "task_failed",
-                     "request_save_model"):
+                     "heartbeat", "request_save_model"):
             self.server.register_function(getattr(coordinator, name), name)
         self.server.register_function(lambda: coordinator.epoch, "epoch")
         self._thread: Optional[threading.Thread] = None
@@ -324,18 +385,116 @@ def connect(host: str, port: int):
 
 
 # ---------------------------------------------------------------------------
-# client-side reader
+# client-side retry / lease plumbing
 
 
-def coordinator_epoch(coordinator) -> int:
+@dataclasses.dataclass
+class RetryPolicy:
+    """Exponential backoff with jitter and a hard deadline for client
+    RPCs (the Go client wrapped every master call in a backoff loop,
+    go/master/client.go). ``seed`` makes the jitter deterministic — the
+    fault-injection tests replay exact schedules."""
+
+    base_delay: float = 0.05
+    multiplier: float = 2.0
+    max_delay: float = 2.0
+    deadline: float = 60.0
+    jitter: float = 0.25
+    seed: int = 0
+
+
+# transport-level failures worth retrying; an xmlrpc.client.Fault is a
+# SERVER-side exception (a bug, not a blip) and is never retried
+def _retryable_errors():
+    import http.client
+    import xmlrpc.client
+    return (OSError, xmlrpc.client.ProtocolError, http.client.HTTPException)
+
+
+def call_with_retry(fn, *args, policy: Optional[RetryPolicy] = None,
+                    _sleep=time.sleep):
+    """Call ``fn(*args)``, retrying transport failures with exponential
+    backoff + jitter until ``policy.deadline`` seconds have elapsed —
+    graceful degradation when the coordinator restarts or the network
+    blips, a clear TimeoutError when it is really gone."""
+    import random
+    policy = policy or RetryPolicy()
+    rng = random.Random(policy.seed)
+    retryable = _retryable_errors()
+    delay = policy.base_delay
+    start = time.monotonic()
+    while True:
+        try:
+            return fn(*args)
+        except retryable as e:
+            elapsed = time.monotonic() - start
+            if elapsed >= policy.deadline:
+                raise TimeoutError(
+                    f"coordinator RPC failed for {elapsed:.1f}s "
+                    f"(deadline {policy.deadline}s): {e!r}") from e
+            d = delay * (1.0 + policy.jitter * (2.0 * rng.random() - 1.0))
+            _sleep(max(0.0, min(d, policy.deadline - elapsed)))
+            delay = min(delay * policy.multiplier, policy.max_delay)
+
+
+def coordinator_epoch(coordinator, retry: Optional[RetryPolicy] = None
+                      ) -> int:
     """Current epoch of an in-process Coordinator (property) or an RPC
-    proxy (registered function)."""
+    proxy (registered function), optionally retried through a
+    RetryPolicy."""
     e = coordinator.epoch
-    return e() if callable(e) else e
+    if not callable(e):
+        return e
+    if retry is None:
+        return e()
+    return call_with_retry(e, policy=retry)
+
+
+def _heartbeat_conn(coordinator):
+    """A connection the heartbeat THREAD may use concurrently with the
+    reader's. An in-process Coordinator is thread-safe (its lock); an
+    xmlrpc ServerProxy is NOT, so the heartbeater gets its own proxy to
+    the same endpoint. Returns None when no safe channel exists."""
+    import xmlrpc.client as xc
+    if isinstance(coordinator, xc.ServerProxy):
+        host = coordinator._ServerProxy__host        # "host:port"
+        return xc.ServerProxy(f"http://{host}", allow_none=True)
+    if isinstance(coordinator, Coordinator):
+        return coordinator
+    return None                                      # wrapped/unknown
+
+
+class _Heartbeater:
+    """Background lease renewal for one task: beats every
+    ``interval`` seconds until stopped. Transport errors are tolerated
+    (the next beat retries; a missed lease just re-queues the task); a
+    server without the heartbeat RPC (xmlrpc Fault) stops the beats —
+    the pre-lease wall-clock timeout then governs, as before."""
+
+    def __init__(self, conn, task_id: int, interval: float):
+        self._stop = threading.Event()
+
+        def beat():
+            import xmlrpc.client as xc
+            while not self._stop.wait(interval):
+                try:
+                    conn.heartbeat(task_id)
+                except xc.Fault:
+                    return                       # old server: no leases
+                except Exception:
+                    pass                         # blip: retry next beat
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
 
 
 def task_reader(coordinator, chunk_reader: Callable[[Any], Any],
-                idle_timeout: float = 600.0, poll_interval: float = 0.2):
+                idle_timeout: float = 600.0, poll_interval: float = 0.2,
+                retry: Optional[RetryPolicy] = None,
+                heartbeat_interval: Optional[float] = None):
     """Reader over coordinator-dispatched tasks (master client NextRecord
     parity, go/master/client.go:232).
 
@@ -345,17 +504,36 @@ def task_reader(coordinator, chunk_reader: Callable[[Any], Any],
     bounded by failure_max).
 
     An empty queue whose epoch has NOT turned means other trainers still
-    hold pending tasks (one may have died — its task re-queues on
-    timeout): like the Go client, poll until the pass completes or
+    hold pending tasks (one may have died — its lease expires and the
+    task re-queues): like the Go client, poll until the pass completes or
     `idle_timeout` seconds pass with nothing to do (raise it when peer
-    trainers may legitimately hold a task longer than that)."""
+    trainers may legitimately hold a task longer than that).
+
+    Robustness (docs/robustness.md): every RPC goes through
+    ``call_with_retry`` — exponential backoff with jitter up to
+    ``retry.deadline`` (default 60s), so a coordinator restart or
+    network blip delays the reader instead of killing the trainer; a
+    coordinator unreachable at startup degrades the same way. While a
+    task's records are being consumed, a background heartbeat renews its
+    lease every ``heartbeat_interval`` seconds (default: a third of the
+    server lease when discoverable, else 5s), so a SLOW trainer keeps
+    its task while a DEAD one loses it."""
+    retry = retry or RetryPolicy()
+
     def reader():
-        epoch0 = coordinator_epoch(coordinator)
+        epoch0 = coordinator_epoch(coordinator, retry=retry)
         idle = 0.0
+        hb_conn = _heartbeat_conn(coordinator)
+        hb_every = heartbeat_interval
+        if hb_every is None:
+            lease = getattr(coordinator, "timeout_s", None)
+            hb_every = lease / 3.0 if isinstance(lease, (int, float)) \
+                else 5.0
         while True:
-            t = coordinator.get_task(epoch0)
+            t = call_with_retry(coordinator.get_task, epoch0,
+                                policy=retry)
             if t is None:
-                if coordinator_epoch(coordinator) != epoch0:
+                if coordinator_epoch(coordinator, retry=retry) != epoch0:
                     return                   # pass completed
                 if idle >= idle_timeout:
                     import warnings
@@ -369,12 +547,25 @@ def task_reader(coordinator, chunk_reader: Callable[[Any], Any],
                 idle += poll_interval
                 continue
             idle = 0.0
+            beater = _Heartbeater(hb_conn, t["task_id"], hb_every) \
+                if hb_conn is not None else None
+            failed = False
             try:
                 for chunk in t["chunks"]:
                     for rec in chunk_reader(chunk):
                         yield rec
             except Exception:
-                coordinator.task_failed(t["task_id"])
+                failed = True
+            finally:
+                # also runs on GeneratorExit (consumer abandoned the
+                # reader): the lease then expires on its own and the
+                # task re-queues — exactly the dead-trainer path
+                if beater is not None:
+                    beater.stop()
+            if failed:
+                call_with_retry(coordinator.task_failed, t["task_id"],
+                                policy=retry)
                 continue
-            coordinator.task_finished(t["task_id"])
+            call_with_retry(coordinator.task_finished, t["task_id"],
+                            policy=retry)
     return reader

@@ -90,6 +90,34 @@ class EndForwardBackward:
         self.batch_id = batch_id
 
 
+class FaultEvent:
+    """A numeric fault surfaced by the guarded train step (SGD.train with
+    a FaultPolicy — see trainer/fault.py).
+
+    kind: "nonfinite" — one or more recent steps produced a non-finite
+        cost/gradient and their updates were skipped (bad_streak is the
+        current consecutive count, still below the policy's limit);
+        "rollback" — the streak reached max_bad_steps; params+optimizer
+        state were restored from the newest intact checkpoint
+        (restored_step), or kept as-is when no checkpoint exists
+        (restored_step None — updates were skipped, so they are intact).
+
+    Handlers may raise to abort the run; the default handler logs."""
+
+    def __init__(self, pass_id: int, batch_id: int, kind: str,
+                 bad_streak: int, restored_step: Optional[int] = None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.kind = kind
+        self.bad_streak = bad_streak
+        self.restored_step = restored_step
+
+    def __repr__(self):
+        return (f"FaultEvent(kind={self.kind!r}, pass={self.pass_id}, "
+                f"batch={self.batch_id}, bad_streak={self.bad_streak}, "
+                f"restored_step={self.restored_step})")
+
+
 class TestResult(WithMetric):
     def __init__(self, cost: float, metrics=None):
         super().__init__(metrics)

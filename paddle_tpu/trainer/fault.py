@@ -1,0 +1,73 @@
+"""Fault policy for the guarded train step.
+
+The reference stack survived bad steps at the cluster level: the Go
+master re-queued tasks from dead trainers and dropped poison tasks after
+``failure_max`` retries (go/master/service.go:313), and the pserver kept
+optimizer state in verified checkpoints off the serving path
+(go/pserver/service.go:272). Neither guards the *numerics* of a step — a
+single non-finite loss silently poisons the parameters forever.
+
+:class:`FaultPolicy` closes that hole for the TPU-native loop. With a
+policy attached (``SGD.train(..., fault_policy=FaultPolicy())``):
+
+  - every train step checks cost AND gradient finiteness ON DEVICE (a
+    ``jnp.isfinite`` reduction folded into the jitted step — no host
+    sync is added to the step path);
+  - a bad step keeps params / optimizer slots / layer state bit-identical
+    to the pre-step values (the update is selected away with
+    ``jnp.where``), so an injected NaN can never reach the parameters;
+  - a device-side counter tracks CONSECUTIVE bad steps; the host reads
+    it only every ``check_period`` steps (default: ``max_bad_steps``, so
+    detection costs one scalar transfer per K steps, not per step);
+  - once the streak reaches ``max_bad_steps`` the trainer restores
+    params + optimizer state from the newest intact checkpoint (when a
+    checkpoint manager is attached) and emits a
+    :class:`paddle_tpu.trainer.event.FaultEvent` so handlers can log,
+    alert, or raise to abort the run.
+
+Skipped steps still fire their iteration events (the cost a handler
+reads is the raw, possibly non-finite value — visibility, not
+censorship), but their metric contributions are zeroed on device so pass
+averages stay finite; the per-step metric ``fault_ok`` is 1.0 on good
+steps and 0.0 on skipped ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["FaultPolicy"]
+
+
+@dataclasses.dataclass
+class FaultPolicy:
+    """Opt-in numeric fault handling for ``SGD.train``.
+
+    max_bad_steps: consecutive non-finite steps tolerated (updates are
+        skipped throughout) before a checkpoint rollback + FaultEvent.
+    check_period: how often (in steps) the host reads the device-side
+        bad-step streak. ``None`` means ``max_bad_steps`` — the longest
+        cadence that still catches every rollback-worthy streak while it
+        is live. ``1`` reproduces eager per-step detection (one scalar
+        device read per step).
+    rollback: restore from the newest intact checkpoint when the streak
+        hits ``max_bad_steps``. With no checkpoint manager attached (or
+        no checkpoint on disk yet) the rollback is a no-op — parameters
+        are already intact because every bad update was skipped — and
+        the FaultEvent carries ``restored_step=None``.
+    """
+
+    max_bad_steps: int = 3
+    check_period: Optional[int] = None
+    rollback: bool = True
+
+    def __post_init__(self):
+        if self.max_bad_steps < 1:
+            raise ValueError("max_bad_steps must be >= 1")
+        if self.check_period is not None and self.check_period < 1:
+            raise ValueError("check_period must be >= 1 (or None)")
+
+    @property
+    def effective_check_period(self) -> int:
+        return self.check_period or self.max_bad_steps

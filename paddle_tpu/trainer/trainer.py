@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -126,6 +127,11 @@ class SGD:
         self.opt_state = self.optimizer.init_state(parameters.raw)
         self._rng = jax.random.PRNGKey(global_config().seed)
         self._step_count = 0
+        # position counters for auto-resume: completed passes, and
+        # completed batches within the current pass (both checkpointed, so
+        # a relaunched run re-enters the pass it died in)
+        self._pass_count = 0
+        self._batch_in_pass = 0
         if mesh is None:
             mesh = self._default_mesh()
         self.mesh = mesh
@@ -143,6 +149,10 @@ class SGD:
         self.pipeline_schedule = pipeline_schedule
         self.pipeline_microbatches = pipeline_microbatches
         self._train_step = self._build_train_step()
+        # guarded variant (train(fault_policy=...)) compiled on first use
+        self._train_step_guarded = None
+        self._fault_policy = None
+        self._bad_streak = None
         self._test_step = self._build_test_step()
 
     # ------------------------------------------------------------------
@@ -220,7 +230,52 @@ class SGD:
                      for n in self._eval_out_names}
         return total, (metrics, new_state, eval_outs)
 
-    def _build_train_step(self):
+    def _guard_step(self, step_fn):
+        """Fold the FaultPolicy finiteness guard into a train step — ON
+        DEVICE, no host sync (trainer/fault.py). The guard checks the
+        cost and every post-update float leaf (params, optimizer slots,
+        layer state): a non-finite gradient necessarily produces a
+        non-finite update under every optimizer here, and checking the
+        results also catches slot overflow from huge-but-finite grads
+        (g^2 -> inf in Adam's v) that a grads-only check would let
+        poison the state. On a bad step the update is selected away with
+        jnp.where — params/slots/state stay bit-identical to skipping
+        the batch — the step's metric contributions are zeroed (pass
+        averages stay finite; `fault_ok` records 1/0), and a device-side
+        consecutive-bad-step counter rides along for the host to sample
+        on the policy's check_period."""
+        def gstep(params, opt_state, state, feed, rng, n_real, bad_streak):
+            (new_params, new_opt_state, new_state, loss, metrics,
+             eval_outs) = step_fn(params, opt_state, state, feed, rng,
+                                  n_real)
+            ok = jnp.isfinite(loss)
+            for leaf in jax.tree_util.tree_leaves(
+                    (new_params, new_opt_state, new_state)):
+                if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+                    ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+
+            def sel(n, o):
+                return jnp.where(ok, n, o)
+
+            new_params = jax.tree_util.tree_map(sel, new_params, params)
+            new_opt_state = jax.tree_util.tree_map(sel, new_opt_state,
+                                                   opt_state)
+            new_state = jax.tree_util.tree_map(sel, new_state, state)
+            metrics = {k: jnp.where(ok, v, jnp.zeros_like(v))
+                       for k, v in metrics.items()}
+            metrics["fault_ok"] = ok.astype(jnp.float32)
+            # [current streak, peak since the host last looked]: the peak
+            # is sticky so a K-streak that ends between host checks is
+            # still detected at the next check
+            cur = jnp.where(ok, jnp.zeros((), bad_streak.dtype),
+                            bad_streak[0] + 1)
+            high = jnp.maximum(bad_streak[1], cur)
+            bad_streak = jnp.stack([cur, high])
+            return (new_params, new_opt_state, new_state, loss, metrics,
+                    eval_outs, bad_streak)
+        return gstep
+
+    def _build_train_step(self, guarded: bool = False):
         # Row-sparse tables (ParamAttr(sparse=True) embeddings fed by data
         # layers): prefetch their touched rows, differentiate w.r.t. the
         # row block only, scatter-update rows + slots. The dense
@@ -235,7 +290,7 @@ class SGD:
                 raise NotImplementedError(
                     "gradient_printer is not supported with a pipelined "
                     "train step; use it on the plain path")
-            return self._build_pipelined_train_step()
+            return self._build_pipelined_train_step(guarded=guarded)
         if sparse_map and self._grad_tap_names:
             raise NotImplementedError(
                 "gradient_printer is not supported together with "
@@ -320,6 +375,8 @@ class SGD:
             return (new_params, new_opt_state, new_state, loss, metrics,
                     eval_outs)
 
+        if guarded:
+            step = self._guard_step(step)
         if self.mesh is not None:
             from paddle_tpu.parallel import tensor_parallel as tp
             from paddle_tpu.parallel.data_parallel import shard_train_step
@@ -337,10 +394,11 @@ class SGD:
                     for name, arr in self.parameters.raw.items()}
                 o_sh = tp.opt_state_shardings(self.opt_state, p_sh,
                                               self.mesh)
-            return shard_train_step(step, self.mesh, p_sh, o_sh)
+            return shard_train_step(step, self.mesh, p_sh, o_sh,
+                                    n_extra=1 if guarded else 0)
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def _build_pipelined_train_step(self):
+    def _build_pipelined_train_step(self, guarded: bool = False):
         """Train step with the model body GPipe-pipelined over the mesh
         `pp` axis (ParallelNeuralNetwork parity — see
         parallel/pipeline.py). The tail (costs, metrics) runs replicated
@@ -361,7 +419,7 @@ class SGD:
         if self.pipeline_schedule == "1f1b":
             return self._build_1f1b_train_step(
                 stage_fn, stack_params, body_names, x_src, body_end,
-                prologue_skip)
+                prologue_skip, guarded=guarded)
 
         def step(params, opt_state, state, feed, rng, n_real):
             def loss_fn(p):
@@ -388,7 +446,9 @@ class SGD:
             return (new_params, new_opt_state, new_state, loss, metrics,
                     eval_outs)
 
-        return shard_train_step(step, mesh)
+        if guarded:
+            step = self._guard_step(step)
+        return shard_train_step(step, mesh, n_extra=1 if guarded else 0)
 
     def _prologue_forward(self, params, state, feed, rng, n_real, x_src,
                           prologue_skip):
@@ -417,7 +477,8 @@ class SGD:
         return [l.name for l in self.topology.layers if l.name not in anc]
 
     def _build_1f1b_train_step(self, stage_fn, stack_params, body_names,
-                               x_src, body_end, prologue_skip=None):
+                               x_src, body_end, prologue_skip=None,
+                               guarded: bool = False):
         """Hand-scheduled 1F1B: gradients come out of the schedule
         itself (parallel/pipeline.pipeline_1f1b), not an outer
         jax.grad; a cheap replicated tail pass afterwards produces the
@@ -538,7 +599,9 @@ class SGD:
             return (new_params, new_opt_state, new_state, loss, metrics,
                     eval_outs)
 
-        return shard_train_step(step, mesh)
+        if guarded:
+            step = self._guard_step(step)
+        return shard_train_step(step, mesh, n_extra=1 if guarded else 0)
 
     def _build_test_step(self):
         def step(params, state, feed, n_real):
@@ -553,6 +616,8 @@ class SGD:
               num_batches_per_pass: Optional[int] = None,
               coordinator=None, chunk_reader=None, batch_size: int = 0,
               checkpoint_manager=None, checkpoint_period: int = 0,
+              checkpoint_dir: Optional[str] = None,
+              auto_resume: bool = False, fault_policy=None,
               idle_timeout: float = 600.0):
         """reader: callable yielding BATCHES (lists of sample tuples), i.e.
         the output of paddle_tpu.reader.batch(...).
@@ -561,38 +626,73 @@ class SGD:
         service.go + NewRemoteParameterUpdater): pass `coordinator` (a
         Coordinator or a connect() RPC proxy) + `chunk_reader` instead of
         `reader` — data then flows through coordinator-dispatched tasks
-        (timeout-requeued if this trainer dies), `num_passes` counts
+        (lease-requeued if this trainer dies), `num_passes` counts
         coordinator epochs, and with `checkpoint_manager` the trainer
         auto-restores the newest full-state checkpoint on entry and saves
         every `checkpoint_period` batches + each pass end, so a SIGKILLed
-        trainer resumes within the pass it died in."""
+        trainer resumes within the pass it died in.
+
+        checkpoint_dir: shorthand for checkpoint_manager=
+        CheckpointManager(checkpoint_dir) (docs/robustness.md).
+
+        auto_resume: restore the newest intact checkpoint before the
+        first pass and continue FROM it — pass counter, position within
+        the interrupted pass, optimizer slots, and RNG state all resume,
+        so a kill -9'd run relaunched with the same flags replays the
+        uninterrupted run exactly (deterministic readers; num_passes is
+        then the run TOTAL, not additional passes). No-op when no
+        checkpoint exists yet.
+
+        fault_policy: a trainer.fault.FaultPolicy — check every step's
+        numerics on device, skip non-finite updates, and roll back to
+        the newest checkpoint after K consecutive bad steps, emitting
+        event.FaultEvent (docs/robustness.md)."""
         from paddle_tpu.trainer.data_feeder import DataFeeder
         if event_handler is None:
             event_handler = _default_event_handler
         feeder = DataFeeder(self.topology.data_type(), feeding)
+        if checkpoint_manager is None and checkpoint_dir:
+            from paddle_tpu.trainer.checkpoint import CheckpointManager
+            checkpoint_manager = CheckpointManager(checkpoint_dir)
+
+        self._fault_policy = fault_policy
+        if fault_policy is not None:
+            if self._train_step_guarded is None:
+                self._train_step_guarded = self._build_train_step(
+                    guarded=True)
+            if self._bad_streak is None:
+                self._bad_streak = jnp.zeros((2,), jnp.int32)
+            self._fault_steps_since_check = 0
 
         if coordinator is not None:
             from paddle_tpu.reader import batch as batch_reader
-            from paddle_tpu.trainer.coordinator import (coordinator_epoch,
+            from paddle_tpu.trainer.coordinator import (RetryPolicy,
+                                                        coordinator_epoch,
                                                         task_reader)
             assert chunk_reader is not None, \
                 "coordinator mode needs chunk_reader(chunk) -> records"
+            # every coordinator RPC (here and inside task_reader) retries
+            # with backoff — a coordinator restarting while trainers come
+            # up delays them instead of killing them
+            retry = RetryPolicy()
             rdr = task_reader(coordinator, chunk_reader,
-                              idle_timeout=idle_timeout)
+                              idle_timeout=idle_timeout, retry=retry)
             if batch_size:
                 rdr = batch_reader(rdr, batch_size)
             if checkpoint_manager is not None:
                 self.restore_checkpoint(checkpoint_manager)
 
             try:
-                while coordinator_epoch(coordinator) < num_passes:
-                    pass_id = coordinator_epoch(coordinator)
+                while coordinator_epoch(coordinator,
+                                        retry=retry) < num_passes:
+                    pass_id = coordinator_epoch(coordinator, retry=retry)
                     self._run_pass(pass_id, rdr, feeder, event_handler,
                                    num_batches_per_pass, checkpoint_manager,
                                    checkpoint_period)
                     if checkpoint_manager is not None:
                         self.save_checkpoint(checkpoint_manager)
-                    if coordinator_epoch(coordinator) == pass_id:
+                    if coordinator_epoch(coordinator, retry=retry) == \
+                            pass_id:
                         # the reader gave up without the epoch turning
                         # (every task dropped, or idle_timeout hit) —
                         # surfaced by task_reader's warning; don't spin
@@ -609,11 +709,22 @@ class SGD:
                     checkpoint_manager.wait()
             return
 
+        start_pass, skip_batches = 0, 0
+        if auto_resume and checkpoint_manager is not None and \
+                self.restore_checkpoint(checkpoint_manager):
+            # replay position: skip the passes (and the leading batches
+            # of the interrupted pass) the checkpoint already covers.
+            # RNG splits for skipped batches already happened before the
+            # save, so skipped batches must not re-split (_run_pass).
+            start_pass = self._pass_count
+            skip_batches = self._batch_in_pass
         try:
-            for pass_id in range(num_passes):
+            for pass_id in range(start_pass, num_passes):
                 self._run_pass(pass_id, reader, feeder, event_handler,
                                num_batches_per_pass, checkpoint_manager,
-                               checkpoint_period)
+                               checkpoint_period,
+                               skip_batches=skip_batches
+                               if pass_id == start_pass else 0)
                 if checkpoint_manager is not None:
                     self.save_checkpoint(checkpoint_manager)
         finally:
@@ -700,13 +811,53 @@ class SGD:
                 return
             yield feed
 
+    @staticmethod
+    def _kahan_add(acc, v):
+        """One compensated-summation step on device: (sum, comp) + v.
+        Eager jnp ops — XLA never sees the expression, so the
+        compensation term cannot be algebraically simplified away."""
+        s, c = acc
+        y = v - c
+        t = s + y
+        return t, (t - s) - y
+
+    def _check_faults(self, policy, pass_id, batch_id, event_handler,
+                      checkpoint_manager):
+        """Host side of the guarded step: sample the device-side
+        [current, peak-since-last-check] bad-step counter every
+        check_period steps (the only host sync the fault path adds), and
+        roll back + emit FaultEvent when the peak reached the policy
+        limit. The peak is sticky on device, so a K-streak that ends
+        between checks is still seen."""
+        self._fault_steps_since_check += 1
+        if self._fault_steps_since_check < policy.effective_check_period:
+            return
+        self._fault_steps_since_check = 0
+        cur, high = (int(v) for v in jax.device_get(self._bad_streak))
+        if high >= policy.max_bad_steps:
+            restored = None
+            if policy.rollback and checkpoint_manager is not None and \
+                    self.restore_checkpoint(checkpoint_manager):
+                restored = self._step_count
+            self._bad_streak = jnp.zeros((2,), jnp.int32)
+            event_handler(evt.FaultEvent(pass_id, batch_id, "rollback",
+                                         high, restored))
+        elif high > 0:
+            # streak live or recently ended, below the rollback limit:
+            # surface it, and lower the peak to the live value so an
+            # ended streak is reported once
+            self._bad_streak = jnp.asarray([cur, cur], jnp.int32)
+            event_handler(evt.FaultEvent(pass_id, batch_id, "nonfinite",
+                                         high, None))
+
     def _run_pass(self, pass_id, reader, feeder, event_handler,
                   num_batches_per_pass, checkpoint_manager=None,
-                  checkpoint_period: int = 0):
+                  checkpoint_period: int = 0, skip_batches: int = 0):
         event_handler(evt.BeginPass(pass_id))
         pass_metrics: Dict[str, float] = {}
-        metrics_dev = None         # lazy path: running on-device sums
+        metrics_dev = None      # lazy path: on-device (sum, comp) pairs
         n_batches = 0
+        policy = self._fault_policy
         for ev in self.evaluators:
             ev.start()
         # With host-side evaluators attached, their streaming update needs
@@ -715,27 +866,53 @@ class SGD:
         # dispatch queue runs ahead of the device (the JAX async idiom) —
         # a handler reading e.cost still syncs, on ITS schedule.
         lazy = not self.evaluators
+        # lazy per-pass sums accumulate compensated (Kahan) — or in real
+        # float64 when x64 is on — so long-pass averages match the eager
+        # path's host-float64 accumulation instead of drifting in
+        # sequential f32 (docs/perf.md 'Lazy pass metrics').
+        acc_dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        self._batch_in_pass = skip_batches
         for batch_id, feed in enumerate(self._prefetched(reader, feeder)):
             if num_batches_per_pass is not None and \
                     batch_id >= num_batches_per_pass:
                 break
+            if batch_id < skip_batches:
+                # auto-resume replay: the checkpoint already covers this
+                # batch — and its RNG split happened before the save, so
+                # the batch is consumed without stepping or re-splitting
+                continue
             event_handler(evt.BeginIteration(pass_id, batch_id))
             n_real_host = int(feed.pop("__batch_size__"))
             n_real = jnp.asarray(n_real_host, jnp.int32)
             self._rng, sub = jax.random.split(self._rng)
             with stat_timer("train_step"):
-                (new_params, self.opt_state, new_state, loss,
-                 metrics, eval_outs) = self._train_step(
-                    self._own_params(), self.opt_state,
-                    self.parameters.state, feed, sub, n_real)
+                if policy is not None:
+                    (new_params, self.opt_state, new_state, loss,
+                     metrics, eval_outs,
+                     self._bad_streak) = self._train_step_guarded(
+                        self._own_params(), self.opt_state,
+                        self.parameters.state, feed, sub, n_real,
+                        self._bad_streak)
+                else:
+                    (new_params, self.opt_state, new_state, loss,
+                     metrics, eval_outs) = self._train_step(
+                        self._own_params(), self.opt_state,
+                        self.parameters.state, feed, sub, n_real)
             self._merge_params(new_params)
             self.parameters.state = new_state
             self._step_count += 1
+            self._batch_in_pass = batch_id + 1
             n_batches += 1
             if lazy:
-                # running on-device sum: O(1) live buffers, still async
-                metrics_dev = metrics if metrics_dev is None else {
-                    k: metrics_dev[k] + v for k, v in metrics.items()}
+                # running on-device sums: O(1) live buffers, still async
+                if metrics_dev is None:
+                    metrics_dev = {
+                        k: (v.astype(acc_dt), jnp.zeros((), acc_dt))
+                        for k, v in metrics.items()}
+                else:
+                    metrics_dev = {
+                        k: self._kahan_add(metrics_dev[k], v.astype(acc_dt))
+                        for k, v in metrics.items()}
                 fetch_host = self._fetch_host   # plain function — the
                 # event closure must not pin the trainer alive
                 event_handler(evt.LazyEndIteration(
@@ -751,16 +928,30 @@ class SGD:
                     self._feed_evaluators(eval_host, n_real_host))
                 event_handler(evt.EndIteration(pass_id, batch_id,
                                                loss_np, metrics_np))
+            if policy is not None:
+                self._check_faults(policy, pass_id, batch_id,
+                                   event_handler, checkpoint_manager)
             if checkpoint_manager is not None and checkpoint_period and \
                     self._step_count % checkpoint_period == 0:
                 self.save_checkpoint(checkpoint_manager)
         if metrics_dev is not None:
             # one transfer fetches the whole pass's sums
-            for k, v in jax.device_get(metrics_dev).items():
-                pass_metrics[k] = pass_metrics.get(k, 0.0) + float(v)
-        avg = {k: v / max(n_batches, 1) for k, v in pass_metrics.items()}
+            for k, (s, c) in jax.device_get(metrics_dev).items():
+                pass_metrics[k] = pass_metrics.get(k, 0.0) + float(s) + \
+                    float(c)
+        # guarded runs: skipped steps contributed zeros — average over
+        # the GOOD steps so one bad batch doesn't dilute the pass metrics
+        denom = float(max(n_batches, 1))
+        if policy is not None and "fault_ok" in pass_metrics:
+            good = pass_metrics.pop("fault_ok")
+            avg = {k: v / max(good, 1.0) for k, v in pass_metrics.items()}
+            avg["fault_ok"] = good / denom
+        else:
+            avg = {k: v / denom for k, v in pass_metrics.items()}
         for ev in self.evaluators:
             avg.update(ev.result())
+        self._pass_count = pass_id + 1
+        self._batch_in_pass = 0
         event_handler(evt.EndPass(pass_id, avg, self.parameters))
 
     def test(self, reader, feeding=None) -> evt.TestResult:
@@ -826,6 +1017,8 @@ class SGD:
         service.go:272, paddle/optimizer/serialization.h)."""
         import numpy as _np
         m = {"step_count": self._step_count,
+             "pass_count": self._pass_count,
+             "batch_in_pass": self._batch_in_pass,
              "rng": _np.asarray(jax.random.key_data(self._rng)).tolist()}
         m.update(meta or {})
         return manager.save(self._step_count, self.parameters.raw,
@@ -842,6 +1035,8 @@ class SGD:
         self.parameters.state = tree["state"]
         self.opt_state = tree["opt_state"]
         self._step_count = int(tree["meta"].get("step_count", 0))
+        self._pass_count = int(tree["meta"].get("pass_count", 0))
+        self._batch_in_pass = int(tree["meta"].get("batch_in_pass", 0))
         if "rng" in tree["meta"]:
             # Restore raw uint32 bits to keep the legacy key flavor the
             # rest of the trainer uses — wrap_key_data would produce a
@@ -869,3 +1064,5 @@ def _default_event_handler(e):
                   f"Cost {e.cost:.6f}, {e.evaluator}")
     elif isinstance(e, evt.EndPass):
         print(f"Pass {e.pass_id} done. {e.evaluator}")
+    elif isinstance(e, evt.FaultEvent):
+        print(f"FAULT {e!r}", file=sys.stderr)

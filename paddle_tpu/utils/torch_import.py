@@ -74,10 +74,21 @@ def import_torch_state_dict(parameters, state_dict: Mapping[str, object],
     if name_map is None:
         pnames = list(parameters.names())
         tkeys = list(state_dict.keys())
-        if strict and len(pnames) != len(tkeys):
-            raise ValueError(
-                f"positional import needs equal counts: {len(pnames)} "
-                f"parameters vs {len(tkeys)} torch tensors (pass name_map)")
+        if len(pnames) != len(tkeys):
+            if strict:
+                raise ValueError(
+                    f"positional import needs equal counts: {len(pnames)} "
+                    f"parameters vs {len(tkeys)} torch tensors "
+                    "(pass name_map)")
+            import warnings
+            short, long_ = sorted((len(pnames), len(tkeys)))
+            side = "parameters" if len(pnames) > len(tkeys) \
+                else "torch tensors"
+            warnings.warn(
+                f"positional import with strict=False: {len(pnames)} "
+                f"parameters vs {len(tkeys)} torch tensors — only the "
+                f"first {short} pairs load, {long_ - short} trailing "
+                f"{side} are skipped", stacklevel=2)
         name_map = dict(zip(pnames, tkeys))
     n = 0
     for pname, tkey in name_map.items():

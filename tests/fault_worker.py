@@ -1,0 +1,65 @@
+"""Subprocess trainer for the SIGKILL auto-resume chaos test
+(tests/test_faults.py): a plain (non-coordinator) run with
+--checkpoint_dir/--checkpoint_period/--auto_resume semantics, printing a
+'STEP n' marker per completed batch so FaultPlan.kill_at_marker can
+SIGKILL it at an exact step, and a params digest at the end so the
+resumed run can be compared bit-for-bit with an uninterrupted one.
+
+argv: <ckpt_dir> <num_passes> <per_step_delay_s>
+"""
+
+import hashlib
+import sys
+import time
+
+
+def main():
+    ckpt_dir = sys.argv[1]
+    num_passes = int(sys.argv[2])
+    delay = float(sys.argv[3])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+
+    paddle.init(seed=0)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    y = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    out = paddle.layer.fc(x, size=2, act=paddle.activation.Softmax(),
+                          name="out")
+    cost = paddle.layer.classification_cost(out, y, name="cost")
+    params = paddle.create_parameters(paddle.Topology(cost))
+    tr = paddle.SGD(cost=cost, parameters=params,
+                    update_equation=paddle.optimizer.Momentum(
+                        learning_rate=0.05))
+
+    def reader():
+        rng = np.random.RandomState(42)
+        for _ in range(6):
+            f = rng.randn(4, 8).astype("float32")
+            lbl = rng.randint(0, 2, 4)
+            yield [(f[i], int(lbl[i])) for i in range(4)]
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            print(f"STEP {tr._step_count}", flush=True)
+            if delay:
+                time.sleep(delay)
+
+    tr.train(reader, num_passes=num_passes, event_handler=handler,
+             checkpoint_dir=ckpt_dir, checkpoint_period=1,
+             auto_resume=True)
+
+    h = hashlib.md5()
+    for k in sorted(tr.parameters.raw):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(
+            np.asarray(tr.parameters.raw[k])).tobytes())
+    print(f"WORKER DONE steps={tr._step_count} digest={h.hexdigest()}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,37 @@ class TestCoordinator:
         assert grants.count(True) == 1
         assert c.request_save_model(1) is True
 
+    def test_save_election_regrants_current_trainer(self):
+        # RequestSaveModel parity: the CURRENT saving trainer re-asking
+        # is re-granted (service.go TrainerID == savingTrainer); others
+        # stay denied — per epoch and per window alike
+        c = Coordinator(chunks=[1])
+        assert c.request_save_model(0, 30.0, "tr-A") is True
+        assert c.request_save_model(0, 30.0, "tr-A") is True
+        assert c.request_save_model(0, 30.0, "tr-B") is False
+        c2 = Coordinator(chunks=[1])
+        assert c2.request_save_model(None, 30.0, "tr-A") is True
+        assert c2.request_save_model(None, 30.0, "tr-A") is True
+        assert c2.request_save_model(None, 30.0, "tr-B") is False
+        # anonymous callers are never re-granted within the window
+        c3 = Coordinator(chunks=[1])
+        assert c3.request_save_model() is True
+        assert c3.request_save_model() is False
+
+    def test_public_status_properties(self, tmp_path):
+        store = FileStore(str(tmp_path))
+        c1 = Coordinator(chunks=list(range(4)), chunks_per_task=2,
+                         store=store)
+        assert c1.chunks == tuple(range(4))
+        assert c1.chunks_per_task == 2
+        assert c1.recovered is False
+        # a coordinator recovering from the snapshot reports it and
+        # serves the RECOVERED chunk list, not its constructor args
+        c2 = Coordinator(chunks=[], store=store)
+        assert c2.recovered is True
+        assert c2.chunks == tuple(range(4))
+        assert c2.chunks_per_task == 2
+
     def test_task_reader_skips_bad_chunk(self):
         c = Coordinator(chunks=["a", "bad", "b"], chunks_per_task=1,
                         failure_max=2)

@@ -1,0 +1,323 @@
+"""Chaos tests — the fault-injection harness (paddle_tpu/testing/faults)
+driven against the real training loop: checkpoint write faults, NaN
+steps under the guarded train step, coordinator RPC drops/delays and
+lease expiry, and a SIGKILL'd subprocess trainer auto-resuming
+(docs/robustness.md)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.testing.faults import FaultPlan
+from paddle_tpu.trainer.checkpoint import CheckpointManager
+from paddle_tpu.trainer.coordinator import (Coordinator, CoordinatorServer,
+                                            RetryPolicy, call_with_retry,
+                                            connect, task_reader)
+from paddle_tpu.trainer.fault import FaultPolicy
+
+
+def _trainer(seed=0):
+    from paddle_tpu.core import registry
+    registry.reset_name_counters()
+    paddle.init(use_tpu=False, seed=seed)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(16))
+    out = paddle.layer.fc(x, size=4, act=paddle.activation.Softmax(),
+                          name="out")
+    y = paddle.layer.data("y", paddle.data_type.integer_value(4))
+    cost = paddle.layer.classification_cost(out, y, name="cost")
+    params = paddle.create_parameters(paddle.Topology(cost))
+    return paddle.SGD(cost=cost, parameters=params,
+                      update_equation=paddle.optimizer.Adam(
+                          learning_rate=1e-2))
+
+
+def _reader(n_batches=8, batch=16):
+    rng = np.random.RandomState(3)
+    feats = rng.randn(n_batches, batch, 16).astype("float32")
+    labels = rng.randint(0, 4, (n_batches, batch))
+
+    def reader():
+        for b in range(n_batches):
+            yield [(feats[b, i], int(labels[b, i])) for i in range(batch)]
+    return reader
+
+
+# ---------------------------------------------------------------- (a) disk
+
+class TestCheckpointFaults:
+    def test_enospc_surfaces_and_previous_survives(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_write=False)
+        plan = FaultPlan()
+        w = {"w": np.ones((4, 4), np.float32)}
+        with plan.checkpoint_write_failure(at_save=1):
+            mgr.save(1, w)
+            with pytest.raises(OSError):
+                mgr.save(2, w)
+        assert mgr.latest_step() == 1
+
+    def test_torn_write_recovery(self, tmp_path):
+        """Satellite: a write that dies mid-file (ENOSPC at a chosen
+        byte) leaves a torn artifact — in the .tmp staging dir, never
+        renamed — and latest_step() returns the previous INTACT one."""
+        mgr = CheckpointManager(str(tmp_path), async_write=False)
+        plan = FaultPlan()
+        w = {"w": np.arange(256, dtype=np.float32).reshape(16, 16)}
+        mgr.save(1, w)
+        with plan.checkpoint_write_failure(at_save=0, at_byte=128):
+            with pytest.raises(OSError):
+                mgr.save(2, w)
+        # the torn bytes exist on disk, but only in staging
+        torn = tmp_path / "ckpt-0000000002.tmp" / "state.npz"
+        assert torn.exists() and torn.stat().st_size <= 128
+        assert mgr.latest_step() == 1
+        step, tree = mgr.restore()
+        assert step == 1
+        np.testing.assert_array_equal(tree["params"]["w"], w["w"])
+
+    def test_async_write_failure_surfaces_at_wait(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_write=True)
+        plan = FaultPlan()
+        with plan.checkpoint_write_failure(at_save=0):
+            mgr.save(1, {"w": np.ones((2, 2), np.float32)})
+            with pytest.raises(RuntimeError, match="checkpoint"):
+                mgr.wait()
+        assert mgr.latest_step() is None
+
+    def test_md5_corruption_falls_back(self, tmp_path):
+        """Satellite: bit-rot on the NEWEST checkpoint -> restore uses
+        the one before it."""
+        mgr = CheckpointManager(str(tmp_path), async_write=False)
+        mgr.save(1, {"w": np.full((2, 2), 1.0, np.float32)})
+        mgr.save(2, {"w": np.full((2, 2), 2.0, np.float32)})
+        corrupted = FaultPlan.corrupt_newest_checkpoint(str(tmp_path))
+        assert corrupted == 2
+        assert mgr.latest_step() == 1
+        step, tree = mgr.restore()
+        assert step == 1
+        np.testing.assert_array_equal(tree["params"]["w"],
+                                      np.full((2, 2), 1.0, np.float32))
+
+
+# ----------------------------------------------------------- (c) numerics
+
+class TestGuardedStep:
+    def test_nan_steps_never_reach_params(self):
+        """Injected non-finite losses at chosen steps leave the params
+        finite and BIT-identical to a run that skipped those batches."""
+        plan = FaultPlan()
+        bad = {2, 5}
+        events = []
+        tr = _trainer()
+        tr.train(plan.poison_batches(_reader(), bad), num_passes=1,
+                 fault_policy=FaultPolicy(max_bad_steps=3),
+                 event_handler=events.append)
+
+        tr2 = _trainer()
+
+        def skipping():
+            for b, batch in enumerate(_reader()()):
+                if b not in bad:
+                    yield batch
+        tr2.train(skipping, num_passes=1,
+                  fault_policy=FaultPolicy(max_bad_steps=3))
+
+        for k in tr.parameters.raw:
+            a = np.asarray(tr.parameters.raw[k])
+            b = np.asarray(tr2.parameters.raw[k])
+            assert np.isfinite(a).all()
+            np.testing.assert_array_equal(a, b)
+        faults = [e for e in events
+                  if isinstance(e, paddle.event.FaultEvent)]
+        assert faults and all(f.kind == "nonfinite" for f in faults)
+        done = [e for e in events if isinstance(e, paddle.event.EndPass)]
+        # skipped steps are excluded from pass averages; the good-step
+        # fraction is surfaced
+        assert done[0].metrics["fault_ok"] == pytest.approx(0.75)
+        assert np.isfinite(done[0].metrics["cost"])
+
+    def test_inf_injection_also_guarded(self):
+        plan = FaultPlan()
+        tr = _trainer()
+        tr.train(plan.poison_batches(_reader(), {1}, value=float("inf")),
+                 num_passes=1, fault_policy=FaultPolicy(max_bad_steps=2))
+        for k, v in tr.parameters.raw.items():
+            assert np.isfinite(np.asarray(v)).all(), k
+
+    def test_k_bad_steps_roll_back(self, tmp_path):
+        """K consecutive bad steps -> restore from the newest intact
+        checkpoint + a FaultEvent(kind='rollback')."""
+        plan = FaultPlan()
+        mgr = CheckpointManager(str(tmp_path))
+        events = []
+        tr = _trainer()
+        tr.train(plan.poison_batches(_reader(), {3, 4, 5}), num_passes=1,
+                 fault_policy=FaultPolicy(max_bad_steps=3),
+                 checkpoint_manager=mgr, checkpoint_period=2,
+                 event_handler=events.append)
+        rb = [e for e in events if isinstance(e, paddle.event.FaultEvent)
+              and e.kind == "rollback"]
+        assert len(rb) == 1
+        assert rb[0].bad_streak == 3
+        assert rb[0].restored_step is not None     # a checkpoint existed
+        for k, v in tr.parameters.raw.items():
+            assert np.isfinite(np.asarray(v)).all(), k
+
+    def test_streak_detected_between_checks(self):
+        """A K-streak that ENDS between host checks is still caught (the
+        device-side peak counter is sticky): bad steps 1-3 with
+        check_period=4 must roll up a rollback event at the step-4
+        check."""
+        plan = FaultPlan()
+        events = []
+        tr = _trainer()
+        tr.train(plan.poison_batches(_reader(), {1, 2, 3}), num_passes=1,
+                 fault_policy=FaultPolicy(max_bad_steps=3, check_period=4),
+                 event_handler=events.append)
+        kinds = [e.kind for e in events
+                 if isinstance(e, paddle.event.FaultEvent)]
+        assert "rollback" in kinds
+
+
+# ---------------------------------------------------------------- (b) rpc
+
+class TestCoordinatorChaos:
+    def test_retry_survives_drops_and_delays(self):
+        """Injected RPC drops/delays: task_reader retries with backoff
+        and still completes the epoch."""
+        plan = FaultPlan(seed=7)
+        c = Coordinator(chunks=["a", "b", "c"], chunks_per_task=1)
+        flaky = plan.flaky_coordinator(
+            c,
+            drop={"get_task": [0, 2], "task_finished": [0]},
+            delay={"get_task": {1: 0.05}})
+        retry = RetryPolicy(base_delay=0.01, deadline=5.0)
+        recs = list(task_reader(flaky, lambda ch: [ch + "0"],
+                                retry=retry)())
+        assert sorted(recs) == ["a0", "b0", "c0"]
+        assert c.epoch == 1
+        assert flaky.faults_injected >= 3
+
+    def test_deadline_exhaustion_raises(self):
+        c = Coordinator(chunks=["a"], chunks_per_task=1)
+        plan = FaultPlan()
+        flaky = plan.flaky_coordinator(c, drop_rate=1.0)
+        with pytest.raises(TimeoutError):
+            call_with_retry(flaky.get_task, 0,
+                            policy=RetryPolicy(base_delay=0.01,
+                                               deadline=0.2))
+
+    def test_unreachable_coordinator_times_out_cleanly(self):
+        """Startup degradation: nothing listening -> bounded backoff,
+        then a clear TimeoutError (not a raw socket error)."""
+        dead = connect("127.0.0.1", 1)       # nothing listens there
+        with pytest.raises(TimeoutError):
+            call_with_retry(dead.get_task, 0,
+                            policy=RetryPolicy(base_delay=0.01,
+                                               deadline=0.3))
+
+    def test_heartbeat_keeps_slow_trainer_alive(self):
+        c = Coordinator(chunks=[1, 2], chunks_per_task=1, timeout_s=0.3)
+        t = c.get_task()
+        for _ in range(5):                    # hold it well past the lease
+            time.sleep(0.1)
+            assert c.heartbeat(t["task_id"])
+        assert c.task_finished(t["task_id"])  # still ours
+
+    def test_expired_lease_requeues_and_heartbeat_refuses(self):
+        c = Coordinator(chunks=[1], chunks_per_task=1, timeout_s=0.05,
+                        failure_max=10)
+        t = c.get_task()
+        time.sleep(0.1)
+        assert c.heartbeat(t["task_id"]) is False   # lease lapsed
+        t2 = c.get_task()                           # re-served
+        assert t2 is not None and t2["task_id"] == t["task_id"]
+
+    def test_lease_expiry_hands_task_to_other_trainer(self):
+        """Acceptance: trainer A takes a task over RPC and dies silently
+        (no heartbeat); its lease expires and trainer B — heartbeating
+        through the same server — finishes the whole epoch."""
+        c = Coordinator(chunks=["a", "b", "c"], chunks_per_task=1,
+                        timeout_s=0.4, failure_max=10)
+        srv = CoordinatorServer(c).start()
+        try:
+            dead = connect("127.0.0.1", srv.port)
+            taken = dead.get_task()              # trainer A: takes + dies
+            assert taken is not None
+
+            live = connect("127.0.0.1", srv.port)
+            recs = []
+
+            def slow_chunks(ch):
+                # slower than the lease: only survivable via heartbeat
+                time.sleep(0.5)
+                yield ch + "0"
+
+            rdr = task_reader(live, slow_chunks,
+                              retry=RetryPolicy(base_delay=0.01,
+                                                deadline=10.0),
+                              heartbeat_interval=0.1)
+            for r in rdr():
+                recs.append(r)
+            assert sorted(recs) == ["a0", "b0", "c0"]
+            assert c.epoch == 1
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------------------------- (d) murder
+
+def _cpu_env():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+class TestSigkillAutoResume:
+    def test_sigkill_then_auto_resume_matches_uninterrupted(self, tmp_path):
+        """Acceptance: a subprocess trainer SIGKILL'd mid-pass and
+        relaunched with the same --checkpoint_dir/--auto_resume flags
+        finishes with the SAME step count and bit-identical params as an
+        uninterrupted run (checkpoint_period=1: no step lost)."""
+        import subprocess
+        import sys as _sys
+
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "fault_worker.py")
+
+        def launch(ckpt, delay):
+            return subprocess.Popen(
+                [_sys.executable, worker, ckpt, "2", str(delay)],
+                env=_cpu_env(), stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True)
+
+        # reference: uninterrupted run
+        ref = launch(str(tmp_path / "ref"), 0.0)
+        out, err = ref.communicate(timeout=180)
+        assert ref.returncode == 0, err[-2000:]
+        ref_line = [l for l in out.splitlines()
+                    if l.startswith("WORKER DONE")][0]
+
+        # chaos: kill mid-pass at step 4, then relaunch with same flags
+        ckpt = str(tmp_path / "chaos")
+        victim = launch(ckpt, 0.15)
+        died_at = FaultPlan.kill_at_marker(victim, step=4)
+        assert died_at >= 4 and victim.returncode != 0
+        mgr = CheckpointManager(ckpt)
+        assert mgr.latest_step() is not None     # an intact ckpt survives
+
+        resumed = launch(ckpt, 0.0)
+        out2, err2 = resumed.communicate(timeout=180)
+        assert resumed.returncode == 0, err2[-2000:]
+        res_line = [l for l in out2.splitlines()
+                    if l.startswith("WORKER DONE")][0]
+        # same step count AND same params digest as never having died
+        assert res_line == ref_line
+        # and the resumed run really did skip completed work: fewer than
+        # a full run's worth of fresh STEP markers
+        steps2 = [l for l in out2.splitlines() if l.startswith("STEP")]
+        assert len(steps2) < 12

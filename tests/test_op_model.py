@@ -192,14 +192,28 @@ class TestModelSaveLoad:
                            f"127.0.0.1:{server.port}")
         try:
             params = _tiny_params()
-            wins = [paddle.model.save_model(params, str(tmp_path), epoch=1)
-                    for _ in range(3)]
+            # three DISTINCT trainers race (save_model forwards the
+            # process trainer_id; vary it to simulate three processes)
+            wins = []
+            for tid in ("tr-A", "tr-B", "tr-C"):
+                monkeypatch.setattr(paddle.model, "trainer_id", tid)
+                wins.append(paddle.model.save_model(params, str(tmp_path),
+                                                    epoch=1))
             assert wins.count(True) == 1
+            # the WINNER re-requesting is re-granted (service.go:474
+            # TrainerID == savingTrainer), a loser stays denied
+            winner = ("tr-A", "tr-B", "tr-C")[wins.index(True)]
+            monkeypatch.setattr(paddle.model, "trainer_id", winner)
+            assert paddle.model.save_model(params, str(tmp_path),
+                                           epoch=1) is True
             # reference-style call with NO epoch: the server-side time
             # window (service.go RequestSaveModel duration) dedups —
             # still exactly one winner, resolved under the save lock
-            wins = [paddle.model.save_model(params, str(tmp_path / "w"))
-                    for _ in range(3)]
+            wins = []
+            for tid in ("tr-D", "tr-E", "tr-F"):
+                monkeypatch.setattr(paddle.model, "trainer_id", tid)
+                wins.append(paddle.model.save_model(params,
+                                                    str(tmp_path / "w")))
             assert wins.count(True) == 1
             # each winner wrote under <path>/<trainer_id>/model.tar
             saved = [os.path.join(r, f) for r, _, fs in os.walk(tmp_path)

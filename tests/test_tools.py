@@ -89,6 +89,16 @@ class TestTorchImport:
         with pytest.raises(ValueError):
             import_torch_state_dict(params, {"only_one": np.zeros((2, 2))})
 
+    def test_positional_non_strict_warns_with_skip_count(self):
+        # strict=False on a length mismatch must say HOW MUCH was
+        # skipped instead of silently truncating via dict(zip(...))
+        params = self._params()
+        names = list(params.names())
+        sd = {"t0": np.zeros(params.get_shape(names[0]), np.float32)}
+        with pytest.warns(UserWarning, match=r"skipped"):
+            n = import_torch_state_dict(params, sd, strict=False)
+        assert n == 1
+
     def test_square_matrix_warns_and_transpose_true_forces(self):
         # a square Linear weight is layout-ambiguous under 'auto': the
         # exact-match branch keeps it as-is but must warn; transpose=True
