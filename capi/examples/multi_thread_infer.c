@@ -19,7 +19,7 @@ extern long paddle_tpu_create(const char *model_path);
 extern long paddle_tpu_create_shared(long handle);
 extern int paddle_tpu_forward(long handle, const float *in, int batch,
                               int dim, float *out, int out_cap);
-extern void paddle_tpu_destroy(long handle);
+extern int paddle_tpu_destroy(long handle);
 
 #define BATCH 2
 #define OUT_CAP 4096

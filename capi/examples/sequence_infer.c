@@ -11,9 +11,9 @@
 
 extern int paddle_tpu_init(void);
 extern long paddle_tpu_create(const char *model_path);
-extern void paddle_tpu_destroy(long handle);
+extern int paddle_tpu_destroy(long handle);
 extern long paddle_tpu_args_create(void);
-extern void paddle_tpu_args_destroy(long args);
+extern int paddle_tpu_args_destroy(long args);
 extern int paddle_tpu_arg_set_ids(long args, int slot, const int *ids, int n);
 extern int paddle_tpu_arg_set_seq_starts(long args, int slot,
                                          const int *starts, int n);

@@ -11,9 +11,9 @@
 
 extern int paddle_tpu_init(void);
 extern long paddle_tpu_create(const char *model_path);
-extern void paddle_tpu_destroy(long handle);
+extern int paddle_tpu_destroy(long handle);
 extern long paddle_tpu_args_create(void);
-extern void paddle_tpu_args_destroy(long args);
+extern int paddle_tpu_args_destroy(long args);
 extern int paddle_tpu_arg_set_sparse(long args, int slot, int rows, int dim,
                                      const int *row_offsets, const int *cols,
                                      const float *vals, int nnz);

@@ -13,10 +13,24 @@
  * released after init and re-acquired per call, so multiple C threads
  * may serve concurrently over shared weights (serialized by the GIL at
  * dispatch; the XLA execution itself releases it).
- */
+ *
+ * Error contract: the host guarantees no Python exception crosses this
+ * boundary — every failure is a typed negative code, and
+ * paddle_tpu_last_error(handle) retrieves the message (pass 0 for
+ * process-wide failures such as a bad model path). Codes match
+ * paddle_tpu/capi_host.py. */
+
+#define PADDLE_TPU_OK 0
+#define PADDLE_TPU_ERR_INTERNAL -1     /* unexpected failure            */
+#define PADDLE_TPU_ERR_BAD_HANDLE -2   /* stale / double-destroyed      */
+#define PADDLE_TPU_ERR_BAD_ARG -3      /* malformed payload             */
+#define PADDLE_TPU_ERR_SHORT_BUFFER -4 /* buffer < declared shape       */
+#define PADDLE_TPU_ERR_BAD_SLOT -5     /* slot outside data contract    */
+#define PADDLE_TPU_ERR_BAD_MODEL -6    /* artifact unreadable           */
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <stdio.h>
 #include <string.h>
 
 static PyThreadState *g_main_state = NULL;
@@ -39,8 +53,52 @@ int paddle_tpu_init(void) {
     return 0;
 }
 
+/* Record a C-side failure (e.g. insufficient output capacity) in the
+ * host's error table so paddle_tpu_last_error covers it. GIL held. */
+static void record_error_locked(long handle, const char *msg) {
+    PyObject *m = host();
+    if (m == NULL) { PyErr_Clear(); return; }
+    PyObject *fn = PyObject_GetAttrString(m, "record_error");
+    if (fn != NULL) {
+        PyObject *res = PyObject_CallFunction(fn, "ls", handle, msg);
+        Py_XDECREF(res);
+        Py_DECREF(fn);
+    }
+    if (PyErr_Occurred()) PyErr_Clear();
+    Py_DECREF(m);
+}
+
+/* Message for the most recent failure on `handle` ('' if none; pass 0
+ * for process-wide failures). The pointer stays valid until this
+ * thread's next paddle_tpu_* call. */
+const char *paddle_tpu_last_error(long handle) {
+    static __thread char buf[1024];
+    buf[0] = '\0';
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject *m = host();
+    if (m != NULL) {
+        PyObject *fn = PyObject_GetAttrString(m, "last_error");
+        if (fn != NULL) {
+            PyObject *res = PyObject_CallFunction(fn, "l", handle);
+            if (res != NULL) {
+                const char *s = PyUnicode_AsUTF8(res);
+                if (s != NULL) {
+                    strncpy(buf, s, sizeof(buf) - 1);
+                    buf[sizeof(buf) - 1] = '\0';
+                }
+                Py_DECREF(res);
+            }
+            Py_DECREF(fn);
+        }
+        Py_DECREF(m);
+    }
+    if (PyErr_Occurred()) PyErr_Clear();
+    PyGILState_Release(g);
+    return buf;
+}
+
 static long call_long(const char *fn_name, PyObject *args) {
-    long out = -1;
+    long out = PADDLE_TPU_ERR_INTERNAL;
     PyGILState_STATE g = PyGILState_Ensure();
     PyObject *m = host();
     if (m != NULL) {
@@ -55,7 +113,8 @@ static long call_long(const char *fn_name, PyObject *args) {
         }
         Py_DECREF(m);
     }
-    if (PyErr_Occurred()) PyErr_Print();
+    /* the host never raises by contract; this is pure belt-and-braces */
+    if (PyErr_Occurred()) PyErr_Clear();
     Py_XDECREF(args);
     PyGILState_Release(g);
     return out;
@@ -76,27 +135,42 @@ long paddle_tpu_create_shared(long handle) {
 }
 
 /* Writes batch*out_dim floats into out (capacity out_cap floats).
- * Returns out_dim per sample, or -1 on error / insufficient capacity. */
+ * Returns out_dim per sample, or a negative PADDLE_TPU_ERR_* code. */
 int paddle_tpu_forward(long handle, const float *in, int batch, int dim,
                        float *out, int out_cap) {
-    int out_dim = -1;
+    int out_dim = PADDLE_TPU_ERR_INTERNAL;
     PyGILState_STATE g = PyGILState_Ensure();
     PyObject *m = host();
     if (m != NULL) {
         PyObject *fn = PyObject_GetAttrString(m, "forward");
         if (fn != NULL) {
+            Py_ssize_t in_len = (batch > 0 && dim > 0)
+                ? (Py_ssize_t)batch * dim * (Py_ssize_t)sizeof(float) : 0;
             PyObject *res = PyObject_CallFunction(
-                fn, "ly#ii", handle, (const char *)in,
-                (Py_ssize_t)(batch * dim * sizeof(float)), batch, dim);
+                fn, "ly#ii", handle, (const char *)in, in_len, batch, dim);
             if (res != NULL) {
-                PyObject *bytes_obj = PyTuple_GetItem(res, 0);
-                long od = PyLong_AsLong(PyTuple_GetItem(res, 1));
-                char *buf = NULL;
-                Py_ssize_t n = 0;
-                if (PyBytes_AsStringAndSize(bytes_obj, &buf, &n) == 0 &&
-                    n <= (Py_ssize_t)(out_cap * sizeof(float))) {
-                    memcpy(out, buf, n);
-                    out_dim = (int)od;
+                if (PyLong_Check(res)) {          /* typed error code */
+                    out_dim = (int)PyLong_AsLong(res);
+                } else {
+                    PyObject *bytes_obj = PyTuple_GetItem(res, 0);
+                    long od = PyLong_AsLong(PyTuple_GetItem(res, 1));
+                    char *buf = NULL;
+                    Py_ssize_t n = 0;
+                    if (PyBytes_AsStringAndSize(bytes_obj, &buf,
+                                                &n) == 0) {
+                        if (n <= (Py_ssize_t)(out_cap * sizeof(float))) {
+                            memcpy(out, buf, n);
+                            out_dim = (int)od;
+                        } else {
+                            char msg[160];
+                            snprintf(msg, sizeof(msg),
+                                     "forward: output needs %ld floats, "
+                                     "caller capacity is %d",
+                                     (long)(n / sizeof(float)), out_cap);
+                            record_error_locked(handle, msg);
+                            out_dim = PADDLE_TPU_ERR_SHORT_BUFFER;
+                        }
+                    }
                 }
                 Py_DECREF(res);
             }
@@ -104,16 +178,17 @@ int paddle_tpu_forward(long handle, const float *in, int batch, int dim,
         }
         Py_DECREF(m);
     }
-    if (PyErr_Occurred()) PyErr_Print();
+    if (PyErr_Occurred()) PyErr_Clear();
     PyGILState_Release(g);
     return out_dim;
 }
 
-void paddle_tpu_destroy(long handle) {
+/* Returns PADDLE_TPU_OK, or ERR_BAD_HANDLE for a stale/double destroy. */
+int paddle_tpu_destroy(long handle) {
     PyGILState_STATE g = PyGILState_Ensure();
     PyObject *args = Py_BuildValue("(l)", handle);
     PyGILState_Release(g);
-    call_long("destroy", args);
+    return (int)call_long("destroy", args);
 }
 
 /* ------------------------------------------------------------------ */
@@ -125,20 +200,21 @@ long paddle_tpu_args_create(void) {
     return call_long("args_create", NULL);
 }
 
-void paddle_tpu_args_destroy(long args_h) {
+int paddle_tpu_args_destroy(long args_h) {
     PyGILState_STATE g = PyGILState_Ensure();
     PyObject *args = Py_BuildValue("(l)", args_h);
     PyGILState_Release(g);
-    call_long("args_destroy", args);
+    return (int)call_long("args_destroy", args);
 }
 
 /* Dense float matrix [rows, dim] for slot. */
 int paddle_tpu_arg_set_value(long args_h, int slot, const float *data,
                              int rows, int dim) {
     PyGILState_STATE g = PyGILState_Ensure();
+    Py_ssize_t len = (rows > 0 && dim > 0)
+        ? (Py_ssize_t)rows * dim * (Py_ssize_t)sizeof(float) : 0;
     PyObject *args = Py_BuildValue(
-        "(liy#ii)", args_h, slot, (const char *)data,
-        (Py_ssize_t)((Py_ssize_t)rows * dim * sizeof(float)), rows, dim);
+        "(liy#ii)", args_h, slot, (const char *)data, len, rows, dim);
     PyGILState_Release(g);
     return (int)call_long("arg_set_value", args);
 }
@@ -146,9 +222,9 @@ int paddle_tpu_arg_set_value(long args_h, int slot, const float *data,
 /* Flat int32 ids [n] for slot (paddle_arguments_set_ids). */
 int paddle_tpu_arg_set_ids(long args_h, int slot, const int *ids, int n) {
     PyGILState_STATE g = PyGILState_Ensure();
+    Py_ssize_t len = n > 0 ? (Py_ssize_t)n * (Py_ssize_t)sizeof(int) : 0;
     PyObject *args = Py_BuildValue(
-        "(liy#i)", args_h, slot, (const char *)ids,
-        (Py_ssize_t)((Py_ssize_t)n * sizeof(int)), n);
+        "(liy#i)", args_h, slot, (const char *)ids, len, n);
     PyGILState_Release(g);
     return (int)call_long("arg_set_ids", args);
 }
@@ -158,9 +234,9 @@ int paddle_tpu_arg_set_ids(long args_h, int slot, const int *ids, int n) {
 int paddle_tpu_arg_set_seq_starts(long args_h, int slot, const int *starts,
                                   int n) {
     PyGILState_STATE g = PyGILState_Ensure();
+    Py_ssize_t len = n > 0 ? (Py_ssize_t)n * (Py_ssize_t)sizeof(int) : 0;
     PyObject *args = Py_BuildValue(
-        "(liy#i)", args_h, slot, (const char *)starts,
-        (Py_ssize_t)((Py_ssize_t)n * sizeof(int)), n);
+        "(liy#i)", args_h, slot, (const char *)starts, len, n);
     PyGILState_Release(g);
     return (int)call_long("arg_set_seq_starts", args);
 }
@@ -171,23 +247,24 @@ int paddle_tpu_arg_set_sparse(long args_h, int slot, int rows, int dim,
                               const int *row_offsets, const int *cols,
                               const float *vals, int nnz) {
     PyGILState_STATE g = PyGILState_Ensure();
+    Py_ssize_t off_len = rows >= 0
+        ? (Py_ssize_t)(rows + 1) * (Py_ssize_t)sizeof(int) : 0;
+    Py_ssize_t col_len = nnz > 0
+        ? (Py_ssize_t)nnz * (Py_ssize_t)sizeof(int) : 0;
     PyObject *args;
     if (vals != NULL) {
         args = Py_BuildValue(
             "(liiiy#y#y#i)", args_h, slot, rows, dim,
-            (const char *)row_offsets,
-            (Py_ssize_t)((Py_ssize_t)(rows + 1) * sizeof(int)),
-            (const char *)cols,
-            (Py_ssize_t)((Py_ssize_t)nnz * sizeof(int)),
+            (const char *)row_offsets, off_len,
+            (const char *)cols, col_len,
             (const char *)vals,
-            (Py_ssize_t)((Py_ssize_t)nnz * sizeof(float)), nnz);
+            nnz > 0 ? (Py_ssize_t)nnz * (Py_ssize_t)sizeof(float) : 0,
+            nnz);
     } else {
         args = Py_BuildValue(
             "(liiiy#y#Oi)", args_h, slot, rows, dim,
-            (const char *)row_offsets,
-            (Py_ssize_t)((Py_ssize_t)(rows + 1) * sizeof(int)),
-            (const char *)cols,
-            (Py_ssize_t)((Py_ssize_t)nnz * sizeof(int)), Py_None, nnz);
+            (const char *)row_offsets, off_len,
+            (const char *)cols, col_len, Py_None, nnz);
     }
     PyGILState_Release(g);
     return (int)call_long("arg_set_sparse", args);
@@ -195,12 +272,12 @@ int paddle_tpu_arg_set_sparse(long args_h, int slot, int rows, int dim,
 
 /* Typed forward. Writes out_rows*out_dim floats into out; for sequence
  * outputs also writes [num_seqs+1] int32 offsets into seq_starts (pass
- * NULL/0 to skip). Returns 0 on success, -1 on error or insufficient
- * capacity. */
+ * NULL/0 to skip). Returns PADDLE_TPU_OK or a negative PADDLE_TPU_ERR_*
+ * code (see paddle_tpu_last_error). */
 int paddle_tpu_forward_args(long handle, long args_h, float *out,
                             long out_cap, int *out_rows, int *out_dim,
                             int *seq_starts, int starts_cap) {
-    int rc = -1;
+    int rc = PADDLE_TPU_ERR_INTERNAL;
     PyGILState_STATE g = PyGILState_Ensure();
     PyObject *m = host();
     if (m != NULL) {
@@ -208,31 +285,49 @@ int paddle_tpu_forward_args(long handle, long args_h, float *out,
         if (fn != NULL) {
             PyObject *res = PyObject_CallFunction(fn, "ll", handle, args_h);
             if (res != NULL) {
-                PyObject *out_obj = PyTuple_GetItem(res, 0);
-                long rows = PyLong_AsLong(PyTuple_GetItem(res, 1));
-                long dim = PyLong_AsLong(PyTuple_GetItem(res, 2));
-                PyObject *starts_obj = PyTuple_GetItem(res, 3);
-                char *buf = NULL;
-                Py_ssize_t n = 0;
-                if (PyBytes_AsStringAndSize(out_obj, &buf, &n) == 0 &&
-                    n <= (Py_ssize_t)(out_cap * (long)sizeof(float))) {
-                    char *sbuf = NULL;
-                    Py_ssize_t sn = 0;
-                    if (PyBytes_AsStringAndSize(starts_obj, &sbuf,
+                if (PyLong_Check(res)) {          /* typed error code */
+                    rc = (int)PyLong_AsLong(res);
+                } else {
+                    PyObject *out_obj = PyTuple_GetItem(res, 0);
+                    long rows = PyLong_AsLong(PyTuple_GetItem(res, 1));
+                    long dim = PyLong_AsLong(PyTuple_GetItem(res, 2));
+                    PyObject *starts_obj = PyTuple_GetItem(res, 3);
+                    char *buf = NULL, *sbuf = NULL;
+                    Py_ssize_t n = 0, sn = 0;
+                    if (PyBytes_AsStringAndSize(out_obj, &buf, &n) == 0 &&
+                        PyBytes_AsStringAndSize(starts_obj, &sbuf,
                                                 &sn) == 0) {
-                        /* a sequence output (sn > 0) REQUIRES a large
-                         * enough seq_starts buffer — truncating offsets
-                         * silently would hand the caller garbage row
-                         * boundaries */
-                        if (sn == 0 ||
-                            (seq_starts != NULL &&
-                             sn <= (Py_ssize_t)(starts_cap *
-                                                (long)sizeof(int)))) {
+                        if (n > (Py_ssize_t)(out_cap *
+                                             (long)sizeof(float))) {
+                            char msg[160];
+                            snprintf(msg, sizeof(msg),
+                                     "forward_args: output needs %ld "
+                                     "floats, caller capacity is %ld",
+                                     (long)(n / sizeof(float)), out_cap);
+                            record_error_locked(handle, msg);
+                            rc = PADDLE_TPU_ERR_SHORT_BUFFER;
+                        } else if (sn > 0 &&
+                                   (seq_starts == NULL ||
+                                    sn > (Py_ssize_t)(starts_cap *
+                                                      (long)sizeof(int)))) {
+                            /* a sequence output REQUIRES a large enough
+                             * seq_starts buffer — truncating offsets
+                             * silently would hand the caller garbage
+                             * row boundaries */
+                            char msg[160];
+                            snprintf(msg, sizeof(msg),
+                                     "forward_args: sequence output "
+                                     "needs %ld start offsets, caller "
+                                     "capacity is %d",
+                                     (long)(sn / sizeof(int)), starts_cap);
+                            record_error_locked(handle, msg);
+                            rc = PADDLE_TPU_ERR_SHORT_BUFFER;
+                        } else {
                             memcpy(out, buf, n);
                             if (sn > 0) memcpy(seq_starts, sbuf, sn);
                             if (out_rows != NULL) *out_rows = (int)rows;
                             if (out_dim != NULL) *out_dim = (int)dim;
-                            rc = 0;
+                            rc = PADDLE_TPU_OK;
                         }
                     }
                 }
@@ -242,7 +337,7 @@ int paddle_tpu_forward_args(long handle, long args_h, float *out,
         }
         Py_DECREF(m);
     }
-    if (PyErr_Occurred()) { PyErr_Print(); rc = -1; }
+    if (PyErr_Occurred()) PyErr_Clear();
     PyGILState_Release(g);
     return rc;
 }

@@ -1,4 +1,4 @@
-"""Python host for the C inference ABI.
+"""Python host for the C inference ABI — fault-isolated boundary.
 
 The reference's C API (paddle/capi/gradient_machine.h:36-88) exposed
 create-for-inference(+merged parameters), shared-weight clones for
@@ -7,11 +7,34 @@ is Python/JAX, so the C shim (capi/paddle_tpu_capi.c) embeds CPython and
 dispatches to this module; handles are plain ints so the C side never
 touches object lifetimes.
 
+Boundary contract (docs/robustness.md "Serving"): NO exception ever
+crosses into C. Every entry point validates its inputs (handle liveness,
+buffer lengths against declared rows/dims/nnz, non-negative counts, slot
+indices against the model's data contract) and returns a typed negative
+error code on failure; ``last_error(handle)`` retrieves the message for
+the most recent failure on that handle (pass 0 for process-wide /
+handle-less failures like a bad model path). The handle registry is a
+lock-protected, refcounted table: concurrent ``create_shared`` /
+``forward`` / ``destroy`` races cannot use-after-free a shared engine —
+destroying the source while clones serve is safe, an in-flight forward
+holds its own reference, and a stale handle is an error code, never a
+crash.
+
+Error codes (mirrored as PADDLE_TPU_ERR_* in capi/paddle_tpu_capi.c):
+  0  OK
+ -1  ERR_INTERNAL      unexpected failure; message has the details
+ -2  ERR_BAD_HANDLE    stale / double-destroyed / unknown handle
+ -3  ERR_BAD_ARG       malformed payload (negative counts, bad offsets…)
+ -4  ERR_SHORT_BUFFER  buffer smaller than the declared shape requires
+ -5  ERR_BAD_SLOT      slot index outside the model's data contract
+ -6  ERR_BAD_MODEL     artifact missing / unreadable / not a model
+
 Functions (C symbol -> here):
   paddle_tpu_create               -> create(model_path)
   paddle_tpu_create_shared        -> create_shared(handle)   # shared weights
   paddle_tpu_forward              -> forward(handle, bytes, batch, dim)
   paddle_tpu_destroy              -> destroy(handle)
+  paddle_tpu_last_error           -> last_error(handle)
 
 Typed arguments (capi/arguments.h parity — the reference serves integer-id,
 sequence and sparse inputs from C, not just dense float):
@@ -25,58 +48,223 @@ sequence and sparse inputs from C, not just dense float):
       (paddle_matrix_create_sparse / sparse_binary, capi/matrix.h:44-114)
   paddle_tpu_forward_args         -> forward_args(handle, a)
   paddle_tpu_args_destroy         -> args_destroy(a)
+
+On success ``forward`` returns (out_bytes, out_dim) and ``forward_args``
+returns (out_bytes, out_rows, out_dim, starts_bytes); on failure both
+return a plain negative int — the C shim distinguishes by type.
 """
 
 from __future__ import annotations
 
 import itertools
-from typing import Dict
+import threading
+from typing import Dict, Optional
 
 import numpy as np
 
-_handles: Dict[int, object] = {}
+OK = 0
+ERR_INTERNAL = -1
+ERR_BAD_HANDLE = -2
+ERR_BAD_ARG = -3
+ERR_SHORT_BUFFER = -4
+ERR_BAD_SLOT = -5
+ERR_BAD_MODEL = -6
+
+
+class _Engine:
+    """One loaded model, possibly referenced by several handles (the
+    source handle plus its shared-weight clones). ``refs`` counts live
+    handles; the Inference object itself stays alive for any in-flight
+    forward that captured it before a concurrent destroy."""
+
+    __slots__ = ("inference", "refs")
+
+    def __init__(self, inference):
+        self.inference = inference
+        self.refs = 1
+
+
+_lock = threading.RLock()
+_handles: Dict[int, _Engine] = {}
 _args: Dict[int, dict] = {}
+_errors: Dict[int, str] = {}
 _next_id = itertools.count(1)
 
 
-def create(model_path: str) -> int:
-    """Load a save_inference_model artifact; returns a handle id.
+def _fail(code: int, handle: int, msg: str) -> int:
+    """Record ``msg`` for ``last_error`` (under the handle and under 0,
+    the process-wide slot) and return the code."""
+    with _lock:
+        if len(_errors) > 4096:     # stale-handle keys: bound the table
+            _errors.clear()
+        _errors[int(handle)] = msg
+        _errors[0] = msg
+    return code
+
+
+def last_error(handle: int = 0) -> str:
+    """Message for the most recent failure on ``handle`` ('' if none).
+    Handle 0 holds the most recent failure process-wide — use it for
+    errors with no live handle (create failures, bad handle values)."""
+    with _lock:
+        try:
+            return _errors.get(int(handle), "")
+        except (TypeError, ValueError):
+            return ""
+
+
+def record_error(handle: int, msg: str) -> int:
+    """C-side hook: the shim records its own failures (e.g. an output
+    buffer too small for the result) so last_error covers them too."""
+    return _fail(ERR_INTERNAL, handle, str(msg))
+
+
+def _engine(handle) -> Optional["_Engine"]:
+    with _lock:
+        try:
+            return _handles.get(int(handle))
+        except (TypeError, ValueError):
+            return None
+
+
+def live_handles() -> int:
+    """Number of live model handles (test/ops introspection)."""
+    with _lock:
+        return len(_handles)
+
+
+def live_args() -> int:
+    """Number of live argument bundles (test/ops introspection)."""
+    with _lock:
+        return len(_args)
+
+
+def engine_refs(handle: int) -> int:
+    """Refcount of the engine behind ``handle`` (0 if stale)."""
+    eng = _engine(handle)
+    return eng.refs if eng is not None else 0
+
+
+def create(model_path) -> int:
+    """Load a save_inference_model artifact; returns a handle id (> 0)
+    or a negative error code.
     (`paddle_gradient_machine_create_for_inference_with_parameters`.)"""
-    from paddle_tpu.trainer.inference import load_inference_model
-    h = next(_next_id)
-    _handles[h] = load_inference_model(model_path)
-    return h
+    try:
+        if not isinstance(model_path, (str, bytes)):
+            return _fail(ERR_BAD_ARG, 0,
+                         f"create: model path must be a string, "
+                         f"got {type(model_path).__name__}")
+        from paddle_tpu.trainer.inference import load_inference_model
+        try:
+            inf = load_inference_model(model_path)
+        except Exception as e:
+            return _fail(ERR_BAD_MODEL, 0,
+                         f"create: cannot load model {model_path!r}: {e}")
+        with _lock:
+            h = next(_next_id)
+            _handles[h] = _Engine(inf)
+        return h
+    except BaseException as e:                     # never let it cross
+        return _fail(ERR_INTERNAL, 0, f"create: {e!r}")
 
 
-def create_shared(handle: int) -> int:
-    """A second engine sharing the SAME weight arrays (multi-instance
+def create_shared(handle) -> int:
+    """A second handle sharing the SAME weight arrays (multi-instance
     serving — `paddle_gradient_machine_create_shared_param`,
     capi/gradient_machine.h:88). Device buffers are immutable and shared;
-    only the handle differs — the source's jitted forward (and its compiled
-    executable cache) is reused so clones don't recompile."""
-    src = _handles[handle]
-    h = next(_next_id)
-    _handles[h] = src
-    return h
+    only the handle differs — the source's jitted forward (and its
+    compiled executable cache) is reused so clones don't recompile.
+    The clone bumps the engine refcount, so destroying the source while
+    clones serve is safe."""
+    try:
+        with _lock:
+            eng = _engine(handle)
+            if eng is None:
+                return _fail(ERR_BAD_HANDLE, handle,
+                             f"create_shared: stale or unknown "
+                             f"handle {handle}")
+            h = next(_next_id)
+            eng.refs += 1
+            _handles[h] = eng
+        return h
+    except BaseException as e:
+        return _fail(ERR_INTERNAL, handle, f"create_shared: {e!r}")
 
 
-def forward(handle: int, data: bytes, batch: int, dim: int):
+def destroy(handle) -> int:
+    """Release one handle. The engine is dropped when its last handle
+    (source or clone) goes; in-flight forwards that already checked out
+    the engine finish safely on their own reference."""
+    try:
+        with _lock:
+            eng = _engine(handle)
+            if eng is None:
+                return _fail(ERR_BAD_HANDLE, handle,
+                             f"destroy: stale or unknown handle {handle} "
+                             f"(double destroy?)")
+            del _handles[int(handle)]
+            eng.refs -= 1
+            _errors.pop(int(handle), None)
+        return OK
+    except BaseException as e:
+        return _fail(ERR_INTERNAL, handle, f"destroy: {e!r}")
+
+
+def forward(handle, data, batch, dim):
     """Dense forward: `data` is batch*dim float32s; returns
-    (out_bytes, out_dim) with out_bytes = batch*out_dim float32s.
-    (`paddle_gradient_machine_forward`.)"""
-    inf = _handles[handle]
-    x = np.frombuffer(data, dtype=np.float32,
-                      count=batch * dim).reshape(batch, dim)
-    samples = [(x[i],) for i in range(batch)]
-    probs = inf.infer(samples)
-    probs = np.asarray(probs, dtype=np.float32)
-    probs = probs.reshape(batch, -1)
-    return probs.tobytes(), int(probs.shape[1])
-
-
-def destroy(handle: int) -> int:
-    _handles.pop(handle, None)
-    return 0
+    (out_bytes, out_dim) with out_bytes = batch*out_dim float32s, or a
+    negative error code. (`paddle_gradient_machine_forward`.)"""
+    try:
+        try:
+            batch, dim = int(batch), int(dim)
+        except (TypeError, ValueError):
+            return _fail(ERR_BAD_ARG, handle,
+                         "forward: batch/dim must be integers")
+        if batch <= 0 or dim <= 0:
+            return _fail(ERR_BAD_ARG, handle,
+                         f"forward: batch ({batch}) and dim ({dim}) "
+                         f"must be positive")
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            return _fail(ERR_BAD_ARG, handle,
+                         f"forward: payload must be bytes, "
+                         f"got {type(data).__name__}")
+        need = batch * dim * 4
+        if len(data) < need:
+            return _fail(ERR_SHORT_BUFFER, handle,
+                         f"forward: input buffer is {len(data)} bytes; "
+                         f"batch*dim float32 needs {need}")
+        eng = _engine(handle)
+        if eng is None:
+            return _fail(ERR_BAD_HANDLE, handle,
+                         f"forward: stale or unknown handle {handle}")
+        inf = eng.inference               # local ref survives destroy()
+        from paddle_tpu.core.data_type import SeqType
+        data_types = inf.topology.data_type()
+        if len(data_types) != 1:
+            return _fail(ERR_BAD_ARG, handle,
+                         f"forward: model declares {len(data_types)} "
+                         f"input slots; dense forward serves exactly "
+                         f"one — use forward_args")
+        name, itype = data_types[0]
+        if itype.seq_type != SeqType.NO_SEQUENCE:
+            return _fail(ERR_BAD_ARG, handle,
+                         f"forward: input slot {name!r} is sequence-"
+                         f"typed — use forward_args with seq starts")
+        if itype.kind == "dense" and dim != itype.dim:
+            return _fail(ERR_BAD_ARG, handle,
+                         f"forward: dim {dim} != model's declared "
+                         f"input dim {itype.dim}")
+        x = np.frombuffer(data, dtype=np.float32,
+                          count=batch * dim).reshape(batch, dim)
+        samples = [(x[i],) for i in range(batch)]
+        try:
+            probs = inf.infer(samples)
+        except Exception as e:
+            return _fail(ERR_INTERNAL, handle, f"forward: {e}")
+        probs = np.asarray(probs, dtype=np.float32).reshape(batch, -1)
+        return np.ascontiguousarray(probs).tobytes(), int(probs.shape[1])
+    except BaseException as e:
+        return _fail(ERR_INTERNAL, handle, f"forward: {e!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -87,58 +275,205 @@ def args_create() -> int:
     """An arguments bundle: slot index -> typed payload. Slots feed the
     model's data layers in Topology.data_type() order, exactly as the
     reference binds `paddle_arguments` slots to input layers by index."""
-    a = next(_next_id)
-    _args[a] = {}
-    return a
+    try:
+        with _lock:
+            a = next(_next_id)
+            _args[a] = {}
+        return a
+    except BaseException as e:
+        return _fail(ERR_INTERNAL, 0, f"args_create: {e!r}")
 
 
-def args_destroy(a: int) -> int:
-    _args.pop(a, None)
-    return 0
+def args_destroy(a) -> int:
+    try:
+        with _lock:
+            try:
+                payload = _args.pop(int(a), None)
+            except (TypeError, ValueError):
+                payload = None
+            if payload is None:
+                return _fail(ERR_BAD_HANDLE, a,
+                             f"args_destroy: stale or unknown arguments "
+                             f"handle {a} (double destroy?)")
+            _errors.pop(int(a), None)
+        return OK
+    except BaseException as e:
+        return _fail(ERR_INTERNAL, a, f"args_destroy: {e!r}")
 
 
-def _slot(a: int, slot: int) -> dict:
-    return _args[a].setdefault(slot, {})
+def _bundle(a) -> Optional[dict]:
+    with _lock:
+        try:
+            return _args.get(int(a))
+        except (TypeError, ValueError):
+            return None
 
 
-def arg_set_value(a: int, slot: int, data: bytes, rows: int,
-                  dim: int) -> int:
+def _set_slot(a, slot, key, value, what: str):
+    """Shared tail of the arg setters: bundle + slot validation, then
+    store. Returns OK or an error code."""
+    bundle = _bundle(a)
+    if bundle is None:
+        return _fail(ERR_BAD_HANDLE, a,
+                     f"{what}: stale or unknown arguments handle {a}")
+    try:
+        slot = int(slot)
+    except (TypeError, ValueError):
+        return _fail(ERR_BAD_SLOT, a, f"{what}: slot must be an integer")
+    if slot < 0:
+        return _fail(ERR_BAD_SLOT, a,
+                     f"{what}: slot {slot} must be non-negative")
+    with _lock:
+        bundle.setdefault(slot, {})[key] = value
+    return OK
+
+
+def _check_buffer(data, n_items: int, what: str, desc: str,
+                  handle) -> Optional[int]:
+    """None if `data` holds at least n_items 4-byte items, else a code."""
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        return _fail(ERR_BAD_ARG, handle,
+                     f"{what}: {desc} must be bytes, "
+                     f"got {type(data).__name__}")
+    need = n_items * 4
+    if len(data) < need:
+        return _fail(ERR_SHORT_BUFFER, handle,
+                     f"{what}: {desc} is {len(data)} bytes; declared "
+                     f"shape needs {need}")
+    return None
+
+
+def arg_set_value(a, slot, data, rows, dim) -> int:
     """Dense float matrix [rows, dim] (paddle_arguments_set_value)."""
-    _slot(a, slot)["value"] = np.frombuffer(
-        data, np.float32, count=rows * dim).reshape(rows, dim)
-    return 0
+    try:
+        try:
+            rows, dim = int(rows), int(dim)
+        except (TypeError, ValueError):
+            return _fail(ERR_BAD_ARG, a,
+                         "arg_set_value: rows/dim must be integers")
+        if rows < 0 or dim <= 0:
+            return _fail(ERR_BAD_ARG, a,
+                         f"arg_set_value: rows ({rows}) must be >= 0 "
+                         f"and dim ({dim}) > 0")
+        bad = _check_buffer(data, rows * dim, "arg_set_value",
+                            f"value buffer for [{rows}, {dim}]", a)
+        if bad is not None:
+            return bad
+        val = np.frombuffer(data, np.float32,
+                            count=rows * dim).reshape(rows, dim)
+        return _set_slot(a, slot, "value", val, "arg_set_value")
+    except BaseException as e:
+        return _fail(ERR_INTERNAL, a, f"arg_set_value: {e!r}")
 
 
-def arg_set_ids(a: int, slot: int, data: bytes, n: int) -> int:
+def arg_set_ids(a, slot, data, n) -> int:
     """Integer ids, flat [n] (paddle_arguments_set_ids,
     capi/arguments.h:110). Without seq starts: one id per sample; with
     seq starts: the concatenated token stream of all sequences."""
-    _slot(a, slot)["ids"] = np.frombuffer(data, np.int32, count=n).copy()
-    return 0
+    try:
+        try:
+            n = int(n)
+        except (TypeError, ValueError):
+            return _fail(ERR_BAD_ARG, a, "arg_set_ids: n must be an integer")
+        if n < 0:
+            return _fail(ERR_BAD_ARG, a,
+                         f"arg_set_ids: n ({n}) must be >= 0")
+        bad = _check_buffer(data, n, "arg_set_ids", f"ids buffer [{n}]", a)
+        if bad is not None:
+            return bad
+        ids = np.frombuffer(data, np.int32, count=n).copy()
+        return _set_slot(a, slot, "ids", ids, "arg_set_ids")
+    except BaseException as e:
+        return _fail(ERR_INTERNAL, a, f"arg_set_ids: {e!r}")
 
 
-def arg_set_seq_starts(a: int, slot: int, data: bytes, n: int) -> int:
+def arg_set_seq_starts(a, slot, data, n) -> int:
     """Sequence start offsets [num_seqs + 1] into this slot's flat
     ids/value rows (paddle_arguments_set_sequence_start_pos,
     capi/arguments.h:137)."""
-    _slot(a, slot)["starts"] = np.frombuffer(data, np.int32, count=n).copy()
-    return 0
+    try:
+        try:
+            n = int(n)
+        except (TypeError, ValueError):
+            return _fail(ERR_BAD_ARG, a,
+                         "arg_set_seq_starts: n must be an integer")
+        if n < 2:
+            return _fail(ERR_BAD_ARG, a,
+                         f"arg_set_seq_starts: need at least 2 offsets "
+                         f"([num_seqs+1]), got n={n}")
+        bad = _check_buffer(data, n, "arg_set_seq_starts",
+                            f"starts buffer [{n}]", a)
+        if bad is not None:
+            return bad
+        starts = np.frombuffer(data, np.int32, count=n).copy()
+        if starts[0] != 0:
+            return _fail(ERR_BAD_ARG, a,
+                         f"arg_set_seq_starts: starts[0] must be 0, "
+                         f"got {int(starts[0])}")
+        if np.any(np.diff(starts) < 0):
+            return _fail(ERR_BAD_ARG, a,
+                         "arg_set_seq_starts: offsets must be "
+                         "non-decreasing")
+        return _set_slot(a, slot, "starts", starts, "arg_set_seq_starts")
+    except BaseException as e:
+        return _fail(ERR_INTERNAL, a, f"arg_set_seq_starts: {e!r}")
 
 
-def arg_set_sparse(a: int, slot: int, rows: int, dim: int,
-                   offsets: bytes, cols: bytes, vals, nnz: int) -> int:
+def arg_set_sparse(a, slot, rows, dim, offsets, cols, vals, nnz) -> int:
     """CSR sparse rows: offsets [rows+1], cols [nnz], vals [nnz] floats or
     None for sparse-binary (capi/matrix.h:44-114)."""
-    offs = np.frombuffer(offsets, np.int32, count=rows + 1)
-    c = np.frombuffer(cols, np.int32, count=nnz)
-    v = None if vals is None else np.frombuffer(vals, np.float32, count=nnz)
-    _slot(a, slot)["sparse"] = (offs.copy(), c.copy(),
-                                None if v is None else v.copy(), dim)
-    return 0
+    try:
+        try:
+            rows, dim, nnz = int(rows), int(dim), int(nnz)
+        except (TypeError, ValueError):
+            return _fail(ERR_BAD_ARG, a,
+                         "arg_set_sparse: rows/dim/nnz must be integers")
+        if rows < 0 or dim <= 0 or nnz < 0:
+            return _fail(ERR_BAD_ARG, a,
+                         f"arg_set_sparse: rows ({rows}) and nnz ({nnz}) "
+                         f"must be >= 0, dim ({dim}) > 0")
+        bad = (_check_buffer(offsets, rows + 1, "arg_set_sparse",
+                             f"row offsets [{rows + 1}]", a) or
+               _check_buffer(cols, nnz, "arg_set_sparse",
+                             f"cols [{nnz}]", a))
+        if bad is not None:
+            return bad
+        if vals is not None:
+            bad = _check_buffer(vals, nnz, "arg_set_sparse",
+                                f"vals [{nnz}]", a)
+            if bad is not None:
+                return bad
+        offs = np.frombuffer(offsets, np.int32, count=rows + 1).copy()
+        c = np.frombuffer(cols, np.int32, count=nnz).copy()
+        v = None if vals is None else np.frombuffer(
+            vals, np.float32, count=nnz).copy()
+        if rows and (offs[0] != 0 or np.any(np.diff(offs) < 0) or
+                     offs[-1] > nnz):
+            return _fail(ERR_BAD_ARG, a,
+                         f"arg_set_sparse: CSR offsets must start at 0, "
+                         f"be non-decreasing and end <= nnz ({nnz})")
+        if nnz and (np.any(c < 0) or np.any(c >= dim)):
+            return _fail(ERR_BAD_ARG, a,
+                         f"arg_set_sparse: column ids must be in "
+                         f"[0, {dim})")
+        return _set_slot(a, slot, "sparse", (offs, c, v, dim),
+                         "arg_set_sparse")
+    except BaseException as e:
+        return _fail(ERR_INTERNAL, a, f"arg_set_sparse: {e!r}")
+
+
+def _check_starts(starts, n_rows: int):
+    """Starts validated at set time against themselves; here against the
+    slot's actual row count."""
+    if int(starts[-1]) > n_rows:
+        raise ValueError(
+            f"seq starts end at {int(starts[-1])} but the slot holds "
+            f"only {n_rows} rows")
 
 
 def _slot_samples(payload: dict, itype):
-    """One slot's payload -> the per-sample column DataFeeder expects."""
+    """One slot's payload -> the per-sample column DataFeeder expects.
+    Raises ValueError on contract violations (caught by forward_args)."""
     from paddle_tpu.core.data_type import SeqType
     starts = payload.get("starts")
     if "sparse" in payload:
@@ -157,6 +492,7 @@ def _slot_samples(payload: dict, itype):
         # group them into sequences (sample = list of per-step id lists)
         if starts is None:
             raise ValueError("sequence slot needs seq starts")
+        _check_starts(starts, len(rows))
         return [rows[starts[i]:starts[i + 1]]
                 for i in range(len(starts) - 1)]
     if "ids" in payload:
@@ -165,6 +501,7 @@ def _slot_samples(payload: dict, itype):
             return [int(v) for v in ids]
         if starts is None:
             raise ValueError("sequence slot needs seq starts")
+        _check_starts(starts, len(ids))
         return [ids[starts[i]:starts[i + 1]]
                 for i in range(len(starts) - 1)]
     if "value" in payload:
@@ -173,45 +510,81 @@ def _slot_samples(payload: dict, itype):
             return [val[i] for i in range(val.shape[0])]
         if starts is None:
             raise ValueError("sequence slot needs seq starts")
+        _check_starts(starts, val.shape[0])
         return [val[starts[i]:starts[i + 1]]
                 for i in range(len(starts) - 1)]
     raise ValueError("slot has no payload")
 
 
-def forward_args(handle: int, a: int):
-    """Typed forward. Returns (out_bytes, out_rows, out_dim, starts_bytes):
-    dense outputs give out_rows == batch and empty starts; sequence outputs
-    give one row per valid token plus [num_seqs+1] int32 offsets — the
-    mirror of paddle_arguments_get_sequence_start_pos on the output side."""
-    from paddle_tpu.core.sequence import SequenceBatch
-    from paddle_tpu.trainer.data_feeder import DataFeeder
-    inf = _handles[handle]
-    data_types = inf.topology.data_type()
-    payloads = _args[a]
-    columns = []
-    for slot, (_name, itype) in enumerate(data_types):
-        if slot not in payloads:
-            raise ValueError(f"slot {slot} not set")
-        columns.append(_slot_samples(payloads[slot], itype))
-    batch = len(columns[0])
-    if any(len(c) != batch for c in columns):
-        raise ValueError("slots disagree on batch size")
-    samples = [tuple(c[i] for c in columns) for i in range(batch)]
+def forward_args(handle, a):
+    """Typed forward. Returns (out_bytes, out_rows, out_dim, starts_bytes)
+    or a negative error code: dense outputs give out_rows == batch and
+    empty starts; sequence outputs give one row per valid token plus
+    [num_seqs+1] int32 offsets — the mirror of
+    paddle_arguments_get_sequence_start_pos on the output side."""
+    try:
+        from paddle_tpu.core.sequence import SequenceBatch
+        eng = _engine(handle)
+        if eng is None:
+            return _fail(ERR_BAD_HANDLE, handle,
+                         f"forward_args: stale or unknown handle {handle}")
+        inf = eng.inference               # survives a concurrent destroy
+        bundle = _bundle(a)
+        if bundle is None:
+            return _fail(ERR_BAD_HANDLE, handle,
+                         f"forward_args: stale or unknown arguments "
+                         f"handle {a}")
+        with _lock:                       # consistent view of the slots
+            payloads = {k: dict(v) for k, v in bundle.items()}
+        data_types = inf.topology.data_type()
+        extra = sorted(k for k in payloads if k >= len(data_types))
+        if extra:
+            return _fail(ERR_BAD_SLOT, handle,
+                         f"forward_args: slot {extra[0]} out of range — "
+                         f"model declares {len(data_types)} input slots")
+        columns = []
+        for slot, (name, itype) in enumerate(data_types):
+            if slot not in payloads:
+                return _fail(ERR_BAD_SLOT, handle,
+                             f"forward_args: slot {slot} ({name!r}) "
+                             f"not set")
+            try:
+                columns.append(_slot_samples(payloads[slot], itype))
+            except ValueError as e:
+                return _fail(ERR_BAD_ARG, handle,
+                             f"forward_args: slot {slot} ({name!r}): {e}")
+        batch = len(columns[0])
+        if batch == 0:
+            return _fail(ERR_BAD_ARG, handle,
+                         "forward_args: empty batch (slot 0 has no rows)")
+        if any(len(c) != batch for c in columns):
+            sizes = [len(c) for c in columns]
+            return _fail(ERR_BAD_ARG, handle,
+                         f"forward_args: slots disagree on batch size: "
+                         f"{sizes}")
+        samples = [tuple(c[i] for c in columns) for i in range(batch)]
 
-    feed = DataFeeder(data_types)(samples)
-    feed.pop("__batch_size__", None)
-    outs = inf._fwd(inf.parameters.raw, inf.parameters.state, feed)
-    o = outs[0]
-    if isinstance(o, SequenceBatch):
-        dat = np.asarray(o.data, np.float32)
-        lens = np.asarray(o.lengths)[:batch]
-        rows = np.concatenate(
-            [dat[i, :lens[i]].reshape(lens[i], -1) for i in range(batch)],
-            axis=0)
-        starts = np.concatenate(
-            [[0], np.cumsum(lens)]).astype(np.int32)
-        return (np.ascontiguousarray(rows).tobytes(), int(rows.shape[0]),
-                int(rows.shape[1]), starts.tobytes())
-    arr = np.asarray(o, np.float32)[:batch].reshape(batch, -1)
-    return (np.ascontiguousarray(arr).tobytes(), batch,
-            int(arr.shape[1]), b"")
+        try:
+            from paddle_tpu.trainer.data_feeder import DataFeeder
+            feed = DataFeeder(data_types)(samples)
+            feed.pop("__batch_size__", None)
+            outs = inf._fwd(inf.parameters.raw, inf.parameters.state, feed)
+        except Exception as e:
+            return _fail(ERR_INTERNAL, handle, f"forward_args: {e}")
+        o = outs[0]
+        if isinstance(o, SequenceBatch):
+            dat = np.asarray(o.data, np.float32)
+            lens = np.asarray(o.lengths)[:batch]
+            rows = np.concatenate(
+                [dat[i, :lens[i]].reshape(lens[i], -1)
+                 for i in range(batch)], axis=0)
+            starts = np.concatenate(
+                [[0], np.cumsum(lens)]).astype(np.int32)
+            return (np.ascontiguousarray(rows).tobytes(),
+                    int(rows.shape[0]), int(rows.shape[1]),
+                    starts.tobytes())
+        arr = np.asarray(o, np.float32)[:batch].reshape(batch, -1)
+        return (np.ascontiguousarray(arr).tobytes(), batch,
+                int(arr.shape[1]), b"")
+    except BaseException as e:
+        return _fail(ERR_INTERNAL, handle, f"forward_args: {e!r}")

@@ -308,6 +308,47 @@ def _cmd_infer(args) -> int:
     return 0
 
 
+def _cmd_serve(args) -> int:
+    """Serve a merged artifact over HTTP with admission control — the
+    hardened twin of the C ABI's multi-threaded serving story
+    (docs/robustness.md "Serving"): bounded queue + backpressure,
+    per-request deadlines, circuit breaker, graceful drain on
+    SIGTERM/SIGINT, /health and /stats snapshots."""
+    import signal
+    import threading
+
+    from paddle_tpu.serving import (CircuitBreaker, InferenceServer,
+                                    build_http_server)
+
+    breaker = CircuitBreaker(window=args.breaker_window,
+                             failure_threshold=args.breaker_threshold,
+                             cooldown=args.breaker_cooldown)
+    server = InferenceServer(
+        args.model, max_queue=args.max_queue, workers=args.workers,
+        default_deadline=(args.deadline_ms / 1e3
+                          if args.deadline_ms else None),
+        breaker=breaker).start()
+    httpd = build_http_server(server, args.host, args.port)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    print(json.dumps({"job": "serve", "status": "serving",
+                      "host": args.host,
+                      "port": httpd.server_address[1],
+                      "workers": args.workers,
+                      "max_queue": args.max_queue}), flush=True)
+    while not stop:
+        time.sleep(0.2)
+    httpd.shutdown()            # stop admissions at the transport...
+    server.shutdown(drain=True)  # ...then drain the queued requests
+    print(json.dumps({"job": "serve", "status": "stopped",
+                      "stats": server.stats()}))
+    return 0
+
+
 def _cmd_coordinator(args) -> int:
     """Run the elastic-training coordinator as a daemon — the
     `paddle_master` binary's role (go/cmd/master/master.go): partition
@@ -421,6 +462,27 @@ def main(argv=None) -> int:
     inf.add_argument("--seq_len", type=int, default=16,
                      help="synthetic sequence length (no --config)")
 
+    sv = sub.add_parser("serve", help="serve a merged artifact over HTTP "
+                        "with admission control (docs/robustness.md)")
+    sv.add_argument("--model", required=True,
+                    help="merged .tar from `paddle_tpu merge`")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=0,
+                    help="0 picks a free port (printed as JSON)")
+    sv.add_argument("--workers", type=int, default=2,
+                    help="forward worker threads")
+    sv.add_argument("--max_queue", type=int, default=64,
+                    help="bounded request queue; a full queue rejects "
+                         "with retry-after instead of buffering")
+    sv.add_argument("--deadline_ms", type=float, default=0,
+                    help="default per-request deadline (0: none)")
+    sv.add_argument("--breaker_window", type=int, default=64,
+                    help="circuit-breaker sliding window size")
+    sv.add_argument("--breaker_threshold", type=float, default=0.5,
+                    help="failure fraction that opens the breaker")
+    sv.add_argument("--breaker_cooldown", type=float, default=2.0,
+                    help="seconds open before half-open probes")
+
     sub.add_parser("version", help="print version (paddle version parity)")
 
     co = sub.add_parser("coordinator", help="run the elastic-training "
@@ -451,6 +513,8 @@ def main(argv=None) -> int:
         return _cmd_diagram(args)
     if args.command == "coordinator":
         return _cmd_coordinator(args)
+    if args.command == "serve":
+        return _cmd_serve(args)
     if args.command == "version":
         import paddle_tpu
         print(json.dumps({"version": paddle_tpu.__version__,

@@ -128,6 +128,9 @@ pt_reader* pt_reader_open(const char* path) {
   if (!f) return NULL;
   pt_reader* r = (pt_reader*)calloc(1, sizeof(pt_reader));
   r->f = f;
+  long fsize = -1;
+  if (fseek(f, 0, SEEK_END) == 0) fsize = ftell(f);
+  fseek(f, 0, SEEK_SET);
   // index pass: walk chunk headers
   uint32_t cap = 16;
   r->chunk_off = (long*)malloc(cap * sizeof(long));
@@ -136,8 +139,12 @@ pt_reader* pt_reader_open(const char* path) {
     long off = ftell(f);
     uint32_t hdr[4];
     if (fread(hdr, sizeof(hdr), 1, f) != 1) break;
-    if (hdr[0] != kMagic) { fclose(f); free(r->chunk_off);
-      free(r->chunk_n); free(r); return NULL; }
+    // torn/truncated tail (crash mid-append, partial copy): the shard
+    // ends here — index the intact prefix instead of failing the open,
+    // matching _py_index in reader/recordio.py
+    if (hdr[0] != kMagic) break;
+    if (fsize >= 0 && off + (long)sizeof(hdr) + (long)hdr[2] > fsize)
+      break;  // header intact but payload runs past EOF: torn tail
     if (r->n_chunks == cap) {
       cap *= 2;
       r->chunk_off = (long*)realloc(r->chunk_off, cap * sizeof(long));

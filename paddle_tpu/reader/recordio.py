@@ -16,6 +16,16 @@ C++ DataProviders did the disk IO. Here:
 
 Layout (little-endian u32): chunk := magic "PTRC" | num_records |
 payload_len | crc32(payload) | payload; payload := (len | bytes)*.
+
+Robustness (docs/robustness.md):
+- writes land in ``path + ".tmp"`` then ``os.replace`` — a crash
+  mid-write never leaves a torn shard at the final path (the checkpoint
+  atomicity protocol);
+- a truncated/torn TAIL (bad magic or a chunk running past EOF) ends
+  the index with a warning instead of killing the job;
+- ``skip_corrupt=True`` on read_chunk/chunk_reader logs and SKIPS a
+  crc-mismatched chunk (counted in ``corrupt_chunks_skipped()``)
+  instead of aborting mid-epoch. Same semantics on the native path.
 """
 
 from __future__ import annotations
@@ -28,8 +38,19 @@ import tempfile
 import zlib
 from typing import Iterable, List, Optional
 
+from paddle_tpu.utils.logging import get_logger
+
 _MAGIC = 0x50545243
 _HDR = struct.Struct("<IIII")
+
+#: chunks dropped by skip_corrupt across this process (all shards)
+_CORRUPT_SKIPPED = [0]
+
+
+def corrupt_chunks_skipped() -> int:
+    """How many crc-mismatched chunks skip_corrupt dropped (process-wide
+    counter; chaos tests diff it around an epoch)."""
+    return _CORRUPT_SKIPPED[0]
 
 # --------------------------------------------------------------- native
 
@@ -90,43 +111,57 @@ def _native() -> Optional[ctypes.CDLL]:
 def write_records(path: str, records: Iterable[bytes],
                   max_chunk_bytes: int = 1 << 20,
                   use_native: Optional[bool] = None) -> None:
-    """Write an iterable of byte records as a PTRecordIO file."""
+    """Write an iterable of byte records as a PTRecordIO file.
+    Atomic: bytes land in ``path + ".tmp"`` and are renamed into place
+    only after a successful flush/close — a crash mid-write leaves the
+    previous shard (or nothing) at ``path``, never a torn file that
+    passes ``os.path.exists`` (the checkpoint atomicity protocol)."""
     lib = _native() if use_native in (None, True) else None
     if use_native is True and lib is None:
         raise RuntimeError("native recordio codec unavailable")
-    if lib is not None:
-        w = lib.pt_writer_open(path.encode(), max_chunk_bytes)
-        if not w:
-            raise OSError(f"cannot open {path!r} for writing")
-        try:
-            for rec in records:
-                if lib.pt_writer_write(w, rec, len(rec)) != 0:
-                    raise OSError("recordio write failed")
-        finally:
-            if lib.pt_writer_close(w) != 0:
-                raise OSError("recordio flush/close failed")
-        return
-    # pure-python twin
-    with open(path, "wb") as f:
-        payload = bytearray()
-        n = 0
+    tmp = path + ".tmp"
+    try:
+        if lib is not None:
+            w = lib.pt_writer_open(tmp.encode(), max_chunk_bytes)
+            if not w:
+                raise OSError(f"cannot open {tmp!r} for writing")
+            try:
+                for rec in records:
+                    if lib.pt_writer_write(w, rec, len(rec)) != 0:
+                        raise OSError("recordio write failed")
+            finally:
+                if lib.pt_writer_close(w) != 0:
+                    raise OSError("recordio flush/close failed")
+        else:
+            # pure-python twin
+            with open(tmp, "wb") as f:
+                payload = bytearray()
+                n = 0
 
-        def flush():
-            nonlocal payload, n
-            if not n:
-                return
-            f.write(_HDR.pack(_MAGIC, n, len(payload),
-                              zlib.crc32(bytes(payload)) & 0xFFFFFFFF))
-            f.write(payload)
-            payload = bytearray()
-            n = 0
+                def flush():
+                    nonlocal payload, n
+                    if not n:
+                        return
+                    f.write(_HDR.pack(
+                        _MAGIC, n, len(payload),
+                        zlib.crc32(bytes(payload)) & 0xFFFFFFFF))
+                    f.write(payload)
+                    payload = bytearray()
+                    n = 0
 
-        for rec in records:
-            payload += struct.pack("<I", len(rec)) + rec
-            n += 1
-            if len(payload) >= max_chunk_bytes:
+                for rec in records:
+                    payload += struct.pack("<I", len(rec)) + rec
+                    n += 1
+                    if len(payload) >= max_chunk_bytes:
+                        flush()
                 flush()
-        flush()
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
 
 
 # --------------------------------------------------------------- reading
@@ -154,7 +189,23 @@ def _py_index(path: str) -> List[tuple]:
                 break
             magic, n, plen, crc = _HDR.unpack(hdr)
             if magic != _MAGIC:
-                raise ValueError(f"{path}: bad chunk magic at {off}")
+                # a torn/truncated tail (crash mid-append, partial copy):
+                # the shard ends here — salvage the intact prefix instead
+                # of killing the whole job
+                get_logger().warning(
+                    "%s: bad chunk magic at byte %d — treating as "
+                    "end-of-file (torn shard tail?); %d intact chunks "
+                    "indexed", path, off, len(chunks))
+                break
+            if off + _HDR.size + plen > st.st_size:
+                # header intact but the payload runs past EOF: a chunk
+                # whose write never completed — same salvage semantics
+                get_logger().warning(
+                    "%s: chunk at byte %d declares %d payload bytes but "
+                    "the file ends at %d — dropping the torn tail chunk "
+                    "(%d intact chunks indexed)", path, off, plen,
+                    st.st_size, len(chunks))
+                break
             chunks.append((off, n, plen, crc))
             f.seek(plen, 1)
     if len(_INDEX_CACHE) > 256:      # bound the cache
@@ -176,9 +227,23 @@ def num_chunks(path: str, use_native: Optional[bool] = None) -> int:
     return len(_py_index(path))
 
 
+def _skip_corrupt_chunk(path: str, k: int) -> List[bytes]:
+    """Shared skip_corrupt tail: log, count, return an empty chunk."""
+    _CORRUPT_SKIPPED[0] += 1
+    get_logger().warning(
+        "%s: chunk %d crc mismatch — skipping its records "
+        "(skip_corrupt; %d corrupt chunks skipped so far)",
+        path, k, _CORRUPT_SKIPPED[0])
+    return []
+
+
 def read_chunk(path: str, k: int,
-               use_native: Optional[bool] = None) -> List[bytes]:
-    """All records of chunk k (crc-validated)."""
+               use_native: Optional[bool] = None,
+               skip_corrupt: bool = False) -> List[bytes]:
+    """All records of chunk k (crc-validated). A crc mismatch raises
+    ValueError — or, with ``skip_corrupt=True``, logs, bumps the
+    ``corrupt_chunks_skipped()`` counter and returns [] so an epoch
+    completes with just that chunk's records missing."""
     lib = _native() if use_native in (None, True) else None
     if use_native is True and lib is None:
         raise RuntimeError("native recordio codec unavailable")
@@ -189,6 +254,8 @@ def read_chunk(path: str, k: int,
         try:
             rc = lib.pt_reader_seek_chunk(r, k)
             if rc == -2:
+                if skip_corrupt:
+                    return _skip_corrupt_chunk(path, k)
                 raise ValueError(f"{path}: chunk {k} crc mismatch")
             if rc != 0:
                 raise IndexError(f"{path}: no chunk {k}")
@@ -210,6 +277,8 @@ def read_chunk(path: str, k: int,
         f.seek(off + _HDR.size)
         payload = f.read(plen)
     if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        if skip_corrupt:
+            return _skip_corrupt_chunk(path, k)
         raise ValueError(f"{path}: chunk {k} crc mismatch")
     out = []
     cur = 0
@@ -228,11 +297,13 @@ def chunk_descriptors(path: str) -> List[tuple]:
     return [(path, k) for k in range(num_chunks(path))]
 
 
-def chunk_reader(deserialize=None):
+def chunk_reader(deserialize=None, skip_corrupt: bool = False):
     """Returns the Coordinator-side chunk_reader: takes a (path, k)
-    descriptor, yields (deserialized) records of that chunk."""
+    descriptor, yields (deserialized) records of that chunk. With
+    ``skip_corrupt=True`` a crc-mismatched chunk is logged + counted
+    and yields nothing instead of aborting the epoch."""
     def read(desc):
         path, k = desc
-        for rec in read_chunk(path, k):
+        for rec in read_chunk(path, k, skip_corrupt=skip_corrupt):
             yield deserialize(rec) if deserialize else rec
     return read

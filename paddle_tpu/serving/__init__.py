@@ -1,0 +1,15 @@
+"""Hardened inference serving (docs/robustness.md "Serving").
+
+Admission-controlled serving over merged inference artifacts: bounded
+queue with backpressure, per-request deadlines, a sliding-window
+circuit breaker, graceful drain, and health/stats snapshots. The C-ABI
+twin of this discipline lives in paddle_tpu/capi_host.py (typed error
+codes, no exception crosses into C)."""
+
+from paddle_tpu.serving.breaker import CircuitBreaker
+from paddle_tpu.serving.http import build_http_server
+from paddle_tpu.serving.server import (Expired, InferenceServer, Rejected,
+                                       ServerClosed, ServingError)
+
+__all__ = ["CircuitBreaker", "InferenceServer", "ServingError",
+           "Rejected", "Expired", "ServerClosed", "build_http_server"]

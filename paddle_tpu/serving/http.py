@@ -1,0 +1,98 @@
+"""Minimal JSON/HTTP front for InferenceServer (the `paddle_tpu serve`
+CLI's transport; stdlib-only so the serving path adds no dependency).
+
+Endpoints:
+  GET  /health          -> InferenceServer.health()
+  GET  /stats           -> InferenceServer.stats()
+  POST /infer           -> body {"rows": [[f32...], ...],
+                                 "deadline_ms": optional}
+                           200 {"outputs": [[...], ...]}
+
+Admission failures map onto transport status codes:
+  429 + Retry-After     queue full (backpressure)
+  503 + Retry-After     circuit breaker open (load shed) / draining
+  504                   deadline expired
+  400                   malformed payload
+  500                   forward failed
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from paddle_tpu.serving.server import (Expired, InferenceServer, Rejected,
+                                       ServerClosed, ServingError)
+
+
+def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
+                      port: int = 0) -> ThreadingHTTPServer:
+    """An HTTP server bound to (host, port) — port 0 picks a free one
+    (see .server_address). Caller runs .serve_forever() (usually on a
+    thread) and .shutdown()."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):     # quiet; stats() has it
+            pass
+
+        def _json(self, code: int, payload: dict, headers=()):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._json(200, server.health())
+            elif self.path == "/stats":
+                self._json(200, server.stats())
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/infer":
+                self._json(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                rows = req["rows"]
+                if not isinstance(rows, list) or not rows:
+                    raise ValueError("rows must be a non-empty list")
+                deadline = req.get("deadline_ms")
+                deadline = float(deadline) / 1e3 \
+                    if deadline is not None else None
+            except (ValueError, KeyError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                out = server.infer_rows(rows, deadline)
+            except Rejected as e:
+                code = 429 if e.reason == "queue_full" else 503
+                self._json(code, {"error": str(e), "reason": e.reason,
+                                  "retry_after": e.retry_after},
+                           headers=[("Retry-After",
+                                     f"{max(e.retry_after, 0.01):.3f}")])
+                return
+            except Expired as e:
+                self._json(504, {"error": str(e)})
+                return
+            except ServerClosed as e:
+                self._json(503, {"error": str(e), "reason": "draining"})
+                return
+            except ServingError as e:
+                self._json(500, {"error": str(e)})
+                return
+            except ValueError as e:       # ragged / non-numeric rows
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            self._json(200, {"outputs": np.asarray(out).tolist()})
+
+    return ThreadingHTTPServer((host, port), Handler)

@@ -1,0 +1,330 @@
+"""InferenceServer — admission-controlled serving over a merged model.
+
+The reference C API existed so a fleet of C threads could serve a shared
+model (`paddle_gradient_machine_create_shared_param`); what it never had
+was admission control — overload meant unbounded queues and timeouts
+meant dead clients. This wraps ``load_inference_model`` with:
+
+- a BOUNDED request queue with backpressure: a full queue rejects
+  instantly with a retry-after hint instead of buffering unboundedly
+  (``Rejected``, reason ``queue_full``);
+- per-request DEADLINES enforced around the jitted forward: a request
+  that expires while queued is never run; one whose forward finishes
+  past its deadline is counted ``expired`` and its result discarded;
+- a sliding-window failure-rate CIRCUIT BREAKER (serving/breaker.py)
+  that sheds load while the model is sick and half-opens on a cooldown
+  (``Rejected``, reason ``breaker_open``);
+- graceful DRAIN on shutdown: no new admissions, queued work completes;
+- ``health()`` / ``stats()`` snapshots — queue depth, p50/p99 latency,
+  served/rejected/expired/failed counters — with every forward timed
+  through ``utils/stats.py`` (``serving/forward`` in global_stat).
+
+See docs/robustness.md "Serving" and tests/test_serving_faults.py (the
+chaos suite driving hung forwards, poisoned requests, bursts and
+mid-request destroys against this class).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Union
+
+import numpy as np
+
+from paddle_tpu.serving.breaker import CircuitBreaker
+from paddle_tpu.utils.stats import stat_timer
+
+
+class ServingError(RuntimeError):
+    """Base of every typed serving failure."""
+
+
+class Rejected(ServingError):
+    """Shed at admission. ``retry_after`` (seconds) is the client hint;
+    ``reason`` is 'queue_full' or 'breaker_open'."""
+
+    def __init__(self, msg: str, retry_after: float, reason: str):
+        super().__init__(msg)
+        self.retry_after = float(retry_after)
+        self.reason = reason
+
+
+class Expired(ServingError):
+    """The request's deadline passed (queued too long, or the forward
+    ran past it)."""
+
+
+class ServerClosed(ServingError):
+    """Submitted to a draining or stopped server."""
+
+
+class _Request:
+    __slots__ = ("samples", "deadline", "done", "result", "error",
+                 "enqueued_at", "_settled")
+
+    def __init__(self, samples, deadline: Optional[float], now: float):
+        self.samples = samples
+        self.deadline = deadline
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[ServingError] = None
+        self.enqueued_at = now
+        self._settled = False
+
+    def get(self, timeout: Optional[float] = None):
+        """Block for the result; raises the typed error on failure. With
+        a deadline, waits only slightly past it — a hung forward cannot
+        hang the CLIENT, only the worker slot (the breaker then opens)."""
+        if timeout is None and self.deadline is not None:
+            timeout = max(self.deadline - time.monotonic(), 0.0) + 0.25
+        if not self.done.wait(timeout):
+            raise Expired("request still in flight past its deadline")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class InferenceServer:
+    """Admission-controlled, breaker-protected serving facade.
+
+    ``model`` is a merged-artifact path (load_inference_model) or a
+    ready ``Inference``. ``workers`` threads pull from the bounded
+    queue; ``default_deadline`` (seconds) applies when submit() passes
+    none. ``breaker=None`` installs a default CircuitBreaker; pass an
+    instance to tune it, or ``breaker=False`` to disable shedding."""
+
+    def __init__(self, model, *, max_queue: int = 64, workers: int = 1,
+                 default_deadline: Optional[float] = None,
+                 breaker: Union[CircuitBreaker, None, bool] = None,
+                 latency_window: int = 256,
+                 clock: Callable[[], float] = time.monotonic):
+        if isinstance(model, (str, bytes)):
+            from paddle_tpu.trainer.inference import load_inference_model
+            model = load_inference_model(model)
+        self._inf = model
+        self.max_queue = int(max_queue)
+        self.num_workers = max(1, int(workers))
+        self.default_deadline = default_deadline
+        if breaker is None:
+            breaker = CircuitBreaker()
+        self.breaker: Optional[CircuitBreaker] = breaker or None
+        self._clock = clock
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._threads: List[threading.Thread] = []
+        self._accepting = False
+        self._stopping = False
+        self._inflight = 0
+        self._latencies: deque = deque(maxlen=int(latency_window))
+        self._started_at = None
+        self._counters = {"served": 0, "rejected_full": 0,
+                          "rejected_breaker": 0, "expired": 0,
+                          "failed": 0, "closed": 0}
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "InferenceServer":
+        with self._cv:
+            if self._threads:
+                return self
+            self._accepting = True
+            self._stopping = False
+            self._started_at = self._clock()
+            for i in range(self.num_workers):
+                t = threading.Thread(target=self._worker_loop,
+                                     name=f"serving-worker-{i}",
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        return self
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = 30.0) -> None:
+        """Stop accepting. With ``drain`` the queued requests complete
+        first; without it they fail with ServerClosed immediately."""
+        with self._cv:
+            self._accepting = False
+            if not drain:
+                while self._queue:
+                    req = self._queue.popleft()
+                    self._settle(req, error=ServerClosed(
+                        "server shut down before this request ran"))
+                    self._counters["closed"] += 1
+            self._stopping = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout)
+        with self._cv:
+            self._threads = []
+
+    # ------------------------------------------------------------ admission
+    def submit(self, samples,
+               deadline: Optional[float] = None) -> _Request:
+        """Admit one request (a list of sample tuples, as
+        Inference.infer takes). Returns a future-like _Request. Raises
+        Rejected/ServerClosed at admission; the request itself settles
+        with a result or a typed error."""
+        now = self._clock()
+        if deadline is None:
+            deadline = self.default_deadline
+        abs_deadline = (time.monotonic() + deadline) \
+            if deadline is not None else None
+        with self._cv:
+            if not self._accepting:
+                raise ServerClosed("server is draining or stopped")
+            if self.breaker is not None:
+                ok, retry = self.breaker.allow()
+                if not ok:
+                    self._counters["rejected_breaker"] += 1
+                    raise Rejected(
+                        f"circuit breaker open; retry in {retry:.2f}s",
+                        retry_after=retry, reason="breaker_open")
+            if len(self._queue) >= self.max_queue:
+                self._counters["rejected_full"] += 1
+                retry = self._retry_hint()
+                raise Rejected(
+                    f"queue full ({self.max_queue}); retry in "
+                    f"{retry:.2f}s", retry_after=retry,
+                    reason="queue_full")
+            req = _Request(samples, abs_deadline, now)
+            self._queue.append(req)
+            self._cv.notify()
+        return req
+
+    def infer(self, samples, deadline: Optional[float] = None):
+        """Synchronous submit + wait."""
+        return self.submit(samples, deadline).get()
+
+    def _retry_hint(self) -> float:
+        lats = list(self._latencies)
+        per = (sum(lats) / len(lats)) if lats else 0.05
+        return max(per * (len(self._queue) + 1) / self.num_workers, 0.01)
+
+    # ------------------------------------------------------------- workers
+    def _settle(self, req: _Request, result=None,
+                error: Optional[ServingError] = None) -> bool:
+        """Deliver exactly once (caller may have timed out and gone)."""
+        if req._settled:
+            return False
+        req._settled = True
+        req.result = result
+        req.error = error
+        req.done.set()
+        return True
+
+    def _worker_loop(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopping:
+                    self._cv.wait(0.2)
+                if not self._queue:
+                    if self._stopping:
+                        return
+                    continue
+                req = self._queue.popleft()
+                self._inflight += 1
+            try:
+                self._serve_one(req)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def _serve_one(self, req: _Request):
+        now = time.monotonic()
+        if req.deadline is not None and now > req.deadline:
+            # expired while queued: never runs. Pure overload — handled
+            # by backpressure, so it does NOT feed the breaker.
+            with self._cv:
+                self._counters["expired"] += 1
+            self._settle(req, error=Expired(
+                "deadline passed while queued"))
+            return
+        t0 = time.perf_counter()
+        try:
+            with stat_timer("serving/forward"):
+                result = self._forward(req.samples)
+        except Exception as e:
+            with self._cv:
+                self._counters["failed"] += 1
+            if self.breaker is not None:
+                self.breaker.record(False)
+            self._settle(req, error=ServingError(f"forward failed: {e}"))
+            return
+        dt = time.perf_counter() - t0
+        with self._cv:
+            self._latencies.append(dt)
+        if req.deadline is not None and time.monotonic() > req.deadline:
+            # ran, but too slowly: the deadline is enforced AROUND the
+            # jitted forward. A slow/hung model is a model fault — it
+            # feeds the breaker so sustained hangs shed load.
+            with self._cv:
+                self._counters["expired"] += 1
+            if self.breaker is not None:
+                self.breaker.record(False)
+            self._settle(req, error=Expired(
+                f"forward took {dt * 1e3:.0f}ms, past the deadline"))
+            return
+        if self.breaker is not None:
+            self.breaker.record(True)
+        self._settle(req, result=result)
+        with self._cv:
+            self._counters["served"] += 1
+
+    def _forward(self, samples):
+        out = self._inf.forward_batch(samples)
+        return out[0] if len(out) == 1 else out
+
+    # ------------------------------------------------------------ snapshots
+    def _percentile(self, lats: List[float], q: float) -> float:
+        if not lats:
+            return 0.0
+        s = sorted(lats)
+        idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+        return s[idx]
+
+    def health(self) -> dict:
+        with self._cv:
+            running = bool(self._threads)
+            accepting = self._accepting
+            depth = len(self._queue)
+        bstate = self.breaker.state if self.breaker is not None \
+            else "disabled"
+        if not running:
+            status = "stopped"
+        elif not accepting:
+            status = "draining"
+        elif bstate == "open":
+            status = "shedding"
+        else:
+            status = "ok"
+        return {"status": status, "accepting": accepting,
+                "queue_depth": depth, "workers": self.num_workers,
+                "breaker": bstate}
+
+    def stats(self) -> dict:
+        with self._cv:
+            counters = dict(self._counters)
+            depth = len(self._queue)
+            inflight = self._inflight
+            lats = list(self._latencies)
+            uptime = (self._clock() - self._started_at) \
+                if self._started_at is not None else 0.0
+        out = dict(counters)
+        out.update({
+            "queue_depth": depth,
+            "inflight": inflight,
+            "p50_ms": round(self._percentile(lats, 0.50) * 1e3, 3),
+            "p99_ms": round(self._percentile(lats, 0.99) * 1e3, 3),
+            "uptime_s": round(uptime, 3),
+            "breaker": self.breaker.snapshot()
+            if self.breaker is not None else None,
+        })
+        return out
+
+    # convenience for HTTP clients sending raw dense rows
+    def infer_rows(self, rows, deadline: Optional[float] = None):
+        samples = [(np.asarray(r, np.float32),) for r in rows]
+        out = self.infer(samples, deadline)
+        return np.asarray(out)

@@ -1,4 +1,4 @@
-"""Deterministic fault injection for chaos-testing the training loop.
+"""Deterministic fault injection for chaos-testing training AND serving.
 
 The reference stack's fault tolerance was proven by hope: the Go master
 re-queued tasks and the pserver checkpointed, but nothing in the tree
@@ -14,12 +14,22 @@ can
   (c) poison chosen training batches so the loss goes NaN/Inf at exact
       step indices;
   (d) SIGKILL a subprocess trainer when its stdout reaches a chosen
-      step marker.
+      step marker;
+
+and, for the serving path (docs/robustness.md "Serving"):
+
+  (e) make chosen forward calls SLOW, FAIL, or HANG on an event
+      (``flaky_forward`` — drives the InferenceServer's deadline and
+      circuit-breaker machinery);
+  (f) POISON request byte payloads deterministically
+      (``poison_bytes`` — the capi_host fuzz inputs);
+  (g) destroy a C-ABI handle mid-request (``destroy_during``) and fire
+      request BURSTS from a thread pool (``burst``) for overload tests.
 
 Everything is deterministic given the seed and the schedule, so a chaos
-test that fails replays exactly. See ``tests/test_faults.py`` for the
-tests that drive all four against the real loop, and
-``docs/robustness.md`` for the recipe.
+test that fails replays exactly. See ``tests/test_faults.py`` and
+``tests/test_serving_faults.py`` for the tests that drive these against
+the real loop/server, and ``docs/robustness.md`` for the recipe.
 """
 
 from __future__ import annotations
@@ -185,6 +195,121 @@ class FaultPlan:
                         for sample in batch]
                 yield batch
         return poisoned
+
+    # ------------------------------------------- (e) serving: forward
+    @contextlib.contextmanager
+    def flaky_forward(self, inference, fail: Iterable[int] = (),
+                      delay: Optional[Dict[int, float]] = None,
+                      hang: Optional[Dict[int, threading.Event]] = None,
+                      fail_rate: float = 0.0):
+        """Within the context, the target Inference's jitted forward is
+        wrapped so chosen 0-based call indices
+
+          - raise RuntimeError (a poisoned request / kernel abort)
+            — ``fail`` indices, plus ``fail_rate`` seeded-random drops;
+          - sleep ``delay[i]`` seconds first (a slow device);
+          - block on ``hang[i]`` (an Event) until the TEST releases it
+            — a deterministic hung forward, the case deadlines +
+            the circuit breaker must absorb.
+
+        Yields a stats dict (``injected`` count). Thread-safe: serving
+        workers may call concurrently."""
+        real = inference._fwd
+        fail_set: Set[int] = set(int(i) for i in fail)
+        delays = dict(delay or {})
+        hangs = dict(hang or {})
+        rng = random.Random(self.seed)
+        lock = threading.Lock()
+        count = [0]
+        stats = {"injected": 0, "calls": 0}
+
+        def fwd(*args, **kw):
+            with lock:
+                i = count[0]
+                count[0] += 1
+                stats["calls"] += 1
+                bad = i in fail_set or (
+                    fail_rate and rng.random() < fail_rate)
+                wait = delays.get(i, 0.0)
+                ev = hangs.get(i)
+                if bad or wait or ev is not None:
+                    stats["injected"] += 1
+            if ev is not None:
+                ev.wait()
+            if wait:
+                time.sleep(wait)
+            if bad:
+                raise RuntimeError(f"injected forward fault: call #{i}")
+            return real(*args, **kw)
+
+        inference._fwd = fwd
+        try:
+            yield stats
+        finally:
+            inference._fwd = real
+
+    # ------------------------------------------- (f) serving: payloads
+    def poison_bytes(self, data: bytes, flips: int = 4,
+                     truncate: Optional[int] = None) -> bytes:
+        """A deterministically corrupted copy of ``data``: ``flips``
+        seeded byte-flips, optionally truncated to ``truncate`` bytes —
+        the malformed payloads the C-ABI fuzz feeds every entry point."""
+        buf = bytearray(data if truncate is None else data[:truncate])
+        for _ in range(flips):
+            if not buf:
+                break
+            buf[self._rng.randrange(len(buf))] ^= 0xFF
+        return bytes(buf)
+
+    # --------------------------------------- (g) serving: concurrency
+    @staticmethod
+    def destroy_during(destroy: Callable[[int], int], handle: int,
+                       delay_s: float = 0.005) -> threading.Thread:
+        """Destroy ``handle`` from another thread after ``delay_s`` —
+        the mid-request-destroy race the refcounted registry must make
+        safe. Returns the (started) thread; join it."""
+        def run():
+            time.sleep(delay_s)
+            destroy(handle)
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return t
+
+    @staticmethod
+    def burst(fn: Callable[[int], object], n: int, threads: int = 8,
+              timeout: float = 60.0):
+        """Fire ``fn(i)`` for i in range(n) from a pool of ``threads`` —
+        the burst-overload fault. Returns (results, errors): per-index
+        return values and caught exceptions (None where the other
+        applies). Raises TimeoutError if the burst doesn't settle —
+        i.e. a deadlock in the system under test."""
+        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import TimeoutError as _FutTimeout
+        results = [None] * n
+        errors: list = [None] * n
+
+        def run(i):
+            try:
+                results[i] = fn(i)
+            except Exception as e:       # typed errors are the data
+                errors[i] = e
+
+        pool = ThreadPoolExecutor(max_workers=threads)
+        futs = [pool.submit(run, i) for i in range(n)]
+        try:
+            for f in futs:
+                try:
+                    f.result(timeout=timeout)
+                except _FutTimeout:
+                    # don't wait on the wedged worker — that would turn
+                    # a detected deadlock into a hung test
+                    pool.shutdown(wait=False, cancel_futures=True)
+                    raise TimeoutError(
+                        f"burst did not settle within {timeout}s "
+                        f"(deadlock in the system under test?)")
+        finally:
+            pool.shutdown(wait=False)
+        return results, errors
 
     # --------------------------------------------- (d) process murder
     @staticmethod

@@ -208,13 +208,22 @@ class CheckpointManager:
 
     def restore(self, step: Optional[int] = None
                 ) -> Optional[Tuple[int, Dict[str, Any]]]:
-        """Returns (step, {params, opt_state, state, meta}) or None."""
+        """Returns (step, {params, opt_state, state, meta}) or None.
+        An explicit ``step`` gets the same md5 integrity check
+        latest_step() applies — restoring a corrupt artifact raises
+        instead of silently loading garbage parameters."""
         self.wait()
+        explicit = step is not None
         if step is None:
-            step = self.latest_step()
+            step = self.latest_step()       # verifies as it scans
         if step is None:
             return None
         path = os.path.join(self.dir, f"ckpt-{step:010d}")
+        if explicit and not self._verify(step):
+            raise RuntimeError(
+                f"checkpoint {path} failed integrity verification "
+                f"(md5 mismatch or missing/torn state) — refusing to "
+                f"load a corrupt artifact")
         with open(os.path.join(path, "meta.json")) as f:
             m = json.load(f)
         data = np.load(os.path.join(path, "state.npz"), allow_pickle=False)

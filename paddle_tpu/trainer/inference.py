@@ -37,16 +37,30 @@ class Inference:
             return [outs[n] for n in self.output_names]
 
         self._fwd = jax.jit(fwd)
+        self._default_feeder: Optional[DataFeeder] = None
+
+    def forward_batch(self, samples, feeding=None):
+        """ONE batch through the jitted forward; returns a list of numpy
+        arrays (one per output). This is the serving hot path
+        (serving/server.py wraps it with deadlines and the breaker) —
+        no re-batching loop, and the default feeder is cached."""
+        if feeding is None:
+            if self._default_feeder is None:
+                self._default_feeder = DataFeeder(
+                    self.topology.data_type(), None)
+            feeder = self._default_feeder
+        else:
+            feeder = DataFeeder(self.topology.data_type(), feeding)
+        feed = feeder(samples)
+        feed.pop("__batch_size__", None)
+        outs = self._fwd(self.parameters.raw, self.parameters.state, feed)
+        return [np.asarray(o.data) if isinstance(o, SequenceBatch)
+                else np.asarray(o) for o in outs]
 
     def iter_infer_field(self, input, feeding=None, batch_size: int = 128):
-        feeder = DataFeeder(self.topology.data_type(), feeding)
         for start in range(0, len(input), batch_size):
-            chunk = input[start:start + batch_size]
-            feed = feeder(chunk)
-            feed.pop("__batch_size__", None)
-            outs = self._fwd(self.parameters.raw, self.parameters.state, feed)
-            yield [np.asarray(o.data) if isinstance(o, SequenceBatch)
-                   else np.asarray(o) for o in outs]
+            yield self.forward_batch(input[start:start + batch_size],
+                                     feeding)
 
     def infer(self, input, field="value", feeding=None,
               batch_size: int = 128):
@@ -100,13 +114,32 @@ def save_inference_model(path: str, output_layer,
 
 
 def load_inference_model(path: str) -> Inference:
-    """Load a save_inference_model artifact into a ready Inference."""
+    """Load a save_inference_model artifact into a ready Inference.
+    A missing/torn/foreign file raises ValueError naming the artifact
+    (the C-ABI host maps it to ERR_BAD_MODEL; serving startup fails
+    fast instead of faulting on the first request)."""
     import io
     import tarfile
 
-    with tarfile.open(path, "r") as tf:
-        blob = tf.extractfile("topology.json").read()
-        pbytes = tf.extractfile("params.tar").read()
-    topo = Topology.deserialize(blob)
-    params = Parameters.from_tar(io.BytesIO(pbytes))
+    if isinstance(path, bytes):
+        path = path.decode()
+    try:
+        with tarfile.open(path, "r") as tf:
+            names = set(tf.getnames())
+            missing = {"topology.json", "params.tar"} - names
+            if missing:
+                raise ValueError(
+                    f"{path!r} is not an inference artifact: missing "
+                    f"{sorted(missing)} (have {sorted(names)})")
+            blob = tf.extractfile("topology.json").read()
+            pbytes = tf.extractfile("params.tar").read()
+    except (OSError, tarfile.TarError) as e:
+        raise ValueError(
+            f"cannot load inference artifact {path!r}: {e}") from e
+    try:
+        topo = Topology.deserialize(blob)
+        params = Parameters.from_tar(io.BytesIO(pbytes))
+    except Exception as e:
+        raise ValueError(
+            f"inference artifact {path!r} is corrupt: {e}") from e
     return Inference(parameters=params, topology=topo)

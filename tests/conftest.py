@@ -30,6 +30,35 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests (deselected in tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "chaos(timeout=120): fault-injection chaos tests — faulthandler "
+        "dumps all thread stacks if the test exceeds its timeout, so a "
+        "deadlocked serving test prints stacks instead of dying to a "
+        "silent `timeout -k` kill")
+
+
+@pytest.fixture(autouse=True)
+def _chaos_faulthandler(request):
+    """Dump-on-timeout for @pytest.mark.chaos: if a chaos test wedges
+    (a serving deadlock, a stuck worker join), every thread's stack is
+    printed to stderr before the outer timeout kills the run."""
+    marker = request.node.get_closest_marker("chaos")
+    if marker is None:
+        yield
+        return
+    import faulthandler
+    timeout = float(marker.kwargs.get("timeout", 120.0))
+    faulthandler.dump_traceback_later(timeout, exit=False)
+    try:
+        yield
+    finally:
+        faulthandler.cancel_dump_traceback_later()
+
+
 @pytest.fixture(autouse=True)
 def _reset_layer_names():
     """Fresh auto-name counters per test so graphs don't collide."""

@@ -1,0 +1,271 @@
+"""Fault-isolated C-ABI boundary tests (paddle_tpu/capi_host.py).
+
+The contract under test (docs/robustness.md "Serving"): no exception
+ever crosses the boundary — every malformed input produces a typed
+negative error code with a retrievable last_error() message, and the
+lock-protected refcounted handle registry survives concurrent
+create_shared/forward/destroy races (including destroying the source
+while clones serve). These tests call the host module directly, exactly
+as the embedded-CPython shim does."""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import capi_host as ch
+from paddle_tpu.testing import FaultPlan
+from paddle_tpu.trainer.inference import save_inference_model
+
+
+@pytest.fixture()
+def model_tar(tmp_path):
+    paddle.init(seed=7)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    out = paddle.layer.fc(x, size=4, act=paddle.activation.Softmax())
+    params = paddle.create_parameters(paddle.Topology(out))
+    path = str(tmp_path / "model.tar")
+    save_inference_model(path, out, params)
+    return path
+
+
+def good_payload(batch=2, dim=8):
+    return np.linspace(0, 1, batch * dim).astype(np.float32).tobytes()
+
+
+class TestErrorCodes:
+    def test_create_bad_path_is_code_not_exception(self, tmp_path):
+        rc = ch.create(str(tmp_path / "nope.tar"))
+        assert rc == ch.ERR_BAD_MODEL
+        assert "nope.tar" in ch.last_error(0)
+
+    def test_create_garbage_file(self, tmp_path):
+        p = tmp_path / "garbage.tar"
+        p.write_bytes(b"this is not a tar at all")
+        assert ch.create(str(p)) == ch.ERR_BAD_MODEL
+        assert "garbage.tar" in ch.last_error(0)
+
+    def test_stale_handle_everywhere(self, model_tar):
+        h = ch.create(model_tar)
+        assert h > 0
+        assert ch.destroy(h) == ch.OK
+        assert ch.forward(h, good_payload(), 2, 8) == ch.ERR_BAD_HANDLE
+        assert str(h) in ch.last_error(h)
+        assert ch.create_shared(h) == ch.ERR_BAD_HANDLE
+        assert ch.destroy(h) == ch.ERR_BAD_HANDLE   # double destroy
+        assert "double destroy" in ch.last_error(h)
+
+    def test_forward_short_buffer(self, model_tar):
+        h = ch.create(model_tar)
+        short = good_payload(2, 8)[:-8]             # 8 bytes missing
+        assert ch.forward(h, short, 2, 8) == ch.ERR_SHORT_BUFFER
+        assert "bytes" in ch.last_error(h)
+        ch.destroy(h)
+
+    def test_forward_bad_counts(self, model_tar):
+        h = ch.create(model_tar)
+        assert ch.forward(h, good_payload(), -1, 8) == ch.ERR_BAD_ARG
+        assert ch.forward(h, good_payload(), 2, 0) == ch.ERR_BAD_ARG
+        assert ch.forward(h, good_payload(), 2, 5) == ch.ERR_BAD_ARG
+        assert "declared input dim" in ch.last_error(h)
+        ch.destroy(h)
+
+    def test_forward_success_shape(self, model_tar):
+        h = ch.create(model_tar)
+        res = ch.forward(h, good_payload(), 2, 8)
+        assert isinstance(res, tuple)
+        blob, out_dim = res
+        assert out_dim == 4 and len(blob) == 2 * 4 * 4
+        assert ch.destroy(h) == ch.OK
+
+    def test_shared_clone_survives_source_destroy(self, model_tar):
+        h = ch.create(model_tar)
+        c = ch.create_shared(h)
+        assert ch.engine_refs(h) == 2
+        assert ch.destroy(h) == ch.OK               # source goes first
+        res = ch.forward(c, good_payload(), 2, 8)   # clone still serves
+        assert isinstance(res, tuple)
+        assert ch.engine_refs(c) == 1
+        assert ch.destroy(c) == ch.OK
+
+
+class TestArgsFuzz:
+    def test_stale_args_bundle(self):
+        a = ch.args_create()
+        assert ch.args_destroy(a) == ch.OK
+        assert ch.args_destroy(a) == ch.ERR_BAD_HANDLE
+        assert ch.arg_set_ids(a, 0, b"\0\0\0\0", 1) == ch.ERR_BAD_HANDLE
+
+    def test_setter_validation(self):
+        a = ch.args_create()
+        ids = np.arange(4, dtype=np.int32).tobytes()
+        assert ch.arg_set_ids(a, -1, ids, 4) == ch.ERR_BAD_SLOT
+        assert ch.arg_set_ids(a, 0, ids, -4) == ch.ERR_BAD_ARG
+        assert ch.arg_set_ids(a, 0, ids[:7], 4) == ch.ERR_SHORT_BUFFER
+        assert ch.arg_set_value(a, 0, b"", 2, 3) == ch.ERR_SHORT_BUFFER
+        assert ch.arg_set_value(a, 0, b"", -2, 3) == ch.ERR_BAD_ARG
+        bad_starts = np.array([1, 3], np.int32).tobytes()
+        assert ch.arg_set_seq_starts(a, 0, bad_starts, 2) == ch.ERR_BAD_ARG
+        dec = np.array([0, 3, 2], np.int32).tobytes()
+        assert ch.arg_set_seq_starts(a, 0, dec, 3) == ch.ERR_BAD_ARG
+        assert ch.arg_set_seq_starts(a, 0, b"\0\0\0\0", 1) == ch.ERR_BAD_ARG
+        assert ch.args_destroy(a) == ch.OK
+
+    def test_sparse_validation(self):
+        a = ch.args_create()
+        offs = np.array([0, 2, 3], np.int32).tobytes()
+        cols = np.array([1, 5, 9], np.int32).tobytes()
+        assert ch.arg_set_sparse(a, 0, 2, 16, offs, cols, None,
+                                 3) == ch.OK
+        assert ch.arg_set_sparse(a, 0, -2, 16, offs, cols, None,
+                                 3) == ch.ERR_BAD_ARG
+        assert ch.arg_set_sparse(a, 0, 2, 16, offs[:8], cols, None,
+                                 3) == ch.ERR_SHORT_BUFFER
+        assert ch.arg_set_sparse(a, 0, 2, 16, offs, cols[:4], None,
+                                 3) == ch.ERR_SHORT_BUFFER
+        # column id out of the declared dim
+        bad_cols = np.array([1, 5, 99], np.int32).tobytes()
+        assert ch.arg_set_sparse(a, 0, 2, 16, offs, bad_cols, None,
+                                 3) == ch.ERR_BAD_ARG
+        # decreasing CSR offsets
+        bad_offs = np.array([0, 3, 2], np.int32).tobytes()
+        assert ch.arg_set_sparse(a, 0, 2, 16, bad_offs, cols, None,
+                                 3) == ch.ERR_BAD_ARG
+        assert "offsets" in ch.last_error(a)
+        ch.args_destroy(a)
+
+    def test_forward_args_slot_contract(self, model_tar):
+        h = ch.create(model_tar)
+        a = ch.args_create()
+        # nothing set: slot 0 missing
+        assert ch.forward_args(h, a) == ch.ERR_BAD_SLOT
+        assert "slot 0" in ch.last_error(h)
+        # slot beyond the model's data contract
+        val = np.zeros((2, 8), np.float32).tobytes()
+        assert ch.arg_set_value(a, 5, val, 2, 8) == ch.OK
+        assert ch.forward_args(h, a) == ch.ERR_BAD_SLOT
+        assert "out of range" in ch.last_error(h)
+        ch.args_destroy(a)
+        # stale bundle after destroy
+        assert ch.forward_args(h, a) == ch.ERR_BAD_HANDLE
+        ch.destroy(h)
+
+    def test_forward_args_success(self, model_tar):
+        h = ch.create(model_tar)
+        a = ch.args_create()
+        val = np.linspace(0, 1, 16).astype(np.float32).tobytes()
+        assert ch.arg_set_value(a, 0, val, 2, 8) == ch.OK
+        res = ch.forward_args(h, a)
+        assert isinstance(res, tuple)
+        blob, rows, dim, starts = res
+        assert rows == 2 and dim == 4 and starts == b""
+        ch.args_destroy(a)
+        ch.destroy(h)
+
+    def test_seeded_payload_fuzz_never_raises(self, model_tar):
+        """Poisoned request bytes against every entry point: whatever
+        the payload, the boundary answers with an int code or a valid
+        tuple — never an exception."""
+        plan = FaultPlan(seed=123)
+        rng = random.Random(123)
+        h = ch.create(model_tar)
+        a = ch.args_create()
+        good = good_payload(2, 8)
+        for i in range(300):
+            blob = plan.poison_bytes(good, flips=rng.randrange(1, 6),
+                                     truncate=rng.randrange(0, len(good)))
+            bundle = rng.choice([a, 0, -1, 999999])
+            handle = rng.choice([h, 0, -5, 424242])
+            rows = rng.randrange(-3, 5)
+            dim = rng.randrange(-3, 10)
+            n = rng.randrange(-3, 20)
+            op = rng.randrange(6)
+            if op == 0:
+                r = ch.forward(handle, blob, rows, dim)
+            elif op == 1:
+                r = ch.arg_set_value(bundle, rng.randrange(-2, 3),
+                                     blob, rows, dim)
+            elif op == 2:
+                r = ch.arg_set_ids(bundle, rng.randrange(-2, 3), blob, n)
+            elif op == 3:
+                r = ch.arg_set_seq_starts(bundle, rng.randrange(-2, 3),
+                                          blob, n)
+            elif op == 4:
+                r = ch.arg_set_sparse(bundle, rng.randrange(-2, 3),
+                                      rows, dim, blob, blob, None, n)
+            else:
+                r = ch.forward_args(handle, bundle)
+            assert isinstance(r, (int, tuple)), (i, op, r)
+            if isinstance(r, int) and r != ch.OK:
+                # every failure has a retrievable message somewhere
+                key = handle if op in (0, 5) else bundle
+                assert ch.last_error(key) or ch.last_error(0)
+        ch.args_destroy(a)
+        ch.destroy(h)
+
+
+@pytest.mark.chaos(timeout=180)
+class TestConcurrency:
+    def test_eight_thread_hammer(self, model_tar):
+        """8 threads of mixed create_shared/forward/destroy against one
+        source engine, with the source destroyed mid-flight: zero
+        exceptions, only typed codes or valid results, and the registry
+        drains back to empty."""
+        base_handles = ch.live_handles()
+        src = ch.create(model_tar)
+        payload = good_payload(2, 8)
+        errors = []
+        codes_seen = set()
+        stop = threading.Event()
+
+        def client(tid):
+            rng = random.Random(tid)
+            local = []
+            try:
+                for i in range(40):
+                    op = rng.randrange(4)
+                    if op == 0 or not local:
+                        c = ch.create_shared(src)
+                        if c > 0:
+                            local.append(c)
+                        else:
+                            codes_seen.add(c)
+                    elif op == 1:
+                        hh = rng.choice(local)
+                        r = ch.forward(hh, payload, 2, 8)
+                        if isinstance(r, int):
+                            codes_seen.add(r)
+                        else:
+                            assert r[1] == 4
+                    elif op == 2:
+                        hh = local.pop(rng.randrange(len(local)))
+                        r = ch.destroy(hh)
+                        codes_seen.add(r)
+                    else:
+                        # deliberately poke a junk handle
+                        codes_seen.add(ch.forward(rng.randrange(
+                            10**6, 2 * 10**6), payload, 2, 8))
+            except BaseException as e:     # the failure under test
+                errors.append((tid, repr(e)))
+            finally:
+                for hh in local:
+                    ch.destroy(hh)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(8)]
+        # destroy the SOURCE while clones are being created/served
+        killer = FaultPlan.destroy_during(ch.destroy, src, delay_s=0.05)
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+            assert not t.is_alive(), "hammer thread wedged (deadlock?)"
+        killer.join(10)
+        stop.set()
+        assert errors == []
+        # after the source died, late create_shared calls fail typed
+        assert codes_seen <= {ch.OK, ch.ERR_BAD_HANDLE}
+        ch.destroy(src)                    # already gone: typed, no raise
+        assert ch.live_handles() == base_handles

@@ -100,6 +100,23 @@ class TestCheckpointFaults:
         np.testing.assert_array_equal(tree["params"]["w"],
                                       np.full((2, 2), 1.0, np.float32))
 
+    def test_explicit_step_restore_verifies_too(self, tmp_path):
+        """Satellite: restore(step=N) must run the same md5 check
+        latest_step() does — an explicitly-named corrupt checkpoint
+        raises a clear error instead of loading garbage."""
+        mgr = CheckpointManager(str(tmp_path), async_write=False)
+        mgr.save(1, {"w": np.full((2, 2), 1.0, np.float32)})
+        mgr.save(2, {"w": np.full((2, 2), 2.0, np.float32)})
+        corrupted = FaultPlan.corrupt_newest_checkpoint(str(tmp_path))
+        with pytest.raises(RuntimeError,
+                           match=f"ckpt-{corrupted:010d}"):
+            mgr.restore(step=corrupted)
+        # an intact explicit step still loads
+        step, tree = mgr.restore(step=1)
+        assert step == 1
+        np.testing.assert_array_equal(tree["params"]["w"],
+                                      np.full((2, 2), 1.0, np.float32))
+
 
 # ----------------------------------------------------------- (c) numerics
 

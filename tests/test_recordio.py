@@ -88,6 +88,125 @@ class TestIntegrity:
         assert struct.unpack("<I", last[-1])[0] == 63
 
 
+class TestTornAndCorruptShards:
+    """Robustness satellites (docs/robustness.md): torn tails are EOF,
+    crc mismatches are skippable, writes are atomic."""
+
+    def test_write_is_atomic_under_crash(self, tmp_path):
+        p = str(tmp_path / "a.ptrec")
+        rio.write_records(p, records(20, seed=5), use_native=False)
+        before = open(p, "rb").read()
+
+        def crashing():
+            yield b"x" * 100
+            raise RuntimeError("crash mid-write")
+
+        with pytest.raises(RuntimeError, match="crash mid-write"):
+            rio.write_records(p, crashing(), use_native=False)
+        # the previous shard survives intact; no torn .tmp remains
+        assert open(p, "rb").read() == before
+        assert not os.path.exists(p + ".tmp")
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_garbage_tail_is_eof_not_fatal(self, tmp_path, use_native):
+        if use_native and not has_native():
+            pytest.skip("no compiler for the native codec")
+        recs = records(30, seed=6)
+        p = str(tmp_path / "g.ptrec")
+        rio.write_records(p, recs, max_chunk_bytes=512,
+                          use_native=use_native)
+        nc = rio.num_chunks(p, use_native=use_native)
+        with open(p, "ab") as f:               # torn append: bad magic
+            f.write(b"GARBAGE-NOT-A-CHUNK")
+        assert rio.num_chunks(p, use_native=use_native) == nc
+        got = []
+        for k in range(nc):
+            got.extend(rio.read_chunk(p, k, use_native=use_native))
+        assert got == recs
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_truncated_tail_chunk_dropped(self, tmp_path, use_native):
+        if use_native and not has_native():
+            pytest.skip("no compiler for the native codec")
+        recs = records(30, seed=7)
+        p = str(tmp_path / "t.ptrec")
+        rio.write_records(p, recs, max_chunk_bytes=512,
+                          use_native=use_native)
+        nc = rio.num_chunks(p, use_native=use_native)
+        assert nc > 2
+        blob = open(p, "rb").read()
+        open(p, "wb").write(blob[:-10])        # payload runs past EOF
+        nc2 = rio.num_chunks(p, use_native=use_native)
+        assert nc2 == nc - 1                   # torn tail chunk dropped
+        for k in range(nc2):                   # intact prefix readable
+            rio.read_chunk(p, k, use_native=use_native)
+
+    def _flip_chunk_payload(self, path, k):
+        """Corrupt one byte inside chunk k's payload."""
+        idx = rio._py_index(path)
+        off, n, plen, crc = idx[k]
+        blob = bytearray(open(path, "rb").read())
+        blob[off + rio._HDR.size + plen // 2] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_skip_corrupt_drops_only_that_chunk(self, tmp_path,
+                                                use_native):
+        if use_native and not has_native():
+            pytest.skip("no compiler for the native codec")
+        recs = [struct.pack("<I", i) for i in range(64)]
+        p = str(tmp_path / "c.ptrec")
+        rio.write_records(p, recs, max_chunk_bytes=64,
+                          use_native=use_native)
+        nc = rio.num_chunks(p, use_native=use_native)
+        assert nc > 3
+        self._flip_chunk_payload(p, 1)
+        # strict mode still aborts
+        with pytest.raises(ValueError, match="crc"):
+            rio.read_chunk(p, 1, use_native=use_native)
+        # skip_corrupt: that chunk's records are missing, counted
+        before = rio.corrupt_chunks_skipped()
+        got = []
+        for k in range(nc):
+            got.extend(rio.read_chunk(p, k, use_native=use_native,
+                                      skip_corrupt=True))
+        assert rio.corrupt_chunks_skipped() == before + 1
+        lost = rio.read_chunk(str(tmp_path / "c.ptrec"), 1,
+                              use_native=use_native, skip_corrupt=True)
+        assert lost == []
+        good = [struct.unpack("<I", r)[0] for r in got]
+        all_ids = set(range(64))
+        missing = all_ids - set(good)
+        # exactly one chunk's worth of contiguous records is gone
+        assert missing and missing != all_ids
+        assert sorted(good) == sorted(all_ids - missing)
+
+    def test_corrupt_shard_completes_epoch_via_coordinator(self,
+                                                           tmp_path):
+        """Acceptance: one crc-flipped chunk; the elastic epoch under
+        skip_corrupt=True completes with exactly that chunk's records
+        missing and the skip counted."""
+        from paddle_tpu.trainer.coordinator import Coordinator, task_reader
+        recs = [struct.pack("<I", i) for i in range(50)]
+        p = str(tmp_path / "e.ptrec")
+        rio.write_records(p, recs, max_chunk_bytes=128, use_native=False)
+        # which records live in chunk 2?
+        chunk2 = {struct.unpack("<I", r)[0]
+                  for r in rio.read_chunk(p, 2, use_native=False)}
+        self._flip_chunk_payload(p, 2)
+        before = rio.corrupt_chunks_skipped()
+        coord = Coordinator(rio.chunk_descriptors(p), chunks_per_task=1,
+                            timeout_s=30.0)
+        reader = task_reader(
+            coord,
+            rio.chunk_reader(lambda b: struct.unpack("<I", b)[0],
+                             skip_corrupt=True),
+            idle_timeout=10.0)
+        seen = sorted(reader())                # completes the epoch
+        assert rio.corrupt_chunks_skipped() == before + 1
+        assert seen == sorted(set(range(50)) - chunk2)
+
+
 class TestCoordinatorIntegration:
     def test_chunks_feed_the_elastic_reader(self, tmp_path):
         """chunk_descriptors + chunk_reader drive the real Coordinator:

@@ -1,0 +1,347 @@
+"""Serving chaos suite — InferenceServer under injected faults.
+
+The acceptance contract (ISSUE: hardened inference serving): N client
+threads with injected hung-forward, poisoned-bytes, mid-request-destroy
+and burst-overload faults produce zero interpreter crashes or
+deadlocks, only typed errors at the boundary, and the circuit breaker
+opens under fault and recovers (serves successfully) after the faults
+stop. Faults come from paddle_tpu.testing.FaultPlan (e)-(g); every
+test is @chaos so a wedge dumps all thread stacks (tests/conftest.py).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import (CircuitBreaker, Expired, InferenceServer,
+                                Rejected, ServerClosed, ServingError,
+                                build_http_server)
+from paddle_tpu.testing import FaultPlan
+from paddle_tpu.trainer.inference import Inference
+
+pytestmark = pytest.mark.chaos
+
+
+def tiny_inference(dim=8, out=4, seed=5):
+    paddle.init(seed=seed)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(dim))
+    o = paddle.layer.fc(x, size=out, act=paddle.activation.Softmax())
+    params = paddle.create_parameters(paddle.Topology(o))
+    return Inference(output_layer=o, parameters=params)
+
+
+def samples(batch=2, dim=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(dim).astype(np.float32),) for _ in range(batch)]
+
+
+class TestServerBasics:
+    def test_serves_and_snapshots(self):
+        inf = tiny_inference()
+        srv = InferenceServer(inf, max_queue=8, workers=2,
+                              breaker=False).start()
+        try:
+            want = np.asarray(inf.infer(samples()))
+            got = np.asarray(srv.infer(samples()))
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+            for _ in range(5):
+                srv.infer(samples())
+            st = srv.stats()
+            assert st["served"] == 6
+            assert st["p50_ms"] > 0.0
+            assert srv.health()["status"] == "ok"
+        finally:
+            srv.shutdown(drain=True)
+        assert srv.health()["status"] == "stopped"
+
+    def test_graceful_drain_completes_queued_work(self):
+        inf = tiny_inference()
+        plan = FaultPlan(seed=3)
+        srv = InferenceServer(inf, max_queue=16, workers=1,
+                              breaker=False).start()
+        with plan.flaky_forward(inf, delay={i: 0.05 for i in range(8)}):
+            reqs = [srv.submit(samples(seed=i)) for i in range(6)]
+            t = threading.Thread(target=srv.shutdown,
+                                 kwargs={"drain": True})
+            t.start()
+            for r in reqs:                  # all queued work completes
+                assert np.asarray(r.get(timeout=30)).shape == (2, 4)
+            t.join(30)
+            assert not t.is_alive()
+        with pytest.raises(ServerClosed):
+            srv.submit(samples())
+        assert srv.stats()["served"] == 6
+
+    def test_shutdown_without_drain_fails_queued_typed(self):
+        inf = tiny_inference()
+        plan = FaultPlan(seed=4)
+        srv = InferenceServer(inf, max_queue=16, workers=1,
+                              breaker=False).start()
+        with plan.flaky_forward(inf, delay={0: 0.2}):
+            first = srv.submit(samples())          # occupies the worker
+            queued = [srv.submit(samples(seed=i)) for i in range(4)]
+            time.sleep(0.05)                        # worker picked first
+            srv.shutdown(drain=False, timeout=10)
+            dropped = 0
+            for r in queued:
+                try:
+                    r.get(timeout=10)
+                except ServerClosed:
+                    dropped += 1
+            assert dropped >= 3                     # queue was flushed
+            first.get(timeout=10)                   # in-flight completed
+
+
+class TestBackpressure:
+    def test_burst_overload_rejects_with_retry_after(self):
+        """Burst fault: 30 concurrent requests against queue=3/worker=1
+        with a slowed forward — the bounded queue sheds the overflow
+        with Rejected(retry_after>0), everything settles, nothing
+        crashes or deadlocks."""
+        inf = tiny_inference()
+        plan = FaultPlan(seed=9)
+        srv = InferenceServer(inf, max_queue=3, workers=1,
+                              breaker=False).start()
+        try:
+            with plan.flaky_forward(
+                    inf, delay={i: 0.03 for i in range(64)}):
+                results, errors = FaultPlan.burst(
+                    lambda i: srv.infer(samples(seed=i)), 30,
+                    threads=8, timeout=60)
+            served = sum(r is not None for r in results)
+            rejected = [e for e in errors if isinstance(e, Rejected)]
+            other = [e for e in errors
+                     if e is not None and not isinstance(e, Rejected)]
+            assert other == []              # typed backpressure only
+            assert served + len(rejected) == 30
+            assert len(rejected) > 0        # the bound actually bound
+            assert all(e.retry_after > 0 and e.reason == "queue_full"
+                       for e in rejected)
+            st = srv.stats()
+            assert st["rejected_full"] == len(rejected)
+            assert st["served"] == served
+        finally:
+            srv.shutdown(drain=True)
+
+    def test_deadline_expires_queued_requests(self):
+        inf = tiny_inference()
+        plan = FaultPlan(seed=10)
+        srv = InferenceServer(inf, max_queue=16, workers=1,
+                              breaker=False).start()
+        try:
+            with plan.flaky_forward(inf, delay={0: 0.3}):
+                slow = srv.submit(samples())
+                doomed = srv.submit(samples(seed=1), deadline=0.05)
+                with pytest.raises(Expired):
+                    doomed.get()
+                slow.get(timeout=10)
+            assert srv.stats()["expired"] >= 1
+        finally:
+            srv.shutdown(drain=True)
+
+
+class TestHungForwardAndBreaker:
+    def test_hung_forward_expires_then_recovers(self):
+        """A hung forward (blocks on an Event) must not hang the client:
+        the deadline bounds the wait, the request is typed Expired, and
+        after the fault is released the server serves again."""
+        inf = tiny_inference()
+        plan = FaultPlan(seed=11)
+        release = threading.Event()
+        srv = InferenceServer(inf, max_queue=8, workers=1,
+                              breaker=False).start()
+        try:
+            with plan.flaky_forward(inf, hang={0: release}):
+                req = srv.submit(samples(), deadline=0.2)
+                t0 = time.monotonic()
+                with pytest.raises(Expired):
+                    req.get()
+                assert time.monotonic() - t0 < 5.0   # client not hung
+                release.set()                        # un-wedge the worker
+            out = srv.infer(samples(), deadline=10.0)
+            assert np.asarray(out).shape == (2, 4)
+        finally:
+            release.set()
+            srv.shutdown(drain=True, timeout=10)
+
+    def test_breaker_opens_under_faults_and_half_open_recovers(self):
+        """Poisoned forwards push the failure rate over threshold: the
+        breaker OPENS (submit -> Rejected(breaker_open)), then after the
+        cooldown it half-opens, probes succeed, and serving resumes."""
+        inf = tiny_inference()
+        plan = FaultPlan(seed=12)
+        breaker = CircuitBreaker(window=16, failure_threshold=0.5,
+                                 min_requests=4, cooldown=0.3,
+                                 half_open_probes=2)
+        srv = InferenceServer(inf, max_queue=16, workers=1,
+                              breaker=breaker).start()
+        try:
+            with plan.flaky_forward(inf, fail_rate=1.0):
+                failures = 0
+                for i in range(8):
+                    try:
+                        srv.infer(samples(seed=i), deadline=5.0)
+                    except ServingError:
+                        failures += 1
+                assert failures >= 4
+                assert breaker.state == "open"
+                with pytest.raises(Rejected) as ei:
+                    srv.submit(samples())
+                assert ei.value.reason == "breaker_open"
+                assert ei.value.retry_after > 0
+                assert srv.health()["status"] == "shedding"
+            # faults stop; wait out the cooldown, probes close it
+            time.sleep(0.35)
+            for i in range(3):
+                out = srv.infer(samples(seed=100 + i), deadline=10.0)
+                assert np.asarray(out).shape == (2, 4)
+            assert breaker.state == "closed"
+            assert srv.stats()["rejected_breaker"] >= 1
+            assert srv.stats()["breaker"]["trips"] >= 1
+        finally:
+            srv.shutdown(drain=True)
+
+
+class TestMixedChaosAcceptance:
+    def test_eight_clients_mixed_faults_no_crash_no_deadlock(self):
+        """THE acceptance run: 8 client threads of mixed traffic against
+        a live server while the fault plan injects slow forwards, failed
+        (poisoned) forwards, and burst overload — plus concurrent C-ABI
+        clone/forward/destroy traffic with a mid-request destroy. Zero
+        untyped exceptions, zero deadlocks; the breaker opens under the
+        fault storm and the server serves again after it passes."""
+        from paddle_tpu import capi_host as ch
+        from paddle_tpu.trainer.inference import save_inference_model
+        import tempfile
+        import os
+
+        inf = tiny_inference()
+        # the C-ABI lane gets its own tiny artifact
+        tar = os.path.join(tempfile.mkdtemp(), "m.tar")
+        paddle.init(seed=6)
+        x2 = paddle.layer.data("px", paddle.data_type.dense_vector(8))
+        o2 = paddle.layer.fc(x2, size=4,
+                             act=paddle.activation.Softmax())
+        p2 = paddle.create_parameters(paddle.Topology(o2))
+        save_inference_model(tar, o2, p2)
+
+        plan = FaultPlan(seed=13)
+        breaker = CircuitBreaker(window=16, failure_threshold=0.5,
+                                 min_requests=4, cooldown=0.25,
+                                 half_open_probes=1)
+        srv = InferenceServer(inf, max_queue=8, workers=2,
+                              default_deadline=5.0,
+                              breaker=breaker).start()
+        src = ch.create(tar)
+        assert src > 0
+        payload = np.linspace(0, 1, 16).astype(np.float32).tobytes()
+        untyped = []
+
+        def http_client(tid):
+            import random as _r
+            rng = _r.Random(tid)
+            for i in range(25):
+                try:
+                    srv.infer(samples(seed=tid * 100 + i),
+                              deadline=rng.choice([0.5, 2.0, 5.0]))
+                except (Rejected, Expired, ServingError):
+                    pass                        # typed: expected
+                except BaseException as e:      # the failure under test
+                    untyped.append(repr(e))
+
+        def capi_client(tid):
+            import random as _r
+            rng = _r.Random(1000 + tid)
+            for i in range(25):
+                c = ch.create_shared(src)
+                if c > 0:
+                    blob = payload if rng.random() < 0.5 else \
+                        plan.poison_bytes(payload, flips=3,
+                                          truncate=rng.randrange(16))
+                    r = ch.forward(c, blob, 2, 8)
+                    if not isinstance(r, (int, tuple)):
+                        untyped.append(repr(r))
+                    ch.destroy(c)
+                elif c != ch.ERR_BAD_HANDLE:
+                    untyped.append(f"create_shared -> {c}")
+
+        # fault storm: half the forwards fail, some are slow
+        with plan.flaky_forward(inf, fail_rate=0.5,
+                                delay={i: 0.02 for i in range(0, 60, 7)}):
+            threads = ([threading.Thread(target=http_client, args=(t,))
+                        for t in range(5)] +
+                       [threading.Thread(target=capi_client, args=(t,))
+                        for t in range(3)])
+            killer = FaultPlan.destroy_during(ch.destroy, src,
+                                              delay_s=0.4)
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+                assert not t.is_alive(), "client thread wedged"
+            killer.join(10)
+        assert untyped == []
+
+        # recovery: faults gone — after cooldown the breaker must close
+        # and real traffic serves again
+        deadline = time.monotonic() + 30
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                out = srv.infer(samples(seed=999), deadline=10.0)
+                assert np.asarray(out).shape == (2, 4)
+                ok = True
+                break
+            except (Rejected, Expired):
+                time.sleep(0.1)
+        assert ok, "server never recovered after faults stopped"
+        st = srv.stats()
+        assert st["served"] > 0
+        srv.shutdown(drain=True, timeout=30)
+        ch.destroy(src)                 # typed even if killer got it
+
+
+class TestHTTPFront:
+    def test_http_infer_health_stats(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        inf = tiny_inference()
+        srv = InferenceServer(inf, max_queue=8, workers=1,
+                              breaker=False).start()
+        httpd = build_http_server(srv, "127.0.0.1", 0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            rows = [[0.1] * 8, [0.2] * 8]
+            req = urllib.request.Request(
+                base + "/infer",
+                data=json.dumps({"rows": rows}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                body = json.loads(r.read())
+            assert np.asarray(body["outputs"]).shape == (2, 4)
+            with urllib.request.urlopen(base + "/health",
+                                        timeout=10) as r:
+                assert json.loads(r.read())["status"] == "ok"
+            with urllib.request.urlopen(base + "/stats",
+                                        timeout=10) as r:
+                assert json.loads(r.read())["served"] == 1
+            # malformed payload is a 400, not a stack trace
+            bad = urllib.request.Request(
+                base + "/infer", data=b"{\"rows\": \"nope\"}",
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(bad, timeout=10)
+                assert False, "expected HTTPError"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            httpd.shutdown()
+            srv.shutdown(drain=True)
