@@ -190,6 +190,19 @@ def _job_train(trainer, ns, args) -> int:
     if reader is None:
         raise SystemExit("--job=train needs a `train_reader` in the config")
 
+    if args.data_max_bad or args.data_sample_timeout or args.data_prefetch:
+        # supervise the config's batch reader: bounded prefetch with
+        # clean shutdown, hung-source watchdog, per-batch error budget
+        # (docs/robustness.md "Data pipeline")
+        from paddle_tpu.reader import ErrorBudget, supervised
+        reader = supervised(
+            reader,
+            buffer_size=args.data_prefetch or 4,
+            sample_timeout=args.data_sample_timeout or None,
+            error_budget=ErrorBudget(max_bad=args.data_max_bad,
+                                     on_bad=args.data_on_bad),
+            name="train-feed")
+
     def handler(e):
         if isinstance(e, paddle.event.EndIteration) and \
                 e.batch_id % max(args.log_period, 1) == 0:
@@ -436,6 +449,21 @@ def main(argv=None) -> int:
                     help="enable the guarded train step: skip non-finite "
                          "updates, roll back after N consecutive bad "
                          "steps (0 disables)")
+    tr.add_argument("--data_prefetch", type=int, default=0,
+                    help="supervise the train reader with an N-batch "
+                         "bounded prefetch pipeline (0 disables; "
+                         "docs/robustness.md 'Data pipeline')")
+    tr.add_argument("--data_sample_timeout", type=float, default=0,
+                    help="hung-source watchdog: warn + count when the "
+                         "reader produces nothing for N seconds "
+                         "(0 disables)")
+    tr.add_argument("--data_max_bad", type=int, default=0,
+                    help="error budget: tolerate N quarantined bad "
+                         "batches before emitting a data FaultEvent")
+    tr.add_argument("--data_on_bad", default="log",
+                    choices=["log", "raise"],
+                    help="past --data_max_bad: keep skipping (log) or "
+                         "abort the run (raise)")
     tr.add_argument("--init_model_path", default=None,
                     help="params.tar to start from")
     tr.add_argument("--log_period", type=int, default=100)

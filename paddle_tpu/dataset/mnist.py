@@ -36,7 +36,10 @@ def _reader(images_file, labels_file, synth_n, synth_seed):
     def reader():
         ip = os.path.join(common.DATA_HOME, "mnist", images_file)
         lp = os.path.join(common.DATA_HOME, "mnist", labels_file)
-        if os.path.exists(ip) and os.path.exists(lp):
+        # has_cached verifies the optional MD5SUMS manifest: a corrupt
+        # drop-in warns and falls back to synthetic (common.py)
+        if common.has_cached("mnist", images_file) and \
+                common.has_cached("mnist", labels_file):
             images, labels = _read_idx(ip, lp)
         else:
             images, labels = synthetic.class_clustered(

@@ -16,9 +16,62 @@ from typing import Any, Callable, Iterable, List, Sequence
 
 Reader = Callable[[], Iterable[Any]]
 
+from paddle_tpu.reader.pipeline import (CheckpointableReader,  # noqa: E402
+                                        ErrorBudget, ErrorBudgetExceeded,
+                                        SupervisedReader, supervised)
+
+
+class _CheckpointableBatches:
+    """``batch()`` over a checkpointable sample reader (one exposing
+    ``state()``/``set_state()`` — CheckpointableReader or an ordered
+    SupervisedReader over one): records the source position at every
+    batch boundary so the trainer can checkpoint mid-pass reader state.
+    ``state_for(n)`` is the position after the n-th batch yielded by the
+    CURRENT iteration (a bounded window of recent batches is kept)."""
+
+    _KEEP = 256
+
+    def __init__(self, reader, batch_size: int, drop_last: bool):
+        self._src = reader
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._states: dict = {}
+
+    def set_state(self, st) -> None:
+        self._src.set_state(st)
+
+    def state_for(self, n: int):
+        return self._states.get(n)
+
+    def _mark(self, n: int) -> None:
+        self._states[n] = self._src.state()
+        while len(self._states) > self._KEEP:
+            del self._states[min(self._states)]
+
+    def __call__(self):
+        self._states = {}
+        n = 0
+        buf: List[Any] = []
+        for sample in self._src():
+            buf.append(sample)
+            if len(buf) == self.batch_size:
+                self._mark(n)
+                yield buf
+                n += 1
+                buf = []
+        if buf and not self.drop_last:
+            self._mark(n)
+            yield buf
+
 
 def batch(reader: Reader, batch_size: int, drop_last: bool = False) -> Reader:
-    """paddle.batch parity: sample reader -> batch reader."""
+    """paddle.batch parity: sample reader -> batch reader. A
+    checkpointable sample reader yields a checkpointable batch reader
+    (see _CheckpointableBatches / docs/robustness.md "Data pipeline")."""
+    if hasattr(reader, "state") and hasattr(reader, "set_state") and \
+            getattr(reader, "checkpointable", True):
+        return _CheckpointableBatches(reader, batch_size, drop_last)
+
     def batch_reader():
         buf: List[Any] = []
         for sample in reader():
@@ -99,28 +152,57 @@ def firstn(reader: Reader, n: int) -> Reader:
     return limited
 
 
+def _shutdown_put(q: "_queue.Queue", item, stop: threading.Event) -> bool:
+    """Bounded-queue put that bails once the consumer shut the reader
+    down — a fill thread must never block forever against a full queue
+    after the consumer abandoned the generator."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except _queue.Full:
+            continue
+    return False
+
+
 def buffered(reader: Reader, size: int) -> Reader:
     """Async prefetch via a background thread — the DoubleBuffer equivalent
-    (paddle/gserver/dataproviders/DataProvider.h:249)."""
-    end = object()
+    (paddle/gserver/dataproviders/DataProvider.h:249).
+
+    Lifecycle (docs/robustness.md "Data pipeline"): a source exception
+    re-raises in the CONSUMER at the point it occurred (never a silently
+    truncated epoch), and abandoning the generator mid-epoch (break /
+    close()) stops the fill thread instead of leaking it against a full
+    queue. For supervision beyond that — watchdog, error budget, worker
+    restarts — use reader.supervised()."""
 
     def buffered_reader():
         q: _queue.Queue = _queue.Queue(maxsize=size)
+        stop = threading.Event()
 
         def fill():
             try:
                 for sample in reader():
-                    q.put(sample)
-            finally:
-                q.put(end)
+                    if not _shutdown_put(q, ("item", sample), stop):
+                        return
+                _shutdown_put(q, ("end", None), stop)
+            except BaseException as e:    # re-raised by the consumer
+                _shutdown_put(q, ("err", e), stop)
 
-        t = threading.Thread(target=fill, daemon=True)
+        t = threading.Thread(target=fill, daemon=True,
+                             name="pt-data-buffered")
         t.start()
-        while True:
-            s = q.get()
-            if s is end:
-                break
-            yield s
+        try:
+            while True:
+                kind, val = q.get()
+                if kind == "end":
+                    return
+                if kind == "err":
+                    raise val
+                yield val
+        finally:
+            stop.set()
+            t.join(timeout=1.0)
     return buffered_reader
 
 
@@ -129,68 +211,82 @@ def xmap_readers(mapper, reader: Reader, process_num: int,
     """Apply `mapper` to samples with `process_num` worker threads
     (reader.decorator.xmap_readers parity, decorator.py:233 — the
     reference's "processes" are threads too). order=True preserves the
-    input order; otherwise samples come out as workers finish. Worker
-    exceptions re-raise in the consumer."""
-    import queue
-    import threading
+    input order; otherwise samples come out as workers finish.
 
-    end = object()
+    Lifecycle (docs/robustness.md "Data pipeline"): a worker/source
+    exception re-raises in the consumer AT the failing sample — not
+    after the whole epoch drains — and abandoning the generator early
+    shuts the feed/worker threads down instead of deadlocking them on
+    full queues. For quarantine/restart semantics use
+    reader.supervised(mapper=...)."""
 
     def xreader():
-        in_q: queue.Queue = queue.Queue(buffer_size)
-        out_q: queue.Queue = queue.Queue(buffer_size)
-        errors: List[BaseException] = []
+        in_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_q: _queue.Queue = _queue.Queue(buffer_size)
+        stop = threading.Event()
 
         def feed():
             try:
                 for i, s in enumerate(reader()):
-                    in_q.put((i, s))
-            except BaseException as e:   # surfaced below
-                errors.append(e)
-            finally:
+                    if not _shutdown_put(in_q, ("item", i, s), stop):
+                        return
                 for _ in range(process_num):
-                    in_q.put(end)
+                    if not _shutdown_put(in_q, ("end",), stop):
+                        return
+            except BaseException as e:
+                _shutdown_put(out_q, ("err", e), stop)
 
         def work():
-            while True:
-                item = in_q.get()
-                if item is end:
-                    out_q.put(end)
-                    return
-                i, s = item
+            while not stop.is_set():
                 try:
-                    out_q.put((i, mapper(s)))
-                except BaseException as e:
-                    errors.append(e)
-                    out_q.put(end)
+                    item = in_q.get(timeout=0.1)
+                except _queue.Empty:
+                    continue
+                if item[0] == "end":
+                    _shutdown_put(out_q, ("wend",), stop)
+                    return
+                _, i, s = item
+                try:
+                    v = mapper(s)
+                except BaseException as e:   # surfaced NOW, not at drain
+                    _shutdown_put(out_q, ("err", e), stop)
+                    return
+                if not _shutdown_put(out_q, ("item", i, v), stop):
                     return
 
-        threads = [threading.Thread(target=feed, daemon=True)] + \
-            [threading.Thread(target=work, daemon=True)
-             for _ in range(process_num)]
+        threads = [threading.Thread(target=feed, daemon=True,
+                                    name="pt-data-xmap-feed")] + \
+            [threading.Thread(target=work, daemon=True,
+                              name=f"pt-data-xmap-w{w}")
+             for w in range(process_num)]
         for t in threads:
             t.start()
 
         finished = 0
         pending = {}
         next_i = 0
-        while finished < process_num:
-            item = out_q.get()
-            if item is end:
-                finished += 1
-                continue
-            i, v = item
-            if not order:
-                yield v
-            else:
-                pending[i] = v
-                while next_i in pending:
-                    yield pending.pop(next_i)
-                    next_i += 1
-        if errors:
-            raise errors[0]
-        # order mode: indices are dense, so nothing can remain pending
-        assert not pending, "xmap_readers lost samples"
+        try:
+            while finished < process_num:
+                item = out_q.get()
+                if item[0] == "wend":
+                    finished += 1
+                    continue
+                if item[0] == "err":
+                    raise item[1]
+                _, i, v = item
+                if not order:
+                    yield v
+                else:
+                    pending[i] = v
+                    while next_i in pending:
+                        yield pending.pop(next_i)
+                        next_i += 1
+            # order mode: indices are dense, so nothing can stay pending
+            assert not pending, "xmap_readers lost samples"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=1.0)
 
     return xreader
 

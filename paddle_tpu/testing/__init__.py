@@ -1,6 +1,6 @@
 """Testing utilities — deterministic fault injection for chaos tests
 (docs/robustness.md)."""
 
-from paddle_tpu.testing.faults import FaultPlan
+from paddle_tpu.testing.faults import FaultPlan, WorkerCrash
 
-__all__ = ["FaultPlan"]
+__all__ = ["FaultPlan", "WorkerCrash"]

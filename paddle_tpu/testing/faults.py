@@ -24,7 +24,18 @@ and, for the serving path (docs/robustness.md "Serving"):
   (f) POISON request byte payloads deterministically
       (``poison_bytes`` — the capi_host fuzz inputs);
   (g) destroy a C-ABI handle mid-request (``destroy_during``) and fire
-      request BURSTS from a thread pool (``burst``) for overload tests.
+      request BURSTS from a thread pool (``burst``) for overload tests;
+
+and, for the data pipeline (docs/robustness.md "Data pipeline"):
+
+  (h) HANG or SLOW a source at chosen sample indices (``hung_reader`` —
+      drives the supervised pipeline's watchdog), make a mapper RAISE at
+      chosen calls (``raising_mapper`` — the quarantine lane), CRASH the
+      worker thread running a mapper (``crashing_mapper`` raises
+      :class:`WorkerCrash`, a BaseException — the restart path), and
+      CORRUPT chosen pickled records before they land in a RecordIO
+      shard (``corrupt_records`` — per-record corruption that passes the
+      chunk crc but fails deserialization).
 
 Everything is deterministic given the seed and the schedule, so a chaos
 test that fails replays exactly. See ``tests/test_faults.py`` and
@@ -46,7 +57,15 @@ from typing import Callable, Dict, Iterable, Optional, Sequence, Set
 
 import numpy as np
 
-__all__ = ["FaultPlan", "FlakyCoordinator"]
+__all__ = ["FaultPlan", "FlakyCoordinator", "WorkerCrash"]
+
+
+class WorkerCrash(BaseException):
+    """A simulated worker-thread death (segfaulting native op, stack
+    overflow, interpreter teardown). Deliberately NOT an Exception: the
+    supervised pipeline quarantines mapper ``Exception``s as bad
+    samples, but a BaseException means the WORKER died — its in-flight
+    sample is requeued and the worker restarted (reader/pipeline.py)."""
 
 
 class FlakyCoordinator:
@@ -310,6 +329,87 @@ class FaultPlan:
         finally:
             pool.shutdown(wait=False)
         return results, errors
+
+    # --------------------------------------------- (h) data pipeline
+    @staticmethod
+    def hung_reader(reader: Callable, hang: Optional[Dict[int, float]] = None,
+                    release: Optional[Dict[int, threading.Event]] = None
+                    ) -> Callable:
+        """Wrap a sample Reader so chosen 0-based sample indices HANG
+        before being yielded: ``hang[i]`` seconds (a finite hang — a
+        stuck disk/NFS read that eventually completes), or until the
+        test sets ``release[i]`` (a deterministic indefinite hang). The
+        supervised pipeline's watchdog must detect the stall; no sample
+        is lost — delivery is late, not absent. Indices reset per
+        epoch (per ``reader()`` call), so a resumed/second pass replays
+        the same schedule."""
+        hangs = dict(hang or {})
+        events = dict(release or {})
+
+        def rdr():
+            for i, s in enumerate(reader()):
+                if i in events:
+                    events[i].wait()
+                if i in hangs:
+                    time.sleep(hangs[i])
+                yield s
+        return rdr
+
+    def raising_mapper(self, mapper: Callable, at: Iterable[int],
+                       exc_type=ValueError) -> Callable:
+        """Wrap a mapper so the given 0-based CALL indices raise
+        ``exc_type`` — the per-sample fault the quarantine lane must
+        absorb. The call counter is shared across worker threads
+        (lock-protected), so exactly len(at) calls fail."""
+        bad = set(int(i) for i in at)
+        lock = threading.Lock()
+        count = [0]
+
+        def m(sample):
+            with lock:
+                i = count[0]
+                count[0] += 1
+            if i in bad:
+                raise exc_type(f"injected mapper fault: call #{i}")
+            return mapper(sample)
+        return m
+
+    def crashing_mapper(self, mapper: Callable,
+                        at: Iterable[int]) -> Callable:
+        """Wrap a mapper so the given 0-based call indices raise
+        :class:`WorkerCrash` (a BaseException): the worker THREAD dies
+        mid-sample. The pipeline must requeue the in-flight sample and
+        restart the worker — zero records lost. Call counter shared
+        across threads, so the requeued retry (a later call index)
+        succeeds."""
+        bad = set(int(i) for i in at)
+        lock = threading.Lock()
+        count = [0]
+
+        def m(sample):
+            with lock:
+                i = count[0]
+                count[0] += 1
+            if i in bad:
+                raise WorkerCrash(f"injected worker crash: call #{i}")
+            return mapper(sample)
+        return m
+
+    def corrupt_records(self, records: Iterable[bytes],
+                        at: Iterable[int]) -> Iterable[bytes]:
+        """Yield ``records`` with the chosen 0-based indices replaced by
+        garbage that can NEVER unpickle (leading 0xFF is no pickle
+        opcode) — per-record corruption inside an otherwise crc-valid
+        chunk. Feed the result to recordio.write_records to build a
+        shard with exactly len(at) bad records."""
+        bad = set(int(i) for i in at)
+        for i, rec in enumerate(records):
+            if i in bad:
+                filler = bytes(self._rng.randrange(256)
+                               for _ in range(max(len(rec) - 1, 4)))
+                yield b"\xff" + filler
+            else:
+                yield rec
 
     # --------------------------------------------- (d) process murder
     @staticmethod

@@ -123,6 +123,15 @@ class CheckpointManager:
         flat = _flatten(payload)
         path = os.path.join(self.dir, f"ckpt-{step:010d}")
         user_meta = dict(meta or {})
+        # fail fast ON the caller's thread: meta rides in meta.json (the
+        # trainer's counters, RNG bits, reader position — see
+        # SGD.save_checkpoint), and a non-JSON value must not become a
+        # background-thread failure surfaced one save later at wait()
+        try:
+            json.dumps(user_meta)
+        except TypeError as e:
+            raise TypeError(
+                f"checkpoint meta must be JSON-serializable: {e}") from e
 
         def write():
             tmp = path + ".tmp"

@@ -54,8 +54,31 @@ class DataFeeder:
         n_real = len(batch)
         for name, itype in self.data_types:
             col = self.feeding[name]
-            rows = [sample[col] for sample in batch]
-            feed[name] = self._convert_column(rows, itype)
+            try:
+                rows = [sample[col] for sample in batch]
+            except (IndexError, KeyError, TypeError) as e:
+                # name the offending SAMPLE, not just the numpy frame: a
+                # malformed record that slipped past the reader's
+                # quarantine should point back at its batch position
+                bad = next((i for i, s in enumerate(batch)
+                            if not hasattr(s, "__getitem__")
+                            or (hasattr(s, "__len__") and len(s) <= col)),
+                           None)
+                raise ValueError(
+                    f"batch sample{f' #{bad}' if bad is not None else ''} "
+                    f"has no column {col} for data layer {name!r} "
+                    f"(feeding={self.feeding}): {e}") from e
+            try:
+                feed[name] = self._convert_column(rows, itype)
+            except (ValueError, TypeError) as e:
+                if "unsupported" in str(e):
+                    raise
+                raise ValueError(
+                    f"cannot convert column {col} (data layer {name!r}, "
+                    f"{itype.kind}/dim={itype.dim}): {e} — is a sample "
+                    "malformed? Wrap the reader in reader.supervised() "
+                    "with an ErrorBudget to quarantine bad samples "
+                    "(docs/robustness.md)") from e
         feed["__batch_size__"] = n_real
         return feed
 

@@ -118,6 +118,37 @@ class FaultEvent:
                 f"restored_step={self.restored_step})")
 
 
+class DataFaultEvent(FaultEvent):
+    """A data-pipeline fault (reader/pipeline.py — docs/robustness.md
+    "Data pipeline"). A FaultEvent subclass so handlers that catch
+    FaultEvent see data faults too; pass_id/batch_id are -1 (the
+    pipeline runs below the train loop's batch numbering).
+
+    kind: "data_budget"     — the ErrorBudget is exhausted: more than
+              max_bad samples were quarantined (count is the running
+              bad-sample total, error the last exception);
+          "source_stall"    — the source produced nothing for longer
+              than the watchdog's sample_timeout (count: consecutive
+              stall ticks);
+          "worker_restart"  — a crashed prefetch worker was replaced
+              (count: restarts so far; its in-flight sample was
+              requeued, not lost);
+          "restart_budget"  — worker restarts exceeded max_restarts;
+              the pipeline raises to the consumer after emitting this.
+    """
+
+    def __init__(self, kind: str, count: int, error=None,
+                 where: Optional[str] = None):
+        super().__init__(-1, -1, kind, count, None)
+        self.count = count
+        self.error = error
+        self.where = where
+
+    def __repr__(self):
+        return (f"DataFaultEvent(kind={self.kind!r}, count={self.count}, "
+                f"where={self.where!r}, error={self.error!r})")
+
+
 class TestResult(WithMetric):
     def __init__(self, cost: float, metrics=None):
         super().__init__(metrics)

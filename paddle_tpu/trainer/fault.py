@@ -30,6 +30,13 @@ reads is the raw, possibly non-finite value — visibility, not
 censorship), but their metric contributions are zeroed on device so pass
 averages stay finite; the per-step metric ``fault_ok`` is 1.0 on good
 steps and 0.0 on skipped ones.
+
+The DATA-path twin of this policy is :class:`ErrorBudget`
+(paddle_tpu/reader/pipeline.py, re-exported here): where FaultPolicy
+budgets non-finite *steps*, ErrorBudget budgets bad *samples* —
+quarantined and counted instead of killing the epoch, with a
+DataFaultEvent once the budget is blown. Both feed the same event
+stream, so one handler sees numeric and data faults alike.
 """
 
 from __future__ import annotations
@@ -37,7 +44,15 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-__all__ = ["FaultPolicy"]
+__all__ = ["FaultPolicy", "ErrorBudget", "ErrorBudgetExceeded"]
+
+
+def __getattr__(name):
+    # lazy: reader.pipeline must not load (nor cycle) at trainer import
+    if name in ("ErrorBudget", "ErrorBudgetExceeded"):
+        from paddle_tpu.reader import pipeline
+        return getattr(pipeline, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 @dataclasses.dataclass

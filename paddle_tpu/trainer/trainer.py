@@ -132,6 +132,13 @@ class SGD:
         # a relaunched run re-enters the pass it died in)
         self._pass_count = 0
         self._batch_in_pass = 0
+        # checkpointable-reader plumbing (reader/pipeline.py): when the
+        # train reader exposes state_for()/set_state(), mid-pass
+        # checkpoints carry the reader position and auto-resume SEEKS
+        # instead of re-reading the consumed prefix
+        self._reader_batches = None
+        self._reader_batch_base = 0
+        self._reader_state = None
         if mesh is None:
             mesh = self._default_mesh()
         self.mesh = mesh
@@ -641,7 +648,11 @@ class SGD:
         so a kill -9'd run relaunched with the same flags replays the
         uninterrupted run exactly (deterministic readers; num_passes is
         then the run TOTAL, not additional passes). No-op when no
-        checkpoint exists yet.
+        checkpoint exists yet. A CHECKPOINTABLE reader (reader.batch
+        over a CheckpointableReader — reader/pipeline.py) resumes by
+        seeking the source to the saved (epoch, shard, chunk, offset)
+        instead of re-reading the consumed prefix: each remaining
+        record is consumed exactly once, none re-read or dropped.
 
         fault_policy: a trainer.fault.FaultPolicy — check every step's
         numerics on device, skip non-finite updates, and roll back to
@@ -709,7 +720,15 @@ class SGD:
                     checkpoint_manager.wait()
             return
 
-        start_pass, skip_batches = 0, 0
+        # a checkpointable reader (reader.batch over a
+        # CheckpointableReader / ordered SupervisedReader) carries its
+        # position through checkpoints: resume SEEKS the source instead
+        # of re-reading and discarding the consumed prefix
+        ckptable = hasattr(reader, "state_for") and \
+            hasattr(reader, "set_state")
+        self._reader_batches = reader if ckptable else None
+
+        start_pass, skip_batches, seek_batches = 0, 0, 0
         if auto_resume and checkpoint_manager is not None and \
                 self.restore_checkpoint(checkpoint_manager):
             # replay position: skip the passes (and the leading batches
@@ -718,16 +737,25 @@ class SGD:
             # save, so skipped batches must not re-split (_run_pass).
             start_pass = self._pass_count
             skip_batches = self._batch_in_pass
+            if ckptable and skip_batches and self._reader_state:
+                # mid-pass reader state: position the source exactly
+                # after the last checkpointed batch — each remaining
+                # record is then consumed exactly once, nothing re-read
+                reader.set_state(self._reader_state)
+                seek_batches, skip_batches = skip_batches, 0
         try:
             for pass_id in range(start_pass, num_passes):
                 self._run_pass(pass_id, reader, feeder, event_handler,
                                num_batches_per_pass, checkpoint_manager,
                                checkpoint_period,
                                skip_batches=skip_batches
+                               if pass_id == start_pass else 0,
+                               batch_offset=seek_batches
                                if pass_id == start_pass else 0)
                 if checkpoint_manager is not None:
                     self.save_checkpoint(checkpoint_manager)
         finally:
+            self._reader_batches = None
             if checkpoint_manager is not None:
                 checkpoint_manager.wait()
 
@@ -852,7 +880,13 @@ class SGD:
 
     def _run_pass(self, pass_id, reader, feeder, event_handler,
                   num_batches_per_pass, checkpoint_manager=None,
-                  checkpoint_period: int = 0, skip_batches: int = 0):
+                  checkpoint_period: int = 0, skip_batches: int = 0,
+                  batch_offset: int = 0):
+        """batch_offset: reader-state resume — the source was SEEKED
+        past the first `batch_offset` batches (nothing to re-read), so
+        batch numbering continues from there while the reader yields
+        only the remainder. skip_batches is the legacy replay path for
+        non-checkpointable readers: consume-and-discard."""
         event_handler(evt.BeginPass(pass_id))
         pass_metrics: Dict[str, float] = {}
         metrics_dev = None      # lazy path: on-device (sum, comp) pairs
@@ -871,8 +905,10 @@ class SGD:
         # path's host-float64 accumulation instead of drifting in
         # sequential f32 (docs/perf.md 'Lazy pass metrics').
         acc_dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-        self._batch_in_pass = skip_batches
-        for batch_id, feed in enumerate(self._prefetched(reader, feeder)):
+        self._batch_in_pass = skip_batches or batch_offset
+        self._reader_batch_base = batch_offset
+        for idx, feed in enumerate(self._prefetched(reader, feeder)):
+            batch_id = idx + batch_offset
             if num_batches_per_pass is not None and \
                     batch_id >= num_batches_per_pass:
                 break
@@ -1020,6 +1056,15 @@ class SGD:
              "pass_count": self._pass_count,
              "batch_in_pass": self._batch_in_pass,
              "rng": _np.asarray(jax.random.key_data(self._rng)).tolist()}
+        # mid-pass position of a checkpointable reader: the source state
+        # after the last completed batch, so auto-resume seeks instead
+        # of replaying (reader/pipeline.py; pass-end saves carry none —
+        # the next pass starts fresh)
+        if self._reader_batches is not None and self._batch_in_pass > 0:
+            rs = self._reader_batches.state_for(
+                self._batch_in_pass - 1 - self._reader_batch_base)
+            if rs is not None:
+                m["reader_state"] = rs
         m.update(meta or {})
         return manager.save(self._step_count, self.parameters.raw,
                             self.opt_state, self.parameters.state, m)
@@ -1037,6 +1082,7 @@ class SGD:
         self._step_count = int(tree["meta"].get("step_count", 0))
         self._pass_count = int(tree["meta"].get("pass_count", 0))
         self._batch_in_pass = int(tree["meta"].get("batch_in_pass", 0))
+        self._reader_state = tree["meta"].get("reader_state")
         if "rng" in tree["meta"]:
             # Restore raw uint32 bits to keep the legacy key flavor the
             # rest of the trainer uses — wrap_key_data would produce a

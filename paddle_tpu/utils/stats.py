@@ -67,7 +67,42 @@ class StatSet:
             print(f"Stat={name:<30} {item}")
 
 
+class CounterSet:
+    """Process-global named event counters (the counter half of Stat.h's
+    globalStat). Timers measure durations; counters count occurrences —
+    quarantined samples, worker restarts, source stalls
+    (reader/pipeline.py), corrupt chunks — so chaos tests can diff exact
+    fault counts around an epoch."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def bump(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            v = self._counts.get(name, 0) + n
+            self._counts[name] = v
+            return v
+
+    def value(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def reset(self):
+        with self._lock:
+            self._counts.clear()
+
+    def items(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def print_all_status(self):
+        for name, v in sorted(self.items().items()):
+            print(f"Counter={name:<30} {v}")
+
+
 global_stat = StatSet()
+global_counters = CounterSet()
 
 
 @contextlib.contextmanager

@@ -59,6 +59,49 @@ def _chaos_faulthandler(request):
         faulthandler.cancel_dump_traceback_later()
 
 
+@pytest.hookimpl(tryfirst=True, hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Expose each phase's outcome to fixtures (the thread-leak check
+    only fires on tests that PASSED — a failing test's traceback can
+    legitimately pin an abandoned generator alive)."""
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, "rep_" + rep.when, rep)
+
+
+@pytest.fixture(autouse=True)
+def _no_pipeline_thread_leaks(request):
+    """Fail any test that leaks a data-pipeline thread (buffered /
+    xmap_readers / supervised — all named 'pt-data-*'), so a shutdown
+    regression is caught by CI as a failure instead of as a hang. The
+    grace window lets just-closed generators' threads observe their
+    stop events (they poll every 0.1s)."""
+    import gc
+    import threading
+    import time
+
+    def leaked():
+        from paddle_tpu.reader.pipeline import THREAD_PREFIX
+        return [t for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith(THREAD_PREFIX)]
+
+    yield
+    rep = getattr(request.node, "rep_call", None)
+    if rep is None or not rep.passed:
+        return
+    if leaked():
+        gc.collect()          # close abandoned generators deterministically
+    deadline = time.time() + 5.0
+    while leaked() and time.time() < deadline:
+        time.sleep(0.05)
+    left = leaked()
+    assert not left, (
+        f"test leaked {len(left)} data-pipeline thread(s): "
+        f"{[t.name for t in left]} — a reader was abandoned without "
+        "its fill/worker threads shutting down (reader/pipeline.py "
+        "lifecycle contract)")
+
+
 @pytest.fixture(autouse=True)
 def _reset_layer_names():
     """Fresh auto-name counters per test so graphs don't collide."""

@@ -1,0 +1,111 @@
+"""Subprocess trainer for the reader-state SIGKILL chaos test
+(tests/test_reader_faults.py): trains over a RecordIO-backed
+CheckpointableReader with per-step SYNCHRONOUS checkpoints, appends each
+stepped batch's record ids to a consumption log, and prints a
+'STEP n' marker only at the NEXT BeginIteration — i.e. strictly after
+step n's checkpoint (with its reader position) landed on disk. A
+SIGKILL delivered at the marker therefore leaves checkpoint, log and
+reader position consistent: the resumed run must consume each remaining
+record EXACTLY once (no re-reads, no drops).
+
+argv: <shard_path> <ckpt_dir> <consumed_log> <num_passes> <delay_s>
+Records are pickled (record_id, float32[8] features, int label).
+"""
+
+import sys
+import time
+
+
+def main():
+    shard, ckpt_dir, log_path = sys.argv[1], sys.argv[2], sys.argv[3]
+    num_passes = int(sys.argv[4])
+    delay = float(sys.argv[5])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu.reader import CheckpointableReader, batch
+    from paddle_tpu.trainer.checkpoint import CheckpointManager
+
+    paddle.init(seed=0)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    y = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    out = paddle.layer.fc(x, size=2, act=paddle.activation.Softmax(),
+                          name="out")
+    cost = paddle.layer.classification_cost(out, y, name="cost")
+    params = paddle.create_parameters(paddle.Topology(cost))
+    tr = paddle.SGD(cost=cost, parameters=params,
+                    update_equation=paddle.optimizer.Momentum(
+                        learning_rate=0.05))
+
+    # samples: (id, feat, label); the feeder reads x<-col 1, y<-col 2 and
+    # the id column rides along so the consumption log can name records
+    reader = batch(CheckpointableReader(shard), 4)
+
+    from collections import deque
+    ids_q = deque()
+    samples_read = [0]
+
+    class _LoggedBatches:
+        """Forward the checkpointable batch reader, stashing each
+        batch's record ids in produce order (the prefetch thread runs
+        ahead; the handler pops in consume order) and counting every
+        sample READ from the shard — the exactly-once proof: a resumed
+        run that seeks reads only the remainder, one that replays
+        re-reads the whole pass."""
+
+        def __init__(self, inner):
+            self._b = inner
+
+        def set_state(self, st):
+            self._b.set_state(st)
+
+        def state_for(self, n):
+            return self._b.state_for(n)
+
+        def __call__(self):
+            for b in self._b():
+                ids_q.append([s[0] for s in b])
+                samples_read[0] += len(b)
+                yield b
+
+    log = open(log_path, "a", buffering=1)
+    pending_marker = [None]
+
+    def handler(e):
+        if isinstance(e, paddle.event.BeginIteration):
+            # marker for the PREVIOUS step: its (synchronous) checkpoint
+            # — including the reader position — is already on disk, so a
+            # SIGKILL here is a clean exactly-once resume point
+            if pending_marker[0] is not None:
+                print(f"STEP {pending_marker[0]}", flush=True)
+                if delay:
+                    time.sleep(delay)
+        elif isinstance(e, paddle.event.EndIteration):
+            ids = ids_q.popleft()
+            log.write(f"pass={e.pass_id} batch={e.batch_id} "
+                      f"ids={','.join(str(i) for i in ids)}\n")
+            pending_marker[0] = tr._step_count
+
+    mgr = CheckpointManager(ckpt_dir, async_write=False)
+    tr.train(_LoggedBatches(reader), num_passes=num_passes,
+             event_handler=handler, feeding={"x": 1, "y": 2},
+             checkpoint_manager=mgr, checkpoint_period=1,
+             auto_resume=True)
+    log.close()
+
+    import hashlib
+    import numpy as np
+    h = hashlib.md5()
+    for k in sorted(tr.parameters.raw):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(
+            np.asarray(tr.parameters.raw[k])).tobytes())
+    print(f"WORKER READ samples={samples_read[0]}", flush=True)
+    print(f"WORKER DONE steps={tr._step_count} digest={h.hexdigest()}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

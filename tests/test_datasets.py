@@ -161,3 +161,56 @@ class TestRealFileParsing:
         assert len(samples) == 2
         assert samples[0][0].shape == (3 * 8 * 8,)
         assert samples[1][1] == 99
+
+
+class TestMd5Manifest:
+    """Satellite (docs/robustness.md): an optional MD5SUMS manifest in a
+    module's DATA_HOME dir verifies real drop-ins; a mismatch warns and
+    falls back to the synthetic generator instead of training on
+    corrupt data."""
+
+    def _write(self, data_home, module, filename, payload):
+        d = os.path.join(data_home, module)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, filename), "wb") as f:
+            f.write(payload)
+        return os.path.join(d, filename)
+
+    def test_no_manifest_passes(self, data_home):
+        self._write(data_home, "m", "f.bin", b"payload")
+        assert common.has_cached("m", "f.bin")
+
+    def test_matching_manifest_passes(self, data_home):
+        p = self._write(data_home, "m", "f.bin", b"payload")
+        digest = common.file_md5(p)
+        with open(os.path.join(data_home, "m", common.MANIFEST_NAME),
+                  "w") as f:
+            f.write(f"{digest}  f.bin\nsomethingelse  other.bin\n")
+        assert common.has_cached("m", "f.bin")
+
+    def test_mismatch_warns_and_rejects(self, data_home):
+        self._write(data_home, "m", "f.bin", b"CORRUPTED")
+        with open(os.path.join(data_home, "m", common.MANIFEST_NAME),
+                  "w") as f:
+            f.write("0" * 32 + "  f.bin\n")
+        with pytest.warns(UserWarning, match="md5 mismatch"):
+            assert not common.has_cached("m", "f.bin")
+
+    def test_explicit_md5_arg(self, data_home):
+        p = self._write(data_home, "m", "f.bin", b"payload")
+        assert common.has_cached("m", "f.bin", md5=common.file_md5(p))
+        with pytest.warns(UserWarning, match="md5 mismatch"):
+            assert not common.has_cached("m", "f.bin", md5="0" * 32)
+
+    def test_corrupt_mnist_falls_back_to_synthetic(self, data_home):
+        # garbage gz files + a manifest that disowns them: the loader
+        # must warn and serve the synthetic set instead of crashing
+        for name in ("train-images-idx3-ubyte.gz",
+                     "train-labels-idx1-ubyte.gz"):
+            self._write(data_home, "mnist", name, b"not a gzip")
+        with open(os.path.join(data_home, "mnist", common.MANIFEST_NAME),
+                  "w") as f:
+            f.write("1" * 32 + "  train-images-idx3-ubyte.gz\n")
+        with pytest.warns(UserWarning, match="md5 mismatch"):
+            samples = _first(D.mnist.train(), 2)
+        assert samples[0][0].shape == (784,)

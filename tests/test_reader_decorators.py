@@ -62,3 +62,92 @@ class TestDecorators:
         rdr = R.cache(once)
         assert list(rdr()) == list(rdr()) == [0, 1, 2, 3]
         assert len(calls) == 1
+
+
+def _raising_reader(n_good, exc):
+    def reader():
+        yield from range(n_good)
+        raise exc
+    return reader
+
+
+def _pipeline_threads():
+    import threading
+    from paddle_tpu.reader.pipeline import THREAD_PREFIX
+    return [t for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith(THREAD_PREFIX)]
+
+
+def _assert_threads_drain(timeout=3.0):
+    import time
+    deadline = time.time() + timeout
+    while _pipeline_threads() and time.time() < deadline:
+        time.sleep(0.05)
+    assert not _pipeline_threads(), [t.name for t in _pipeline_threads()]
+
+
+class TestDecoratorLifecycle:
+    """Satellite (docs/robustness.md "Data pipeline"): exception
+    propagation and clean shutdown through the threaded decorators — a
+    source error must reach the CONSUMER (never a silently truncated
+    epoch), and abandoning a decorated reader mid-epoch must not leak
+    fill/worker threads."""
+
+    def test_buffered_propagates_source_error(self):
+        rdr = R.buffered(_raising_reader(3, OSError("disk gone")), 2)
+        got = []
+        with pytest.raises(OSError, match="disk gone"):
+            for v in rdr():
+                got.append(v)
+        assert got == [0, 1, 2]     # the good prefix was delivered
+
+    def test_xmap_propagates_source_error(self):
+        rdr = R.xmap_readers(lambda v: v, _raising_reader(3, OSError("x")),
+                             2, 4)
+        with pytest.raises(OSError):
+            list(rdr())
+
+    def test_xmap_mapper_error_surfaces_promptly(self):
+        """The failing sample's error must arrive AT that sample, not
+        after the whole epoch drains: with a 10k-sample source and a
+        mapper failing at sample 1, the consumer must raise long before
+        the source could have been drained through the size-4 queues."""
+        def mapper(v):
+            if v == 1:
+                raise RuntimeError("bad sample")
+            return v
+
+        rdr = R.xmap_readers(mapper, counts(10000), 2, 4)
+        seen = 0
+        with pytest.raises(RuntimeError, match="bad sample"):
+            for _ in rdr():
+                seen += 1
+        assert seen < 1000          # not an end-of-epoch deferral
+
+    def test_compose_propagates_and_component_error(self):
+        rdr = R.compose(counts(5), _raising_reader(2, ValueError("c2")))
+        with pytest.raises(ValueError, match="c2"):
+            list(rdr())
+
+    def test_no_thread_leak_on_abandon(self):
+        """Abandoning each threaded decorator mid-epoch returns the
+        thread census to baseline (the conftest fixture enforces the
+        same invariant globally; this pins it per decorator)."""
+        makers = [
+            lambda: R.buffered(counts(100000), 2),
+            lambda: R.xmap_readers(lambda v: v, counts(100000), 3, 2),
+            lambda: R.supervised(counts(100000), mapper=lambda v: v,
+                                 num_workers=3, buffer_size=2),
+        ]
+        for make in makers:
+            g = make()()
+            for _ in range(5):
+                next(g)
+            g.close()
+            _assert_threads_drain()
+
+    def test_no_thread_leak_after_error(self):
+        rdr = R.buffered(_raising_reader(2, OSError("gone")), 2)
+        with pytest.raises(OSError):
+            list(rdr())
+        _assert_threads_drain()
