@@ -1,0 +1,28 @@
+"""ptlint — JAX-aware static analysis + runtime sanitizers.
+
+Two complementary disciplines (docs/static_analysis.md):
+
+* the **linter** (`paddle_tpu lint`, tools/ptlint.py, tests/test_lint.py)
+  walks the package ASTs and flags the JAX failure modes that silently
+  destroy "as fast as the hardware allows": host syncs inside traced
+  code, jit-in-a-loop recompilation, trace-time side effects, reused
+  PRNG keys, off-convention threads, silent f64 widening;
+* the **sanitizer** (analysis/sanitizer.py, the ``recompile_budget``
+  pytest marker) watches the live process: XLA compilations per jitted
+  function against a budget, and leaked tracers escaping jit.
+
+The linter is wired into tier-1 (tests/test_lint.py must report zero
+non-baselined findings over paddle_tpu/, tools/ and tests/), so every
+future PR is gated on both.
+"""
+
+from paddle_tpu.analysis.core import (Finding, Rule, all_rules,  # noqa: F401
+                                      iter_suppressions, register_rule)
+from paddle_tpu.analysis.runner import (LintConfig, lint_paths,  # noqa: F401
+                                        load_config, main)
+from paddle_tpu.analysis.sanitizer import (CompileBudgetExceeded,  # noqa: F401
+                                           CompileWatch, compile_watch,
+                                           find_tracers, no_leaked_tracers)
+
+# importing rules registers R1..R6 with the registry
+import paddle_tpu.analysis.rules  # noqa: F401,E402  isort:skip

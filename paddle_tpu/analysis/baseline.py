@@ -1,0 +1,103 @@
+"""ptlint baseline — grandfathered findings, each with a justification.
+
+The baseline lets the linter be adopted over a living codebase: real
+findings are FIXED, intentional ones carry an inline suppression with a
+reason, and the handful that are neither (e.g. a pattern the rule
+cannot see is safe) live here — visible, justified, and counted, so a
+new occurrence of the same pattern still fails CI.
+
+Entries match on (rule, path, stripped source line), NOT line numbers,
+so unrelated edits above a finding do not invalidate the baseline; each
+carries ``why`` (required) and a ``count`` of identical occurrences.
+``ptlint --write-baseline`` regenerates the file (filling ``why`` with
+TODO markers a human must replace before committing — tests/test_lint.py
+rejects TODO justifications).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from paddle_tpu.analysis.core import Finding
+
+__all__ = ["load_baseline", "match_baseline", "write_baseline"]
+
+
+def load_baseline(path: str) -> List[dict]:
+    """[] when the file does not exist (empty baseline)."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        blob = json.load(f)
+    entries = blob.get("entries", [])
+    for e in entries:
+        missing = [k for k in ("rule", "path", "source", "why")
+                   if k not in e]
+        if missing:
+            raise ValueError(
+                f"baseline entry {e!r} missing keys {missing} "
+                f"(every grandfathered finding needs a 'why')")
+        e.setdefault("count", 1)
+    return entries
+
+
+def match_baseline(findings: List[Finding], entries: List[dict]
+                   ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """Split findings into (new, baselined); also return the STALE
+    entries — baseline lines whose finding no longer exists (the fix
+    landed: the entry must be deleted so it cannot mask a future
+    regression)."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in entries:
+        k = (e["rule"], e["path"], e["source"])
+        budget[k] = budget.get(k, 0) + int(e["count"])
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = [e for e in entries
+             if budget.get((e["rule"], e["path"], e["source"]), 0) > 0]
+    # one stale report per exhausted key
+    seen = set()
+    stale_unique = []
+    for e in stale:
+        k = (e["rule"], e["path"], e["source"])
+        if k not in seen:
+            seen.add(k)
+            stale_unique.append(e)
+    return new, old, stale_unique
+
+
+def write_baseline(path: str, findings: List[Finding],
+                   previous: List[dict]) -> int:
+    """Regenerate the baseline from current findings, keeping existing
+    justifications where the (rule, path, source) key survives."""
+    why: Dict[Tuple[str, str, str], str] = {
+        (e["rule"], e["path"], e["source"]): e["why"] for e in previous}
+    counts: Dict[Tuple[str, str, str], int] = {}
+    order: List[Tuple[str, str, str]] = []
+    for f in findings:
+        k = f.key()
+        if k not in counts:
+            order.append(k)
+        counts[k] = counts.get(k, 0) + 1
+    entries = [{"rule": r, "path": p, "source": s,
+                "count": counts[(r, p, s)],
+                "why": why.get((r, p, s),
+                               "TODO: justify or fix before commit")}
+               for (r, p, s) in sorted(order)]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1,
+                   "comment": "ptlint grandfathered findings — see "
+                              "docs/static_analysis.md; every entry "
+                              "needs a real 'why'",
+                   "entries": entries}, f, indent=2)
+        f.write("\n")
+    return len(entries)

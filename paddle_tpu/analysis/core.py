@@ -1,0 +1,168 @@
+"""ptlint core: findings, the rule registry, and suppression comments.
+
+A rule is a class with ``id`` ("R1"), ``name`` ("host-sync") and a
+``check(ctx)`` generator over :class:`Finding`. Rules register
+themselves via :func:`register_rule`; the runner instantiates every
+enabled rule per file and hands it a parsed :class:`FileContext`.
+
+Suppressions are per-line comments::
+
+    x = float(loss)   # ptlint: disable=R1(event handler syncs on its own schedule)
+    # ptlint: disable=host-sync(applies to the NEXT line when alone on its line)
+    y = float(cost)
+
+Rules are named by id (``R1``) or slug (``host-sync``); several may be
+listed comma-separated, with one trailing ``(reason)`` covering all of
+them. A comment-only suppression line applies to the next statement
+line (long lines cannot always fit the reason inline).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple, Type
+
+__all__ = ["Finding", "Rule", "FileContext", "register_rule", "all_rules",
+           "iter_suppressions", "parse_file"]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint hit: rule id + slug, file position, message."""
+    rule: str                 # "R1"
+    name: str                 # "host-sync"
+    path: str                 # repo-relative, forward slashes
+    line: int                 # 1-based
+    col: int
+    message: str
+    # the stripped source line — the baseline matches on it so entries
+    # survive unrelated line-number drift
+    source: str = ""
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.source)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule}[{self.name}] {self.message}")
+
+
+class Rule:
+    """Base class: subclasses set id/name/description and yield
+    Findings from check()."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def __init__(self, options: Optional[dict] = None):
+        self.options = options or {}
+
+    def check(self, ctx: "FileContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register_rule(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator adding a Rule to the registry (id must be
+    unique)."""
+    assert cls.id and cls.name, f"{cls} needs id and name"
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    return dict(_REGISTRY)
+
+
+# --------------------------------------------------------------- suppression
+_SUPPRESS_RE = re.compile(
+    r"#\s*ptlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+    r"(?:\(([^)]*)\))?")
+
+
+@dataclass
+class Suppression:
+    line: int                  # the line the suppression APPLIES to
+    rules: Tuple[str, ...]     # ids or slugs, as written
+    reason: str
+
+    def covers(self, finding: Finding) -> bool:
+        return finding.line == self.line and (
+            finding.rule in self.rules or finding.name in self.rules)
+
+
+def iter_suppressions(text: str) -> Iterator[Suppression]:
+    """Parse ``# ptlint: disable=...`` comments out of real comment
+    tokens (a disable inside a string literal is not a suppression)."""
+    try:
+        tokens = list(tokenize.generate_tokens(StringIO(text).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return
+    lines = text.splitlines()
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(","))
+        reason = (m.group(2) or "").strip()
+        row = tok.start[0]
+        # comment alone on its line => applies to the next non-blank,
+        # non-comment line
+        if lines[row - 1].lstrip().startswith("#"):
+            nxt = row + 1
+            while nxt <= len(lines) and (
+                    not lines[nxt - 1].strip()
+                    or lines[nxt - 1].lstrip().startswith("#")):
+                nxt += 1
+            row = nxt
+        yield Suppression(row, rules, reason)
+
+
+# ------------------------------------------------------------------ context
+@dataclass
+class FileContext:
+    """Everything a rule needs about one file."""
+    path: str                          # repo-relative
+    text: str
+    tree: ast.AST
+    lines: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.text.splitlines()
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: Rule, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        return Finding(rule.id, rule.name, self.path, line,
+                       getattr(node, "col_offset", 0) + 1, message,
+                       source=self.source_line(line))
+
+
+def parse_file(path: str, rel: str, text: Optional[str] = None
+               ) -> Optional[FileContext]:
+    """Parse one file into a FileContext; None when unparsable (the
+    runner reports a diagnostics entry instead of crashing)."""
+    if text is None:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError:
+        return None
+    return FileContext(rel, text, tree)

@@ -1,0 +1,749 @@
+"""ptlint's six JAX-specific rules (docs/static_analysis.md).
+
+R1 host-sync          float()/bool()/int()/.item()/np.asarray()/
+                      jax.device_get() on traced values inside functions
+                      reachable from jit — each one is a device
+                      round-trip in the hot path.
+R2 recompile          jax.jit created inside a loop body (a fresh cache
+                      per iteration = compile every step), or a
+                      locally-defined function/lambda passed as an
+                      argument to a jitted callable (new closure
+                      identity per call = retrace per call).
+R3 trace-side-effect  print(), global/nonlocal writes, or appends to
+                      closure lists inside traced functions — they run
+                      at TRACE time (once per compile), not at step
+                      time, and leak tracers into host state.
+R4 prng-reuse         a PRNGKey consumed twice without an intervening
+                      split()/fold_in() — correlated randomness, the
+                      silent statistics bug.
+R5 thread-hygiene     threading.Thread outside the ``pt-*`` naming +
+                      stop-event convention (reader/pipeline.py), and
+                      bare Lock.acquire() instead of ``with``.
+R6 dtype-widening     np.float64 literals / dtype=float flowing into
+                      device arrays in ops/ — silent 2x memory + ICI
+                      traffic when x64 is enabled.
+
+The trace-reachability model is per-file: a function is "traced" when
+it is decorated with / passed to a trace entry point (jax.jit, grad,
+vmap, scan, shard_map, pallas_call, the repo's shard_train_step, ...),
+when it is defined inside a traced function, or when a traced function
+calls or forwards it by name. Cross-file reachability is intentionally
+out of scope (documented in docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from paddle_tpu.analysis.core import (FileContext, Finding, Rule,
+                                      register_rule)
+
+# ----------------------------------------------------------- name resolution
+
+#: canonical callables whose callable argument is traced by XLA
+TRACE_WRAPPERS = {
+    "jax.jit", "jax.pjit", "jax.grad", "jax.value_and_grad", "jax.vjp",
+    "jax.jvp", "jax.linearize", "jax.vmap", "jax.pmap", "jax.eval_shape",
+    "jax.checkpoint", "jax.remat", "jax.lax.scan", "jax.lax.while_loop",
+    "jax.lax.cond", "jax.lax.fori_loop", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.lax.custom_root",
+    "jax.experimental.shard_map.shard_map", "jax.custom_jvp",
+    "jax.custom_vjp", "jax.experimental.pallas.pallas_call",
+}
+
+#: bare tails accepted as trace wrappers even when the alias map cannot
+#: resolve them (repo-local wrappers that jit internally)
+TRACE_WRAPPER_TAILS = {"shard_train_step", "pallas_call", "shard_map",
+                       "pipeline", "pipeline_1f1b"}
+
+JIT_NAMES = {"jax.jit", "jax.pjit"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.scan' from an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _alias_map(tree: ast.AST) -> Dict[str, str]:
+    """local name -> canonical dotted prefix, from every import."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module and \
+                node.level == 0:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+class _Names:
+    """Canonicalize dotted names through the file's import aliases."""
+
+    def __init__(self, tree: ast.AST):
+        self.aliases = _alias_map(tree)
+
+    def canon(self, node: ast.AST) -> Optional[str]:
+        d = _dotted(node)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        base = self.aliases.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+    def is_trace_wrapper(self, func: ast.AST) -> bool:
+        c = self.canon(func)
+        if c is None:
+            return False
+        if c in TRACE_WRAPPERS:
+            return True
+        return c.rsplit(".", 1)[-1] in TRACE_WRAPPER_TAILS
+
+    def is_jit(self, func: ast.AST) -> bool:
+        c = self.canon(func)
+        return c in JIT_NAMES or (
+            c is not None and c.rsplit(".", 1)[-1] == "jit")
+
+
+# ----------------------------------------------------- traced-function index
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class _FuncInfo:
+    __slots__ = ("node", "parent", "traced", "why")
+
+    def __init__(self, node, parent):
+        self.node = node
+        self.parent = parent            # enclosing _FuncInfo or None
+        self.traced = False
+        self.why = ""
+
+
+def _index_functions(tree: ast.AST) -> List[_FuncInfo]:
+    infos: List[_FuncInfo] = []
+
+    def walk(node, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNCS + (ast.Lambda,)):
+                info = _FuncInfo(child, parent)
+                infos.append(info)
+                walk(child, info)
+            else:
+                walk(child, parent)
+
+    walk(tree, None)
+    return infos
+
+
+def _decorator_is_trace(dec: ast.AST, names: _Names) -> bool:
+    """@jax.jit / @jit / @functools.partial(jax.jit, ...)."""
+    if names.is_trace_wrapper(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        c = names.canon(dec.func)
+        if c in ("functools.partial", "partial") and dec.args:
+            return names.is_trace_wrapper(dec.args[0])
+        return names.is_trace_wrapper(dec.func)
+    return False
+
+
+def _body_names(info: _FuncInfo) -> Tuple[Set[str], Set[str]]:
+    """(called names, names passed as call arguments) in a function
+    body, excluding nested function bodies (they get their own info)."""
+    called: Set[str] = set()
+    passed: Set[str] = set()
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNCS + (ast.Lambda,)):
+                continue
+            if isinstance(child, ast.Call):
+                if isinstance(child.func, ast.Name):
+                    called.add(child.func.id)
+                elif isinstance(child.func, ast.Attribute):
+                    called.add(child.func.attr)
+                for a in list(child.args) + \
+                        [kw.value for kw in child.keywords]:
+                    if isinstance(a, ast.Name):
+                        passed.add(a.id)
+            walk(child)
+
+    walk(info.node)
+    return called, passed
+
+
+def traced_functions(ctx: FileContext, names: _Names) -> List[_FuncInfo]:
+    """Mark every function the tracer can reach (see module docstring)
+    and return the full index."""
+    infos = _index_functions(ctx.tree)
+    by_name: Dict[str, List[_FuncInfo]] = {}
+    for info in infos:
+        if isinstance(info.node, _FUNCS):
+            by_name.setdefault(info.node.name, []).append(info)
+
+    lambda_ids = {id(i.node): i for i in infos
+                  if isinstance(i.node, ast.Lambda)}
+
+    # seeds: trace decorators, and names/lambdas handed to trace wrappers
+    for info in infos:
+        if isinstance(info.node, _FUNCS):
+            for dec in info.node.decorator_list:
+                if _decorator_is_trace(dec, names):
+                    info.traced, info.why = True, "decorated"
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and
+                names.is_trace_wrapper(node.func)):
+            continue
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(a, ast.Name):
+                for info in by_name.get(a.id, ()):
+                    info.traced, info.why = True, "passed to tracer"
+            elif id(a) in lambda_ids:
+                i = lambda_ids[id(a)]
+                i.traced, i.why = True, "lambda passed to tracer"
+
+    # propagate: nested defs, plus same-file functions a traced function
+    # calls or forwards
+    changed = True
+    while changed:
+        changed = False
+        for info in infos:
+            if not info.traced and info.parent is not None and \
+                    info.parent.traced:
+                info.traced, info.why = True, "nested in traced"
+                changed = True
+        for info in infos:
+            if not info.traced:
+                continue
+            called, passed = _body_names(info)
+            for name in called | passed:
+                for tgt in by_name.get(name, ()):
+                    if not tgt.traced:
+                        tgt.traced = True
+                        tgt.why = f"reached from {info.why or 'traced'}"
+                        changed = True
+    return infos
+
+
+def _own_body_walk(func_node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body, excluding nested function/lambda bodies."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FUNCS + (ast.Lambda,)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _params(func_node) -> Set[str]:
+    if isinstance(func_node, (ast.Lambda,) + _FUNCS):
+        a = func_node.args
+        out = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+        if a.vararg:
+            out.add(a.vararg.arg)
+        if a.kwarg:
+            out.add(a.kwarg.arg)
+        out.discard("self")
+        return out
+    return set()
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+# ================================================================== R1
+@register_rule
+class HostSyncRule(Rule):
+    id = "R1"
+    name = "host-sync"
+    description = ("host<->device sync inside traced/hot code: "
+                   "float()/bool()/int()/.item()/np.asarray()/"
+                   "jax.device_get() on a traced value")
+
+    CASTS = {"float", "bool", "int"}
+    NP_PULLS = {"numpy.asarray", "numpy.array"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        names = _Names(ctx.tree)
+        for info in traced_functions(ctx, names):
+            if not info.traced:
+                continue
+            taint = _params(info.node)
+            # one-and-a-half passes of assignment taint: anything
+            # computed from a traced parameter is traced too
+            for _ in range(2):
+                for node in _own_body_walk(info.node):
+                    if isinstance(node, ast.Assign) and \
+                            _names_in(node.value) & taint:
+                        for tgt in node.targets:
+                            taint |= {n.id for n in ast.walk(tgt)
+                                      if isinstance(n, ast.Name)}
+            for node in _own_body_walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in self.CASTS:
+                    if node.args and _names_in(node.args[0]) & taint:
+                        yield ctx.finding(
+                            self, node,
+                            f"{f.id}() on traced value "
+                            f"'{ast.unparse(node.args[0])}' forces a "
+                            "device->host sync inside traced code; keep "
+                            "it on device (jnp) or fetch once outside")
+                elif isinstance(f, ast.Attribute) and f.attr == "item" \
+                        and not node.args:
+                    yield ctx.finding(
+                        self, node,
+                        ".item() inside traced code is a host sync; "
+                        "return the array and read it after the step")
+                else:
+                    c = names.canon(f)
+                    if c in self.NP_PULLS and node.args and \
+                            _names_in(node.args[0]) & taint:
+                        yield ctx.finding(
+                            self, node,
+                            f"{c}() pulls a traced value to host numpy "
+                            "inside traced code; use jnp.* instead")
+                    elif c == "jax.device_get":
+                        yield ctx.finding(
+                            self, node,
+                            "jax.device_get inside traced code is a "
+                            "host sync per step; fetch outside the "
+                            "traced function")
+
+
+# ================================================================== R2
+@register_rule
+class RecompileRule(Rule):
+    id = "R2"
+    name = "recompile"
+    description = ("recompilation hazard: jax.jit inside a loop body, "
+                   "or a local function/lambda argument to a jitted "
+                   "callable")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        names = _Names(ctx.tree)
+        # names bound to jitted callables anywhere in the file
+        jitted_names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    names.is_jit(node.value.func):
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            jitted_names.add(n.id)
+            if isinstance(node, _FUNCS):
+                if any(_decorator_is_trace(d, names)
+                       for d in node.decorator_list):
+                    jitted_names.add(node.name)
+
+        local_funcs = {i.node.name for i in _index_functions(ctx.tree)
+                       if isinstance(i.node, _FUNCS) and
+                       i.parent is not None}
+
+        loop_stack: List[ast.AST] = []
+        func_depth = [0]
+        findings: List[Finding] = []
+
+        def visit(node):
+            is_loop = isinstance(node, (ast.For, ast.While,
+                                        ast.AsyncFor))
+            is_func = isinstance(node, _FUNCS + (ast.Lambda,))
+            if is_loop:
+                loop_stack.append(node)
+            if is_func:
+                func_depth[0] += 1
+                # a jit-decorated def inside a loop is a fresh cache
+                # per iteration
+                if loop_stack and isinstance(node, _FUNCS) and any(
+                        _decorator_is_trace(d, names)
+                        for d in node.decorator_list):
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"jit-decorated '{node.name}' defined inside a "
+                        "loop: a fresh compile cache per iteration — "
+                        "hoist the jitted function out of the loop"))
+            if isinstance(node, ast.Call):
+                if names.is_jit(node.func) and loop_stack:
+                    findings.append(ctx.finding(
+                        self, node,
+                        "jax.jit called inside a loop body: every "
+                        "iteration builds a new jitted callable with an "
+                        "empty cache (compiles every step); hoist it "
+                        "out of the loop"))
+                # local def / lambda argument to a jitted callable:
+                # fresh identity per call => retrace per call when
+                # marked static (and a leaked-closure hazard otherwise)
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in jitted_names:
+                    for a in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        if isinstance(a, ast.Lambda) or (
+                                isinstance(a, ast.Name) and
+                                a.id in local_funcs):
+                            findings.append(ctx.finding(
+                                self, a,
+                                "function/lambda argument to jitted "
+                                f"callable '{node.func.id}': a new "
+                                "closure identity per call retraces "
+                                "every call — close over it or pass "
+                                "data, not code"))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_loop:
+                loop_stack.pop()
+            if is_func:
+                func_depth[0] -= 1
+
+        visit(ctx.tree)
+        return findings
+
+
+# ================================================================== R3
+@register_rule
+class TraceSideEffectRule(Rule):
+    id = "R3"
+    name = "trace-side-effect"
+    description = ("side effect at trace time: print / global-nonlocal "
+                   "write / closure-list append inside a traced "
+                   "function")
+
+    MUTATORS = {"append", "extend", "add", "insert", "update"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        names = _Names(ctx.tree)
+        for info in traced_functions(ctx, names):
+            if not info.traced:
+                continue
+            local = _params(info.node) | {"self"}
+            for node in _own_body_walk(info.node):
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    tgts = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in tgts:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                local.add(n.id)
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    local |= _names_in(node.target)
+                elif isinstance(node, ast.withitem) and \
+                        node.optional_vars is not None:
+                    local |= _names_in(node.optional_vars)
+                elif isinstance(node, ast.comprehension):
+                    local |= _names_in(node.target)
+            for node in _own_body_walk(info.node):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    kw = ("global" if isinstance(node, ast.Global)
+                          else "nonlocal")
+                    yield ctx.finding(
+                        self, node,
+                        f"{kw} write inside a traced function runs at "
+                        "trace time (once per compile), not per step — "
+                        "thread state through the function instead")
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Name) and f.id == "print":
+                        yield ctx.finding(
+                            self, node,
+                            "print() inside a traced function fires at "
+                            "trace time only (and prints tracers); use "
+                            "jax.debug.print for per-step output")
+                    elif isinstance(f, ast.Attribute) and \
+                            f.attr in self.MUTATORS and \
+                            isinstance(f.value, ast.Name) and \
+                            f.value.id not in local:
+                        yield ctx.finding(
+                            self, node,
+                            f"'{f.value.id}.{f.attr}(...)' mutates a "
+                            "closure/global container inside a traced "
+                            "function: it runs at trace time and leaks "
+                            "tracers into host state — return the "
+                            "value instead")
+
+
+# ================================================================== R4
+@register_rule
+class PRNGReuseRule(Rule):
+    id = "R4"
+    name = "prng-reuse"
+    description = ("a PRNGKey consumed twice without an intervening "
+                   "split()/fold_in(): correlated randomness")
+
+    NON_CONSUMING = {"split", "fold_in", "PRNGKey", "key", "key_data",
+                     "wrap_key_data", "clone", "key_impl"}
+
+    def _consumes(self, node: ast.Call, names: _Names) -> Optional[str]:
+        """The key NAME a jax.random call consumes, else None."""
+        c = names.canon(node.func)
+        if not c or not c.startswith("jax.random."):
+            return None
+        tail = c.rsplit(".", 1)[-1]
+        if tail in self.NON_CONSUMING:
+            return None
+        if node.args and isinstance(node.args[0], ast.Name):
+            return node.args[0].id
+        for kw in node.keywords:
+            if kw.arg == "key" and isinstance(kw.value, ast.Name):
+                return kw.value.id
+        return None
+
+    def _reassigns(self, node: ast.AST) -> Set[str]:
+        """Names (re)bound by this statement."""
+        out: Set[str] = set()
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                out |= {n.id for n in ast.walk(t)
+                        if isinstance(n, ast.Name)}
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            out |= {n.id for n in ast.walk(node.target)
+                    if isinstance(n, ast.Name)}
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            out |= _names_in(node.target)
+        return out
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        names = _Names(ctx.tree)
+        scopes = [i.node for i in _index_functions(ctx.tree)] + [ctx.tree]
+        for scope in scopes:
+            yield from self._check_scope(ctx, names, scope)
+
+    @staticmethod
+    def _expr_parts(st: ast.AST) -> Iterable[ast.AST]:
+        """Nodes of one statement EXCLUDING nested statement bodies
+        (those are recursed with their own branch context) and lambda
+        bodies (their own scope)."""
+        if isinstance(st, (ast.If, ast.While)):
+            roots = [st.test]
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            roots = [st.iter]
+        elif isinstance(st, ast.With):
+            roots = [i.context_expr for i in st.items]
+        elif isinstance(st, ast.Try):
+            roots = []
+        else:
+            roots = [st]
+        for r in roots:
+            stack = [r]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, ast.Lambda):
+                    continue
+                yield n
+                stack.extend(ast.iter_child_nodes(n))
+
+    def _check_scope(self, ctx, names, scope):
+        # (branch-context, node) per consumed name; branch context is
+        # the chain of (If/Try id, arm) so an if/else pair does not
+        # count as sequential reuse
+        last: Dict[str, Tuple[Tuple, ast.AST]] = {}
+        findings: List[Finding] = []
+
+        def prefix_compatible(a: Tuple, b: Tuple) -> bool:
+            n = min(len(a), len(b))
+            return a[:n] == b[:n]
+
+        def handle_stmts(stmts, branch):
+            consumed_here: Set[str] = set()
+            assigned_here: Set[str] = set()
+
+            def absorb(sub):
+                c, a = sub
+                consumed_here.update(c)
+                assigned_here.update(a)
+
+            for st in stmts:
+                if isinstance(st, _FUNCS + (ast.ClassDef,)):
+                    continue        # separate scope
+                for kname in self._reassigns(st):
+                    last.pop(kname, None)
+                    assigned_here.add(kname)
+                for node in self._expr_parts(st):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    kname = self._consumes(node, names)
+                    if kname is None:
+                        continue
+                    prev = last.get(kname)
+                    if prev is not None and \
+                            prefix_compatible(prev[0], branch):
+                        findings.append(ctx.finding(
+                            self, node,
+                            f"PRNGKey '{kname}' already consumed at "
+                            f"line {prev[1].lineno}; reuse draws "
+                            "CORRELATED samples — jax.random.split "
+                            "it first"))
+                    else:
+                        last[kname] = (branch, node)
+                    consumed_here.add(kname)
+                # recurse into compound statements with branch context
+                if isinstance(st, ast.If):
+                    absorb(handle_stmts(st.body, branch + ((id(st), 0),)))
+                    absorb(handle_stmts(st.orelse,
+                                        branch + ((id(st), 1),)))
+                elif isinstance(st, (ast.For, ast.While, ast.AsyncFor)):
+                    c, a = handle_stmts(st.body, branch + ((id(st), 0),))
+                    # loop back edge: a key consumed in the body but
+                    # never re-split inside it is reused every iteration
+                    for kname in c - a:
+                        node = last.get(kname, (None, st))[1]
+                        findings.append(ctx.finding(
+                            self, node,
+                            f"PRNGKey '{kname}' consumed inside a loop "
+                            "without re-splitting in the body: every "
+                            "iteration draws the SAME randomness"))
+                    absorb((c, a))
+                    absorb(handle_stmts(st.orelse, branch))
+                elif isinstance(st, ast.Try):
+                    absorb(handle_stmts(st.body, branch + ((id(st), 0),)))
+                    for h in st.handlers:
+                        absorb(handle_stmts(h.body,
+                                            branch + ((id(st), 1),)))
+                    absorb(handle_stmts(st.orelse + st.finalbody,
+                                        branch))
+                elif isinstance(st, ast.With):
+                    absorb(handle_stmts(st.body, branch))
+            return consumed_here, assigned_here
+
+        body = scope.body if isinstance(scope, _FUNCS + (ast.Module,)) \
+            else []
+        handle_stmts(body, ())
+        return findings
+
+
+# ================================================================== R5
+@register_rule
+class ThreadHygieneRule(Rule):
+    id = "R5"
+    name = "thread-hygiene"
+    description = ("threading.Thread outside the pt-* naming/stop-event "
+                   "convention, or bare Lock.acquire()")
+
+    def _name_ok(self, kw_value: ast.AST) -> bool:
+        """name= must start with 'pt-' when statically known."""
+        if isinstance(kw_value, ast.Constant) and \
+                isinstance(kw_value.value, str):
+            return kw_value.value.startswith("pt-")
+        if isinstance(kw_value, ast.JoinedStr) and kw_value.values:
+            first = kw_value.values[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str):
+                return first.value.startswith("pt-")
+            return True         # leading {THREAD_PREFIX}-style: accept
+        return True             # dynamic expression: accept
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        names = _Names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            c = names.canon(node.func)
+            if c == "threading.Thread":
+                kw = {k.arg: k.value for k in node.keywords}
+                if "name" not in kw:
+                    yield ctx.finding(
+                        self, node,
+                        "unnamed thread: name it 'pt-<subsystem>-...' "
+                        "so the conftest leak fixture and stack dumps "
+                        "can attribute it (reader/pipeline.py "
+                        "convention)")
+                elif not self._name_ok(kw["name"]):
+                    yield ctx.finding(
+                        self, node,
+                        "thread name must start with 'pt-' (the "
+                        "pt-* naming + stop-event convention, "
+                        "reader/pipeline.py)")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "acquire" and \
+                    isinstance(node.func.value, (ast.Name,
+                                                 ast.Attribute)):
+                yield ctx.finding(
+                    self, node,
+                    "bare .acquire(): an exception between acquire and "
+                    "release deadlocks every other thread — use 'with "
+                    "lock:' (or try/finally)")
+
+
+# ================================================================== R6
+@register_rule
+class DtypeWideningRule(Rule):
+    id = "R6"
+    name = "dtype-widening"
+    description = ("np.float64 / dtype=float / un-dtyped float-literal "
+                   "arrays in device-op code: silent widening when x64 "
+                   "is on")
+
+    F64 = {"numpy.float64", "jax.numpy.float64"}
+    ARRAY_CTORS = {"numpy.array", "numpy.asarray", "jax.numpy.array",
+                   "jax.numpy.asarray"}
+
+    def _applies(self, ctx: FileContext) -> bool:
+        paths = self.options.get("paths", ["paddle_tpu/ops"])
+        return any(ctx.path.startswith(p.rstrip("/") + "/") or
+                   ctx.path == p for p in paths)
+
+    @staticmethod
+    def _has_float_literal(node: ast.AST) -> bool:
+        return any(isinstance(n, ast.Constant) and
+                   isinstance(n.value, float)
+                   for n in ast.walk(node))
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not self._applies(ctx):
+            return
+        names = _Names(ctx.tree)
+        f64_attr_ids = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                if names.canon(node) in self.F64 and \
+                        id(node) not in f64_attr_ids:
+                    f64_attr_ids.add(id(node))
+                    yield ctx.finding(
+                        self, node,
+                        "float64 reference in device-op code: with "
+                        "x64 enabled this widens arrays to 2x memory "
+                        "and bandwidth — use float32 (or an explicit "
+                        "accumulator dtype)")
+            elif isinstance(node, ast.Call):
+                c = names.canon(node.func)
+                kw = {k.arg for k in node.keywords}
+                # np.asarray(x, np.float32) passes dtype positionally
+                if c in self.ARRAY_CTORS and "dtype" not in kw and \
+                        len(node.args) == 1 and \
+                        self._has_float_literal(node.args[0]):
+                    yield ctx.finding(
+                        self, node,
+                        f"{c} over Python float literals without "
+                        "dtype=: Python floats default to float64 "
+                        "under x64 — pass an explicit dtype")
+                elif "dtype" in kw:
+                    for k in node.keywords:
+                        if k.arg != "dtype":
+                            continue
+                        if isinstance(k.value, ast.Name) and \
+                                k.value.id == "float":
+                            yield ctx.finding(
+                                self, k.value,
+                                "dtype=float is Python float = "
+                                "float64: name the width explicitly "
+                                "(jnp.float32)")
+                        elif isinstance(k.value, ast.Constant) and \
+                                k.value.value == "float64":
+                            yield ctx.finding(
+                                self, k.value,
+                                "dtype='float64' in device-op code: "
+                                "use float32 (or gate on "
+                                "jax_enable_x64)")

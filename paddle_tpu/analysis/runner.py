@@ -1,0 +1,290 @@
+"""ptlint runner: config, file walking, suppression/baseline filtering,
+and the CLI (`paddle_tpu lint`, tools/ptlint.py).
+
+Configuration lives in pyproject.toml::
+
+    [tool.ptlint]
+    paths = ["paddle_tpu", "tools", "tests"]
+    exclude = ["tests/golden"]
+    rules = ["R1", "R2", "R3", "R4", "R5", "R6"]
+    baseline = "tools/ptlint_baseline.json"
+
+    [tool.ptlint.dtype-widening]
+    paths = ["paddle_tpu/ops"]
+
+Exit codes: 0 clean, 1 new findings (or stale baseline entries),
+2 usage/config error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from paddle_tpu.analysis import baseline as bl
+from paddle_tpu.analysis.core import (Finding, all_rules,
+                                      iter_suppressions, parse_file)
+
+__all__ = ["LintConfig", "load_config", "lint_paths", "format_findings",
+           "main"]
+
+DEFAULT_PATHS = ["paddle_tpu", "tools", "tests"]
+DEFAULT_BASELINE = "tools/ptlint_baseline.json"
+
+
+@dataclass
+class LintConfig:
+    root: str = "."
+    paths: List[str] = field(default_factory=lambda: list(DEFAULT_PATHS))
+    exclude: List[str] = field(default_factory=list)
+    rules: Optional[List[str]] = None      # None = all registered
+    baseline: str = DEFAULT_BASELINE
+    rule_options: Dict[str, dict] = field(default_factory=dict)
+
+
+def _read_toml(path: str) -> dict:
+    try:
+        import tomllib
+        with open(path, "rb") as f:
+            return tomllib.load(f)
+    except ImportError:
+        # 3.10 fallback: a minimal parser good enough for the
+        # [tool.ptlint] shapes above (string/list-of-string values)
+        data: dict = {}
+        section: dict = data
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip() if not \
+                    line.strip().startswith("#") else ""
+                if not line:
+                    continue
+                m = re.match(r"\[([^\]]+)\]$", line)
+                if m:
+                    section = data
+                    for part in m.group(1).split("."):
+                        section = section.setdefault(part.strip(), {})
+                    continue
+                if "=" not in line:
+                    continue
+                key, _, val = line.partition("=")
+                key, val = key.strip().strip('"'), val.strip()
+                if val.startswith("["):
+                    section[key] = re.findall(r'"([^"]*)"', val)
+                elif val.startswith('"'):
+                    section[key] = val.strip('"')
+                elif val in ("true", "false"):
+                    section[key] = val == "true"
+                else:
+                    try:
+                        section[key] = int(val)
+                    except ValueError:
+                        section[key] = val
+        return data
+
+
+def load_config(root: str = ".") -> LintConfig:
+    cfg = LintConfig(root=root)
+    pp = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(pp):
+        return cfg
+    tool = _read_toml(pp).get("tool", {}).get("ptlint", {})
+    if "paths" in tool:
+        cfg.paths = list(tool["paths"])
+    if "exclude" in tool:
+        cfg.exclude = list(tool["exclude"])
+    if "rules" in tool:
+        cfg.rules = list(tool["rules"])
+    if "baseline" in tool:
+        cfg.baseline = tool["baseline"]
+    slug_to_id = {cls.name: rid for rid, cls in all_rules().items()}
+    for key, val in tool.items():
+        if isinstance(val, dict):
+            cfg.rule_options[slug_to_id.get(key, key)] = val
+    return cfg
+
+
+def _iter_py_files(cfg: LintConfig):
+    excl = [e.rstrip("/") for e in cfg.exclude]
+
+    def excluded(rel: str) -> bool:
+        return any(rel == e or rel.startswith(e + "/") for e in excl)
+
+    for p in cfg.paths:
+        ap = os.path.join(cfg.root, p)
+        if os.path.isfile(ap):
+            if not excluded(p):
+                yield ap, p.replace(os.sep, "/")
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, cfg.root).replace(os.sep, "/")
+                if not excluded(rel):
+                    yield full, rel
+
+
+@dataclass
+class LintResult:
+    new: List[Finding] = field(default_factory=list)
+    suppressed: List[Tuple[Finding, str]] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[dict] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)   # unparsable files
+    files: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.stale_baseline and \
+            not self.errors
+
+
+def lint_paths(cfg: LintConfig,
+               use_baseline: bool = True) -> LintResult:
+    registry = all_rules()
+    enabled = cfg.rules if cfg.rules is not None else sorted(registry)
+    unknown = [r for r in enabled if r not in registry]
+    if unknown:
+        raise ValueError(f"unknown rule id(s) {unknown}; "
+                         f"known: {sorted(registry)}")
+    rules = [registry[r](cfg.rule_options.get(r)) for r in enabled]
+
+    res = LintResult()
+    raw: List[Finding] = []
+    for full, rel in _iter_py_files(cfg):
+        res.files += 1
+        ctx = parse_file(full, rel)
+        if ctx is None:
+            res.errors.append(f"{rel}: syntax error — ptlint cannot "
+                              "parse it (neither can the interpreter)")
+            continue
+        file_findings: List[Finding] = []
+        for rule in rules:
+            file_findings.extend(rule.check(ctx))
+        if not file_findings:
+            continue
+        sups = list(iter_suppressions(ctx.text))
+        for f in sorted(file_findings, key=lambda f: (f.line, f.col,
+                                                      f.rule)):
+            sup = next((s for s in sups if s.covers(f)), None)
+            if sup is not None:
+                res.suppressed.append((f, sup.reason))
+            else:
+                raw.append(f)
+
+    if use_baseline and cfg.baseline:
+        entries = bl.load_baseline(os.path.join(cfg.root, cfg.baseline))
+        res.new, res.baselined, res.stale_baseline = \
+            bl.match_baseline(raw, entries)
+    else:
+        res.new = raw
+    return res
+
+
+# ------------------------------------------------------------------ output
+def format_findings(res: LintResult, fmt: str = "text",
+                    verbose: bool = False) -> str:
+    lines: List[str] = []
+    if fmt == "github":
+        # GitHub Actions annotation commands — render as inline PR
+        # warnings on the touched lines
+        for f in res.new:
+            msg = f"{f.rule}[{f.name}] {f.message}".replace("\n", " ")
+            lines.append(f"::error file={f.path},line={f.line},"
+                         f"col={f.col}::{msg}")
+        for e in res.stale_baseline:
+            lines.append(f"::error file={e['path']}::stale ptlint "
+                         f"baseline entry {e['rule']} "
+                         f"('{e['source'][:60]}') — the finding is "
+                         "gone; delete the entry")
+        for err in res.errors:
+            lines.append(f"::error::{err}")
+    elif fmt == "json":
+        lines.append(json.dumps({
+            "files": res.files,
+            "new": [f.__dict__ for f in res.new],
+            "suppressed": [{**f.__dict__, "reason": r}
+                           for f, r in res.suppressed],
+            "baselined": [f.__dict__ for f in res.baselined],
+            "stale_baseline": res.stale_baseline,
+            "errors": res.errors}, indent=2))
+    else:
+        for f in res.new:
+            lines.append(f.format())
+        for e in res.stale_baseline:
+            lines.append(f"{e['path']}: stale baseline entry "
+                         f"{e['rule']} ('{e['source'][:60]}') — "
+                         "finding fixed; delete the entry")
+        for err in res.errors:
+            lines.append(f"ERROR {err}")
+        if verbose:
+            for f, reason in res.suppressed:
+                lines.append(f"suppressed {f.format()}"
+                             f"  [{reason or 'no reason given'}]")
+            for f in res.baselined:
+                lines.append(f"baselined  {f.format()}")
+        lines.append(
+            f"ptlint: {res.files} files, {len(res.new)} new finding(s), "
+            f"{len(res.suppressed)} suppressed, "
+            f"{len(res.baselined)} baselined"
+            + (f", {len(res.stale_baseline)} STALE baseline entr(ies)"
+               if res.stale_baseline else ""))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ptlint",
+        description="JAX-aware static analysis over the paddle_tpu "
+                    "tree (docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: [tool.ptlint] "
+                         "paths in pyproject.toml)")
+    ap.add_argument("--root", default=".",
+                    help="repo root (pyproject.toml + baseline live "
+                         "here)")
+    ap.add_argument("--format", default="text",
+                    choices=["text", "github", "json"])
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings as new")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current "
+                         "findings (keeps existing justifications)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also list suppressed/baselined findings")
+    args = ap.parse_args(argv)
+
+    try:
+        cfg = load_config(args.root)
+        if args.paths:
+            cfg.paths = args.paths
+        if args.rules:
+            cfg.rules = [r.strip() for r in args.rules.split(",")]
+        res = lint_paths(cfg, use_baseline=not args.no_baseline
+                         and not args.write_baseline)
+    except (ValueError, OSError) as e:
+        print(f"ptlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = os.path.join(cfg.root, cfg.baseline)
+        prev = bl.load_baseline(path)
+        n = bl.write_baseline(path, res.new, prev)
+        print(f"ptlint: wrote {n} baseline entr(ies) to {cfg.baseline}"
+              " — fill in every TODO 'why' before committing")
+        return 0
+
+    out = format_findings(res, args.format, verbose=args.verbose)
+    if out:
+        print(out)
+    return 0 if res.ok else 1

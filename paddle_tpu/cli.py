@@ -346,7 +346,8 @@ def _cmd_serve(args) -> int:
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
-    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="pt-serve-http")
     t.start()
     print(json.dumps({"job": "serve", "status": "serving",
                       "host": args.host,
@@ -401,6 +402,25 @@ def _cmd_coordinator(args) -> int:
     server.stop()
     print(json.dumps({"job": "coordinator", "status": "stopped"}))
     return 0
+
+
+def _cmd_lint(args) -> int:
+    """ptlint — JAX-aware static analysis over the tree
+    (docs/static_analysis.md): host syncs in hot paths, jit-in-loop
+    recompilation, trace-time side effects, PRNG reuse, thread
+    hygiene, silent f64 widening. Config in pyproject [tool.ptlint];
+    the tier-1 gate tests/test_lint.py runs the same analysis."""
+    from paddle_tpu.analysis.runner import main as lint_main
+    argv = list(args.lint_args or [])
+    if args.format:
+        argv += ["--format", args.format]
+    if args.write_baseline:
+        argv.append("--write-baseline")
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    if args.verbose:
+        argv.append("--verbose")
+    return lint_main(argv)
 
 
 def _cmd_diagram(args) -> int:
@@ -513,6 +533,20 @@ def main(argv=None) -> int:
 
     sub.add_parser("version", help="print version (paddle version parity)")
 
+    ln = sub.add_parser("lint", help="JAX-aware static analysis "
+                        "(ptlint — docs/static_analysis.md)")
+    ln.add_argument("lint_args", nargs="*",
+                    help="paths to lint (default: [tool.ptlint] paths)")
+    ln.add_argument("--format", default=None,
+                    choices=["text", "github", "json"],
+                    help="github = GitHub Actions annotations for CI")
+    ln.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the grandfathered-findings file")
+    ln.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings too")
+    ln.add_argument("-v", "--verbose", action="store_true",
+                    help="also list suppressed/baselined findings")
+
     co = sub.add_parser("coordinator", help="run the elastic-training "
                         "coordinator daemon (go/cmd/master parity)")
     co.add_argument("--data", nargs="+", required=True,
@@ -533,6 +567,8 @@ def main(argv=None) -> int:
     dg.add_argument("--out", required=True, help="output .dot path")
     args = ap.parse_args(argv)
 
+    if args.command == "lint":
+        return _cmd_lint(args)
     if args.command == "merge":
         return _cmd_merge(args)
     if args.command == "infer":

@@ -133,7 +133,7 @@ class InferenceServer:
             self._started_at = self._clock()
             for i in range(self.num_workers):
                 t = threading.Thread(target=self._worker_loop,
-                                     name=f"serving-worker-{i}",
+                                     name=f"pt-serve-worker-{i}",
                                      daemon=True)
                 t.start()
                 self._threads.append(t)

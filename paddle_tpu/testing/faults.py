@@ -290,7 +290,8 @@ class FaultPlan:
         def run():
             time.sleep(delay_s)
             destroy(handle)
-        t = threading.Thread(target=run, daemon=True)
+        t = threading.Thread(target=run, daemon=True,
+                             name="pt-fault-destroy")
         t.start()
         return t
 

@@ -160,7 +160,8 @@ class CheckpointManager:
             # non-daemon: a clean interpreter exit joins the thread, so a
             # caller that saves and returns cannot silently lose the write
             self._writer = threading.Thread(target=write_guarded,
-                                            daemon=False)
+                                            daemon=False,
+                                            name="pt-ckpt-writer")
             self._writer.start()
         else:
             write()

@@ -369,7 +369,7 @@ class CoordinatorServer:
 
     def start(self):
         self._thread = threading.Thread(target=self.server.serve_forever,
-                                        daemon=True)
+                                        daemon=True, name="pt-coord-rpc")
         self._thread.start()
         return self
 
@@ -483,7 +483,8 @@ class _Heartbeater:
                     return                       # old server: no leases
                 except Exception:
                     pass                         # blip: retry next beat
-        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread = threading.Thread(target=beat, daemon=True,
+                                        name="pt-coord-heartbeat")
         self._thread.start()
 
     def stop(self):

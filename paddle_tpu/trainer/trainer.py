@@ -815,29 +815,50 @@ class SGD:
         discipline (DataProvider.h:249) applied to the feeder itself. On
         slow-memory hosts the numpy pack of an image batch costs as much
         as the device step; overlapping the two restores device-bound
-        throughput. Order and semantics are unchanged."""
+        throughput. Order and semantics are unchanged.
+
+        Lifecycle contract (reader/pipeline.py convention): the fill
+        thread is named ``pt-data-feed`` and exits on a stop event when
+        the consumer abandons the generator (an early ``break`` out of
+        the pass, num_batches_per_pass) instead of wedging forever on a
+        full queue — the conftest thread-leak fixture enforces it."""
         import queue
         import threading
         q: "queue.Queue" = queue.Queue(maxsize=depth)
+        stop = threading.Event()
         DONE = object()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def work():
             try:
                 for item in reader():
-                    q.put((None, feeder(item)))
-                q.put((None, DONE))
+                    if not put((None, feeder(item))):
+                        return
+                put((None, DONE))
             except BaseException as e:      # surfaced in the main thread
-                q.put((e, None))
+                put((e, None))
 
-        t = threading.Thread(target=work, daemon=True)
+        t = threading.Thread(target=work, daemon=True,
+                             name="pt-data-feed")
         t.start()
-        while True:
-            err, feed = q.get()
-            if err is not None:
-                raise err
-            if feed is DONE:
-                return
-            yield feed
+        try:
+            while True:
+                err, feed = q.get()
+                if err is not None:
+                    raise err
+                if feed is DONE:
+                    return
+                yield feed
+        finally:
+            stop.set()
 
     @staticmethod
     def _kahan_add(acc, v):

@@ -39,6 +39,12 @@ def pytest_configure(config):
         "dumps all thread stacks if the test exceeds its timeout, so a "
         "deadlocked serving test prints stacks instead of dying to a "
         "silent `timeout -k` kill")
+    config.addinivalue_line(
+        "markers",
+        "recompile_budget(max_compiles=4): enforce an XLA compile "
+        "budget — the test fails if any single jitted function "
+        "compiles more than max_compiles times while it runs "
+        "(paddle_tpu/analysis/sanitizer.py; docs/static_analysis.md)")
 
 
 @pytest.fixture(autouse=True)
@@ -72,18 +78,21 @@ def pytest_runtest_makereport(item, call):
 @pytest.fixture(autouse=True)
 def _no_pipeline_thread_leaks(request):
     """Fail any test that leaks a data-pipeline thread (buffered /
-    xmap_readers / supervised — all named 'pt-data-*'), so a shutdown
-    regression is caught by CI as a failure instead of as a hang. The
-    grace window lets just-closed generators' threads observe their
-    stop events (they poll every 0.1s)."""
+    xmap_readers / supervised / the trainer's feed prefetcher — all
+    named 'pt-data-*') or a serving worker ('pt-serve-*'), so a
+    shutdown regression is caught by CI as a failure instead of as a
+    hang. The grace window lets just-closed generators' threads observe
+    their stop events (they poll every 0.1s) and drained serving
+    workers observe _stopping (they poll every 0.2s)."""
     import gc
     import threading
     import time
 
     def leaked():
         from paddle_tpu.reader.pipeline import THREAD_PREFIX
+        prefixes = (THREAD_PREFIX, "pt-serve")
         return [t for t in threading.enumerate()
-                if t.is_alive() and t.name.startswith(THREAD_PREFIX)]
+                if t.is_alive() and t.name.startswith(prefixes)]
 
     yield
     rep = getattr(request.node, "rep_call", None)
@@ -96,10 +105,33 @@ def _no_pipeline_thread_leaks(request):
         time.sleep(0.05)
     left = leaked()
     assert not left, (
-        f"test leaked {len(left)} data-pipeline thread(s): "
-        f"{[t.name for t in left]} — a reader was abandoned without "
-        "its fill/worker threads shutting down (reader/pipeline.py "
-        "lifecycle contract)")
+        f"test leaked {len(left)} pipeline/serving thread(s): "
+        f"{[t.name for t in left]} — a reader or InferenceServer was "
+        "abandoned without its fill/worker threads shutting down "
+        "(reader/pipeline.py / serving/server.py lifecycle contract)")
+
+
+@pytest.fixture(autouse=True)
+def _recompile_budget(request):
+    """@pytest.mark.recompile_budget(max_compiles=N): count XLA
+    compilations per jitted function while the test runs and FAIL it
+    (at teardown, only when the test body passed) if any one function
+    compiled more than N times — the runtime twin of ptlint R2
+    (analysis/sanitizer.py). The watch is exposed as
+    ``request.node._compile_watch`` for tests that want the counts."""
+    marker = request.node.get_closest_marker("recompile_budget")
+    if marker is None:
+        yield
+        return
+    from paddle_tpu.analysis.sanitizer import compile_watch
+    budget = int(marker.kwargs.get(
+        "max_compiles", marker.args[0] if marker.args else 4))
+    with compile_watch() as watch:
+        request.node._compile_watch = watch
+        yield
+    rep = getattr(request.node, "rep_call", None)
+    if rep is not None and rep.passed:
+        watch.check(budget)
 
 
 @pytest.fixture(autouse=True)

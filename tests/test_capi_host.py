@@ -253,7 +253,8 @@ class TestConcurrency:
                 for hh in local:
                     ch.destroy(hh)
 
-        threads = [threading.Thread(target=client, args=(t,))
+        threads = [threading.Thread(target=client, args=(t,),
+                                    name=f"pt-test-client-{t}")
                    for t in range(8)]
         # destroy the SOURCE while clones are being created/served
         killer = FaultPlan.destroy_during(ch.destroy, src, delay_s=0.05)

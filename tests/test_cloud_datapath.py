@@ -98,7 +98,9 @@ class TestCloudDataPath:
             except Exception as e:   # surface into the main thread
                 errors.append(e)
 
-        ts = [threading.Thread(target=worker, args=(i,)) for i in (0, 1)]
+        ts = [threading.Thread(target=worker, args=(i,),
+                               name=f"pt-test-trainer-{i}")
+              for i in (0, 1)]
         for t in ts:
             t.start()
         for t in ts:

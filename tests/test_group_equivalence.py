@@ -176,6 +176,7 @@ class TestGroupRemat:
                                        rng=jax.random.PRNGKey(2))
                 return jnp.sum(outs["o"] ** 2)
 
+            # ptlint: disable=R2(two intentionally different graphs — remat off/on — compiled once each)
             val, grads = jax.jit(jax.value_and_grad(loss))(params)
             results.append((float(val),
                             {k: np.asarray(v) for k, v in grads.items()}))

@@ -1,0 +1,96 @@
+"""Tier-1 lint gate: the repo must be ptlint-clean.
+
+Runs the same analysis as `paddle_tpu lint` / tools/ptlint.py over the
+paths configured in pyproject [tool.ptlint] (paddle_tpu/, tools/,
+tests/) and fails on ANY non-baselined finding — so every future PR is
+gated on the six JAX rules (docs/static_analysis.md). Also enforces
+the hygiene of the escape hatches themselves: every inline suppression
+carries a reason and every baseline entry a real justification (no
+TODOs), and stale baseline entries (the finding was fixed) must be
+deleted so they cannot mask a future regression.
+"""
+
+import os
+
+from paddle_tpu.analysis.baseline import load_baseline
+from paddle_tpu.analysis.runner import (format_findings, lint_paths,
+                                        load_config)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _result():
+    # one lint sweep shared by the assertions below (module cache)
+    if not hasattr(_result, "cached"):
+        _result.cached = lint_paths(load_config(ROOT))
+    return _result.cached
+
+
+def test_configured_paths_cover_the_tree():
+    cfg = load_config(ROOT)
+    assert "paddle_tpu" in cfg.paths
+    assert "tools" in cfg.paths
+    assert "tests" in cfg.paths
+    assert cfg.rules == ["R1", "R2", "R3", "R4", "R5", "R6"]
+
+
+def test_repo_is_lint_clean():
+    res = _result()
+    assert res.files > 100, (
+        f"ptlint only saw {res.files} files — the [tool.ptlint] paths "
+        "are misconfigured")
+    assert not res.errors, "\n".join(res.errors)
+    assert not res.new, (
+        f"{len(res.new)} new ptlint finding(s) — fix them, or "
+        "suppress with '# ptlint: disable=RULE(reason)' (see "
+        "docs/static_analysis.md):\n"
+        + "\n".join(f.format() for f in res.new))
+
+
+def test_no_stale_baseline_entries():
+    res = _result()
+    assert not res.stale_baseline, (
+        "baseline entries whose finding no longer exists — delete "
+        "them from tools/ptlint_baseline.json so they cannot mask a "
+        "future regression:\n"
+        + "\n".join(f"{e['rule']} {e['path']}: {e['source'][:70]}"
+                    for e in res.stale_baseline))
+
+
+def test_every_suppression_has_a_reason():
+    res = _result()
+    bare = [f.format() for f, reason in res.suppressed if not reason]
+    assert not bare, (
+        "suppressions without a reason — write "
+        "'# ptlint: disable=RULE(why it is safe)':\n" + "\n".join(bare))
+
+
+def test_every_baseline_entry_is_justified():
+    entries = load_baseline(os.path.join(ROOT,
+                                         "tools/ptlint_baseline.json"))
+    bad = [e for e in entries
+           if not e["why"].strip() or "TODO" in e["why"]]
+    assert not bad, (
+        "baseline entries need a real one-line justification:\n"
+        + "\n".join(f"{e['rule']} {e['path']}: {e['why']!r}"
+                    for e in bad))
+
+
+def test_github_format_renders_annotations(tmp_path):
+    """--format=github output is the GitHub Actions annotation
+    protocol (CI renders findings inline on the PR diff)."""
+    bad = tmp_path / "hot.py"
+    bad.write_text(
+        "import jax\n"
+        "def train(xs):\n"
+        "    for x in xs:\n"
+        "        jax.jit(lambda v: v)(x)\n")
+    cfg = load_config(ROOT)
+    cfg.paths = [str(bad)]
+    cfg.baseline = ""
+    res = lint_paths(cfg, use_baseline=False)
+    assert len(res.new) == 1
+    out = format_findings(res, "github")
+    assert out.startswith("::error file=")
+    assert ",line=4," in out
+    assert "R2[recompile]" in out

@@ -1,0 +1,540 @@
+"""ptlint rule-engine tests: each rule must catch its target pattern
+(positive fixture) and stay quiet on the idiomatic-correct variant
+(negative fixture), plus suppression semantics, baseline semantics,
+config parsing, and the runtime sanitizer (compile budgets + leaked
+tracers — including the induced-recompile-loop proof).
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import rules as R
+from paddle_tpu.analysis.baseline import (load_baseline, match_baseline,
+                                          write_baseline)
+from paddle_tpu.analysis.core import iter_suppressions, parse_file
+from paddle_tpu.analysis.runner import LintConfig, lint_paths
+
+
+def run_rule(rule_cls, src, path="paddle_tpu/mod.py", options=None):
+    ctx = parse_file("<mem>", path, text=textwrap.dedent(src))
+    assert ctx is not None, "fixture snippet does not parse"
+    return list(rule_cls(options).check(ctx))
+
+
+# ================================================================== R1
+class TestHostSync:
+    def test_catches_float_on_traced_param(self):
+        hits = run_rule(R.HostSyncRule, """
+            import jax, jax.numpy as jnp
+            @jax.jit
+            def step(params, x):
+                loss = jnp.sum(x)
+                return float(loss)
+        """)
+        assert len(hits) == 1 and hits[0].rule == "R1"
+        assert "float()" in hits[0].message
+
+    def test_catches_item_and_asarray_and_device_get(self):
+        hits = run_rule(R.HostSyncRule, """
+            import jax
+            import numpy as np
+            @jax.jit
+            def step(x):
+                a = x.item()
+                b = np.asarray(x)
+                c = jax.device_get(x)
+                return a, b, c
+        """)
+        assert sorted(h.line for h in hits) == [6, 7, 8]
+
+    def test_catches_in_function_reached_from_jitted(self):
+        # reachability: helper isn't decorated, but the jitted step
+        # calls it — the sync still happens inside the trace
+        hits = run_rule(R.HostSyncRule, """
+            import jax, jax.numpy as jnp
+
+            def helper(v):
+                return float(v)
+
+            @jax.jit
+            def step(x):
+                return helper(jnp.sum(x))
+        """)
+        assert len(hits) == 1 and hits[0].line == 5
+
+    def test_quiet_on_untraced_function(self):
+        assert not run_rule(R.HostSyncRule, """
+            def host_side(e):
+                return float(e.cost)
+        """)
+
+    def test_quiet_on_static_closure_value(self):
+        # float(L) over a Python int closure is trace-time constant
+        # folding, not a sync
+        assert not run_rule(R.HostSyncRule, """
+            import jax, jax.numpy as jnp
+            def build(L, alpha):
+                def run(p, scores):
+                    return scores / float(L) ** alpha
+                return jax.jit(run)
+        """)
+
+
+# ================================================================== R2
+class TestRecompile:
+    def test_catches_jit_in_loop(self):
+        hits = run_rule(R.RecompileRule, """
+            import jax
+            def train(xs):
+                for x in xs:
+                    f = jax.jit(lambda v: v * 2)
+                    f(x)
+        """)
+        assert len(hits) == 1 and "loop" in hits[0].message
+
+    def test_catches_jit_decorated_def_in_loop(self):
+        hits = run_rule(R.RecompileRule, """
+            import jax
+            def train(xs):
+                while xs:
+                    @jax.jit
+                    def f(v):
+                        return v * 2
+                    f(xs.pop())
+        """)
+        assert hits and "fresh compile cache" in hits[0].message
+
+    def test_catches_lambda_arg_to_jitted_callable(self):
+        hits = run_rule(R.RecompileRule, """
+            import jax
+            g = jax.jit(lambda x, cb: cb(x))
+            def drive(x):
+                return g(x, lambda v: v + 1)
+        """)
+        assert len(hits) == 1 and "closure identity" in hits[0].message
+
+    def test_quiet_on_hoisted_jit(self):
+        assert not run_rule(R.RecompileRule, """
+            import jax
+            step = jax.jit(lambda v: v * 2)
+            def train(xs):
+                for x in xs:
+                    step(x)
+        """)
+
+    def test_quiet_on_jit_built_once_in_function(self):
+        assert not run_rule(R.RecompileRule, """
+            import jax
+            def build(fn):
+                return jax.jit(fn, donate_argnums=(0,))
+        """)
+
+
+# ================================================================== R3
+class TestTraceSideEffect:
+    def test_catches_print_global_and_closure_append(self):
+        hits = run_rule(R.TraceSideEffectRule, """
+            import jax
+            log = []
+            @jax.jit
+            def step(x):
+                global total
+                print("x =", x)
+                log.append(x)
+                return x * 2
+        """)
+        kinds = sorted(h.line for h in hits)
+        assert kinds == [6, 7, 8]
+
+    def test_quiet_on_local_list_append(self):
+        # building a list of layer outputs locally is the normal idiom
+        assert not run_rule(R.TraceSideEffectRule, """
+            import jax
+            @jax.jit
+            def step(x):
+                outs = []
+                for i in range(3):
+                    outs.append(x * i)
+                return outs
+        """)
+
+    def test_quiet_outside_traced_code(self):
+        assert not run_rule(R.TraceSideEffectRule, """
+            log = []
+            def host(e):
+                print(e)
+                log.append(e)
+        """)
+
+
+# ================================================================== R4
+class TestPRNGReuse:
+    def test_catches_sequential_reuse(self):
+        hits = run_rule(R.PRNGReuseRule, """
+            import jax
+            def init(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+        """)
+        assert len(hits) == 1 and "CORRELATED" in hits[0].message
+
+    def test_catches_loop_reuse_without_split(self):
+        hits = run_rule(R.PRNGReuseRule, """
+            import jax
+            def noise(key, n):
+                out = []
+                for _ in range(n):
+                    out.append(jax.random.normal(key, (3,)))
+                return out
+        """)
+        assert hits and "SAME randomness" in hits[0].message
+
+    def test_quiet_with_split_between(self):
+        assert not run_rule(R.PRNGReuseRule, """
+            import jax
+            def init(key):
+                k1, key = jax.random.split(key)
+                a = jax.random.normal(k1, (3,))
+                k2, key = jax.random.split(key)
+                b = jax.random.uniform(k2, (3,))
+                return a + b
+        """)
+
+    def test_quiet_on_either_or_branches(self):
+        # if/else arms are exclusive — one consumption per execution
+        assert not run_rule(R.PRNGReuseRule, """
+            import jax
+            def sample(key, greedy):
+                if greedy:
+                    return jax.random.normal(key, (3,))
+                else:
+                    return jax.random.uniform(key, (3,))
+        """)
+
+    def test_quiet_on_loop_with_split_inside(self):
+        assert not run_rule(R.PRNGReuseRule, """
+            import jax
+            def noise(key, n):
+                out = []
+                for _ in range(n):
+                    sub, key = jax.random.split(key)
+                    out.append(jax.random.normal(sub, (3,)))
+                return out
+        """)
+
+
+# ================================================================== R5
+class TestThreadHygiene:
+    def test_catches_unnamed_and_misnamed_threads(self):
+        hits = run_rule(R.ThreadHygieneRule, """
+            import threading
+            t1 = threading.Thread(target=print)
+            t2 = threading.Thread(target=print, name="worker-0")
+        """)
+        assert len(hits) == 2
+        assert "unnamed" in hits[0].message or "unnamed" in hits[1].message
+
+    def test_catches_bare_acquire(self):
+        hits = run_rule(R.ThreadHygieneRule, """
+            import threading
+            lock = threading.Lock()
+            def f():
+                lock.acquire()
+                try:
+                    pass
+                finally:
+                    lock.release()
+        """)
+        assert len(hits) == 1 and "with lock" in hits[0].message
+
+    def test_quiet_on_convention(self):
+        assert not run_rule(R.ThreadHygieneRule, """
+            import threading
+            PREFIX = "pt-data"
+            t1 = threading.Thread(target=print, name="pt-serve-worker-0")
+            t2 = threading.Thread(target=print, name=f"pt-data-w{3}")
+            t3 = threading.Thread(target=print, name=f"{PREFIX}-src")
+            lock = threading.Lock()
+            def f():
+                with lock:
+                    pass
+        """)
+
+
+# ================================================================== R6
+class TestDtypeWidening:
+    SRC = """
+        import numpy as np
+        import jax.numpy as jnp
+        def op(x):
+            scale = np.asarray([0.5, 1.5])
+            w = jnp.zeros((3,), dtype=np.float64)
+            return x * scale + w
+    """
+
+    def test_catches_in_ops_paths(self):
+        hits = run_rule(R.DtypeWideningRule, self.SRC,
+                        path="paddle_tpu/ops/linear.py")
+        assert len(hits) == 2
+        assert {h.line for h in hits} == {5, 6}
+
+    def test_quiet_outside_ops_paths(self):
+        # host-side evaluator code legitimately accumulates in f64
+        assert not run_rule(R.DtypeWideningRule, self.SRC,
+                            path="paddle_tpu/evaluator/acc.py")
+
+    def test_quiet_with_explicit_narrow_dtype(self):
+        assert not run_rule(R.DtypeWideningRule, """
+            import numpy as np
+            def op(x):
+                return x * np.asarray([0.5, 1.5], np.float32)
+        """, path="paddle_tpu/ops/linear.py")
+
+    def test_path_override_via_options(self):
+        hits = run_rule(R.DtypeWideningRule, self.SRC,
+                        path="custom/kernels/op.py",
+                        options={"paths": ["custom/kernels"]})
+        assert hits
+
+
+# ==================================================== suppressions
+class TestSuppression:
+    def test_inline_and_preceding_line_forms(self):
+        text = textwrap.dedent("""
+            import threading
+            t = threading.Thread(target=print)  # ptlint: disable=R5(short-lived join below)
+            # ptlint: disable=thread-hygiene(slug form, next line)
+            u = threading.Thread(target=print)
+            v = threading.Thread(target=print)
+        """)
+        sups = list(iter_suppressions(text))
+        assert [s.line for s in sups] == [3, 5]
+        assert sups[0].reason == "short-lived join below"
+        ctx = parse_file("<mem>", "paddle_tpu/x.py", text=text)
+        hits = list(R.ThreadHygieneRule().check(ctx))
+        uncovered = [h for h in hits
+                     if not any(s.covers(h) for s in sups)]
+        assert [h.line for h in uncovered] == [6]
+
+    def test_disable_inside_string_is_not_a_suppression(self):
+        text = 's = "# ptlint: disable=R5(not a comment)"\n'
+        assert not list(iter_suppressions(text))
+
+    def test_wrong_rule_does_not_cover(self):
+        text = ("import threading\n"
+                "t = threading.Thread(target=print)"
+                "  # ptlint: disable=R1(wrong rule)\n")
+        sups = list(iter_suppressions(text))
+        ctx = parse_file("<mem>", "paddle_tpu/x.py", text=text)
+        hits = list(R.ThreadHygieneRule().check(ctx))
+        assert hits and not any(s.covers(hits[0]) for s in sups)
+
+
+# ======================================================== baseline
+class TestBaseline:
+    def _finding(self, src="t = threading.Thread(target=print)"):
+        ctx = parse_file("<mem>", "paddle_tpu/x.py",
+                         text=f"import threading\n{src}\n")
+        return list(R.ThreadHygieneRule().check(ctx))[0]
+
+    def test_match_consumes_and_reports_stale(self):
+        f = self._finding()
+        entry = {"rule": f.rule, "path": f.path, "source": f.source,
+                 "count": 2, "why": "legacy"}
+        new, old, stale = match_baseline([f], [entry])
+        assert not new and old == [f]
+        # one of the two budgeted occurrences is unused -> stale
+        assert stale and stale[0]["source"] == f.source
+        new2, old2, stale2 = match_baseline([f, f], [entry])
+        assert not new2 and len(old2) == 2 and not stale2
+
+    def test_unmatched_finding_stays_new(self):
+        f = self._finding()
+        entry = {"rule": "R1", "path": f.path, "source": f.source,
+                 "count": 1, "why": "different rule"}
+        new, old, stale = match_baseline([f], [entry])
+        assert new == [f] and not old and stale
+
+    def test_write_keeps_existing_justifications(self, tmp_path):
+        f = self._finding()
+        p = tmp_path / "baseline.json"
+        write_baseline(str(p), [f], [])
+        entries = load_baseline(str(p))
+        assert entries[0]["why"].startswith("TODO")
+        entries[0]["why"] = "grandfathered: fixed in the next PR"
+        p.write_text(json.dumps({"entries": entries}))
+        write_baseline(str(p), [f, f], load_baseline(str(p)))
+        again = load_baseline(str(p))
+        assert again[0]["count"] == 2
+        assert again[0]["why"] == "grandfathered: fixed in the next PR"
+
+    def test_entry_without_why_is_rejected(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps({"entries": [
+            {"rule": "R5", "path": "x.py", "source": "s"}]}))
+        with pytest.raises(ValueError, match="why"):
+            load_baseline(str(p))
+
+
+# ===================================================== runner/config
+class TestRunnerConfig:
+    def _tree(self, tmp_path, pyproject=True):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "good.py").write_text("import threading\n"
+                                     "t = threading.Thread("
+                                     "target=print, name='pt-x')\n")
+        (pkg / "bad.py").write_text("import threading\n"
+                                    "t = threading.Thread("
+                                    "target=print)\n")
+        if pyproject:
+            (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+                [tool.ptlint]
+                paths = ["pkg"]
+                rules = ["R5"]
+                baseline = "baseline.json"
+
+                [tool.ptlint.dtype-widening]
+                paths = ["pkg/ops"]
+            """))
+        return tmp_path
+
+    def test_config_and_lint_roundtrip(self, tmp_path):
+        from paddle_tpu.analysis.runner import load_config
+        root = self._tree(tmp_path)
+        cfg = load_config(str(root))
+        assert cfg.paths == ["pkg"] and cfg.rules == ["R5"]
+        assert cfg.rule_options.get("R6") == {"paths": ["pkg/ops"]}
+        res = lint_paths(cfg)
+        assert len(res.new) == 1 and res.new[0].path == "pkg/bad.py"
+        assert res.files == 2 and not res.ok
+
+    def test_baseline_round_trip_through_runner(self, tmp_path):
+        from paddle_tpu.analysis.runner import load_config
+        root = self._tree(tmp_path)
+        cfg = load_config(str(root))
+        res = lint_paths(cfg)
+        write_baseline(str(root / "baseline.json"), res.new, [])
+        res2 = lint_paths(load_config(str(root)))
+        assert not res2.new and len(res2.baselined) == 1
+        # fixing the finding makes the baseline entry STALE -> not ok
+        (root / "pkg" / "bad.py").write_text(
+            "import threading\n"
+            "t = threading.Thread(target=print, name='pt-fixed')\n")
+        res3 = lint_paths(load_config(str(root)))
+        assert not res3.new and res3.stale_baseline and not res3.ok
+
+    def test_unknown_rule_id_rejected(self, tmp_path):
+        root = self._tree(tmp_path, pyproject=False)
+        cfg = LintConfig(root=str(root), paths=["pkg"], rules=["R99"],
+                         baseline="")
+        with pytest.raises(ValueError, match="R99"):
+            lint_paths(cfg)
+
+    def test_syntax_error_is_reported_not_fatal(self, tmp_path):
+        root = self._tree(tmp_path, pyproject=False)
+        (root / "pkg" / "broken.py").write_text("def f(:\n")
+        cfg = LintConfig(root=str(root), paths=["pkg"], rules=["R5"],
+                         baseline="")
+        res = lint_paths(cfg)
+        assert any("broken.py" in e for e in res.errors)
+        assert not res.ok
+
+    def test_cli_lint_subcommand(self, tmp_path, capsys):
+        from paddle_tpu import cli
+        root = self._tree(tmp_path)
+        rc = cli.main(["lint", str(root / "pkg"), "--format", "github",
+                       "--no-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "::error file=" in out and "bad.py,line=2" in out
+        assert "R5[thread-hygiene]" in out
+
+    def test_runner_root_flag(self, tmp_path, capsys):
+        from paddle_tpu.analysis.runner import main as lint_main
+        root = self._tree(tmp_path)
+        rc = lint_main(["--root", str(root), "--format", "github"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "::error file=pkg/bad.py,line=2" in out
+
+
+# ====================================================== sanitizer
+class TestSanitizer:
+    def test_fails_on_induced_recompile_loop_passes_after_fix(self):
+        """The acceptance-criteria proof: a jit-in-the-loop recompile
+        storm blows the budget; hoisting the jit (the R2 fix) passes
+        within it."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.analysis.sanitizer import (CompileBudgetExceeded,
+                                                   compile_watch)
+        x = jnp.ones((4,))
+        with pytest.raises(CompileBudgetExceeded, match="retraces"):
+            with compile_watch(max_compiles=3):
+                for _ in range(6):
+                    # the ptlint-R2 anti-pattern, induced on purpose
+                    jax.jit(lambda v: v * 2)(x)  # ptlint: disable=R2(induced recompile loop — the sanitizer test target)
+        # the fix: bind once, reuse the cache
+        with compile_watch(max_compiles=3) as watch:
+            f = jax.jit(lambda v: v * 2)
+            for _ in range(6):
+                f(x)
+        assert watch.count("<lambda>") <= 1
+
+    @pytest.mark.recompile_budget(max_compiles=2)
+    def test_marker_enforces_budget_on_stable_step(self):
+        """recompile_budget-marked: a shape-stable jitted step compiles
+        once; the conftest fixture fails this test if it ever starts
+        retracing."""
+        import jax
+        import jax.numpy as jnp
+        f = jax.jit(lambda v: (v * 2).sum())
+        for _ in range(8):
+            f(jnp.ones((4, 4)))
+
+    def test_watch_counts_per_function(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.analysis.sanitizer import compile_watch
+
+        def alpha(v):
+            return v + 1
+
+        with compile_watch() as watch:
+            f = jax.jit(alpha)
+            f(jnp.ones(3))      # compile 1
+            f(jnp.ones(3))      # cache hit
+            f(jnp.ones(5))      # new shape: compile 2
+        assert watch.count("alpha") == 2
+
+    def test_find_tracers_catches_closure_leak(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.analysis.sanitizer import find_tracers
+        leaked = []
+
+        @jax.jit
+        def step(x):
+            leaked.append(x)  # ptlint: disable=R3(the leak this test exists to catch)
+            return x * 2
+
+        step(jnp.ones(3))
+        hits = find_tracers({"stash": leaked})
+        assert hits and "stash" in hits[0][0]
+        assert not find_tracers({"clean": [1.0, jnp.ones(2)]})
+
+    def test_no_leaked_tracers_raises_at_jit_boundary(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.analysis.sanitizer import no_leaked_tracers
+        leaked = []
+        with pytest.raises(Exception, match="[Ll]eak"):
+            with no_leaked_tracers():
+                jax.jit(
+                    # ptlint: disable=R3(the leak under test)
+                    lambda x: (leaked.append(x), x * 3)[1]
+                )(jnp.ones(3))

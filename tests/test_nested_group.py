@@ -239,6 +239,7 @@ class TestNestedGroupRemat:
                                        rng=jax.random.PRNGKey(6))
                 return jnp.sum(outs["no"] ** 2)
 
+            # ptlint: disable=R2(two intentionally different graphs — remat off/on — compiled once each)
             val, grads = jax.jit(jax.value_and_grad(loss))(params)
             vals.append((float(val),
                          {k: np.asarray(v) for k, v in grads.items()}))

@@ -65,7 +65,8 @@ class TestServerBasics:
         with plan.flaky_forward(inf, delay={i: 0.05 for i in range(8)}):
             reqs = [srv.submit(samples(seed=i)) for i in range(6)]
             t = threading.Thread(target=srv.shutdown,
-                                 kwargs={"drain": True})
+                                 kwargs={"drain": True},
+                                 name="pt-test-drain")
             t.start()
             for r in reqs:                  # all queued work completes
                 assert np.asarray(r.get(timeout=30)).shape == (2, 4)
@@ -271,9 +272,11 @@ class TestMixedChaosAcceptance:
         # fault storm: half the forwards fail, some are slow
         with plan.flaky_forward(inf, fail_rate=0.5,
                                 delay={i: 0.02 for i in range(0, 60, 7)}):
-            threads = ([threading.Thread(target=http_client, args=(t,))
+            threads = ([threading.Thread(target=http_client, args=(t,),
+                                         name=f"pt-test-http-{t}")
                         for t in range(5)] +
-                       [threading.Thread(target=capi_client, args=(t,))
+                       [threading.Thread(target=capi_client, args=(t,),
+                                         name=f"pt-test-capi-{t}")
                         for t in range(3)])
             killer = FaultPlan.destroy_during(ch.destroy, src,
                                               delay_s=0.4)
@@ -315,7 +318,8 @@ class TestHTTPFront:
                               breaker=False).start()
         httpd = build_http_server(srv, "127.0.0.1", 0)
         port = httpd.server_address[1]
-        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                             name="pt-test-httpd")
         t.start()
         try:
             base = f"http://127.0.0.1:{port}"
