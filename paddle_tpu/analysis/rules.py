@@ -22,6 +22,11 @@ R5 thread-hygiene     threading.Thread outside the ``pt-*`` naming +
 R6 dtype-widening     np.float64 literals / dtype=float flowing into
                       device arrays in ops/ — silent 2x memory + ICI
                       traffic when x64 is enabled.
+R7 broad-except-jit   bare ``except Exception`` directly around a
+                      jitted call that never re-raises — tracer bugs
+                      and real device faults (RESOURCE_EXHAUSTED)
+                      are swallowed alike; catch the specific
+                      XLA/fault types or re-raise.
 
 The trace-reachability model is per-file: a function is "traced" when
 it is decorated with / passed to a trace entry point (jax.jit, grad,
@@ -747,3 +752,103 @@ class DtypeWideningRule(Rule):
                                 "dtype='float64' in device-op code: "
                                 "use float32 (or gate on "
                                 "jax_enable_x64)")
+
+
+# ================================================================== R7
+@register_rule
+class BroadExceptJitRule(Rule):
+    id = "R7"
+    name = "broad-except-jit"
+    description = ("bare `except Exception` (or bare `except:`) "
+                   "directly around a jitted call: it absorbs tracer "
+                   "bugs, shape errors and real device faults alike — "
+                   "catch the specific XLA/fault types "
+                   "(is_resource_exhausted, XlaRuntimeError) or "
+                   "re-raise what you don't handle")
+
+    #: attribute-call tails treated as jitted dispatches (the repo's
+    #: compiled-step/forward conventions)
+    JIT_TAILS = {"_train_step", "_train_step_guarded", "_test_step",
+                 "_fwd", "_forward", "forward_batch"}
+    #: calls whose RESULT is a jitted callable: a name assigned from
+    #: one of these is a jitted dispatch when called
+    JIT_PRODUCERS = {"_get_memory_step", "_build_train_step",
+                     "_build_accum_train_step"}
+    BROAD = {"Exception", "BaseException"}
+
+    def _jitted_names(self, ctx: FileContext, names: _Names) -> Set[str]:
+        """Names statically bound to jitted callables: assigned from
+        jax.jit()/pjit(), assigned from a known jit-producer call, or
+        trace-decorated defs (the R2 index, plus producers)."""
+        out: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                func = node.value.func
+                tail = None
+                if isinstance(func, ast.Attribute):
+                    tail = func.attr
+                produced = names.is_jit(func) or tail in self.JIT_PRODUCERS
+                if produced:
+                    for tgt in node.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                out.add(n.id)
+            elif isinstance(node, _FUNCS) and any(
+                    _decorator_is_trace(d, names)
+                    for d in node.decorator_list):
+                out.add(node.name)
+        return out
+
+    def _is_broad(self, handler: ast.ExceptHandler,
+                  names: _Names) -> bool:
+        if handler.type is None:                       # bare except:
+            return True
+        c = names.canon(handler.type)
+        return c is not None and c.rsplit(".", 1)[-1] in self.BROAD
+
+    def _jit_call_in(self, body, jitted: Set[str]) -> Optional[ast.Call]:
+        """First jitted-dispatch call in the statements, excluding
+        nested function bodies (their handlers are their own scope)."""
+        tails = set(self.options.get("jit_tails", [])) | self.JIT_TAILS
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FUNCS + (ast.Lambda,)):
+                continue
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in jitted:
+                    return node
+                if isinstance(f, ast.Attribute) and (
+                        f.attr in tails or f.attr in jitted):
+                    return node
+            stack.extend(ast.iter_child_nodes(node))
+        return None
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        names = _Names(ctx.tree)
+        jitted = self._jitted_names(ctx, names)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            call = self._jit_call_in(node.body, jitted)
+            if call is None:
+                continue
+            for handler in node.handlers:
+                if not self._is_broad(handler, names):
+                    continue
+                # a handler that re-raises (even conditionally) keeps
+                # unrecognized failures fatal — that is the contract
+                if any(isinstance(n, ast.Raise)
+                       for n in ast.walk(handler)):
+                    continue
+                target = ast.unparse(call.func)
+                yield ctx.finding(
+                    self, handler,
+                    f"broad except around jitted call '{target}(...)' "
+                    "never re-raises: tracer bugs, shape mismatches "
+                    "and real device faults are all swallowed alike — "
+                    "catch the specific XLA/fault types "
+                    "(e.g. trainer.memory.is_resource_exhausted) or "
+                    "add a `raise` for unmatched errors")

@@ -219,11 +219,15 @@ def _job_train(trainer, ns, args) -> int:
     if args.fault_max_bad_steps:
         from paddle_tpu.trainer.fault import FaultPolicy
         fault_policy = FaultPolicy(max_bad_steps=args.fault_max_bad_steps)
+    microbatch = args.microbatch
+    if microbatch is not None and microbatch != "auto":
+        microbatch = int(microbatch)
     num_passes = args.num_passes or int(ns.get("num_passes", 1))
     trainer.train(reader, num_passes=num_passes, event_handler=handler,
                   checkpoint_dir=args.checkpoint_dir,
                   checkpoint_period=args.checkpoint_period,
-                  auto_resume=args.auto_resume, fault_policy=fault_policy)
+                  auto_resume=args.auto_resume, fault_policy=fault_policy,
+                  microbatch=microbatch, oom_probe=args.oom_probe)
     if ns.get("test_reader") is not None:
         res = trainer.test(ns["test_reader"])
         print(f"Test: cost={res.cost:.6f} {res.evaluator}")
@@ -321,6 +325,23 @@ def _cmd_infer(args) -> int:
     return 0
 
 
+def _build_server(args, InferenceServer, CircuitBreaker,
+                  build_http_server):
+    """serve-flag wiring, split from the signal loop so tests can
+    assert the flags reach InferenceServer (tests/test_cli.py)."""
+    breaker = CircuitBreaker(window=args.breaker_window,
+                             failure_threshold=args.breaker_threshold,
+                             cooldown=args.breaker_cooldown)
+    server = InferenceServer(
+        args.model, max_queue=args.max_queue, workers=args.workers,
+        default_deadline=(args.deadline_ms / 1e3
+                          if args.deadline_ms else None),
+        max_batch_memory=args.max_batch_memory or None,
+        breaker=breaker).start()
+    httpd = build_http_server(server, args.host, args.port)
+    return server, httpd
+
+
 def _cmd_serve(args) -> int:
     """Serve a merged artifact over HTTP with admission control — the
     hardened twin of the C ABI's multi-threaded serving story
@@ -333,15 +354,8 @@ def _cmd_serve(args) -> int:
     from paddle_tpu.serving import (CircuitBreaker, InferenceServer,
                                     build_http_server)
 
-    breaker = CircuitBreaker(window=args.breaker_window,
-                             failure_threshold=args.breaker_threshold,
-                             cooldown=args.breaker_cooldown)
-    server = InferenceServer(
-        args.model, max_queue=args.max_queue, workers=args.workers,
-        default_deadline=(args.deadline_ms / 1e3
-                          if args.deadline_ms else None),
-        breaker=breaker).start()
-    httpd = build_http_server(server, args.host, args.port)
+    server, httpd = _build_server(args, InferenceServer, CircuitBreaker,
+                                  build_http_server)
 
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
@@ -480,6 +494,19 @@ def main(argv=None) -> int:
     tr.add_argument("--data_max_bad", type=int, default=0,
                     help="error budget: tolerate N quarantined bad "
                          "batches before emitting a data FaultEvent")
+    tr.add_argument("--microbatch", default=None,
+                    help="adaptive microbatching (docs/robustness.md "
+                         "'Memory pressure'): 'auto' starts full-batch "
+                         "and bisects into gradient-accumulated "
+                         "microbatches when a step hits XLA "
+                         "RESOURCE_EXHAUSTED (numerically equivalent, "
+                         "no samples lost); an integer fixes the "
+                         "starting microbatch rows")
+    tr.add_argument("--oom_probe", action="store_true",
+                    help="with --microbatch: binary-search the largest "
+                         "safe microbatch on the first batch (against "
+                         "state copies) before training, instead of "
+                         "discovering it by failing mid-pass")
     tr.add_argument("--data_on_bad", default="log",
                     choices=["log", "raise"],
                     help="past --data_max_bad: keep skipping (log) or "
@@ -524,6 +551,13 @@ def main(argv=None) -> int:
                          "with retry-after instead of buffering")
     sv.add_argument("--deadline_ms", type=float, default=0,
                     help="default per-request deadline (0: none)")
+    sv.add_argument("--max_batch_memory", type=int, default=0,
+                    help="admission budget in bytes for one request's "
+                         "estimated device footprint (0: none). "
+                         "Independently, a forward that hits XLA "
+                         "RESOURCE_EXHAUSTED sheds with retry-after "
+                         "and halves the adaptive max-batch-rows "
+                         "limit (docs/robustness.md 'Memory pressure')")
     sv.add_argument("--breaker_window", type=int, default=64,
                     help="circuit-breaker sliding window size")
     sv.add_argument("--breaker_threshold", type=float, default=0.5,

@@ -33,25 +33,61 @@ Two surfaces:
 
 from __future__ import annotations
 
+import warnings
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.utils.stats import global_counters
 
-def average_pytree(tree):
+
+def tree_isfinite(tree) -> bool:
+    """True when every float leaf of the pytree is finite — the PR 1
+    guarded-step check applied to a whole parameter tree (one fused
+    device reduction, one host sync)."""
+    ok = jnp.ones((), jnp.bool_)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        leaf = jnp.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return bool(ok)
+
+
+def average_pytree(tree, valid: Optional[bool] = None):
     """Average a pytree of arrays across all jax processes.
 
     Every process must call this with the same structure (a collective).
-    Single-process: returns the tree unchanged."""
+    Single-process: returns the tree unchanged.
+
+    valid: this process's vote on whether its OWN tree may enter the
+    average (the reconcile isfinite guard). Invalid islands are
+    weighted out — every process still participates in the collective
+    (it must: allgather is a barrier) but a poisoned island's
+    NaN/Inf tree is multiplied by zero instead of contaminating every
+    peer. If every island votes invalid, the trees pass through
+    unchanged (nothing sane to average towards)."""
     if jax.process_count() == 1:
         return tree
     from jax.experimental import multihost_utils
 
+    if valid is None:
+        w = jnp.ones((), jnp.float32)
+    else:
+        w = jnp.asarray(1.0 if valid else 0.0, jnp.float32)
+    weights = multihost_utils.process_allgather(w)     # [P]
+    n_valid = jnp.sum(weights)
+    if float(n_valid) == 0.0:
+        return tree
+
     def avg(x):
-        g = multihost_utils.process_allgather(x)   # [P, ...]
-        return jnp.mean(g, axis=0).astype(x.dtype)
+        g = multihost_utils.process_allgather(x)       # [P, ...]
+        wshape = (-1,) + (1,) * (g.ndim - 1)
+        zero_naned = jnp.where(
+            jnp.isfinite(g), g, jnp.zeros_like(g))
+        return (jnp.sum(zero_naned * weights.reshape(wshape), axis=0)
+                / n_valid).astype(x.dtype)
 
     return jax.tree_util.tree_map(avg, tree)
 
@@ -98,12 +134,51 @@ class AsyncSGDIsland:
         return loss, metrics
 
     def reconcile(self):
-        """Average parameters across the island group now."""
+        """Average parameters across the island group now.
+
+        Guarded (the PR 1 isfinite discipline applied to reconcile): an
+        island whose parameters went NaN/Inf — a poisoned batch that
+        slipped through, an overflowed optimizer slot — is DROPPED from
+        the average (logged + ``parallel/poisoned_islands`` counter in
+        utils/stats) instead of contaminating every peer; the poisoned
+        island itself is healed by adopting the clean islands' average.
+        If every island is poisoned, reconcile is a no-op (nothing sane
+        to average towards) and the caller's FaultPolicy rollback is the
+        remaining recovery path."""
         if self.sync_group is None:
+            own = self.trainer.parameters.raw
+            ok = tree_isfinite(own)
+            if not ok:
+                global_counters.bump("parallel/poisoned_islands")
+                warnings.warn(
+                    "this island's parameters are non-finite at "
+                    "reconcile; its tree is dropped from the average "
+                    "and replaced by the healthy islands'",
+                    stacklevel=2)
             self.trainer.parameters.replace(
-                average_pytree(self.trainer.parameters.raw))
+                average_pytree(own, valid=ok))
         else:
             raws = [p.raw for p in self.sync_group]
-            averaged = average_local(raws)
-            for p, a in zip(self.sync_group, averaged):
-                p.replace(a)
+            finite = [tree_isfinite(r) for r in raws]
+            bad = [i for i, f in enumerate(finite) if not f]
+            if bad:
+                global_counters.bump("parallel/poisoned_islands",
+                                     len(bad))
+                warnings.warn(
+                    f"island(s) {bad} have non-finite parameters at "
+                    "reconcile; dropping their trees from the average "
+                    f"({len(raws) - len(bad)} healthy island(s) "
+                    "remain)", stacklevel=2)
+            good = [r for r, f in zip(raws, finite) if f]
+            if not good:
+                warnings.warn(
+                    "every island's parameters are non-finite; "
+                    "skipping reconcile (rollback/fault policy is the "
+                    "remaining recovery)", stacklevel=2)
+                return
+            averaged = average_local(good)
+            # every island (poisoned ones included) adopts the healthy
+            # average — the drop is from the INPUT, not the delivery
+            clean = averaged[0]
+            for p in self.sync_group:
+                p.replace({k: v.copy() for k, v in clean.items()})

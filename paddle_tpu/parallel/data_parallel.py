@@ -67,3 +67,27 @@ def shard_train_step(step_fn: Callable, mesh: Mesh,
 def shard_feed(feed, mesh: Mesh):
     """Place a host feed onto the mesh dp-sharded (device_put)."""
     return jax.device_put(feed, _feed_shardings(feed, mesh))
+
+
+def microbatch_shardings(feed_m, mesh: Mesh):
+    """Shardings for a ``(k, mb, ...)`` microbatched feed (the
+    gradient-accumulation step, trainer/memory.py): the accumulation
+    axis is a TIME axis — replicated, every device scans all k ticks —
+    while the per-microbatch ROW axis keeps the dp split, so each
+    device accumulates over its own rows and the gradient all-reduce
+    still happens once on the summed grads, not per microbatch."""
+    spec = P(None, DP_AXIS) if DP_AXIS in mesh.shape else P()
+
+    def leaf(x):
+        return NamedSharding(mesh, spec)
+    return jax.tree_util.tree_map(leaf, feed_m)
+
+
+def shard_microbatched_feed(feed_m, mesh: Mesh):
+    """Constrain a reshaped ``(k, mb, ...)`` feed inside the jitted
+    accumulation step. The reshape alone would let sharding propagation
+    split the leading k axis over dp — handing each device a fraction
+    of the accumulation STEPS instead of a fraction of the rows, which
+    serializes the scan across devices."""
+    return jax.lax.with_sharding_constraint(
+        feed_m, microbatch_shardings(feed_m, mesh))

@@ -14,6 +14,15 @@ meant dead clients. This wraps ``load_inference_model`` with:
 - a sliding-window failure-rate CIRCUIT BREAKER (serving/breaker.py)
   that sheds load while the model is sick and half-opens on a cooldown
   (``Rejected``, reason ``breaker_open``);
+- memory-pressure shedding (docs/robustness.md "Memory pressure"): a
+  forward that dies with XLA ``RESOURCE_EXHAUSTED`` is a CAPACITY
+  fault, not a model fault — the request is shed with ``Rejected``
+  (reason ``resource_exhausted``, retry-after hint), the adaptive
+  max-batch-rows limit halves so the next oversized request is
+  rejected at ADMISSION instead of wasting a device dispatch, and the
+  circuit breaker is NOT fed (the model isn't poisoned — the batch was
+  too big). ``max_batch_memory`` adds a static admission budget: the
+  request's estimated device bytes must fit it;
 - graceful DRAIN on shutdown: no new admissions, queued work completes;
 - ``health()`` / ``stats()`` snapshots — queue depth, p50/p99 latency,
   served/rejected/expired/failed counters — with every forward timed
@@ -34,7 +43,20 @@ from typing import Callable, List, Optional, Union
 import numpy as np
 
 from paddle_tpu.serving.breaker import CircuitBreaker
-from paddle_tpu.utils.stats import stat_timer
+from paddle_tpu.utils.stats import global_counters, stat_timer
+
+
+def _estimate_nbytes(samples) -> int:
+    """Rough device footprint of a request: the summed nbytes of its
+    sample fields (activation memory scales with it). Estimation only —
+    the authoritative signal stays the allocator's RESOURCE_EXHAUSTED."""
+    total = 0
+    for sample in samples:
+        fields = sample if isinstance(sample, (tuple, list)) else (sample,)
+        for f in fields:
+            arr = np.asarray(f)
+            total += arr.nbytes if arr.dtype != object else 8 * arr.size
+    return total
 
 
 class ServingError(RuntimeError):
@@ -99,6 +121,7 @@ class InferenceServer:
                  default_deadline: Optional[float] = None,
                  breaker: Union[CircuitBreaker, None, bool] = None,
                  latency_window: int = 256,
+                 max_batch_memory: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic):
         if isinstance(model, (str, bytes)):
             from paddle_tpu.trainer.inference import load_inference_model
@@ -110,6 +133,14 @@ class InferenceServer:
         if breaker is None:
             breaker = CircuitBreaker()
         self.breaker: Optional[CircuitBreaker] = breaker or None
+        # memory-pressure admission (docs/robustness.md "Memory
+        # pressure"): a static bytes budget per request, plus an
+        # adaptive row limit that HALVES each time a forward dies with
+        # RESOURCE_EXHAUSTED — oversized requests then shed at
+        # admission instead of wasting a device dispatch
+        self.max_batch_memory = (int(max_batch_memory)
+                                 if max_batch_memory else None)
+        self._batch_limit: Optional[int] = None
         self._clock = clock
         self._cv = threading.Condition()
         self._queue: deque = deque()
@@ -120,7 +151,8 @@ class InferenceServer:
         self._latencies: deque = deque(maxlen=int(latency_window))
         self._started_at = None
         self._counters = {"served": 0, "rejected_full": 0,
-                          "rejected_breaker": 0, "expired": 0,
+                          "rejected_breaker": 0, "rejected_oom": 0,
+                          "oom_events": 0, "expired": 0,
                           "failed": 0, "closed": 0}
 
     # ------------------------------------------------------------ lifecycle
@@ -173,6 +205,28 @@ class InferenceServer:
         with self._cv:
             if not self._accepting:
                 raise ServerClosed("server is draining or stopped")
+            rows = len(samples) if hasattr(samples, "__len__") else None
+            if rows is not None:
+                if self._batch_limit is not None and \
+                        rows > self._batch_limit:
+                    self._counters["rejected_oom"] += 1
+                    raise Rejected(
+                        f"batch of {rows} rows exceeds the adaptive "
+                        f"limit of {self._batch_limit} (a previous "
+                        "forward hit RESOURCE_EXHAUSTED at that size); "
+                        "split the request",
+                        retry_after=self._retry_hint(),
+                        reason="resource_exhausted")
+                if self.max_batch_memory is not None:
+                    est = _estimate_nbytes(samples)
+                    if est > self.max_batch_memory:
+                        self._counters["rejected_oom"] += 1
+                        raise Rejected(
+                            f"request estimated at {est} bytes exceeds "
+                            f"max_batch_memory={self.max_batch_memory}; "
+                            "split the request",
+                            retry_after=self._retry_hint(),
+                            reason="resource_exhausted")
             if self.breaker is not None:
                 ok, retry = self.breaker.allow()
                 if not ok:
@@ -246,6 +300,28 @@ class InferenceServer:
             with stat_timer("serving/forward"):
                 result = self._forward(req.samples)
         except Exception as e:
+            from paddle_tpu.trainer.memory import is_resource_exhausted
+            if is_resource_exhausted(e):
+                # capacity fault, not a model fault: shed with a retry
+                # hint, shrink the admission limit so the next oversized
+                # request never reaches the device, and do NOT feed the
+                # breaker (the model isn't poisoned — the batch was too
+                # big for device memory)
+                rows = len(req.samples) \
+                    if hasattr(req.samples, "__len__") else 2
+                with self._cv:
+                    self._counters["oom_events"] += 1
+                    cap = max(1, rows // 2)
+                    self._batch_limit = cap if self._batch_limit is None \
+                        else min(self._batch_limit, cap)
+                    retry = self._retry_hint()
+                global_counters.bump("serving/oom_events")
+                self._settle(req, error=Rejected(
+                    f"forward hit RESOURCE_EXHAUSTED on {rows} rows; "
+                    f"max batch shrunk to {cap} — split the request "
+                    f"and retry in {retry:.2f}s",
+                    retry_after=retry, reason="resource_exhausted"))
+                return
             with self._cv:
                 self._counters["failed"] += 1
             if self.breaker is not None:
@@ -315,6 +391,7 @@ class InferenceServer:
         out.update({
             "queue_depth": depth,
             "inflight": inflight,
+            "batch_limit": self._batch_limit,
             "p50_ms": round(self._percentile(lats, 0.50) * 1e3, 3),
             "p99_ms": round(self._percentile(lats, 0.99) * 1e3, 3),
             "uptime_s": round(uptime, 3),

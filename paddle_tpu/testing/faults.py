@@ -16,6 +16,16 @@ can
   (d) SIGKILL a subprocess trainer when its stdout reaches a chosen
       step marker;
 
+and, for memory pressure (docs/robustness.md "Memory pressure"):
+
+  (i) raise a realistic ``XlaRuntimeError: RESOURCE_EXHAUSTED`` from the
+      jitted train step at a chosen optimizer step, ``n`` consecutive
+      attempts (``oom_at`` — drives the adaptive microbatcher's bisect +
+      re-run path), or model a device with a FIXED row capacity so every
+      dispatch above it fails (``memory_pressure`` — the
+      allocation-pressure mode that drives ``plan_memory()``'s binary
+      search and runtime adaptation deterministically);
+
 and, for the serving path (docs/robustness.md "Serving"):
 
   (e) make chosen forward calls SLOW, FAIL, or HANG on an event
@@ -214,6 +224,69 @@ class FaultPlan:
                         for sample in batch]
                 yield batch
         return poisoned
+
+    # --------------------------------------------- (i) memory pressure
+    @staticmethod
+    @contextlib.contextmanager
+    def oom_at(trainer, step: int, n: int = 1, nbytes: int = 2 << 30):
+        """Within the context, the trainer's jitted train step raises a
+        realistic ``XlaRuntimeError: RESOURCE_EXHAUSTED`` on its first
+        ``n`` dispatch attempts of optimizer step ``step`` (0-based,
+        ``trainer._step_count`` at dispatch time) — the adaptive
+        microbatcher must bisect ``n`` times and then complete the SAME
+        batch with zero lost samples (trainer/memory.py). Yields a stats
+        dict (``injected``). Uses the trainer's ``_step_interceptor``
+        seam, so the exception comes from exactly where a real device
+        allocator failure would: the step dispatch."""
+        from paddle_tpu.trainer.memory import resource_exhausted_error
+        stats = {"injected": 0}
+        remaining = [int(n)]
+        prev = trainer._step_interceptor
+
+        def intercept(k, mb):
+            if prev is not None:
+                prev(k, mb)
+            if trainer._step_count == step and remaining[0] > 0:
+                remaining[0] -= 1
+                stats["injected"] += 1
+                raise resource_exhausted_error(
+                    nbytes, where=f"oom_at(step={step})")
+
+        trainer._step_interceptor = intercept
+        try:
+            yield stats
+        finally:
+            trainer._step_interceptor = prev
+
+    @staticmethod
+    @contextlib.contextmanager
+    def memory_pressure(trainer, max_rows: int, nbytes: int = 2 << 30):
+        """Model a device whose memory fits at most ``max_rows``
+        microbatch rows: within the context, EVERY dispatch (train step
+        or warmup-probe trial) whose per-microbatch row count exceeds
+        ``max_rows`` raises ``RESOURCE_EXHAUSTED``. Deterministic
+        allocation pressure — ``plan_memory()``'s binary search and the
+        runtime bisect must both converge to a microbatch <= max_rows.
+        Yields a stats dict (``injected``)."""
+        from paddle_tpu.trainer.memory import resource_exhausted_error
+        stats = {"injected": 0}
+        prev = trainer._step_interceptor
+
+        def intercept(k, mb):
+            if prev is not None:
+                prev(k, mb)
+            if mb > max_rows:
+                stats["injected"] += 1
+                raise resource_exhausted_error(
+                    nbytes,
+                    where=f"memory_pressure(max_rows={max_rows}), "
+                          f"microbatch={mb}")
+
+        trainer._step_interceptor = intercept
+        try:
+            yield stats
+        finally:
+            trainer._step_interceptor = prev
 
     # ------------------------------------------- (e) serving: forward
     @contextlib.contextmanager

@@ -216,6 +216,24 @@ class CheckpointManager:
         except (OSError, KeyError, json.JSONDecodeError):
             return False
 
+    def peek_meta(self, step: Optional[int] = None
+                  ) -> Optional[Dict[str, Any]]:
+        """The user meta dict of the newest intact (or given)
+        checkpoint WITHOUT loading the state payload — resume planning
+        reads the memory plan (trainer/memory.py) and tests inspect
+        counters this way without paying the full npz load."""
+        self.wait()
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        path = os.path.join(self.dir, f"ckpt-{step:010d}", "meta.json")
+        try:
+            with open(path) as f:
+                return json.load(f).get("meta", {})
+        except (OSError, json.JSONDecodeError):
+            return None
+
     def restore(self, step: Optional[int] = None
                 ) -> Optional[Tuple[int, Dict[str, Any]]]:
         """Returns (step, {params, opt_state, state, meta}) or None.

@@ -65,7 +65,18 @@ class InMemStore(KVStore):
 
 
 class FileStore(KVStore):
-    """Durable snapshot store on a shared filesystem (atomic rename)."""
+    """Durable snapshot store on a shared filesystem.
+
+    Writes are ATOMIC (tmp + ``os.replace``, the recordio/checkpoint
+    protocol — a crash mid-write never leaves a torn value at the final
+    path, and a failed write removes its tmp) and FRAMED (magic + crc32
+    + length header), so :meth:`get` detects a torn or bit-rotted value
+    and returns ``None`` with a warning instead of handing garbage to
+    the recovery path — a corrupt snapshot must degrade to a fresh
+    partition, not kill the coordinator. Unframed files (an older
+    writer, hand-dropped content) pass through verbatim."""
+
+    _MAGIC = b"PTKV1\n"
 
     def __init__(self, root: str):
         self.root = root
@@ -75,17 +86,57 @@ class FileStore(KVStore):
         return os.path.join(self.root, key.replace("/", "_"))
 
     def put(self, key, value):
+        import zlib
         tmp = self._path(key) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(value)
+        try:
+            with open(tmp, "wb") as f:
+                f.write(self._MAGIC)
+                f.write((zlib.crc32(value) & 0xFFFFFFFF)
+                        .to_bytes(4, "little"))
+                f.write(len(value).to_bytes(8, "little"))
+                f.write(value)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
         os.replace(tmp, self._path(key))
 
     def get(self, key):
+        import warnings
+        import zlib
         try:
             with open(self._path(key), "rb") as f:
-                return f.read()
+                blob = f.read()
         except FileNotFoundError:
             return None
+        except OSError as e:
+            warnings.warn(
+                f"FileStore: could not read {key!r} ({e}); treating as "
+                "absent", stacklevel=2)
+            return None
+        if not blob.startswith(self._MAGIC):
+            return blob          # legacy/unframed value: pass through
+        hdr_end = len(self._MAGIC) + 12
+        if len(blob) < hdr_end:
+            warnings.warn(
+                f"FileStore: {key!r} is torn (truncated header); "
+                "treating as absent", stacklevel=2)
+            return None
+        crc = int.from_bytes(blob[len(self._MAGIC):len(self._MAGIC) + 4],
+                             "little")
+        size = int.from_bytes(blob[len(self._MAGIC) + 4:hdr_end],
+                              "little")
+        value = blob[hdr_end:]
+        if len(value) != size or (zlib.crc32(value) & 0xFFFFFFFF) != crc:
+            warnings.warn(
+                f"FileStore: {key!r} is torn or corrupt "
+                f"({len(value)} of {size} bytes, crc "
+                f"{'ok' if len(value) == size else 'n/a'}); treating "
+                "as absent", stacklevel=2)
+            return None
+        return value
 
 
 _SNAPSHOT_KEY = "coordinator/state"
@@ -289,11 +340,23 @@ class Coordinator:
         self.store.put(_SNAPSHOT_KEY, json.dumps(state).encode())
 
     def _recover(self) -> bool:
-        """service.go:166 — restore queues from the store if present."""
+        """service.go:166 — restore queues from the store if present.
+        A torn/corrupt snapshot (unframed legacy file truncated
+        mid-JSON) degrades to a fresh partition with a warning — the
+        coordinator re-serves the constructor's chunk list instead of
+        dying on its own recovery data."""
         blob = self.store.get(_SNAPSHOT_KEY)
         if not blob:
             return False
-        state = json.loads(blob.decode())
+        try:
+            state = json.loads(blob.decode())
+            state["epoch"], state["todo"], state["chunks"]
+        except (ValueError, UnicodeDecodeError, KeyError, TypeError) as e:
+            import warnings
+            warnings.warn(
+                f"coordinator snapshot is torn or corrupt ({e!r}); "
+                "starting from a fresh partition", stacklevel=2)
+            return False
         self._epoch = state["epoch"]
         self._next_id = state["next_id"]
         mk = lambda d: Task(**d)

@@ -118,6 +118,31 @@ class FaultEvent:
                 f"restored_step={self.restored_step})")
 
 
+class OOMEvent(FaultEvent):
+    """Device memory exhaustion absorbed by the adaptive microbatcher
+    (trainer/memory.py — docs/robustness.md "Memory pressure"). A
+    FaultEvent subclass with ``kind="oom"``, so handlers watching
+    numeric/data faults see memory faults through the same stream.
+
+    The OOM'd step was re-run split into ``accum_steps`` microbatches
+    of ``microbatch`` rows (numerically equivalent to the full-batch
+    step): zero samples lost, zero updates skipped. ``error`` is the
+    caught RESOURCE_EXHAUSTED exception. Handlers may raise to abort
+    instead of adapting."""
+
+    def __init__(self, pass_id: int, batch_id: int, microbatch: int,
+                 accum_steps: int, error=None):
+        super().__init__(pass_id, batch_id, "oom", 0, None)
+        self.microbatch = microbatch
+        self.accum_steps = accum_steps
+        self.error = error
+
+    def __repr__(self):
+        return (f"OOMEvent(pass={self.pass_id}, batch={self.batch_id}, "
+                f"microbatch={self.microbatch}, "
+                f"accum_steps={self.accum_steps})")
+
+
 class DataFaultEvent(FaultEvent):
     """A data-pipeline fault (reader/pipeline.py — docs/robustness.md
     "Data pipeline"). A FaultEvent subclass so handlers that catch

@@ -35,8 +35,13 @@ The DATA-path twin of this policy is :class:`ErrorBudget`
 (paddle_tpu/reader/pipeline.py, re-exported here): where FaultPolicy
 budgets non-finite *steps*, ErrorBudget budgets bad *samples* —
 quarantined and counted instead of killing the epoch, with a
-DataFaultEvent once the budget is blown. Both feed the same event
-stream, so one handler sees numeric and data faults alike.
+DataFaultEvent once the budget is blown. The MEMORY twin is
+:class:`MemoryPlan` / the adaptive microbatcher
+(paddle_tpu/trainer/memory.py, re-exported here): an XLA
+``RESOURCE_EXHAUSTED`` step bisects into gradient-accumulated
+microbatches and re-runs, emitting an ``OOMEvent`` (kind="oom"). All
+three feed the same event stream, so one handler sees numeric, data
+and memory faults alike.
 """
 
 from __future__ import annotations
@@ -44,14 +49,19 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-__all__ = ["FaultPolicy", "ErrorBudget", "ErrorBudgetExceeded"]
+__all__ = ["FaultPolicy", "ErrorBudget", "ErrorBudgetExceeded",
+           "MemoryPlan", "plan_memory", "is_resource_exhausted"]
 
 
 def __getattr__(name):
-    # lazy: reader.pipeline must not load (nor cycle) at trainer import
+    # lazy: reader.pipeline / trainer.memory must not load (nor cycle)
+    # at trainer import
     if name in ("ErrorBudget", "ErrorBudgetExceeded"):
         from paddle_tpu.reader import pipeline
         return getattr(pipeline, name)
+    if name in ("MemoryPlan", "plan_memory", "is_resource_exhausted"):
+        from paddle_tpu.trainer import memory
+        return getattr(memory, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -1,0 +1,331 @@
+"""Memory-pressure resilience: MemoryPlan + the adaptive microbatcher.
+
+The 2017 stack survived oversized workloads by hand: you guessed a
+batch size, the trainer OOM'd, you guessed again (Flags.cpp knobs and
+folklore). On TPU the failure is an ``XlaRuntimeError`` whose message
+starts with ``RESOURCE_EXHAUSTED`` — and today it kills the process and
+loses the pass. This module makes device-memory exhaustion a
+RECOVERABLE fault, the same promotion trainer/fault.py gave non-finite
+steps:
+
+  - :class:`MemoryPlan` — how a batch is executed: per-device
+    microbatch size, gradient-accumulation step count, and provenance
+    (who decided: a probe, a runtime OOM, a config, a checkpoint).
+  - :class:`AdaptiveMicrobatcher` — the adaptive executor wrapped
+    around the jitted train step by ``SGD.train(microbatch=...)``. It
+    catches ``RESOURCE_EXHAUSTED``, bisects the batch into microbatches
+    with on-device gradient accumulation (numerically equivalent to the
+    full-batch step — mean-of-grads over the real rows; proven at
+    k=1,2,4 by tests/test_oom.py), re-runs the FAILED batch so no
+    sample is lost and no update skipped, and emits
+    ``event.OOMEvent`` (kind="oom") through the existing fault-event
+    stream.
+  - :func:`plan_memory` — optional warmup probe: binary-search the
+    largest safe microbatch BEFORE the pass starts, on copies of the
+    training state (nothing mutated, no data consumed).
+
+The discovered plan rides in checkpoint meta (``memory_plan``), so an
+auto-resumed run restarts at the known-safe microbatch instead of
+re-probing (tests/test_oom.py SIGKILLs a worker to prove it). The
+serving-side twin of this discipline lives in serving/server.py: an
+OOM'd forward sheds with ``Rejected(reason="resource_exhausted")`` and
+shrinks the max in-flight batch instead of tripping the circuit
+breaker. See docs/robustness.md "Memory pressure".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.trainer import event as evt
+from paddle_tpu.utils.stats import global_counters
+
+__all__ = ["MemoryPlan", "AdaptiveMicrobatcher", "plan_memory",
+           "is_resource_exhausted", "resource_exhausted_error"]
+
+#: substrings that identify an XLA allocation failure across backends
+#: (TPU/GPU emit "RESOURCE_EXHAUSTED: ...", some CPU paths say
+#: "Out of memory" without the status prefix)
+_OOM_TOKENS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """True when ``exc`` is a device allocation failure — the ONE
+    failure the adaptive executor may absorb. Everything else re-raises
+    (ptlint R7 polices the inverse: no blanket ``except Exception``
+    around jitted calls)."""
+    if not isinstance(exc, (RuntimeError, MemoryError)):
+        return False
+    msg = str(exc)
+    return any(tok in msg for tok in _OOM_TOKENS)
+
+
+def resource_exhausted_error(nbytes: int = 2 << 30,
+                             where: str = "") -> Exception:
+    """A realistic ``XlaRuntimeError: RESOURCE_EXHAUSTED`` for the
+    fault-injection harness (testing/faults.py oom_at /
+    memory_pressure) — the same type and message shape a real TPU
+    allocator failure produces, so the executor's catch path is
+    exercised for real, not against a stand-in exception class."""
+    from jax.errors import JaxRuntimeError
+    suffix = f" [injected: {where}]" if where else ""
+    return JaxRuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        f"{int(nbytes)} bytes.{suffix}")
+
+
+@dataclasses.dataclass
+class MemoryPlan:
+    """How a train batch is executed against device memory.
+
+    microbatch: rows per microbatch (None = the whole batch in one
+        step). The accumulation count for a concrete batch is
+        ``steps_for(batch_rows)``.
+    accum_steps: the accumulation count the last executed batch used
+        (reporting/meta; recomputed per batch from ``microbatch``).
+    provenance: who decided —
+        "full"        no microbatching until an OOM forces it;
+        "configured"  user-passed microbatch size;
+        "probe"       plan_memory() warmup binary search;
+        "adapted"     shrunk at runtime by a caught RESOURCE_EXHAUSTED;
+        "resumed"     restored from checkpoint meta (no re-probe).
+    """
+
+    microbatch: Optional[int] = None
+    accum_steps: int = 1
+    provenance: str = "full"
+
+    def steps_for(self, batch_rows: int) -> int:
+        if self.microbatch is None or self.microbatch >= batch_rows:
+            return 1
+        return -(-batch_rows // self.microbatch)
+
+    def to_meta(self) -> Optional[dict]:
+        """JSON payload for checkpoint meta; None while the plan is
+        still the trivial full-batch one (nothing worth persisting)."""
+        if self.microbatch is None:
+            return None
+        return {"microbatch": int(self.microbatch),
+                "accum_steps": int(self.accum_steps),
+                "provenance": self.provenance}
+
+    @classmethod
+    def from_meta(cls, m, provenance: Optional[str] = None
+                  ) -> Optional["MemoryPlan"]:
+        if not m or m.get("microbatch") is None:
+            return None
+        return cls(microbatch=int(m["microbatch"]),
+                   accum_steps=int(m.get("accum_steps", 1)),
+                   provenance=provenance or
+                   str(m.get("provenance", "resumed")))
+
+
+def _leading_rows(feed) -> int:
+    return int(jax.tree_util.tree_leaves(feed)[0].shape[0])
+
+
+def _pad_to_multiple(feed, k: int):
+    """Pad every feed leaf to a row count divisible by ``k`` (zeros —
+    the padded rows sit past ``n_real`` and are masked out of cost,
+    metrics and gradients exactly like DataFeeder's fixed_batch_size
+    padding). Returns (padded_feed, microbatch_rows)."""
+    b = _leading_rows(feed)
+    mb = -(-b // k)
+    pad = mb * k - b
+    if pad == 0:
+        return feed, mb
+
+    def pad_leaf(a):
+        a = np.asarray(a)
+        return np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+
+    return jax.tree_util.tree_map(pad_leaf, feed), mb
+
+
+def _check_buffers_alive(trainer):
+    """Donated buffers: injected faults raise before dispatch, but a
+    real device OOM can fail AFTER the step consumed its donated
+    inputs, deleting the live params. Detect that here and fail with
+    the recovery action instead of a cryptic 'buffer has been deleted'
+    on the retry."""
+    for leaf in jax.tree_util.tree_leaves(trainer.parameters.raw):
+        deleted = getattr(leaf, "is_deleted", None)
+        if deleted is not None and deleted():
+            raise RuntimeError(
+                "the OOM'd step consumed its donated parameter buffers; "
+                "the live training state is gone — relaunch with "
+                "auto_resume to restore the newest checkpoint (the "
+                "adapted MemoryPlan rides in its meta, so the resumed "
+                "run starts at the known-safe microbatch)")
+
+
+class AdaptiveMicrobatcher:
+    """The adaptive executor behind ``SGD.train(microbatch=...)``.
+
+    Runs every optimizer step under the current :class:`MemoryPlan`;
+    when the jitted step raises ``RESOURCE_EXHAUSTED`` it bisects the
+    microbatch (halving rows, doubling accumulation steps), emits an
+    ``OOMEvent`` through the train loop's event handler, and re-runs
+    the SAME batch — zero samples lost, zero updates skipped. Non-OOM
+    errors re-raise untouched.
+
+    The compiled accumulation steps are cached per count on the
+    trainer (``SGD._get_memory_step``), and the accumulation loop is a
+    ``lax.scan`` — one compile per plan, never one per microbatch
+    (pinned by ``@pytest.mark.recompile_budget`` in tests/test_oom.py).
+    """
+
+    def __init__(self, trainer, plan: Optional[MemoryPlan] = None,
+                 min_microbatch: int = 1, probe: bool = False):
+        self.trainer = trainer
+        self.plan = plan or MemoryPlan()
+        self.min_microbatch = max(1, int(min_microbatch))
+        self.oom_events = 0
+        self._probe_pending = bool(probe)
+
+    def adopt(self, plan: MemoryPlan):
+        """Install a plan decided elsewhere (checkpoint meta on
+        auto-resume) — cancels any pending warmup probe."""
+        self.plan = plan
+        self._probe_pending = False
+
+    def maybe_probe(self, feed, rng, n_real):
+        """Run the warmup probe on the first batch when requested and
+        no better plan exists yet (an adapted/resumed/probed plan
+        always wins — resume must NOT re-probe)."""
+        if not self._probe_pending:
+            return
+        self._probe_pending = False
+        if self.plan.provenance != "full":
+            return
+        self.plan = _probe_feed(self.trainer, feed, rng, n_real,
+                                min_microbatch=self.min_microbatch)
+
+    def run(self, feed, rng, n_real, guarded: bool = False,
+            bad_streak=None, ctx=None):
+        """One optimizer step over ``feed`` under the plan. Returns the
+        step tuple (6 entries, +bad_streak when guarded). ``ctx`` is
+        (pass_id, batch_id, event_handler) for OOMEvent emission."""
+        trainer = self.trainer
+        self.maybe_probe(feed, rng, n_real)
+        while True:
+            b = _leading_rows(feed)
+            k = self.plan.steps_for(b)
+            run_feed, mb = (feed, b) if k == 1 else _pad_to_multiple(
+                feed, k)
+            self.plan.accum_steps = k
+            fn = trainer._get_memory_step(k, guarded)
+            args = (trainer._own_params(), trainer.opt_state,
+                    trainer.parameters.state, run_feed, rng, n_real)
+            if guarded:
+                args = args + (bad_streak,)
+            try:
+                if trainer._step_interceptor is not None:
+                    trainer._step_interceptor(k, mb)
+                return fn(*args)
+            except Exception as e:
+                if not is_resource_exhausted(e):
+                    raise
+                self._absorb_oom(e, b, mb, ctx)
+
+    def _absorb_oom(self, exc, batch_rows: int, mb: int, ctx):
+        """Account one RESOURCE_EXHAUSTED and bisect the plan; re-raise
+        when already at the floor (the device genuinely cannot fit one
+        minimal microbatch — there is nothing left to shrink)."""
+        self.oom_events += 1
+        global_counters.bump("trainer/oom_events")
+        _check_buffers_alive(self.trainer)
+        if mb <= self.min_microbatch:
+            raise exc
+        self.plan.microbatch = max(self.min_microbatch, (mb + 1) // 2)
+        self.plan.accum_steps = self.plan.steps_for(batch_rows)
+        self.plan.provenance = "adapted"
+        warnings.warn(
+            f"train step hit RESOURCE_EXHAUSTED at microbatch={mb}; "
+            f"bisecting to {self.plan.microbatch} rows x "
+            f"{self.plan.accum_steps} accumulation steps and re-running "
+            "the batch (no samples lost)", stacklevel=3)
+        if ctx is not None:
+            pass_id, batch_id, handler = ctx
+            handler(evt.OOMEvent(pass_id, batch_id,
+                                 microbatch=self.plan.microbatch,
+                                 accum_steps=self.plan.accum_steps,
+                                 error=exc))
+
+
+def plan_memory(trainer, batch=None, *, feeding=None, feed=None,
+                n_real=None, min_microbatch: int = 1) -> MemoryPlan:
+    """Warmup probe: binary-search the largest safe microbatch BEFORE
+    training starts, by trial-running the jitted train step on COPIES
+    of the training state — params/optimizer/layer state are untouched
+    and no reader data is consumed (the probe reuses one sample batch).
+
+    ``batch`` is a list of sample tuples (the reader's unit); pass
+    ``feed``/``n_real`` instead to skip the conversion. Returns a
+    :class:`MemoryPlan` with provenance="probe". The compiled step for
+    the winning accumulation count stays in the trainer's cache, so
+    the first real step pays no extra compile.
+    """
+    if feed is None:
+        from paddle_tpu.trainer.data_feeder import DataFeeder
+        feeder = DataFeeder(trainer.topology.data_type(), feeding)
+        feed = feeder(batch)
+        n_real = jnp.asarray(feed.pop("__batch_size__"), jnp.int32)
+    return _probe_feed(trainer, feed, jax.random.PRNGKey(0), n_real,
+                       min_microbatch)
+
+
+def _probe_feed(trainer, feed, rng, n_real,
+                min_microbatch: int = 1) -> MemoryPlan:
+    b = _leading_rows(feed)
+
+    def trial(k: int) -> bool:
+        run_feed, mb = (feed, b) if k == 1 else _pad_to_multiple(feed, k)
+        fn = trainer._get_memory_step(k, guarded=False)
+        params = jax.tree_util.tree_map(jnp.copy, trainer._own_params())
+        opt = jax.tree_util.tree_map(jnp.copy, trainer.opt_state)
+        state = jax.tree_util.tree_map(jnp.copy,
+                                       trainer.parameters.state)
+        try:
+            if trainer._step_interceptor is not None:
+                trainer._step_interceptor(k, mb)
+            out = fn(params, opt, state, run_feed, rng, n_real)
+            jax.block_until_ready(out[3])   # loss: force real execution
+            return True
+        except Exception as e:
+            if not is_resource_exhausted(e):
+                raise
+            global_counters.bump("trainer/oom_probe_failures")
+            return False
+
+    # descend in doubling accumulation counts until a microbatch fits
+    k = 1
+    while not trial(k):
+        mb = -(-b // k)
+        if mb <= min_microbatch:
+            raise RuntimeError(
+                f"memory probe failed at the minimum microbatch "
+                f"({min_microbatch} row(s)): the model does not fit "
+                "device memory at any accumulation count")
+        k = min(b, k * 2)
+    if k == 1:
+        return MemoryPlan(provenance="probe")   # whole batch fits
+    # refine: the largest safe microbatch lies between the winner and
+    # the last failure — one bisection trial narrows the bracket at the
+    # cost of one extra compile
+    lo = -(-b // k)                       # known-safe rows
+    hi = -(-b // max(k // 2, 1))          # known-failing rows
+    mid = (lo + hi) // 2
+    if mid > lo:
+        k_mid = -(-b // mid)
+        if k_mid < k and trial(k_mid):
+            k = k_mid
+            lo = -(-b // k_mid)
+    return MemoryPlan(microbatch=lo, accum_steps=k, provenance="probe")

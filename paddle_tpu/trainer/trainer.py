@@ -160,6 +160,17 @@ class SGD:
         self._train_step_guarded = None
         self._fault_policy = None
         self._bad_streak = None
+        # gradient-accumulation steps compiled on demand, cached per
+        # (accum_steps, guarded) — the memory executor and the warmup
+        # probe share this cache (trainer/memory.py)
+        self._accum_steps = {}
+        self._memory_exec = None
+        self._restored_memory_plan = None
+        # fault-injection seam (testing/faults.py oom_at /
+        # memory_pressure): called as (accum_steps, microbatch_rows)
+        # immediately before each jitted step the memory executor or
+        # probe dispatches; may raise RESOURCE_EXHAUSTED
+        self._step_interceptor = None
         self._test_step = self._build_test_step()
 
     # ------------------------------------------------------------------
@@ -382,6 +393,11 @@ class SGD:
             return (new_params, new_opt_state, new_state, loss, metrics,
                     eval_outs)
 
+        return self._finalize_step(step, guarded)
+
+    def _finalize_step(self, step, guarded: bool):
+        """Shared tail of the plain and accumulation step builders:
+        fold in the fault guard, then mesh-shard or plain-jit."""
         if guarded:
             step = self._guard_step(step)
         if self.mesh is not None:
@@ -404,6 +420,132 @@ class SGD:
             return shard_train_step(step, self.mesh, p_sh, o_sh,
                                     n_extra=1 if guarded else 0)
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_accum_train_step(self, k: int, guarded: bool = False):
+        """Gradient-accumulation step for the memory executor
+        (trainer/memory.py — docs/robustness.md "Memory pressure"): the
+        batch is split into ``k`` microbatches scanned ON DEVICE, the
+        per-microbatch gradients SUM into the full-batch gradient, and
+        the optimizer applies ONE update.
+
+        Equivalence: each microbatch objective is the masked cost over
+        its GLOBAL rows divided by ``n_real`` (the same ``_masked_cost``
+        the 1F1B schedule uses), so the k partial losses — and their
+        gradients — add up to exactly the full-batch value: summing the
+        grads IS the mean-of-per-sample-grads the full step computes.
+        tests/test_oom.py pins loss and params at k=1,2,4 to f32
+        tolerance. The loop is a ``lax.scan``: ONE compile per k, never
+        one per microbatch (``@pytest.mark.recompile_budget``).
+
+        Peak live activation memory drops from O(batch) to O(batch/k)
+        plus one grads-sized accumulator. Stateful layers see
+        microbatch statistics and dropout draws per-microbatch masks
+        (``fold_in(rng, j)``) — the standard grad-accumulation trade,
+        documented in docs/robustness.md."""
+        assert k >= 2, k
+        if self.topology.sparse_tables():
+            raise NotImplementedError(
+                "microbatch accumulation does not compose with "
+                "row-sparse embedding tables yet")
+        if self._grad_tap_names or self.evaluators:
+            raise NotImplementedError(
+                "microbatch accumulation does not support "
+                "gradient-printer or host evaluators")
+        from paddle_tpu.parallel.mesh import PP_AXIS
+        if self.mesh is not None and PP_AXIS in self.mesh.shape and \
+                self.mesh.shape[PP_AXIS] > 1:
+            raise NotImplementedError(
+                "pipelined meshes microbatch through "
+                "pipeline_microbatches, not the memory executor")
+        metric_names = [c.name for c in self.costs] + \
+            [e.name for e in self.extra_layers]
+
+        def mb_loss(params, state, feed_j, rng_j, row0, n_real):
+            from paddle_tpu.core.sequence import SequenceBatch
+            mb_rows = jax.tree_util.tree_leaves(feed_j)[0].shape[0]
+            # rows are contiguous: local row i is global row row0+i, so
+            # the local real-row count keeps n_real-consuming layers
+            # (MoE row masking) exact under the split
+            n_local = jnp.clip(n_real - row0, 0, mb_rows)
+            outs, new_state = self.topology.forward(
+                params, state, feed_j, mode="train", rng=rng_j,
+                mesh=self.mesh, n_real=n_local)
+            total = 0.0
+            metrics = {}
+            for c in self.costs:
+                v = self._masked_cost(outs[c.name], row0, n_real)
+                total = total + v
+                metrics[c.name] = v
+            for e in self.extra_layers:
+                v = outs[e.name]
+                if isinstance(v, SequenceBatch):
+                    raise NotImplementedError(
+                        f"sequence-output extra layer {e.name!r} is not "
+                        "supported under microbatch accumulation")
+                v = v.reshape(v.shape[0], -1).mean(axis=-1)
+                mask = ((row0 + jnp.arange(v.shape[0])) <
+                        n_real).astype(v.dtype)
+                metrics[e.name] = jnp.sum(v * mask) / jnp.maximum(
+                    n_real.astype(v.dtype), 1.0)
+            return total, (metrics, new_state)
+
+        grad_fn = jax.value_and_grad(mb_loss, has_aux=True)
+
+        def step(params, opt_state, state, feed, rng, n_real):
+            b = jax.tree_util.tree_leaves(feed)[0].shape[0]
+            assert b % k == 0, (b, k)   # the executor pads to a multiple
+            mb = b // k
+            feed_m = jax.tree_util.tree_map(
+                lambda a: a.reshape((k, mb) + a.shape[1:]), feed)
+            if self.mesh is not None:
+                from paddle_tpu.parallel.data_parallel import \
+                    shard_microbatched_feed
+                feed_m = shard_microbatched_feed(feed_m, self.mesh)
+            g0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+            m0 = {name: jnp.zeros((), jnp.float32)
+                  for name in metric_names}
+
+            def body(carry, xs):
+                g_acc, loss_acc, m_acc, st = carry
+                feed_j, j = xs
+                row0 = j * mb
+                (loss_j, (metrics_j, new_st)), g_j = grad_fn(
+                    params, st, feed_j, jax.random.fold_in(rng, j),
+                    row0, n_real)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g_j)
+                m_acc = {name: m_acc[name] +
+                         metrics_j[name].astype(jnp.float32)
+                         for name in m_acc}
+                return (g_acc, loss_acc + loss_j.astype(jnp.float32),
+                        m_acc, new_st), None
+
+            (grads, loss, metrics, new_state), _ = jax.lax.scan(
+                body, (g0, jnp.zeros((), jnp.float32), m0, state),
+                (feed_m, jnp.arange(k)))
+            new_params, new_opt_state = self.optimizer.update(
+                params, grads, opt_state, n_real.astype(jnp.float32))
+            return (new_params, new_opt_state, new_state, loss, metrics,
+                    {})
+        return self._finalize_step(step, guarded)
+
+    def _get_memory_step(self, k: int, guarded: bool):
+        """Compiled step for ``k`` accumulation steps (k==1: the plain
+        or guarded full-batch step), cached per (k, guarded). The
+        memory executor and the warmup probe share this cache, so a
+        probed plan's first real step pays no extra compile."""
+        if k <= 1:
+            if guarded:
+                if self._train_step_guarded is None:
+                    self._train_step_guarded = self._build_train_step(
+                        guarded=True)
+                return self._train_step_guarded
+            return self._train_step
+        key = (int(k), bool(guarded))
+        fn = self._accum_steps.get(key)
+        if fn is None:
+            fn = self._build_accum_train_step(k, guarded=guarded)
+            self._accum_steps[key] = fn
+        return fn
 
     def _build_pipelined_train_step(self, guarded: bool = False):
         """Train step with the model body GPipe-pipelined over the mesh
@@ -625,7 +767,8 @@ class SGD:
               checkpoint_manager=None, checkpoint_period: int = 0,
               checkpoint_dir: Optional[str] = None,
               auto_resume: bool = False, fault_policy=None,
-              idle_timeout: float = 600.0):
+              idle_timeout: float = 600.0, microbatch=None,
+              oom_probe: bool = False):
         """reader: callable yielding BATCHES (lists of sample tuples), i.e.
         the output of paddle_tpu.reader.batch(...).
 
@@ -657,7 +800,22 @@ class SGD:
         fault_policy: a trainer.fault.FaultPolicy — check every step's
         numerics on device, skip non-finite updates, and roll back to
         the newest checkpoint after K consecutive bad steps, emitting
-        event.FaultEvent (docs/robustness.md)."""
+        event.FaultEvent (docs/robustness.md).
+
+        microbatch: "auto" or an int — adaptive microbatching
+        (trainer/memory.py, docs/robustness.md "Memory pressure"): a
+        step that raises XLA RESOURCE_EXHAUSTED is bisected into
+        microbatches with on-device gradient accumulation (numerically
+        equivalent to the full-batch step) and re-run — no samples
+        lost, an event.OOMEvent per adaptation. An int fixes the
+        starting microbatch rows; "auto" starts full-batch. The
+        discovered plan rides in checkpoint meta, so auto_resume
+        restarts at the known-safe microbatch without re-probing.
+
+        oom_probe: with microbatch="auto", binary-search the largest
+        safe microbatch on the first batch (against COPIES of the
+        state) before stepping, instead of discovering it by failing
+        mid-pass."""
         from paddle_tpu.trainer.data_feeder import DataFeeder
         if event_handler is None:
             event_handler = _default_event_handler
@@ -675,6 +833,28 @@ class SGD:
                 self._bad_streak = jnp.zeros((2,), jnp.int32)
             self._fault_steps_since_check = 0
 
+        self._memory_exec = None
+        if microbatch is not None:
+            from paddle_tpu.trainer.memory import (AdaptiveMicrobatcher,
+                                                   MemoryPlan)
+            if self.evaluators:
+                raise NotImplementedError(
+                    "microbatch= does not compose with host evaluators "
+                    "yet — drop the evaluators or the microbatching")
+            if microbatch == "auto":
+                plan = MemoryPlan()
+            else:
+                mb = int(microbatch)
+                if mb < 1:
+                    raise ValueError(
+                        "microbatch must be >= 1 or 'auto'")
+                plan = MemoryPlan(microbatch=mb, provenance="configured")
+            self._memory_exec = AdaptiveMicrobatcher(self, plan,
+                                                     probe=oom_probe)
+        elif oom_probe:
+            raise ValueError(
+                "oom_probe=True needs microbatch='auto' or an int")
+
         if coordinator is not None:
             from paddle_tpu.reader import batch as batch_reader
             from paddle_tpu.trainer.coordinator import (RetryPolicy,
@@ -690,8 +870,9 @@ class SGD:
                               idle_timeout=idle_timeout, retry=retry)
             if batch_size:
                 rdr = batch_reader(rdr, batch_size)
-            if checkpoint_manager is not None:
-                self.restore_checkpoint(checkpoint_manager)
+            if checkpoint_manager is not None and \
+                    self.restore_checkpoint(checkpoint_manager):
+                self._adopt_restored_plan()
 
             try:
                 while coordinator_epoch(coordinator,
@@ -735,6 +916,7 @@ class SGD:
             # of the interrupted pass) the checkpoint already covers.
             # RNG splits for skipped batches already happened before the
             # save, so skipped batches must not re-split (_run_pass).
+            self._adopt_restored_plan()
             start_pass = self._pass_count
             skip_batches = self._batch_in_pass
             if ckptable and skip_batches and self._reader_state:
@@ -943,7 +1125,20 @@ class SGD:
             n_real = jnp.asarray(n_real_host, jnp.int32)
             self._rng, sub = jax.random.split(self._rng)
             with stat_timer("train_step"):
-                if policy is not None:
+                if self._memory_exec is not None:
+                    # adaptive microbatching (trainer/memory.py): OOM'd
+                    # steps bisect + re-run instead of killing the pass
+                    out = self._memory_exec.run(
+                        feed, sub, n_real, guarded=policy is not None,
+                        bad_streak=self._bad_streak,
+                        ctx=(pass_id, batch_id, event_handler))
+                    if policy is not None:
+                        (new_params, self.opt_state, new_state, loss,
+                         metrics, eval_outs, self._bad_streak) = out
+                    else:
+                        (new_params, self.opt_state, new_state, loss,
+                         metrics, eval_outs) = out
+                elif policy is not None:
                     (new_params, self.opt_state, new_state, loss,
                      metrics, eval_outs,
                      self._bad_streak) = self._train_step_guarded(
@@ -1086,6 +1281,12 @@ class SGD:
                 self._batch_in_pass - 1 - self._reader_batch_base)
             if rs is not None:
                 m["reader_state"] = rs
+        # the discovered memory plan (trainer/memory.py): auto-resume
+        # restarts at the known-safe microbatch instead of re-probing
+        if self._memory_exec is not None:
+            pm = self._memory_exec.plan.to_meta()
+            if pm is not None:
+                m["memory_plan"] = pm
         m.update(meta or {})
         return manager.save(self._step_count, self.parameters.raw,
                             self.opt_state, self.parameters.state, m)
@@ -1104,12 +1305,26 @@ class SGD:
         self._pass_count = int(tree["meta"].get("pass_count", 0))
         self._batch_in_pass = int(tree["meta"].get("batch_in_pass", 0))
         self._reader_state = tree["meta"].get("reader_state")
+        self._restored_memory_plan = tree["meta"].get("memory_plan")
         if "rng" in tree["meta"]:
             # Restore raw uint32 bits to keep the legacy key flavor the
             # rest of the trainer uses — wrap_key_data would produce a
             # typed key with a different aval and force a jit retrace.
             self._rng = jnp.asarray(tree["meta"]["rng"], jnp.uint32)
         return True
+
+    def _adopt_restored_plan(self):
+        """Auto-resume with microbatching active: restart at the
+        checkpoint's known-safe MemoryPlan instead of re-probing or
+        re-discovering it by OOM (docs/robustness.md 'Memory
+        pressure')."""
+        if self._memory_exec is None or not self._restored_memory_plan:
+            return
+        from paddle_tpu.trainer.memory import MemoryPlan
+        plan = MemoryPlan.from_meta(self._restored_memory_plan,
+                                    provenance="resumed")
+        if plan is not None:
+            self._memory_exec.adopt(plan)
 
     def save_parameter_to_tar(self, f):
         self.parameters.to_tar(f)

@@ -1,0 +1,85 @@
+"""Subprocess trainer for the OOM SIGKILL-resume chaos test
+(tests/test_oom.py): trains with adaptive microbatching under
+deterministic memory pressure (the device "fits" at most max_rows
+microbatch rows), checkpointing every step, printing a 'STEP n' marker
+per completed batch so FaultPlan.kill_at_marker can SIGKILL it at an
+exact step. The final line reports how many OOM adaptations this
+PROCESS absorbed, the plan it ended on (with provenance — a resumed
+run must say 'resumed', proving the plan came from checkpoint meta
+instead of being re-discovered by OOM), and a params digest so the
+killed+resumed run can be compared bit-for-bit with an uninterrupted
+one.
+
+argv: <ckpt_dir> <num_passes> <max_rows> <per_step_delay_s>
+"""
+
+import hashlib
+import sys
+import time
+
+
+def main():
+    ckpt_dir = sys.argv[1]
+    num_passes = int(sys.argv[2])
+    max_rows = int(sys.argv[3])
+    delay = float(sys.argv[4])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.testing import FaultPlan
+
+    paddle.init(seed=0)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    y = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    out = paddle.layer.fc(x, size=2, act=paddle.activation.Softmax(),
+                          name="out")
+    cost = paddle.layer.classification_cost(out, y, name="cost")
+    params = paddle.create_parameters(paddle.Topology(cost))
+    tr = paddle.SGD(cost=cost, parameters=params,
+                    update_equation=paddle.optimizer.Momentum(
+                        learning_rate=0.05))
+
+    def reader():
+        rng = np.random.RandomState(42)
+        for _ in range(6):
+            f = rng.randn(8, 8).astype("float32")
+            lbl = rng.randint(0, 2, 8)
+            yield [(f[i], int(lbl[i])) for i in range(8)]
+
+    ooms = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.OOMEvent):
+            ooms.append(e)
+            print(f"OOM step={tr._step_count} -> microbatch="
+                  f"{e.microbatch} x{e.accum_steps}", flush=True)
+        elif isinstance(e, paddle.event.EndIteration):
+            print(f"STEP {tr._step_count}", flush=True)
+            if delay:
+                time.sleep(delay)
+
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with FaultPlan.memory_pressure(tr, max_rows=max_rows):
+            tr.train(reader, num_passes=num_passes,
+                     event_handler=handler, checkpoint_dir=ckpt_dir,
+                     checkpoint_period=1, auto_resume=True,
+                     microbatch="auto")
+
+    plan = tr._memory_exec.plan
+    h = hashlib.md5()
+    for k in sorted(tr.parameters.raw):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(
+            np.asarray(tr.parameters.raw[k])).tobytes())
+    print(f"WORKER DONE steps={tr._step_count} ooms={len(ooms)} "
+          f"plan={plan.provenance}:{plan.microbatch} "
+          f"digest={h.hexdigest()}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -211,3 +211,96 @@ class TestMergeInfer:
                             text=True, timeout=600, env=env)
         assert rc.returncode == 0, (rc.stdout[-2000:], rc.stderr[-2000:])
         assert "out_dim=10" in rc.stdout
+
+
+class TestMemoryFlags:
+    """--microbatch/--oom_probe (train) and --max_batch_memory (serve)
+    wiring: the flags must reach SGD.train / InferenceServer
+    (docs/robustness.md "Memory pressure")."""
+
+    def _tiny_config(self, tmp_path):
+        cfg = tmp_path / "conf.py"
+        cfg.write_text(
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            "x = paddle.layer.data('x', paddle.data_type.dense_vector(4))\n"
+            "y = paddle.layer.data('y', paddle.data_type.integer_value(2))\n"
+            "out = paddle.layer.fc(x, size=2,"
+            " act=paddle.activation.Softmax())\n"
+            "cost = paddle.layer.classification_cost(out, y)\n"
+            "def train_reader():\n"
+            "    rng = np.random.RandomState(0)\n"
+            "    for _ in range(2):\n"
+            "        f = rng.randn(4, 4).astype('float32')\n"
+            "        yield [(f[i], int(rng.randint(0, 2)))"
+            " for i in range(4)]\n")
+        return str(cfg)
+
+    def test_train_microbatch_flags_reach_sgd(self, tmp_path,
+                                              monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu import cli
+
+        captured = {}
+
+        def fake_train(self, reader=None, **kw):
+            captured.update(kw)
+
+        monkeypatch.setattr(paddle.SGD, "train", fake_train)
+        cfg = self._tiny_config(tmp_path)
+        rc = cli.main(["train", "--config", cfg,
+                       "--microbatch", "auto", "--oom_probe"])
+        assert rc == 0
+        assert captured["microbatch"] == "auto"
+        assert captured["oom_probe"] is True
+
+        captured.clear()
+        rc = cli.main(["train", "--config", cfg, "--microbatch", "16"])
+        assert rc == 0
+        assert captured["microbatch"] == 16     # numeric form -> int
+        assert captured["oom_probe"] is False
+
+        captured.clear()
+        rc = cli.main(["train", "--config", cfg])
+        assert rc == 0
+        assert captured["microbatch"] is None   # default: off
+
+    def test_train_microbatch_end_to_end(self, tmp_path):
+        # the real path (no mocks): a tiny config trains microbatched
+        # through the CLI in-process
+        from paddle_tpu import cli
+        rc = cli.main(["train", "--config", self._tiny_config(tmp_path),
+                       "--microbatch", "2", "--num_passes", "1",
+                       "--log_period", "1"])
+        assert rc == 0
+
+    def test_serve_max_batch_memory_reaches_server(self, monkeypatch):
+        from paddle_tpu import cli
+
+        class FakeServer:
+            def __init__(self, model, **kw):
+                self.kw = kw
+
+            def start(self):
+                return self
+
+        class FakeBreaker:
+            def __init__(self, **kw):
+                pass
+
+        import argparse
+        ns = argparse.Namespace(
+            model="m.tar", max_queue=8, workers=1, deadline_ms=0,
+            max_batch_memory=4096, breaker_window=4,
+            breaker_threshold=0.5, breaker_cooldown=1.0,
+            host="127.0.0.1", port=0)
+        server, httpd = cli._build_server(
+            ns, FakeServer, FakeBreaker,
+            lambda srv, host, port: ("httpd", host, port))
+        assert server.kw["max_batch_memory"] == 4096
+        assert httpd == ("httpd", "127.0.0.1", 0)
+
+        ns.max_batch_memory = 0                 # 0 -> disabled (None)
+        server, _ = cli._build_server(
+            ns, FakeServer, FakeBreaker, lambda *a: None)
+        assert server.kw["max_batch_memory"] is None

@@ -300,6 +300,80 @@ class TestDtypeWidening:
         assert hits
 
 
+# ================================================================== R7
+class TestBroadExceptJit:
+    def test_catches_broad_except_around_jit_assigned_name(self):
+        hits = run_rule(R.BroadExceptJitRule, """
+            import jax
+            step = jax.jit(lambda p, x: p + x)
+            def run(p, x):
+                try:
+                    return step(p, x)
+                except Exception:
+                    return None
+        """)
+        assert len(hits) == 1 and hits[0].rule == "R7"
+        assert "step(...)" in hits[0].message
+
+    def test_catches_bare_except_around_known_step_tail(self):
+        hits = run_rule(R.BroadExceptJitRule, """
+            def run(trainer, args):
+                try:
+                    out = trainer._train_step(*args)
+                except:
+                    out = None
+                return out
+        """)
+        assert len(hits) == 1
+
+    def test_catches_jit_producer_result(self):
+        # a name assigned from _get_memory_step IS a jitted callable
+        hits = run_rule(R.BroadExceptJitRule, """
+            def run(trainer, k, args):
+                fn = trainer._get_memory_step(k, False)
+                try:
+                    return fn(*args)
+                except Exception:
+                    return None
+        """)
+        assert len(hits) == 1
+
+    def test_quiet_when_handler_reraises(self):
+        # the adaptive-microbatcher idiom: absorb RESOURCE_EXHAUSTED,
+        # re-raise everything else — a conditional raise satisfies R7
+        assert not run_rule(R.BroadExceptJitRule, """
+            def run(trainer, k, args, is_oom):
+                fn = trainer._get_memory_step(k, False)
+                try:
+                    return fn(*args)
+                except Exception as e:
+                    if not is_oom(e):
+                        raise
+                    return None
+        """)
+
+    def test_quiet_on_specific_exception_types(self):
+        assert not run_rule(R.BroadExceptJitRule, """
+            import jax
+            step = jax.jit(lambda p, x: p + x)
+            def run(p, x):
+                try:
+                    return step(p, x)
+                except (RuntimeError, MemoryError):
+                    return None
+        """)
+
+    def test_quiet_on_broad_except_around_host_code(self):
+        # a broad except around NON-jitted code is out of scope
+        assert not run_rule(R.BroadExceptJitRule, """
+            def load(path):
+                try:
+                    return open(path).read()
+                except Exception:
+                    return None
+        """)
+
+
 # ==================================================== suppressions
 class TestSuppression:
     def test_inline_and_preceding_line_forms(self):

@@ -1,0 +1,431 @@
+"""Memory-pressure chaos suite (docs/robustness.md "Memory pressure").
+
+The acceptance contract (ISSUE 5): with ``oom_at(step=3, n=2)``
+injected, a full training pass completes with ZERO lost samples and
+final params equal (f32 tolerance) to an uninjected run at the same
+effective batch size; a SIGKILL after the OOM resumes from checkpoint
+meta with the adapted ``MemoryPlan`` — no re-probe, no re-discovery by
+OOM. Plus: gradient-accumulation equivalence at k=1,2,4 (the
+``lax.scan`` loop must not recompile per microbatch —
+``@pytest.mark.recompile_budget``), the warmup probe's binary search
+under deterministic allocation pressure, plan persistence via
+``CheckpointManager.peek_meta``, and the serving-side shed path
+(``Rejected(reason="resource_exhausted")`` without tripping the
+circuit breaker).
+"""
+
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.testing import FaultPlan
+from paddle_tpu.trainer.memory import (MemoryPlan, is_resource_exhausted,
+                                       plan_memory,
+                                       resource_exhausted_error)
+from paddle_tpu.utils.stats import global_counters
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _trainer(lr=0.05):
+    from paddle_tpu.core import registry
+    registry.reset_name_counters()     # identical auto-names per build
+    paddle.init(seed=0)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    y = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    out = paddle.layer.fc(x, size=4, act=paddle.activation.Relu())
+    out = paddle.layer.fc(out, size=2, act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(out, y, name="cost")
+    params = paddle.create_parameters(paddle.Topology(cost))
+    return paddle.SGD(cost=cost, parameters=params,
+                      update_equation=paddle.optimizer.Momentum(
+                          learning_rate=lr))
+
+
+def _reader(rows=8, batches=6, seed=42):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(batches):
+            f = rng.randn(rows, 8).astype("float32")
+            lbl = rng.randint(0, 2, rows)
+            yield [(f[i], int(lbl[i])) for i in range(rows)]
+    return reader
+
+
+def _run(trainer, reader, collect=None, **kw):
+    losses, ooms = [], []
+
+    def handler(e):
+        if isinstance(e, paddle.event.OOMEvent):
+            ooms.append(e)
+        elif isinstance(e, paddle.event.EndIteration):
+            losses.append(e.cost)
+        if collect is not None:
+            collect(e)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        trainer.train(reader, num_passes=1, event_handler=handler, **kw)
+    params = {k: np.asarray(v)
+              for k, v in trainer.parameters.raw.items()}
+    return losses, params, ooms
+
+
+def _assert_params_close(a, b, rtol=2e-5, atol=2e-6):
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=rtol, atol=atol,
+                                   err_msg=k)
+
+
+# ================================================================ plan
+class TestMemoryPlan:
+    def test_steps_for(self):
+        assert MemoryPlan().steps_for(64) == 1
+        assert MemoryPlan(microbatch=64).steps_for(64) == 1
+        assert MemoryPlan(microbatch=16).steps_for(64) == 4
+        assert MemoryPlan(microbatch=3).steps_for(8) == 3   # ceil
+
+    def test_meta_roundtrip(self):
+        assert MemoryPlan().to_meta() is None       # trivial: nothing
+        p = MemoryPlan(microbatch=16, accum_steps=4,
+                       provenance="adapted")
+        m = p.to_meta()
+        assert m == {"microbatch": 16, "accum_steps": 4,
+                     "provenance": "adapted"}
+        q = MemoryPlan.from_meta(m, provenance="resumed")
+        assert (q.microbatch, q.accum_steps, q.provenance) == \
+            (16, 4, "resumed")
+        assert MemoryPlan.from_meta(None) is None
+        assert MemoryPlan.from_meta({}) is None
+
+    def test_is_resource_exhausted(self):
+        assert is_resource_exhausted(resource_exhausted_error())
+        assert is_resource_exhausted(
+            RuntimeError("RESOURCE_EXHAUSTED: Out of memory"))
+        assert is_resource_exhausted(MemoryError("Out of memory"))
+        # the type gate: a ValueError carrying the magic string is NOT
+        # a device allocation failure
+        assert not is_resource_exhausted(
+            ValueError("RESOURCE_EXHAUSTED"))
+        assert not is_resource_exhausted(RuntimeError("NaN loss"))
+
+    def test_realistic_error_is_jax_runtime_error(self):
+        from jax.errors import JaxRuntimeError
+        e = resource_exhausted_error(123456, where="test")
+        assert isinstance(e, JaxRuntimeError)
+        assert "RESOURCE_EXHAUSTED" in str(e) and "123456" in str(e)
+
+
+# ====================================================== equivalence
+class TestAccumEquivalence:
+    """Microbatched step (k=1,2,4) == full-batch step: same per-step
+    losses and same final params within f32 tolerance, and the
+    accumulation loop compiles ONCE per k — not once per microbatch
+    (the recompile budget would blow at 6 steps x k otherwise)."""
+
+    @pytest.mark.recompile_budget(max_compiles=4)
+    def test_k124_matches_full_batch(self):
+        base_losses, base_params, _ = _run(_trainer(), _reader())
+        for mb, k in ((8, 1), (4, 2), (2, 4)):
+            losses, params, ooms = _run(_trainer(), _reader(),
+                                        microbatch=mb)
+            assert not ooms
+            np.testing.assert_allclose(losses, base_losses, rtol=2e-5,
+                                       atol=2e-6)
+            _assert_params_close(base_params, params)
+
+    def test_padded_tail_matches_full_batch(self):
+        # 6-row batches at microbatch=4 -> k=2 with 2 zero-padded rows
+        # past n_real: the mask must keep them out of loss and grads
+        base_losses, base_params, _ = _run(_trainer(), _reader(rows=6))
+        losses, params, _ = _run(_trainer(), _reader(rows=6),
+                                 microbatch=4)
+        np.testing.assert_allclose(losses, base_losses, rtol=2e-5,
+                                   atol=2e-6)
+        _assert_params_close(base_params, params)
+
+    def test_composes_with_fault_policy(self):
+        # guarded + microbatched: the guard folds around the
+        # accumulation step; a healthy run matches the plain one
+        from paddle_tpu.trainer.fault import FaultPolicy
+        base_losses, base_params, _ = _run(_trainer(), _reader())
+        losses, params, _ = _run(
+            _trainer(), _reader(), microbatch=4,
+            fault_policy=FaultPolicy(max_bad_steps=3))
+        np.testing.assert_allclose(losses, base_losses, rtol=2e-5,
+                                   atol=2e-6)
+        _assert_params_close(base_params, params)
+
+
+# ==================================================== chaos acceptance
+class TestOOMChaos:
+    def test_oom_at_step3_completes_with_identical_params(self):
+        """THE acceptance test: oom_at(step=3, n=2) -> the pass
+        completes, the failed batch is re-run microbatched (2 OOM
+        events, 8 -> 4 -> 2 rows), zero samples lost, and the final
+        params equal the uninjected run's at the same effective batch
+        size."""
+        base_losses, base_params, _ = _run(_trainer(), _reader())
+
+        tr = _trainer()
+        before = global_counters.value("trainer/oom_events")
+        with FaultPlan.oom_at(tr, step=3, n=2) as stats:
+            losses, params, ooms = _run(tr, _reader(),
+                                        microbatch="auto")
+        assert stats["injected"] == 2
+        assert global_counters.value("trainer/oom_events") == before + 2
+        assert [e.kind for e in ooms] == ["oom", "oom"]
+        assert [(e.microbatch, e.accum_steps) for e in ooms] == \
+            [(4, 2), (2, 4)]
+        # zero lost samples: every batch stepped exactly once
+        assert len(losses) == len(base_losses) == 6
+        np.testing.assert_allclose(losses, base_losses, rtol=2e-5,
+                                   atol=2e-6)
+        _assert_params_close(base_params, params)
+        assert tr._memory_exec.plan.provenance == "adapted"
+
+    def test_oom_at_floor_reraises(self):
+        # 1-row microbatch still OOMs: the model genuinely does not
+        # fit — absorbing that would be a lie, so it must re-raise
+        tr = _trainer()
+        with FaultPlan.memory_pressure(tr, max_rows=0):
+            with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+                _run(tr, _reader(), microbatch="auto")
+
+    def test_non_oom_errors_pass_through(self):
+        # the executor absorbs RESOURCE_EXHAUSTED and ONLY that (the
+        # R7 contract): an injected ValueError must surface unchanged
+        tr = _trainer()
+
+        def bad_interceptor(k, mb):
+            raise ValueError("not an OOM")
+
+        tr._step_interceptor = bad_interceptor
+        with pytest.raises(ValueError, match="not an OOM"):
+            _run(tr, _reader(), microbatch="auto")
+
+    def test_fixed_microbatch_under_pressure(self):
+        # microbatch=N (configured) starts shrunk: no OOM at all when
+        # N already fits the pressured device
+        tr = _trainer()
+        with FaultPlan.memory_pressure(tr, max_rows=4) as stats:
+            losses, _, ooms = _run(tr, _reader(), microbatch=4)
+        assert not ooms and stats["injected"] == 0
+        assert len(losses) == 6
+        assert tr._memory_exec.plan.provenance == "configured"
+
+
+# ============================================================= probe
+class TestWarmupProbe:
+    def test_oom_probe_binary_search_under_pressure(self):
+        # device "fits" 3 rows: the probe must land on microbatch<=3
+        # BEFORE the pass, so the pass itself sees zero OOM events
+        tr = _trainer()
+        with FaultPlan.memory_pressure(tr, max_rows=3):
+            losses, _, ooms = _run(tr, _reader(), microbatch="auto",
+                                   oom_probe=True)
+        assert not ooms                     # probe pre-discovered
+        assert len(losses) == 6
+        plan = tr._memory_exec.plan
+        assert plan.provenance == "probe"
+        assert plan.microbatch is not None and plan.microbatch <= 3
+
+    def test_plan_memory_direct_mutates_nothing(self):
+        tr = _trainer()
+        before = {k: np.asarray(v).copy()
+                  for k, v in tr.parameters.raw.items()}
+        batch = next(iter(_reader()()))
+        with FaultPlan.memory_pressure(tr, max_rows=3):
+            plan = plan_memory(tr, batch)
+        assert plan.provenance == "probe"
+        assert plan.microbatch is not None and plan.microbatch <= 3
+        # the probe ran on COPIES: training state untouched
+        for k in before:
+            np.testing.assert_array_equal(
+                before[k], np.asarray(tr.parameters.raw[k]))
+        assert tr._step_count == 0
+
+    def test_probe_when_everything_fits_returns_full(self):
+        tr = _trainer()
+        plan = plan_memory(tr, next(iter(_reader()())))
+        assert plan.provenance == "probe"
+        assert plan.microbatch is None      # whole batch fits
+
+
+# ======================================================== persistence
+class TestPlanPersistence:
+    def test_plan_rides_checkpoint_meta_and_resume_adopts(self,
+                                                          tmp_path):
+        from paddle_tpu.trainer.checkpoint import CheckpointManager
+        ckpt = str(tmp_path / "ckpt")
+
+        tr1 = _trainer()
+        with FaultPlan.memory_pressure(tr1, max_rows=4):
+            _, _, ooms1 = _run(tr1, _reader(), microbatch="auto",
+                               checkpoint_dir=ckpt, checkpoint_period=1)
+        assert len(ooms1) == 1              # 8 -> 4, once
+
+        # the plan is in meta, readable WITHOUT the state payload
+        meta = CheckpointManager(ckpt).peek_meta()
+        assert meta["memory_plan"] == {"microbatch": 4,
+                                       "accum_steps": 2,
+                                       "provenance": "adapted"}
+
+        # a resumed run adopts the plan from meta: no re-probe, no
+        # re-discovery by OOM — zero new OOM events under the same
+        # pressure, provenance says where the plan came from
+        tr2 = _trainer()
+        probe_fails = global_counters.value(
+            "trainer/oom_probe_failures")
+        with FaultPlan.memory_pressure(tr2, max_rows=4):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                ooms2 = []
+                tr2.train(_reader(), num_passes=2,
+                          event_handler=lambda e: ooms2.append(e)
+                          if isinstance(e, paddle.event.OOMEvent)
+                          else None,
+                          checkpoint_dir=ckpt, checkpoint_period=1,
+                          auto_resume=True, microbatch="auto",
+                          oom_probe=True)
+        assert not ooms2
+        assert tr2._memory_exec.plan.provenance == "resumed"
+        assert tr2._memory_exec.plan.microbatch == 4
+        # oom_probe=True did NOT re-probe: a resumed plan always wins
+        assert global_counters.value(
+            "trainer/oom_probe_failures") == probe_fails
+
+    @pytest.mark.chaos(timeout=240)
+    def test_sigkill_after_oom_resumes_with_adapted_plan(self,
+                                                         tmp_path):
+        """Subprocess acceptance: SIGKILL the trainer AFTER its OOM
+        adaptation; the relaunched worker must resume from checkpoint
+        meta with the adapted plan (provenance 'resumed', zero new
+        OOMs) and finish with params bit-identical to an uninterrupted
+        injected run."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        worker = os.path.join(REPO, "tests", "oom_worker.py")
+
+        def spawn(d):
+            return subprocess.Popen(
+                [sys.executable, worker, d, "1", "4", "0.05"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env)
+
+        # uninterrupted run under the same pressure = the golden digest
+        golden = spawn(str(tmp_path / "golden"))
+        gold_out, _ = golden.communicate(timeout=180)
+        assert golden.returncode == 0, gold_out[-2000:]
+        gold_line = [l for l in gold_out.splitlines()
+                     if l.startswith("WORKER DONE")][-1]
+        assert "ooms=1" in gold_line       # the adaptation happened
+
+        # killed mid-pass, after the OOM (which hits at step 1)
+        ckpt = str(tmp_path / "ckpt")
+        victim = spawn(ckpt)
+        died_at = FaultPlan.kill_at_marker(victim, step=3)
+        assert died_at >= 3
+
+        resumed = spawn(ckpt)
+        out, _ = resumed.communicate(timeout=180)
+        assert resumed.returncode == 0, out[-2000:]
+        done = [l for l in out.splitlines()
+                if l.startswith("WORKER DONE")][-1]
+        # no re-discovery: the resumed process absorbed ZERO OOMs and
+        # its plan came from checkpoint meta
+        assert "ooms=0" in done, done
+        assert "plan=resumed:4" in done, done
+        # bit-identical finish vs. the uninterrupted injected run
+        assert done.split("digest=")[1] == \
+            gold_line.split("digest=")[1], (done, gold_line)
+
+
+# ============================================================ serving
+class _OOMForward:
+    """A model whose forward 'fits' at most max_rows rows — bigger
+    batches die with a realistic RESOURCE_EXHAUSTED."""
+
+    def __init__(self, max_rows):
+        self.max_rows = max_rows
+        self.calls = 0
+
+    def forward_batch(self, samples):
+        self.calls += 1
+        if len(samples) > self.max_rows:
+            raise resource_exhausted_error(
+                len(samples) << 20, where="fake forward")
+        return [np.zeros((len(samples), 2), np.float32)]
+
+
+@pytest.mark.chaos
+class TestServingOOM:
+    def _server(self, max_rows=2, **kw):
+        from paddle_tpu.serving import CircuitBreaker, InferenceServer
+        kw.setdefault("breaker", CircuitBreaker(
+            window=4, failure_threshold=0.5, cooldown=60.0))
+        return InferenceServer(_OOMForward(max_rows), max_queue=8,
+                               workers=1, **kw).start()
+
+    def _sample_rows(self, n, dim=8):
+        return [(np.zeros(dim, np.float32),) for _ in range(n)]
+
+    def test_oom_sheds_with_retry_after_not_breaker(self):
+        from paddle_tpu.serving import Rejected
+        srv = self._server(max_rows=2)
+        try:
+            # repeated oversized requests: every one sheds typed, the
+            # breaker NEVER opens (capacity != poisoned model)
+            for _ in range(3):
+                with pytest.raises(Rejected) as ei:
+                    srv.infer(self._sample_rows(8))
+                assert ei.value.reason == "resource_exhausted"
+                assert ei.value.retry_after > 0
+            assert srv.breaker.state == "closed"
+            # small requests keep being served throughout
+            out = srv.infer(self._sample_rows(2))
+            assert np.asarray(out).shape == (2, 2)
+            st = srv.stats()
+            assert st["oom_events"] >= 1
+            assert st["served"] == 1 and st["failed"] == 0
+        finally:
+            srv.shutdown()
+
+    def test_adaptive_limit_rejects_at_admission(self):
+        from paddle_tpu.serving import Rejected
+        srv = self._server(max_rows=2)
+        try:
+            with pytest.raises(Rejected):
+                srv.infer(self._sample_rows(8))      # worker-side OOM
+            assert srv.stats()["batch_limit"] == 4   # 8 // 2
+            fwd_calls = srv._inf.calls
+            # the NEXT oversized request never reaches the device
+            with pytest.raises(Rejected) as ei:
+                srv.submit(self._sample_rows(6))
+            assert ei.value.reason == "resource_exhausted"
+            assert srv._inf.calls == fwd_calls
+            assert srv.stats()["rejected_oom"] >= 1
+        finally:
+            srv.shutdown()
+
+    def test_max_batch_memory_admission_budget(self):
+        from paddle_tpu.serving import Rejected
+        # 8 f32 per row = 32 bytes; budget 100 bytes -> 3 rows fit,
+        # 4 rows (128 bytes) reject at admission
+        srv = self._server(max_rows=64, max_batch_memory=100)
+        try:
+            out = srv.infer(self._sample_rows(3))
+            assert np.asarray(out).shape == (3, 2)
+            with pytest.raises(Rejected) as ei:
+                srv.submit(self._sample_rows(4))
+            assert ei.value.reason == "resource_exhausted"
+            assert "max_batch_memory" in str(ei.value)
+        finally:
+            srv.shutdown()

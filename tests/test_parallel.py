@@ -159,3 +159,86 @@ class TestThreeAxisMesh:
         single = run(None)
         meshed = run(create_mesh([("dp", 2), ("mp", 2), ("sp", 2)]))
         np.testing.assert_allclose(single, meshed, rtol=2e-4)
+
+
+class TestIslandReconcileGuard:
+    """AsyncSGDIsland.reconcile under a poisoned island: the isfinite
+    guard (the PR 1 discipline applied to reconcile) must drop the
+    NaN/Inf island's tree from the average — counted in utils/stats —
+    and heal the poisoned island with the healthy average instead of
+    letting one bad island contaminate every peer."""
+
+    def _island(self, seed=0):
+        from paddle_tpu.core import registry
+        registry.reset_name_counters()
+        paddle.init(use_tpu=False, seed=seed)
+        cost = _net()
+        params = paddle.create_parameters(paddle.Topology(cost))
+        tr = paddle.SGD(cost=cost, parameters=params,
+                        update_equation=paddle.optimizer.Momentum(
+                            learning_rate=0.1))
+        return tr
+
+    def test_poisoned_island_dropped_and_healed(self):
+        from paddle_tpu.parallel.async_sgd import AsyncSGDIsland
+        from paddle_tpu.utils.stats import global_counters
+
+        t1, t2, t3 = (self._island(s) for s in (0, 1, 2))
+        healthy = {k: np.asarray(v)
+                   for k, v in t2.parameters.raw.items()}
+        healthy3 = {k: np.asarray(v)
+                    for k, v in t3.parameters.raw.items()}
+        # island 1 went NaN (a poisoned batch that slipped the guard)
+        k0 = sorted(t1.parameters.raw)[0]
+        bad = dict(t1.parameters.raw)
+        bad[k0] = jnp.full_like(bad[k0], jnp.nan)
+        t1.parameters.replace(bad)
+
+        island = AsyncSGDIsland(
+            t1, sync_period=1,
+            sync_group=[t1.parameters, t2.parameters, t3.parameters])
+        before = global_counters.value("parallel/poisoned_islands")
+        with pytest.warns(UserWarning, match="non-finite"):
+            island.reconcile()
+        assert global_counters.value(
+            "parallel/poisoned_islands") == before + 1
+
+        expect = {k: (healthy[k] + healthy3[k]) / 2.0 for k in healthy}
+        for tr in (t1, t2, t3):
+            for k in expect:
+                got = np.asarray(tr.parameters.raw[k])
+                assert np.isfinite(got).all()
+                np.testing.assert_allclose(got, expect[k], rtol=1e-6,
+                                           atol=1e-7)
+
+    def test_all_poisoned_skips_reconcile(self):
+        from paddle_tpu.parallel.async_sgd import AsyncSGDIsland
+
+        t1, t2 = (self._island(s) for s in (0, 1))
+        for tr in (t1, t2):
+            bad = {k: jnp.full_like(v, jnp.inf)
+                   for k, v in tr.parameters.raw.items()}
+            tr.parameters.replace(bad)
+        island = AsyncSGDIsland(t1, sync_period=1,
+                                sync_group=[t1.parameters, t2.parameters])
+        with pytest.warns(UserWarning, match="every island"):
+            island.reconcile()          # no crash, params untouched
+        assert not np.isfinite(
+            np.asarray(t1.parameters.raw[sorted(t1.parameters.raw)[0]])
+        ).any()
+
+    def test_healthy_islands_unchanged_semantics(self):
+        # no poison: reconcile is the plain average (regression guard
+        # for the guarded path)
+        from paddle_tpu.parallel.async_sgd import AsyncSGDIsland
+
+        t1, t2 = (self._island(s) for s in (0, 1))
+        raws = [{k: np.asarray(v) for k, v in t.parameters.raw.items()}
+                for t in (t1, t2)]
+        island = AsyncSGDIsland(t1, sync_period=1,
+                                sync_group=[t1.parameters, t2.parameters])
+        island.reconcile()
+        for k in raws[0]:
+            expect = (raws[0][k] + raws[1][k]) / 2.0
+            np.testing.assert_allclose(np.asarray(t1.parameters.raw[k]),
+                                       expect, rtol=1e-6, atol=1e-7)
