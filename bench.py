@@ -488,6 +488,100 @@ def bench_decode(batch: int = 8, prompt_len: int = 32, max_len: int = 544,
             "roofline_frac": round(ms_tok / roofline_ms, 2)}
 
 
+def bench_decode_continuous(num_slots: int = 8, n_requests: int = 32,
+                            page_size: int = 16,
+                            prompt_lens=(16, 96),
+                            new_tokens=(64, 256),
+                            d_model: int = 512, n_layers: int = 6,
+                            n_heads: int = 8, n_kv_heads: int = None,
+                            vocab_size: int = 32000,
+                            max_len: int = 544, seed: int = 0):
+    """Continuous-batching decode engine (serving/engine.py) on a
+    seeded RAGGED workload: n_requests with uniform-random prompt and
+    generation lengths, more requests than slots, so sequences join and
+    leave the running jitted step mid-flight (joins interleave prefill
+    with other slots' decoding; finished sequences free their KV pages
+    immediately).
+
+    Metrics: `tokens_per_sec` (generated tokens / wall), per-token
+    latency `ms` (p50 inter-token) + `p99_ms` + `ttft_p50_ms`, slot
+    utilization, KV-page high water, preemptions. `roofline_frac` is
+    throughput-based against the paged floor: every step reads all
+    params once plus each ACTIVE sequence's cache at its ACTUAL length
+    (the engine counts cache tokens read exactly) — a tighter floor
+    than the dense rows' worst-case max_len bound, so the same frac is
+    a stronger claim. The CPU smoke slice of this row runs in tier-1
+    (tests/test_paged_decode.py::TestBenchSmoke)."""
+    import time
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+    from paddle_tpu.serving import DecodeEngine
+
+    kv_h = n_kv_heads or n_heads
+    spec = models.transformer_lm(vocab_size=vocab_size, d_model=d_model,
+                                 n_heads=n_heads, n_layers=n_layers,
+                                 d_ff=4 * d_model, max_len=max_len,
+                                 n_kv_heads=n_kv_heads)
+    topo = paddle.Topology(spec.cost, extra_outputs=[spec.output])
+    params = topo.init_params(jax.random.PRNGKey(0))
+    from paddle_tpu.config import global_config
+    cdt = global_config().compute_dtype
+    if cdt != "float32":
+        params = {k: v.astype(cdt) for k, v in params.items()}
+    dec = models.TransformerDecoder(params, n_layers=n_layers,
+                                    n_heads=n_heads)
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, vocab_size,
+                           (int(rng.randint(*prompt_lens)),))
+               .astype("int32") for _ in range(n_requests)]
+    news = [int(rng.randint(*new_tokens)) for _ in range(n_requests)]
+    eng = DecodeEngine(dec, num_slots=num_slots, page_size=page_size,
+                       max_seq_len=max_len)
+    # one warm token compiles the step outside the timed window
+    eng.submit(prompts[0][:4], 1)
+    eng.run(timeout=600)
+    st0 = eng.stats()
+    t0 = time.perf_counter()
+    reqs = [eng.submit(p, n) for p, n in zip(prompts, news)]
+    eng.run(timeout=600)
+    dt = time.perf_counter() - t0
+    for r in reqs:
+        r.get(timeout=1)               # surface any typed failure
+    st = eng.stats()
+    gen = st["tokens_out"] - st0["tokens_out"]
+    steps = st["steps"] - st0["steps"]
+    cache_read = st["cache_tokens_read"] - st0["cache_tokens_read"]
+    active_steps = st["active_slot_steps"] - st0["active_slot_steps"]
+    util = active_steps / (steps * num_slots) if steps else 0.0
+    esize = 2 if cdt != "float32" else 4
+    param_bytes = sum(int(np.prod(v.shape))
+                      for v in params.values()) * esize
+    per_tok_cache = 2 * n_layers * (d_model // n_heads) * kv_h * esize
+    hbm_gb = (param_bytes * steps + cache_read * per_tok_cache) / 1e9
+    hbm_gbps = _device_hbm_gbps(jax.devices()[0]) or 819.0
+    roofline_s = hbm_gb / hbm_gbps
+    return {"ms": st["token_latency_p50_ms"],
+            "p99_ms": st["token_latency_p99_ms"],
+            "ttft_p50_ms": st["ttft_p50_ms"],
+            "tokens_per_sec": round(gen / dt, 1),
+            "new_tokens": gen, "tokens_out": gen,
+            "prefill_tokens": st["prefill_tokens"]
+            - st0["prefill_tokens"],
+            "requests": n_requests, "slots": num_slots,
+            "page_size": page_size,
+            "slot_utilization": round(util, 4),
+            "kv_page_high_water": st["kv_page_high_water"],
+            "preemptions": st["preemptions"] - st0["preemptions"],
+            "steps": steps,
+            "hbm_gb_total": round(hbm_gb, 4),
+            "hbm_gbps_assumed": hbm_gbps,
+            "roofline_bound": "hbm",
+            "roofline_frac": round(dt / roofline_s, 2)
+            if roofline_s > 0 else None}
+
+
 def bench_moe_lm(batch: int = 8, seq_len: int = 1024, d_model: int = 512,
                  n_layers: int = 6, experts: int = 8, iters: int = 10,
                  warmup: int = 3):
@@ -627,6 +721,26 @@ def main():
         suite["decode_bs32_gqa"] = _row(
             "decode_bs32_gqa",
             lambda: bench_decode(batch=32, n_kv_heads=2))
+        # continuous-batching engine rows (paged KV cache, ragged
+        # workload — serving/engine.py): the roofline_frac here is
+        # against the PAGED floor (actual cache lengths), the
+        # ROADMAP item-1 target of < 1.3 across bs 1/8/32
+        suite["decode_continuous_bs1"] = _row(
+            "decode_continuous_bs1",
+            lambda: bench_decode_continuous(num_slots=1, n_requests=6,
+                                            new_tokens=(64, 128)))
+        suite["decode_continuous_bs8"] = _row(
+            "decode_continuous_bs8",
+            lambda: bench_decode_continuous())
+        suite["decode_continuous_bs32"] = _row(
+            "decode_continuous_bs32",
+            lambda: bench_decode_continuous(num_slots=32,
+                                            n_requests=96))
+        suite["decode_continuous_bs32_gqa"] = _row(
+            "decode_continuous_bs32_gqa",
+            lambda: bench_decode_continuous(num_slots=32,
+                                            n_requests=96,
+                                            n_kv_heads=2))
         suite["moe_lm_bs8_t1024"] = _row(
             "moe_lm_bs8_t1024", lambda: bench_moe_lm(iters=half))
 

@@ -20,9 +20,10 @@ __all__ = [
     "convolution_net", "ngram_lm", "nmt_attention", "nmt_generator",
     "wide_and_deep", "movielens_regression", "crf_tagger", "rnn_crf_tagger",
     "transformer_lm", "transformer_encoder", "transformer_classifier",
-    "TransformerDecoder",
+    "TransformerDecoder", "PagedDecoder",
 ]
 from paddle_tpu.models.transformer import (transformer_lm,  # noqa: F401
                                            transformer_classifier,
                                            transformer_encoder)
-from paddle_tpu.models.decode import TransformerDecoder  # noqa: F401
+from paddle_tpu.models.decode import (PagedDecoder,  # noqa: F401
+                                      TransformerDecoder)

@@ -141,6 +141,13 @@ class TransformerDecoder:
                               v_cache.astype(q.dtype))
             attn = attn.reshape(x.shape)
         x = x + attn @ p[f"_{n}_l{i}_proj.w0"]
+        return self._ffn(p, i, x), k_cache, v_cache
+
+    def _ffn(self, p, i, x):
+        """ln2 + FFN (dense or MoE) + residual over [b, t, d] — shared
+        between the dense-cache block and the paged step (PagedDecoder),
+        so the two paths cannot drift numerically."""
+        n = self.name
         ln2 = _ln(x, p[f"_{n}_l{i}_ln2.w0"], p[f"_{n}_l{i}_ln2.wbias"])
         if f"_{n}_l{i}_moe.gate" in p:
             b_, t_, d_ = ln2.shape
@@ -174,7 +181,7 @@ class TransformerDecoder:
             up = jax.nn.relu(ln2 @ p[f"_{n}_l{i}_up.w0"]
                              + p[f"_{n}_l{i}_up.wbias"])
             x = x + up @ p[f"_{n}_l{i}_down.w0"]
-        return x, k_cache, v_cache
+        return x
 
     def _logits(self, p, x):
         n = self.name
@@ -438,6 +445,16 @@ class TransformerDecoder:
             out.append(rows[:n_keep])
         return out
 
+    def paged(self, *, num_slots: int, page_size: int,
+              num_pages: int, max_pages_per_slot: int,
+              temperature: Optional[float] = None) -> "PagedDecoder":
+        """A fixed-shape paged-KV decode step over this decoder's
+        parameter table (the serving engine's hot path)."""
+        return PagedDecoder(self, num_slots=num_slots,
+                            page_size=page_size, num_pages=num_pages,
+                            max_pages_per_slot=max_pages_per_slot,
+                            temperature=temperature)
+
     def generate(self, prompt, max_len: int,
                  temperature: Optional[float] = None,
                  rng: Optional[jax.Array] = None,
@@ -464,3 +481,130 @@ class TransformerDecoder:
             hit = np.where(row == eos_id)[0]
             rows.append(list(map(int, row[:hit[0] + 1] if len(hit) else row)))
         return rows
+
+
+class PagedDecoder:
+    """One fixed-shape, slot-batched decode step over a PAGED KV cache.
+
+    The dense-cache decoder above allocates a [b, max_len, g, dh] cache
+    PER REQUEST BATCH and marches the whole batch in lockstep — padding
+    every sequence's cache read to the longest, and recompiling per
+    (batch, prompt_len) combination. This class is the serving
+    replacement: K/V live in a shared preallocated POOL of fixed-size
+    pages ([L, n_pages, page_size, g, dh]); each slot of a fixed-size
+    slot batch owns a page-table row mapping its logical positions to
+    physical pages. Requests join and leave mid-flight by editing the
+    small int32 inputs (tokens / positions / page tables / active mask)
+    — the jitted step's shapes NEVER change, so continuous batching
+    costs zero recompiles (pinned by @recompile_budget in
+    tests/test_paged_decode.py).
+
+    Numerics are the dense path's, by construction: token embedding,
+    per-layer ln/q/k/v, the grouped-query einsum attention
+    (ops/pallas_decode.paged_attention runs the exact dense einsum over
+    the gathered page view), and the SHARED ``_ffn`` — so greedy paged
+    decode is token-identical to ``TransformerDecoder.generate``
+    (tests/test_paged_decode.py pins this on ragged,
+    page-boundary-straddling batches).
+
+    Scheduling (which slot holds which request, page alloc/free,
+    eviction) is host-side policy and lives in serving/engine.py; this
+    class is only the device step. Physical page 0 is RESERVED as the
+    null page: inactive slots write their (discarded) K/V there and
+    unassigned page-table entries point at it, which keeps the scatter
+    and gather unconditional — no shape-changing branches."""
+
+    def __init__(self, dense: TransformerDecoder, *, num_slots: int,
+                 page_size: int, num_pages: int,
+                 max_pages_per_slot: int,
+                 temperature: Optional[float] = None):
+        assert num_pages >= 2, "need at least the null page + one real"
+        assert max_pages_per_slot * page_size <= \
+            dense.p[f"_{dense.name}_pos_emb.w0"].shape[0], (
+            "slot capacity exceeds the position table — positions past "
+            "it would silently clamp to its last row")
+        self.dense = dense
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        self.temperature = temperature
+        n, h = dense.name, dense.n_heads
+        d = dense.p[f"_{n}_tok_emb.w0"].shape[1]
+        self.head_dim = d // h
+        self.kv_heads = dense.p[f"_{n}_l0_k.w0"].shape[1] // self.head_dim
+        self.dtype = dense.p[f"_{n}_tok_emb.w0"].dtype
+        # donating the pools lets XLA update pages in place (the pools
+        # ARE the device memory budget); the CPU backend has no donation
+        # and would warn on every dispatch
+        donate = () if jax.default_backend() == "cpu" else (1, 2)
+        self._step = jax.jit(self._step_impl, donate_argnums=donate)
+
+    def init_pools(self):
+        """Zeroed (k_pool, v_pool), each [L, n_pages, page_size, g, dh]."""
+        shape = (self.dense.n_layers, self.num_pages, self.page_size,
+                 self.kv_heads, self.head_dim)
+        return jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype)
+
+    def pool_bytes(self) -> int:
+        return 2 * int(jnp.dtype(self.dtype).itemsize) * \
+            self.dense.n_layers * self.num_pages * self.page_size * \
+            self.kv_heads * self.head_dim
+
+    def _paged_block(self, p, i, x, k_pool, v_pool, page_idx, offs,
+                     page_tables, kv_lens):
+        from paddle_tpu.ops import pallas_decode as paged_ops
+        d0 = self.dense
+        n, h = d0.name, d0.n_heads
+        ln1 = _ln(x, p[f"_{n}_l{i}_ln1.w0"], p[f"_{n}_l{i}_ln1.wbias"])
+        q = _heads(ln1 @ p[f"_{n}_l{i}_q.w0"], h)       # [S, 1, h, dh]
+        g = self.kv_heads
+        k = _heads(ln1 @ p[f"_{n}_l{i}_k.w0"], g)[:, 0]  # [S, g, dh]
+        v = _heads(ln1 @ p[f"_{n}_l{i}_v.w0"], g)[:, 0]
+        # unconditional scatter: every slot writes its current token's
+        # K/V at (physical page, in-page offset); inactive slots were
+        # routed to the null page by the caller
+        k_pool = k_pool.at[i, page_idx, offs].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[i, page_idx, offs].set(v.astype(v_pool.dtype))
+        attn = paged_ops.paged_attention(q[:, 0], k_pool[i], v_pool[i],
+                                         page_tables, kv_lens)
+        x = x + attn.reshape(x.shape) @ p[f"_{n}_l{i}_proj.w0"]
+        return d0._ffn(p, i, x), k_pool, v_pool
+
+    def _step_impl(self, p, k_pool, v_pool, tokens, positions,
+                   page_tables, active, key):
+        """tokens/positions/active [S]; page_tables [S, P] int32 ->
+        (next_tokens [S] int32, k_pool', v_pool')."""
+        d0 = self.dense
+        ps = self.page_size
+        x = d0._embed(p, tokens[:, None], positions[:, None])  # [S,1,d]
+        page_idx = jnp.take_along_axis(
+            page_tables, (positions // ps)[:, None], axis=1)[:, 0]
+        page_idx = jnp.where(active, page_idx, 0)       # null the dead
+        offs = positions % ps
+        kv_lens = positions + 1
+        for i in range(d0.n_layers):
+            x, k_pool, v_pool = self._paged_block(
+                p, i, x, k_pool, v_pool, page_idx, offs, page_tables,
+                kv_lens)
+        logits = d0._logits(p, x)[:, 0]                 # [S, V]
+        if self.temperature is None:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            nxt = jax.random.categorical(
+                key, logits.astype(jnp.float32) /
+                self.temperature).astype(jnp.int32)
+        return nxt, k_pool, v_pool
+
+    def step(self, k_pool, v_pool, tokens, positions, page_tables,
+             active, key=None):
+        """Dispatch one decode step (all arrays already device-shaped;
+        see _step_impl). Compiles exactly once for the engine's
+        lifetime — joins/evictions only change VALUES."""
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        return self._step(self.dense.p, k_pool, v_pool,
+                          jnp.asarray(tokens, jnp.int32),
+                          jnp.asarray(positions, jnp.int32),
+                          jnp.asarray(page_tables, jnp.int32),
+                          jnp.asarray(active, jnp.bool_), key)

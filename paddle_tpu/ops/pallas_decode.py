@@ -1,6 +1,12 @@
-"""Pallas decode attention — a RECORDED EXPERIMENT, not the live path.
+"""Decode attention: the paged serving path + a recorded Pallas
+experiment.
 
-Round-5 verdict: measured and REJECTED. The decode trace (docs/perf.md,
+:func:`paged_attention` (bottom) is LIVE — the continuous-batching
+engine's per-step attention over the paged KV pool. The Pallas kernel
+that opens this file is the round-5 recorded experiment it can compose
+with.
+
+Round-5 verdict on the kernel: measured and REJECTED. The decode trace (docs/perf.md,
 "the decode gap, traced") showed XLA lowering the per-step attention
 (q [b,h,dh] against cached K/V over T positions) to VPU multiply-reduce
 fusions at ~160 GB/s effective — the hypothesis was that a Pallas
@@ -24,7 +30,17 @@ kernel stays here, correct and parity-tested
 (tests/test_decode.py::TestPallasDecodeAttention), as the starting
 point if a future round wants to hand-tune the Mosaic lowering.
 
-Cache layout contract: [b, g, dh, T]."""
+Cache layout contract: [b, g, dh, T].
+
+Round 6 adds the LIVE serving path: :func:`paged_attention`, decode
+attention over a PAGED KV cache (fixed-size pages in a preallocated
+pool, per-sequence page tables — the PagedAttention design). The page
+gather produces the contiguous [b, T, g, dh] view and then runs the
+exact einsum formulation above (token-identical to the dense cache by
+construction, pinned in tests/test_paged_decode.py), or composes with
+the recorded-experiment kernel via ``use_kernel=True`` — both paths
+take PER-ROW kv lengths, which is what lets one fixed-shape jitted
+step serve ragged sequences (serving/engine.py)."""
 
 from __future__ import annotations
 
@@ -45,14 +61,16 @@ _VMEM_BYTES = 8 * 1024 * 1024
 
 def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *, scale,
                    rep):
-    kv_len = lens_ref[0]
     k = k_ref[...]                                    # [b, 1, dh, T]
     v = v_ref[...]
     b, _, dh, t = k.shape
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
     cols = jax.lax.broadcasted_iota(jnp.int32, (b, 1, 1, t), 3)
-    live = cols < kv_len
+    if lens_ref.shape[0] == 1:        # one shared length (dense decode)
+        live = cols < lens_ref[0]
+    else:                             # per-row lengths (ragged serving)
+        live = cols < lens_ref[...].reshape(b, 1, 1, 1)
     for r in range(rep):
         q = q_ref[:, r:r + 1].astype(jnp.float32)     # [b, 1, dh, 1]
         s2 = jnp.sum(q * kf, axis=2, keepdims=True) * (scale * LOG2E)
@@ -75,9 +93,10 @@ def decode_supported(q, k_cache) -> bool:
 def decode_attention(q, k_cache, v_cache, kv_len, *, scale=None,
                      interpret=False):
     """q [b, h, dh]; k_cache/v_cache [b, g, dh, T] with h % g == 0
-    (GQA: h == g*rep); kv_len: traced scalar — positions >= kv_len are
-    masked (decode calls always have the query at position kv_len-1, so
-    this IS the causal mask). Returns [b, h, dh]."""
+    (GQA: h == g*rep); kv_len: traced scalar (shared by every row) or a
+    per-row [b] vector — positions >= kv_len are masked (decode calls
+    always have each row's query at position kv_len-1, so this IS the
+    causal mask). Returns [b, h, dh]."""
     b, h, dh = q.shape
     g = k_cache.shape[1]
     t = k_cache.shape[-1]
@@ -86,14 +105,15 @@ def decode_attention(q, k_cache, v_cache, kv_len, *, scale=None,
     if scale is None:
         scale = dh ** -0.5
     q4 = q.reshape(b, h, dh, 1)
-    lens = jnp.asarray(kv_len, jnp.int32).reshape(1)
+    lens = jnp.asarray(kv_len, jnp.int32).reshape(-1)
+    assert lens.shape[0] in (1, b), (lens.shape, b)
 
     kernel = functools.partial(_decode_kernel, scale=scale, rep=rep)
     out = pl.pallas_call(
         kernel,
         grid=(g,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),            # lens [1]
+            pl.BlockSpec(memory_space=pltpu.SMEM),        # lens [1|b]
             pl.BlockSpec((b, rep, dh, 1), lambda j: (0, j, 0, 0)),
             pl.BlockSpec((b, 1, dh, t), lambda j: (0, j, 0, 0)),
             pl.BlockSpec((b, 1, dh, t), lambda j: (0, j, 0, 0)),
@@ -103,3 +123,62 @@ def decode_attention(q, k_cache, v_cache, kv_len, *, scale=None,
         interpret=interpret,
     )(lens, q4, k_cache, v_cache)
     return out.reshape(b, h, dh)
+
+
+# --------------------------------------------------------------- paged
+def gather_pages(pages, page_table):
+    """Contiguous per-sequence view of a paged pool: ``pages``
+    [n_pages, page_size, g, dh] gathered through ``page_table`` [b, P]
+    -> [b, P*page_size, g, dh]. Rows of the table beyond a sequence's
+    allocation point at the reserved null page (0); the caller's length
+    mask keeps those positions out of the softmax."""
+    b, pp = page_table.shape
+    _, ps, g, dh = pages.shape
+    return pages[page_table].reshape(b, pp * ps, g, dh)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, kv_lens, *,
+                    scale=None, use_kernel=False, interpret=False):
+    """Decode attention over a PAGED KV cache (the serving engine's hot
+    path — serving/engine.py).
+
+    q [b, h, dh]: one query token per sequence (slot batch);
+    k_pages/v_pages [n_pages, page_size, g, dh]: the shared page pools
+    (h % g == 0 — GQA reads the cache at stored width);
+    page_table [b, P] int32: each row maps the sequence's logical pages
+    to physical pages (entries past the allocation = the null page 0);
+    kv_lens [b] int32: per-row valid positions — position kv_lens[i]-1
+    is row i's query, so the mask is both the causal mask AND the
+    ragged-length mask. Returns [b, h, dh].
+
+    The gather materializes the same [b, T, g, dh] view the dense cache
+    stores, then runs models/decode.py's exact einsum formulation (the
+    measured optimum of five — docs/perf.md), so paged decode is
+    token-identical to the dense path. ``use_kernel=True`` instead
+    transposes the view into the [b, g, dh, T] contract and composes
+    with the :func:`decode_attention` GQA kernel."""
+    b, h, dh = q.shape
+    g = k_pages.shape[2]
+    assert h % g == 0, (h, g)
+    rep = h // g
+    if scale is None:
+        scale = dh ** -0.5
+    k = gather_pages(k_pages, page_table)              # [b, T, g, dh]
+    v = gather_pages(v_pages, page_table)
+    lens = jnp.asarray(kv_lens, jnp.int32).reshape(-1)
+    if use_kernel:
+        kt = k.transpose(0, 2, 3, 1)                   # [b, g, dh, T]
+        vt = v.transpose(0, 2, 3, 1)
+        return decode_attention(q, kt, vt, lens, scale=scale,
+                                interpret=interpret)
+    t = k.shape[1]
+    # identical formulation (einsum strings, mask value, softmax dtype
+    # path) to models/decode.py _block at t=1 — parity is structural
+    q5 = q.reshape(b, 1, g, rep, dh)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", q5,
+                        k.astype(q.dtype)) * scale
+    mask = jnp.arange(t)[None, :] < lens[:, None]      # [b, T]
+    logits = jnp.where(mask[:, None, None, None], logits, NEG_INF)
+    w = jax.nn.softmax(logits, axis=-1)
+    attn = jnp.einsum("bgrqk,bkgd->bqgrd", w, v.astype(q.dtype))
+    return attn.reshape(b, h, dh)

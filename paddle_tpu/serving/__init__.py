@@ -4,12 +4,19 @@ Admission-controlled serving over merged inference artifacts: bounded
 queue with backpressure, per-request deadlines, a sliding-window
 circuit breaker, graceful drain, and health/stats snapshots. The C-ABI
 twin of this discipline lives in paddle_tpu/capi_host.py (typed error
-codes, no exception crosses into C)."""
+codes, no exception crosses into C).
+
+Generation rides the continuous-batching decode engine
+(serving/engine.py): a fixed-shape jitted decode step over a paged KV
+cache, requests joining/leaving mid-flight, admission scheduled by free
+KV pages — docs/perf.md "Continuous batching"."""
 
 from paddle_tpu.serving.breaker import CircuitBreaker
-from paddle_tpu.serving.http import build_http_server
+from paddle_tpu.serving.engine import DecodeEngine, GenRequest, PagePool
+from paddle_tpu.serving.http import build_http_server, prometheus_text
 from paddle_tpu.serving.server import (Expired, InferenceServer, Rejected,
                                        ServerClosed, ServingError)
 
 __all__ = ["CircuitBreaker", "InferenceServer", "ServingError",
-           "Rejected", "Expired", "ServerClosed", "build_http_server"]
+           "Rejected", "Expired", "ServerClosed", "build_http_server",
+           "prometheus_text", "DecodeEngine", "GenRequest", "PagePool"]

@@ -1,0 +1,634 @@
+"""Continuous-batching decode engine over a paged KV cache.
+
+The dense-cache decoder (models/decode.py) serves generation the way
+2017 served everything: per-request-batch cache allocation, whole-batch
+lockstep, one compilation per shape. Under ragged production traffic
+that wastes the chip twice — short sequences pad to the longest, and a
+finished sequence's slot idles until the whole batch drains. This
+module is the production loop those papers (Orca's iteration-level
+scheduling, PagedAttention's block-pooled KV) built for serving LLMs:
+
+- ``PagePool``: a host-side free-list over preallocated device page
+  pools ([L, n_pages, page_size, g, dh] — models/decode.PagedDecoder).
+  KV memory is pooled across ALL requests in fixed-size pages, so
+  admission is a pages-free check, not a worst-case-length reservation.
+- ``DecodeEngine``: a persistent decode loop over a FIXED slot batch.
+  Each iteration feeds every active slot one token (prompt tokens
+  teacher-forced first — prefill interleaves with other slots'
+  decoding, no whole-batch barrier), dispatches ONE jitted step, and
+  does host-side bookkeeping: requests join free slots mid-flight,
+  finished/cancelled/expired requests free their pages immediately, and
+  page-pool exhaustion PREEMPTS the youngest request (pages back to the
+  pool, request re-queued; greedy decode replays prompt + generated
+  tokens, so its final output is unchanged). Joins/evictions only edit
+  small int32 inputs — the step never recompiles.
+- Admission control by FREE KV PAGES: a request that could never fit
+  the pool is rejected outright (``kv_capacity``); the queue head only
+  takes a slot when enough pages are free to reach its first new token;
+  the wait queue itself is bounded (``queue_full``).
+
+``stats()`` exports KV-page occupancy, slot utilization, per-token
+latency percentiles and the scheduling counters; serving/http.py
+re-exports them as Prometheus gauges on GET /metrics. Faults for the
+chaos suite (mid-decode join/evict/cancel, client disconnect) drive the
+``_step_interceptor`` seam — see testing/faults.py (j) and
+tests/test_serving_faults.py. docs/perf.md ("Continuous batching") has
+the measured before/after; docs/robustness.md the fault family.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from paddle_tpu.serving.server import (Expired, Rejected, ServerClosed,
+                                       ServingError)
+from paddle_tpu.utils.stats import global_counters, stat_timer
+
+
+class PagePool:
+    """Host-side allocator over the device page pools.
+
+    Physical page 0 is RESERVED as the null page (inactive slots write
+    there; unassigned page-table entries point there) and is never
+    handed out. ``free()`` double-free / foreign-page checks make page
+    leaks loud — the chaos suite asserts ``leaked == 0`` after every
+    fault storm."""
+
+    def __init__(self, num_pages: int):
+        assert num_pages >= 2, num_pages
+        self.num_pages = int(num_pages)
+        self.usable = self.num_pages - 1
+        # pop() hands out page 1 first — deterministic layouts in tests
+        self._free_list = list(range(self.num_pages - 1, 0, -1))
+        self._allocated: set = set()
+        self.high_water = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_list)
+
+    @property
+    def used_pages(self) -> int:
+        return len(self._allocated)
+
+    def alloc(self) -> Optional[int]:
+        if not self._free_list:
+            return None
+        p = self._free_list.pop()
+        self._allocated.add(p)
+        self.high_water = max(self.high_water, len(self._allocated))
+        return p
+
+    def free(self, pages) -> None:
+        for p in pages:
+            if p not in self._allocated:
+                raise ValueError(
+                    f"page {p} returned to the pool but not allocated "
+                    "— double free or foreign page id")
+            self._allocated.discard(p)
+            self._free_list.append(p)
+
+    def accounting(self) -> dict:
+        return {"total_usable": self.usable,
+                "free": self.free_pages,
+                "allocated": self.used_pages,
+                "leaked": self.usable - self.free_pages
+                - self.used_pages,
+                "high_water": self.high_water}
+
+
+class GenRequest:
+    """Future-like handle for one generation request.
+
+    ``get()`` blocks for completion and returns the generated token ids
+    (including the eos token when one stopped it). A CANCELLED request
+    (client disconnect) settles with the tokens generated so far — the
+    stream semantics. Deadline expiry / server shutdown settle with the
+    typed serving errors. ``cancel()`` is safe from any thread at any
+    time; the engine observes it at the next iteration and returns the
+    request's pages to the pool."""
+
+    def __init__(self, prompt, max_new_tokens: int,
+                 eos_id: Optional[int], deadline: Optional[float],
+                 now: float):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.deadline = deadline          # absolute time.monotonic()
+        self.tokens: List[int] = []
+        self.state = "waiting"  # waiting|running|done|cancelled|failed
+        self.error: Optional[ServingError] = None
+        self.done = threading.Event()
+        self.submitted_at = now
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.evictions = 0
+        self._cancelled = False
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.tokens)
+
+    def cancel(self) -> None:
+        self._cancelled = True
+
+    def get(self, timeout: Optional[float] = None) -> List[int]:
+        if timeout is None and self.deadline is not None:
+            timeout = max(self.deadline - time.monotonic(), 0.0) + 0.25
+        if not self.done.wait(timeout):
+            raise Expired("generation still in flight past its "
+                          "deadline/timeout")
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+
+class _Slot:
+    """Host bookkeeping for one occupied decode slot."""
+
+    __slots__ = ("req", "replay", "pos", "pages", "arrival",
+                 "last_tok", "last_token_t")
+
+    def __init__(self, req: GenRequest, arrival: int):
+        self.req = req
+        # prompt + already-generated tokens: teacher-forced back through
+        # the step on (re-)admission, so an evicted request's greedy
+        # continuation is exactly what it would have produced unevicted
+        self.replay = req.prompt + req.tokens
+        self.pos = 0                     # next position to feed
+        self.pages: List[int] = []
+        self.arrival = arrival
+        self.last_tok = 0
+        self.last_token_t: Optional[float] = None
+
+    def next_input(self) -> int:
+        if self.pos < len(self.replay):
+            return self.replay[self.pos]
+        return self.last_tok
+
+
+class DecodeEngine:
+    """Persistent continuous-batching decode loop (see module doc).
+
+    ``decoder`` is a models.TransformerDecoder (the dense reference
+    path); the engine builds its PagedDecoder twin over the same
+    parameter table. ``num_pages`` defaults to full capacity (every
+    slot can reach ``max_seq_len``) — size it SMALLER to serve more
+    slots than worst-case memory would allow and let preemption absorb
+    the tail. Construction is cheap; the single XLA compile happens on
+    the first step.
+
+    Drive it synchronously (``step()`` / ``run()`` — deterministic, the
+    test/bench mode) or as a background thread (``start()`` /
+    ``shutdown()`` — the serving mode; InferenceServer wires this)."""
+
+    def __init__(self, decoder, *, num_slots: int = 4,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 max_waiting: int = 64,
+                 temperature: Optional[float] = None,
+                 latency_window: int = 2048,
+                 clock: Callable[[], float] = time.monotonic):
+        pos_rows = decoder.p[f"_{decoder.name}_pos_emb.w0"].shape[0]
+        if max_seq_len is None:
+            max_seq_len = pos_rows
+        self.max_seq_len = min(int(max_seq_len), pos_rows)
+        self.page_size = int(page_size)
+        self.num_slots = int(num_slots)
+        pages_per_slot = -(-self.max_seq_len // self.page_size)
+        if num_pages is None:
+            num_pages = self.num_slots * pages_per_slot + 1
+        self.paged = decoder.paged(
+            num_slots=self.num_slots, page_size=self.page_size,
+            num_pages=int(num_pages),
+            max_pages_per_slot=pages_per_slot, temperature=temperature)
+        self.pool = PagePool(int(num_pages))
+        self.k_pool, self.v_pool = self.paged.init_pools()
+        self.max_waiting = int(max_waiting)
+        self.temperature = temperature
+        self._clock = clock
+        S, P = self.num_slots, pages_per_slot
+        self.slots: List[Optional[_Slot]] = [None] * S
+        self._tokens = np.zeros((S,), np.int32)
+        self._positions = np.zeros((S,), np.int32)
+        self._tables = np.zeros((S, P), np.int32)
+        self._active = np.zeros((S,), np.bool_)
+        self._waiting: deque = deque()
+        self._cv = threading.Condition()
+        self._accepting = True
+        self._stopping = False
+        self._close_now = False
+        self._thread: Optional[threading.Thread] = None
+        self._step_interceptor: Optional[Callable[[int], None]] = None
+        self._steps = 0
+        self._arrival_seq = 0
+        self._active_steps_sum = 0
+        self._cache_tokens_read = 0
+        self._lat: deque = deque(maxlen=int(latency_window))
+        self._ttft: deque = deque(maxlen=256)
+        self._counters = {"submitted": 0, "finished": 0, "cancelled": 0,
+                          "expired": 0, "preemptions": 0,
+                          "rejected_queue": 0, "rejected_capacity": 0,
+                          "closed": 0, "step_failures": 0,
+                          "tokens_out": 0, "prefill_tokens": 0}
+        import jax
+        self._key0 = jax.random.PRNGKey(0)
+
+    # ------------------------------------------------------------ admission
+    def _pages_for(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.page_size)
+
+    def _retry_hint(self) -> float:
+        lats = list(self._lat)
+        per_tok = (sum(lats) / len(lats)) if lats else 0.005
+        return max(per_tok * self.page_size, 0.01)
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               eos_id: Optional[int] = None,
+               deadline: Optional[float] = None) -> GenRequest:
+        """Admit one generation request. Raises the serving-typed
+        errors at admission (``Rejected`` reasons: ``kv_capacity`` for
+        a request the pool could NEVER hold, ``queue_full`` for a
+        saturated wait queue); the request itself settles with tokens
+        or a typed error."""
+        now = self._clock()
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt or int(max_new_tokens) < 1:
+            raise ValueError("need a non-empty prompt and "
+                             "max_new_tokens >= 1")
+        total = len(prompt) + int(max_new_tokens)
+        abs_deadline = (time.monotonic() + deadline) \
+            if deadline is not None else None
+        with self._cv:
+            if not self._accepting:
+                raise ServerClosed("decode engine is draining or "
+                                   "stopped")
+            if total > self.max_seq_len or \
+                    self._pages_for(total) > self.pool.usable:
+                self._counters["rejected_capacity"] += 1
+                raise Rejected(
+                    f"request needs {total} positions "
+                    f"({self._pages_for(total)} KV pages) but the "
+                    f"engine serves at most {self.max_seq_len} "
+                    f"positions / {self.pool.usable} pages — it can "
+                    "never be scheduled; shorten it",
+                    retry_after=0.0, reason="kv_capacity")
+            if len(self._waiting) >= self.max_waiting:
+                self._counters["rejected_queue"] += 1
+                retry = self._retry_hint()
+                raise Rejected(
+                    f"generation queue full ({self.max_waiting}); "
+                    f"retry in {retry:.2f}s", retry_after=retry,
+                    reason="queue_full")
+            req = GenRequest(prompt, max_new_tokens, eos_id,
+                             abs_deadline, now)
+            self._counters["submitted"] += 1
+            self._waiting.append(req)
+            self._cv.notify_all()
+        return req
+
+    # ------------------------------------------------------------ scheduling
+    def _settle(self, req: GenRequest, state: str,
+                error: Optional[ServingError] = None) -> None:
+        req.state = state
+        req.error = error
+        req.finished_at = self._clock()
+        req.done.set()
+
+    def _finish(self, s: int, state: str,
+                error: Optional[ServingError] = None) -> None:
+        """Release slot ``s``: pages back to the pool FIRST (the no-leak
+        invariant), then settle the request."""
+        slot = self.slots[s]
+        self.pool.free(slot.pages)
+        slot.pages = []
+        self._tables[s, :] = 0
+        self._active[s] = False
+        self._tokens[s] = 0
+        self._positions[s] = 0
+        self.slots[s] = None
+        counter = {"done": "finished", "cancelled": "cancelled",
+                   "failed": "failed", "closed": "closed"}.get(state)
+        if state == "done":
+            self._counters["finished"] += 1
+        elif state == "cancelled":
+            self._counters["cancelled"] += 1
+        elif isinstance(error, Expired):
+            self._counters["expired"] += 1
+        elif isinstance(error, ServerClosed):
+            self._counters["closed"] += 1
+        if counter:
+            global_counters.bump(f"serving/decode_{counter}")
+        self._settle(slot.req, state, error)
+        with self._cv:
+            self._cv.notify_all()
+
+    def _evict(self, s: int) -> None:
+        """Preempt slot ``s``: pages to the pool, request back to the
+        FRONT of the wait queue (it keeps its generated tokens and
+        replays them on re-admission — greedy output is unchanged)."""
+        slot = self.slots[s]
+        self.pool.free(slot.pages)
+        slot.pages = []
+        self._tables[s, :] = 0
+        self._active[s] = False
+        self.slots[s] = None
+        req = slot.req
+        req.state = "waiting"
+        req.evictions += 1
+        self._counters["preemptions"] += 1
+        global_counters.bump("serving/decode_preemptions")
+        with self._cv:
+            self._waiting.appendleft(req)
+
+    def _reap(self, now: float) -> None:
+        """Settle cancellations and deadline expiries — running slots
+        and waiting requests both."""
+        for s in range(self.num_slots):
+            slot = self.slots[s]
+            if slot is None:
+                continue
+            if slot.req._cancelled:
+                self._finish(s, "cancelled")
+            elif slot.req.deadline is not None and \
+                    now > slot.req.deadline:
+                self._finish(s, "failed", Expired(
+                    f"deadline passed after {slot.req.num_generated} "
+                    "generated tokens"))
+        with self._cv:
+            keep = deque()
+            for req in self._waiting:
+                if req._cancelled:
+                    self._counters["cancelled"] += 1
+                    self._settle(req, "cancelled")
+                elif req.deadline is not None and now > req.deadline:
+                    self._counters["expired"] += 1
+                    self._settle(req, "failed", Expired(
+                        "deadline passed while queued for a slot"))
+                else:
+                    keep.append(req)
+            self._waiting = keep
+
+    def _admit(self) -> None:
+        """Waiting -> free slots, gated on FREE PAGES: the queue head
+        takes a slot only when the pool can carry it to its first new
+        token (pages allocate lazily after that; preemption is the
+        backstop when concurrent growth outruns the pool)."""
+        with self._cv:
+            for s in range(self.num_slots):
+                if self.slots[s] is not None or not self._waiting:
+                    continue
+                req = self._waiting[0]
+                need_now = self._pages_for(len(req.prompt)
+                                           + len(req.tokens) + 1)
+                if need_now > self.pool.free_pages:
+                    break              # page-aware: head waits for pages
+                self._waiting.popleft()
+                req.state = "running"
+                self._arrival_seq += 1
+                self.slots[s] = _Slot(req, self._arrival_seq)
+
+    def _ensure_pages(self) -> None:
+        """Allocate each active slot's next page at its page boundary;
+        on pool exhaustion preempt the YOUNGEST slot (LIFO — oldest
+        requests keep their progress) until the allocation succeeds."""
+        for s in sorted(
+                (i for i in range(self.num_slots)
+                 if self.slots[i] is not None),
+                key=lambda i: self.slots[i].arrival):
+            slot = self.slots[s]
+            if slot is None:           # evicted by an earlier iteration
+                continue
+            while len(slot.pages) * self.page_size <= slot.pos:
+                page = self.pool.alloc()
+                if page is None:
+                    victims = sorted(
+                        (i for i in range(self.num_slots)
+                         if self.slots[i] is not None),
+                        key=lambda i: -self.slots[i].arrival)
+                    assert victims, "pool exhausted with no slot held"
+                    self._evict(victims[0])
+                    if self.slots[s] is None:
+                        break          # evicted ourselves
+                    continue
+                slot.pages.append(page)
+                self._tables[s, len(slot.pages) - 1] = page
+
+    # ------------------------------------------------------------- the loop
+    def step(self) -> bool:
+        """One engine iteration: reap, admit, page-ensure, ONE jitted
+        dispatch, bookkeep. Returns True iff a device step ran.
+        Single-threaded by contract: the engine thread in serving mode,
+        the caller in sync mode."""
+        interceptor = self._step_interceptor
+        if interceptor is not None:
+            interceptor(self._steps)
+        now = self._clock()
+        self._reap(now)
+        self._admit()
+        self._ensure_pages()
+        active_idx = [s for s in range(self.num_slots)
+                      if self.slots[s] is not None]
+        if not active_idx:
+            return False
+        self._active[:] = False
+        for s in active_idx:
+            slot = self.slots[s]
+            self._tokens[s] = slot.next_input()
+            self._positions[s] = slot.pos
+            self._active[s] = True
+        key = self._key0
+        if self.temperature is not None:
+            import jax
+            key = jax.random.fold_in(self._key0, self._steps)
+        try:
+            with stat_timer("serving/decode_step"):
+                nxt, self.k_pool, self.v_pool = self.paged.step(
+                    self.k_pool, self.v_pool, self._tokens,
+                    self._positions, self._tables, self._active, key)
+                nxt = np.asarray(nxt)  # the ONE host sync per step
+        # ptlint: disable=R7(serving boundary — in-flight requests settle typed and the pools rebuild; the engine thread must never die)
+        except Exception as e:
+            self._recover_from_step_failure(e)
+            return False
+        t_after = self._clock()
+        with self._cv:
+            self._steps += 1
+            self._active_steps_sum += len(active_idx)
+        for s in active_idx:
+            slot = self.slots[s]
+            fed = slot.pos
+            slot.pos += 1
+            with self._cv:
+                self._cache_tokens_read += slot.pos
+            if fed < len(slot.replay) - 1:
+                with self._cv:
+                    self._counters["prefill_tokens"] += 1
+                continue
+            tok = int(nxt[s])
+            req = slot.req
+            with self._cv:
+                if req.first_token_at is None:
+                    req.first_token_at = t_after
+                    self._ttft.append(t_after - req.submitted_at)
+                if slot.last_token_t is not None:
+                    self._lat.append(t_after - slot.last_token_t)
+                slot.last_token_t = t_after
+                req.tokens.append(tok)
+                slot.last_tok = tok
+                self._counters["tokens_out"] += 1
+            global_counters.bump("serving/decode_tokens")
+            if (req.eos_id is not None and tok == req.eos_id) or \
+                    req.num_generated >= req.max_new:
+                self._finish(s, "done")
+        return True
+
+    def _recover_from_step_failure(self, exc: Exception) -> None:
+        """A failed dispatch may have consumed the (donated) pools:
+        settle everything in flight with a typed error, then rebuild
+        pools + free-list so fresh traffic can still be served."""
+        with self._cv:
+            self._counters["step_failures"] += 1
+        err = ServingError(f"decode step failed: {exc}")
+        for s in range(self.num_slots):
+            if self.slots[s] is not None:
+                self._finish(s, "failed", err)
+        with self._cv:
+            while self._waiting:
+                self._settle(self._waiting.popleft(), "failed", err)
+        self.k_pool, self.v_pool = self.paged.init_pools()
+        self.pool = PagePool(self.pool.num_pages)
+        self._tables[:, :] = 0
+        self._active[:] = False
+
+    def _has_work(self) -> bool:
+        return any(s is not None for s in self.slots) or \
+            bool(self._waiting)
+
+    def run(self, timeout: float = 120.0) -> None:
+        """Synchronous drive: step until every submitted request has
+        settled (the deterministic test/bench mode)."""
+        deadline = time.monotonic() + timeout
+        while self._has_work():
+            self.step()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"engine did not drain within {timeout}s "
+                    f"({self.stats()})")
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "DecodeEngine":
+        with self._cv:
+            if self._thread is not None:
+                return self
+            self._stopping = False
+            self._accepting = True
+            t = threading.Thread(target=self._loop,
+                                 name="pt-serve-decode", daemon=True)
+            self._thread = t
+            t.start()
+        return self
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._close_now:
+                    break
+                if not self._has_work():
+                    if self._stopping:
+                        return
+                    self._cv.wait(0.05)
+                    continue
+            self.step()
+        self._close_all()
+
+    def _close_all(self) -> None:
+        """Settle everything in flight with ServerClosed and return
+        every page — runs on the STEPPING thread, so it never races a
+        dispatch."""
+        for s in range(self.num_slots):
+            if self.slots[s] is not None:
+                self._finish(s, "failed", ServerClosed(
+                    "engine shut down mid-generation"))
+        with self._cv:
+            while self._waiting:
+                req = self._waiting.popleft()
+                self._counters["closed"] += 1
+                self._settle(req, "failed", ServerClosed(
+                    "engine shut down before this request ran"))
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = 30.0) -> None:
+        """Stop accepting. With ``drain`` in-flight generation
+        completes; without it everything settles ServerClosed and the
+        pages return to the pool immediately."""
+        with self._cv:
+            self._accepting = False
+            self._close_now = self._close_now or not drain
+            self._stopping = True
+            self._cv.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
+            with self._cv:
+                self._thread = None
+        elif drain:
+            self.run(timeout=timeout if timeout is not None else 120.0)
+        else:
+            self._close_all()
+
+    # ------------------------------------------------------------ snapshots
+    @staticmethod
+    def _percentile(vals: List[float], q: float) -> float:
+        if not vals:
+            return 0.0
+        s = sorted(vals)
+        idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+        return s[idx]
+
+    def page_accounting(self) -> dict:
+        """Pool truth vs slot holdings — the chaos suite's no-leak
+        assertion reads ``leaked`` (== 0 always) and cross-checks
+        ``held_by_slots`` == ``allocated``."""
+        acc = self.pool.accounting()
+        acc["held_by_slots"] = sum(
+            len(s.pages) for s in self.slots if s is not None)
+        return acc
+
+    def stats(self) -> dict:
+        with self._cv:
+            counters = dict(self._counters)
+            lat = list(self._lat)
+            ttft = list(self._ttft)
+            waiting = len(self._waiting)
+            steps = self._steps
+            active_sum = self._active_steps_sum
+            cache_read = self._cache_tokens_read
+        active = sum(1 for s in self.slots if s is not None)
+        util = (active_sum / (steps * self.num_slots)) if steps else 0.0
+        out = dict(counters)
+        out.update({
+            "slots": self.num_slots,
+            "active_slots": active,
+            "waiting": waiting,
+            "slot_utilization": round(util, 4),
+            "kv_pages_total": self.pool.usable,
+            "kv_pages_free": self.pool.free_pages,
+            "kv_pages_used": self.pool.used_pages,
+            "kv_page_high_water": self.pool.high_water,
+            "page_size": self.page_size,
+            "steps": steps,
+            "active_slot_steps": active_sum,
+            "cache_tokens_read": cache_read,
+            "token_latency_p50_ms":
+                round(self._percentile(lat, 0.50) * 1e3, 3),
+            "token_latency_p99_ms":
+                round(self._percentile(lat, 0.99) * 1e3, 3),
+            "ttft_p50_ms": round(self._percentile(ttft, 0.50) * 1e3, 3),
+        })
+        return out

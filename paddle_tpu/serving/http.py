@@ -4,13 +4,26 @@ CLI's transport; stdlib-only so the serving path adds no dependency).
 Endpoints:
   GET  /health          -> InferenceServer.health()
   GET  /stats           -> InferenceServer.stats()
+  GET  /metrics         -> Prometheus text exposition of stats():
+                           serving counters/latency gauges plus, when a
+                           decode engine is attached, the KV-page and
+                           slot-utilization gauges a fleet scheduler
+                           acts on (ROADMAP item 5 observability)
   POST /infer           -> body {"rows": [[f32...], ...],
                                  "deadline_ms": optional}
                            200 {"outputs": [[...], ...]}
+  POST /generate        -> body {"prompt": [int...],
+                                 "max_new_tokens": int,
+                                 "eos_id": optional,
+                                 "deadline_ms": optional}
+                           200 {"tokens": [int...]} — routed through
+                           the continuous-batching decode engine
+                           (501 when no engine is attached)
 
 Admission failures map onto transport status codes:
   429 + Retry-After     queue full (backpressure)
-  503 + Retry-After     circuit breaker open (load shed) / draining
+  503 + Retry-After     circuit breaker open (load shed) / draining /
+                        KV pool can never hold the request
   504                   deadline expired
   400                   malformed payload
   500                   forward failed
@@ -25,6 +38,48 @@ import numpy as np
 
 from paddle_tpu.serving.server import (Expired, InferenceServer, Rejected,
                                        ServerClosed, ServingError)
+
+
+def _prom_lines(prefix: str, stats: dict, out, help_type):
+    """Flatten one stats dict into exposition lines. Counters (served,
+    rejected_*, tokens_out, ...) keep their cumulative semantics;
+    everything else numeric is a gauge. Nested dicts recurse with an
+    underscored prefix; non-numeric leaves are skipped."""
+    for key in sorted(stats):
+        val = stats[key]
+        name = f"{prefix}_{key}"
+        if isinstance(val, dict):
+            _prom_lines(name, val, out, help_type)
+            continue
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
+        kind = "counter" if key in _COUNTER_KEYS else "gauge"
+        if name not in help_type:
+            help_type[name] = kind
+            out.append(f"# TYPE {name} {kind}")
+        out.append(f"{name} {val}")
+
+
+_COUNTER_KEYS = {
+    # InferenceServer counters
+    "served", "rejected_full", "rejected_breaker", "rejected_oom",
+    "oom_events", "expired", "failed", "closed",
+    # DecodeEngine counters
+    "submitted", "finished", "cancelled", "preemptions",
+    "rejected_queue", "rejected_capacity", "step_failures",
+    "tokens_out", "prefill_tokens", "steps", "cache_tokens_read",
+    "trips",
+}
+
+
+def prometheus_text(server: InferenceServer,
+                    prefix: str = "paddle_tpu_serving") -> str:
+    """Render ``server.stats()`` (engine sub-dict included) as
+    Prometheus text exposition format, version 0.0.4."""
+    out: list = []
+    help_type: dict = {}
+    _prom_lines(prefix, server.stats(), out, help_type)
+    return "\n".join(out) + "\n"
 
 
 def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
@@ -52,10 +107,65 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 self._json(200, server.health())
             elif self.path == "/stats":
                 self._json(200, server.stats())
+            elif self.path == "/metrics":
+                body = prometheus_text(server).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 
+        def _do_generate(self):
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                prompt = req["prompt"]
+                if not isinstance(prompt, list) or not prompt:
+                    raise ValueError("prompt must be a non-empty list "
+                                     "of token ids")
+                max_new = int(req["max_new_tokens"])
+                eos_id = req.get("eos_id")
+                eos_id = int(eos_id) if eos_id is not None else None
+                deadline = req.get("deadline_ms")
+                deadline = float(deadline) / 1e3 \
+                    if deadline is not None else None
+            except (ValueError, KeyError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            if server.engine is None:
+                self._json(501, {"error": "no decode engine attached "
+                                          "to this server"})
+                return
+            try:
+                toks = server.generate(prompt, max_new,
+                                       eos_id=eos_id,
+                                       deadline=deadline)
+            except Rejected as e:
+                code = 429 if e.reason == "queue_full" else 503
+                self._json(code, {"error": str(e), "reason": e.reason,
+                                  "retry_after": e.retry_after},
+                           headers=[("Retry-After",
+                                     f"{max(e.retry_after, 0.01):.3f}")])
+                return
+            except Expired as e:
+                self._json(504, {"error": str(e)})
+                return
+            except ServerClosed as e:
+                self._json(503, {"error": str(e), "reason": "draining"})
+                return
+            except ServingError as e:
+                self._json(500, {"error": str(e)})
+                return
+            self._json(200, {"tokens": [int(t) for t in toks]})
+
         def do_POST(self):
+            if self.path == "/generate":
+                self._do_generate()
+                return
             if self.path != "/infer":
                 self._json(404, {"error": f"no route {self.path}"})
                 return

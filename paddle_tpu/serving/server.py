@@ -122,11 +122,19 @@ class InferenceServer:
                  breaker: Union[CircuitBreaker, None, bool] = None,
                  latency_window: int = 256,
                  max_batch_memory: Optional[int] = None,
+                 engine=None,
                  clock: Callable[[], float] = time.monotonic):
         if isinstance(model, (str, bytes)):
             from paddle_tpu.trainer.inference import load_inference_model
             model = load_inference_model(model)
         self._inf = model
+        # optional continuous-batching decode engine
+        # (serving/engine.DecodeEngine): generate() routes through its
+        # page-aware admission — requests are scheduled by FREE KV
+        # PAGES, not queue depth — and stats()/metrics export its
+        # KV-page/slot gauges. start()/shutdown() manage its loop
+        # thread alongside the forward workers.
+        self.engine = engine
         self.max_queue = int(max_queue)
         self.num_workers = max(1, int(workers))
         self.default_deadline = default_deadline
@@ -169,12 +177,16 @@ class InferenceServer:
                                      daemon=True)
                 t.start()
                 self._threads.append(t)
+        if self.engine is not None:
+            self.engine.start()
         return self
 
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = 30.0) -> None:
         """Stop accepting. With ``drain`` the queued requests complete
         first; without it they fail with ServerClosed immediately."""
+        if self.engine is not None:
+            self.engine.shutdown(drain=drain, timeout=timeout)
         with self._cv:
             self._accepting = False
             if not drain:
@@ -249,6 +261,33 @@ class InferenceServer:
     def infer(self, samples, deadline: Optional[float] = None):
         """Synchronous submit + wait."""
         return self.submit(samples, deadline).get()
+
+    # --------------------------------------------------------- generation
+    def submit_generate(self, prompt, max_new_tokens: int, *,
+                        eos_id: Optional[int] = None,
+                        deadline: Optional[float] = None):
+        """Admit one generation request into the continuous-batching
+        decode engine (requires ``engine=``). Admission is the ENGINE's
+        — scheduled by free KV pages, with the same typed errors as
+        ``submit`` (``Rejected`` reasons ``kv_capacity``/``queue_full``,
+        ``ServerClosed`` when draining). Returns the engine's
+        future-like GenRequest (``.get()`` / ``.cancel()``)."""
+        if self.engine is None:
+            raise ServingError(
+                "no decode engine attached — construct the server "
+                "with engine=DecodeEngine(...)")
+        if deadline is None:
+            deadline = self.default_deadline
+        return self.engine.submit(prompt, max_new_tokens,
+                                  eos_id=eos_id, deadline=deadline)
+
+    def generate(self, prompt, max_new_tokens: int, *,
+                 eos_id: Optional[int] = None,
+                 deadline: Optional[float] = None):
+        """Synchronous submit_generate + wait -> generated token ids."""
+        return self.submit_generate(prompt, max_new_tokens,
+                                    eos_id=eos_id,
+                                    deadline=deadline).get()
 
     def _retry_hint(self) -> float:
         lats = list(self._latencies)
@@ -398,6 +437,8 @@ class InferenceServer:
             "breaker": self.breaker.snapshot()
             if self.breaker is not None else None,
         })
+        if self.engine is not None:
+            out["engine"] = self.engine.stats()
         return out
 
     # convenience for HTTP clients sending raw dense rows

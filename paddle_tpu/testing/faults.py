@@ -36,6 +36,20 @@ and, for the serving path (docs/robustness.md "Serving"):
   (g) destroy a C-ABI handle mid-request (``destroy_during``) and fire
       request BURSTS from a thread pool (``burst``) for overload tests;
 
+and, for the continuous-batching decode engine (docs/robustness.md
+"Decode engine"):
+
+  (j) run a deterministic SCHEDULE of scheduler events against a live
+      engine — join/cancel/evict/shutdown at exact engine-step indices
+      (``decode_script`` over the engine's ``_step_interceptor`` seam,
+      so the event lands between two jitted dispatches exactly where a
+      concurrent client's action would) — and CANCEL a generation
+      request once it has streamed a chosen number of tokens from
+      another thread (``disconnect_after`` — the
+      client-disconnect-during-generation fault). The invariant every
+      one of these must preserve: KV pages ALWAYS return to the pool
+      (engine.page_accounting()["leaked"] == 0);
+
 and, for the data pipeline (docs/robustness.md "Data pipeline"):
 
   (h) HANG or SLOW a source at chosen sample indices (``hung_reader`` —
@@ -403,6 +417,62 @@ class FaultPlan:
         finally:
             pool.shutdown(wait=False)
         return results, errors
+
+    # ------------------------------------------ (j) decode engine
+    @staticmethod
+    @contextlib.contextmanager
+    def decode_script(engine, at: Dict[int, Callable]):
+        """Within the context, run ``at[i]()`` immediately BEFORE the
+        engine's ``i``-th step dispatches (0-based, counted from
+        entering the context — a warmed engine replays the same script
+        at the same offsets) — the deterministic twin of a client
+        submitting/cancelling mid-decode or an operator forcing an
+        eviction. Actions run on the engine's stepping thread via the
+        ``_step_interceptor`` seam, so they interleave with the jitted
+        step exactly like real scheduler events: between dispatches,
+        never during one. Yields a stats dict (``fired``: indices that
+        ran)."""
+        actions = {int(i): fn for i, fn in at.items()}
+        stats = {"fired": []}
+        prev = engine._step_interceptor
+        base = engine._steps
+
+        def intercept(step):
+            if prev is not None:
+                prev(step)
+            fn = actions.get(step - base)
+            if fn is not None:
+                stats["fired"].append(step - base)
+                fn()
+
+        engine._step_interceptor = intercept
+        try:
+            yield stats
+        finally:
+            engine._step_interceptor = prev
+
+    @staticmethod
+    def disconnect_after(request, n_tokens: int,
+                         poll_s: float = 0.002,
+                         timeout: float = 60.0) -> threading.Thread:
+        """Cancel ``request`` from another thread once it has streamed
+        ``n_tokens`` generated tokens — a client that consumed part of
+        the stream and disconnected mid-generation. The engine must
+        observe the cancellation at its next step, return every page to
+        the pool, and leave the other in-flight sequences token-exact.
+        Returns the (started) thread; join it."""
+        def run():
+            deadline = time.time() + timeout
+            while (request.num_generated < n_tokens
+                   and not request.done.is_set()
+                   and time.time() < deadline):
+                time.sleep(poll_s)
+            request.cancel()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="pt-fault-disconnect")
+        t.start()
+        return t
 
     # --------------------------------------------- (h) data pipeline
     @staticmethod

@@ -7,6 +7,13 @@ deadlocks, only typed errors at the boundary, and the circuit breaker
 opens under fault and recovers (serves successfully) after the faults
 stop. Faults come from paddle_tpu.testing.FaultPlan (e)-(g); every
 test is @chaos so a wedge dumps all thread stacks (tests/conftest.py).
+
+Round 6 adds the DECODE-ENGINE chaos family (FaultPlan (j), ISSUE 6):
+mid-decode joins/evictions/cancellations and client disconnects
+against the continuous-batching engine. The invariant every fault must
+preserve: KV pages ALWAYS return to the pool (zero leaks), and
+sequences that were not faulted stay TOKEN-IDENTICAL to undisturbed
+runs.
 """
 
 import threading
@@ -15,10 +22,13 @@ import time
 import numpy as np
 import pytest
 
+import jax
 import paddle_tpu as paddle
-from paddle_tpu.serving import (CircuitBreaker, Expired, InferenceServer,
-                                Rejected, ServerClosed, ServingError,
-                                build_http_server)
+from paddle_tpu import models
+from paddle_tpu.serving import (CircuitBreaker, DecodeEngine, Expired,
+                                InferenceServer, Rejected, ServerClosed,
+                                ServingError, build_http_server,
+                                prometheus_text)
 from paddle_tpu.testing import FaultPlan
 from paddle_tpu.trainer.inference import Inference
 
@@ -31,6 +41,22 @@ def tiny_inference(dim=8, out=4, seed=5):
     o = paddle.layer.fc(x, size=out, act=paddle.activation.Softmax())
     params = paddle.create_parameters(paddle.Topology(o))
     return Inference(output_layer=o, parameters=params)
+
+
+DEC_CFG = dict(vocab_size=40, d_model=16, n_heads=2, n_layers=2,
+               d_ff=32, max_len=32)
+
+
+def tiny_decoder(seed=7):
+    paddle.init(use_tpu=False, seed=0)
+    from paddle_tpu.core.registry import reset_name_counters
+    reset_name_counters()
+    spec = models.transformer_lm(**DEC_CFG)
+    costs = spec.cost if isinstance(spec.cost, list) else [spec.cost]
+    topo = paddle.Topology(costs, extra_outputs=[spec.output])
+    params = topo.init_params(jax.random.PRNGKey(seed))
+    return models.TransformerDecoder(params, n_layers=DEC_CFG["n_layers"],
+                                     n_heads=DEC_CFG["n_heads"])
 
 
 def samples(batch=2, dim=8, seed=0):
@@ -346,6 +372,271 @@ class TestHTTPFront:
                 assert False, "expected HTTPError"
             except urllib.error.HTTPError as e:
                 assert e.code == 400
+        finally:
+            httpd.shutdown()
+            srv.shutdown(drain=True)
+
+
+class TestDecodeEngineChaos:
+    """Continuous-batching engine under scheduler chaos (FaultPlan (j)):
+    joins, cancellations and evictions land mid-decode; pages must
+    always return to the pool and unfaulted sequences stay
+    token-identical to undisturbed runs."""
+
+    def test_mid_decode_join_and_cancel_pages_return(self):
+        dec = tiny_decoder()
+        rng = np.random.RandomState(0)
+        p0 = rng.randint(0, 40, (4,)).astype("int32")
+        p1 = rng.randint(0, 40, (6,)).astype("int32")
+        p2 = rng.randint(0, 40, (5,)).astype("int32")
+        # undisturbed references for the two requests that will SURVIVE
+        want1 = dec.generate(p1[None, :], max_len=6 + 8)[0]
+        want2 = dec.generate(p2[None, :], max_len=5 + 7)[0]
+
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=DEC_CFG["max_len"])
+        r0 = eng.submit(p0, 14)
+        joined = []
+        with FaultPlan.decode_script(eng, {
+                2: lambda: joined.append(eng.submit(p1, 8)),
+                4: lambda: joined.append(eng.submit(p2, 7)),
+                6: lambda: r0.cancel()}) as script:
+            eng.run(timeout=300)
+        assert script["fired"] == [2, 4, 6]
+        # the cancelled stream settles with its partial tokens
+        assert r0.state == "cancelled"
+        assert 0 < r0.num_generated < 14
+        assert r0.get(timeout=1) == r0.tokens
+        # the survivors are token-identical to solo runs
+        assert joined[0].get(timeout=1) == [int(t) for t in want1]
+        assert joined[1].get(timeout=1) == [int(t) for t in want2]
+        acc = eng.page_accounting()
+        assert acc["leaked"] == 0 and acc["free"] == acc["total_usable"]
+        st = eng.stats()
+        assert st["cancelled"] == 1 and st["finished"] == 2
+
+    def test_eviction_storm_under_tiny_pool_no_leaks(self):
+        """Pool pressure forces repeated preemption while requests keep
+        arriving mid-flight; every request still completes exactly, and
+        the pool balances to fully free."""
+        dec = tiny_decoder()
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(0, 40, (int(rng.randint(3, 7)),))
+                   .astype("int32") for _ in range(5)]
+        news = [int(rng.randint(6, 12)) for _ in range(5)]
+        want = [dec.generate(p[None, :], max_len=len(p) + n)[0]
+                for p, n in zip(prompts, news)]
+        # 2 slots x up to ~5 pages of demand against 6 usable pages
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=20, num_pages=7)
+        reqs = [eng.submit(p, n) for p, n in zip(prompts, news)]
+        eng.run(timeout=300)
+        for i, r in enumerate(reqs):
+            assert r.get(timeout=1) == [int(t) for t in want[i]], i
+        acc = eng.page_accounting()
+        assert acc["leaked"] == 0 and acc["free"] == acc["total_usable"]
+
+    def test_client_disconnect_during_generation(self):
+        """A client that walks away mid-stream (disconnect_after): the
+        engine cancels at its next step, frees the pages, and the other
+        in-flight sequence is token-identical to a solo run."""
+        dec = tiny_decoder()
+        rng = np.random.RandomState(2)
+        pa = rng.randint(0, 40, (4,)).astype("int32")
+        pb = rng.randint(0, 40, (5,)).astype("int32")
+        want_b = dec.generate(pb[None, :], max_len=5 + 10)[0]
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=DEC_CFG["max_len"]).start()
+        try:
+            ra = eng.submit(pa, 20)
+            rb = eng.submit(pb, 10)
+            killer = FaultPlan.disconnect_after(ra, 4)
+            assert rb.get(timeout=120) == [int(t) for t in want_b]
+            killer.join(60)
+            assert not killer.is_alive()
+            ra.done.wait(60)
+            assert ra.state == "cancelled"
+            assert ra.num_generated >= 4
+        finally:
+            eng.shutdown(drain=True, timeout=60)
+        acc = eng.page_accounting()
+        assert acc["leaked"] == 0 and acc["free"] == acc["total_usable"]
+        assert eng.stats()["cancelled"] == 1
+
+    def test_burst_overload_typed_rejections_only(self):
+        """A thread-pool burst against a small engine: every submit
+        either serves exactly or sheds with a typed Rejected; zero
+        untyped errors, zero deadlocks, zero page leaks."""
+        dec = tiny_decoder()
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=20, max_waiting=3).start()
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 40, (int(rng.randint(3, 7)),))
+                   .astype("int32") for _ in range(16)]
+
+        def one(i):
+            return eng.submit(prompts[i], 4).get(timeout=120)
+
+        try:
+            results, errors = FaultPlan.burst(one, 16, threads=6,
+                                              timeout=120)
+        finally:
+            eng.shutdown(drain=True, timeout=60)
+        served = sum(r is not None for r in results)
+        rejected = [e for e in errors if isinstance(e, Rejected)]
+        other = [e for e in errors
+                 if e is not None and not isinstance(e, Rejected)]
+        assert other == []
+        assert served + len(rejected) == 16
+        assert served >= 1
+        for i, r in enumerate(results):
+            if r is not None:
+                assert len(r) == 4, i
+        assert all(e.reason == "queue_full" and e.retry_after > 0
+                   for e in rejected)
+        acc = eng.page_accounting()
+        assert acc["leaked"] == 0 and acc["free"] == acc["total_usable"]
+
+    def test_deadline_and_shutdown_are_typed(self):
+        dec = tiny_decoder()
+        eng = DecodeEngine(dec, num_slots=1, page_size=4,
+                           max_seq_len=20)
+        blocker = eng.submit(np.zeros((3,), "int32"), 10)
+        doomed = eng.submit(np.zeros((3,), "int32"), 10,
+                            deadline=0.0)            # expired on arrival
+        for _ in range(3):
+            eng.step()
+        with pytest.raises(Expired):
+            doomed.get(timeout=5)
+        # drainless shutdown: in-flight settles ServerClosed, pages back
+        eng.shutdown(drain=False)
+        with pytest.raises(ServerClosed):
+            blocker.get(timeout=5)
+        with pytest.raises(ServerClosed):
+            eng.submit(np.zeros((3,), "int32"), 2)
+        acc = eng.page_accounting()
+        assert acc["leaked"] == 0 and acc["free"] == acc["total_usable"]
+        assert eng.stats()["expired"] == 1
+
+
+class TestServerEngineIntegration:
+    """InferenceServer with an attached DecodeEngine: generate() routes
+    through page-aware admission, stats() carries the KV/slot gauges,
+    and /metrics exposes them in Prometheus text format."""
+
+    def _server(self):
+        dec = tiny_decoder()
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=DEC_CFG["max_len"])
+        srv = InferenceServer(tiny_inference(), max_queue=8, workers=1,
+                              breaker=False, engine=eng).start()
+        return dec, eng, srv
+
+    def test_generate_and_engine_stats(self):
+        dec, eng, srv = self._server()
+        try:
+            prompt = np.zeros((3,), "int32")
+            want = dec.generate(prompt[None, :], max_len=3 + 6)[0]
+            got = srv.generate(prompt, 6, deadline=60.0)
+            assert got == [int(t) for t in want]
+            st = srv.stats()
+            assert st["engine"]["finished"] == 1
+            assert st["engine"]["kv_pages_total"] > 0
+            assert st["engine"]["kv_pages_free"] == \
+                st["engine"]["kv_pages_total"]
+        finally:
+            srv.shutdown(drain=True)
+        # shutdown drained the engine thread too
+        assert eng.stats()["finished"] == 1
+        with pytest.raises(ServerClosed):
+            srv.generate(np.zeros((3,), "int32"), 2)
+
+    def test_prometheus_metrics_text(self):
+        dec, eng, srv = self._server()
+        try:
+            srv.infer(samples())
+            srv.generate(np.zeros((3,), "int32"), 4, deadline=60.0)
+            text = prometheus_text(srv)
+        finally:
+            srv.shutdown(drain=True)
+        assert "# TYPE paddle_tpu_serving_served counter" in text
+        assert "paddle_tpu_serving_served 1" in text
+        assert "# TYPE paddle_tpu_serving_engine_kv_pages_free gauge" \
+            in text
+        assert "paddle_tpu_serving_engine_tokens_out 4" in text
+        assert "paddle_tpu_serving_engine_slot_utilization" in text
+        assert "paddle_tpu_serving_engine_token_latency_p99_ms" in text
+        # every line is exposition-format: comment or "name value"
+        for line in text.strip().splitlines():
+            assert line.startswith("# TYPE ") or \
+                len(line.split(" ")) == 2, line
+
+    def test_http_generate_and_metrics_endpoints(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        dec, eng, srv = self._server()
+        httpd = build_http_server(srv, "127.0.0.1", 0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                             name="pt-test-httpd")
+        t.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            prompt = [0, 0, 0]
+            want = dec.generate(np.asarray(prompt, "int32")[None, :],
+                                max_len=3 + 5)[0]
+            req = urllib.request.Request(
+                base + "/generate",
+                data=json.dumps({"prompt": prompt,
+                                 "max_new_tokens": 5,
+                                 "deadline_ms": 60000}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                body = json.loads(r.read())
+            assert body["tokens"] == [int(x) for x in want]
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+            assert "paddle_tpu_serving_engine_finished 1" in text
+            # malformed generate payload is a 400
+            bad = urllib.request.Request(
+                base + "/generate", data=b'{"prompt": []}',
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(bad, timeout=10)
+                assert False, "expected HTTPError"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            httpd.shutdown()
+            srv.shutdown(drain=True)
+
+    def test_http_generate_without_engine_is_501(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        srv = InferenceServer(tiny_inference(), max_queue=4, workers=1,
+                              breaker=False).start()
+        httpd = build_http_server(srv, "127.0.0.1", 0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                             name="pt-test-httpd-2")
+        t.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"prompt": [1],
+                                 "max_new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                assert False, "expected HTTPError"
+            except urllib.error.HTTPError as e:
+                assert e.code == 501
         finally:
             httpd.shutdown()
             srv.shutdown(drain=True)
