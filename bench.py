@@ -626,13 +626,175 @@ def bench_moe_lm(batch: int = 8, seq_len: int = 1024, d_model: int = 512,
                     extra_flops=3.0 * attn_fwd)
 
 
+#: rows of the CPU smoke tier; tools/bench_gate.py gates them against
+#: BENCH_SMOKE_BASELINE.json in tier-1 (docs/observability.md)
+SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine")
+
+
+def _smoke_trainer(batch: int = 16):
+    """A CPU-trivial 2-layer classifier — the smoke tier measures the
+    FRAMEWORK's step machinery (compiles, host syncs, dispatch), not
+    the model."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.registry import reset_name_counters
+    reset_name_counters()
+    x = paddle.layer.data("smoke_x", paddle.data_type.dense_vector(16))
+    y = paddle.layer.data("smoke_y", paddle.data_type.integer_value(4))
+    h = paddle.layer.fc(x, size=8, act=paddle.activation.Relu(),
+                        name="smoke_h")
+    out = paddle.layer.fc(h, size=4, act=paddle.activation.Softmax(),
+                          name="smoke_prob")
+    cost = paddle.layer.classification_cost(out, y, name="smoke_cost")
+    params = paddle.create_parameters(paddle.Topology(cost))
+    trainer = paddle.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(learning_rate=1e-3,
+                                                  momentum=0.9))
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(16).astype("float32"), int(rng.randint(0, 4)))
+            for _ in range(batch)]
+    return trainer, data
+
+
+def _smoke_decoder():
+    """Tiny transformer decoder (the serving chaos suite's shape) for
+    the continuous-batching engine row."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+    from paddle_tpu.core.registry import reset_name_counters
+    reset_name_counters()
+    spec = models.transformer_lm(vocab_size=40, d_model=16, n_heads=2,
+                                 n_layers=2, d_ff=32, max_len=32)
+    costs = spec.cost if isinstance(spec.cost, list) else [spec.cost]
+    topo = paddle.Topology(costs, extra_outputs=[spec.output])
+    params = topo.init_params(jax.random.PRNGKey(7))
+    return models.TransformerDecoder(params, n_layers=2, n_heads=2)
+
+
+def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
+                decode_requests: int = 5, rows=SMOKE_ROWS,
+                force_recompile_per_step: bool = False) -> dict:
+    """The CPU smoke tier of the perf regression gate (ROADMAP item 5).
+
+    Deliberately two-faced: COUNT metrics (XLA compiles via
+    compile_watch, host syncs per step via host_sync_watch — both
+    analysis/sanitizer.py) are deterministic and gated tightly, while
+    TIMING metrics (steps/s, serving p50/p99, engine tokens/s) carry
+    loose machine-to-machine tolerances and only catch order-of-
+    magnitude regressions. ``tools/bench_gate.py`` compares the result
+    against the committed BENCH_SMOKE_BASELINE.json; the tier-1 test
+    (tests/test_bench_gate.py) runs both an untouched pass and a
+    forced-recompile-per-step injection that must FAIL the gate.
+
+    ``force_recompile_per_step`` is that injection seam: it rebuilds
+    the jitted train step every iteration — the classic shape-drift /
+    jit-in-loop regression ptlint R2 lints for, reproduced at runtime.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis.sanitizer import compile_watch, \
+        host_sync_watch
+
+    paddle.init(seed=0)
+    out = {}
+    if "train_tiny" in rows:
+        trainer, data = _smoke_trainer()
+        with compile_watch() as cw, host_sync_watch() as hs:
+            trainer.train_batch(data)           # compile + warm
+            syncs0 = hs.total
+            t0 = time.perf_counter()
+            for _ in range(train_steps):
+                if force_recompile_per_step:
+                    trainer._train_step = trainer._build_train_step()
+                trainer.train_batch(data)
+            dt = time.perf_counter() - t0
+        out["train_tiny"] = {
+            "steps_per_s": round(train_steps / dt, 2),
+            "step_compiles": cw.total,
+            "host_syncs_per_step": round(
+                (hs.total - syncs0) / train_steps, 3),
+        }
+    if "serving_infer" in rows:
+        from paddle_tpu.serving import InferenceServer
+        from paddle_tpu.trainer.inference import Inference
+        from paddle_tpu.core.registry import reset_name_counters
+        reset_name_counters()
+        import paddle_tpu as _p
+        x = _p.layer.data("smoke_sx", _p.data_type.dense_vector(8))
+        o = _p.layer.fc(x, size=4, act=_p.activation.Softmax(),
+                        name="smoke_sprob")
+        inf = Inference(output_layer=o,
+                        parameters=_p.create_parameters(_p.Topology(o)))
+        rng = np.random.RandomState(0)
+        reqs = [(rng.randn(8).astype("float32"),) for _ in range(2)]
+        srv = InferenceServer(inf, max_queue=64, workers=2,
+                              breaker=False).start()
+        try:
+            srv.infer(reqs)                     # compile + warm
+            for _ in range(serve_requests):
+                srv.infer(reqs)
+            st = srv.stats()
+        finally:
+            srv.shutdown(drain=True)
+        out["serving_infer"] = {
+            "p50_ms": st["p50_ms"],
+            "p99_ms": st["p99_ms"],
+            "served": st["served"],
+        }
+    if "decode_engine" in rows:
+        from paddle_tpu.analysis.sanitizer import compile_watch as _cwf
+        from paddle_tpu.serving import DecodeEngine
+        dec = _smoke_decoder()
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 40, (int(rng.randint(3, 8)),))
+                   .astype("int32") for _ in range(decode_requests)]
+        news = [int(rng.randint(4, 12)) for _ in range(decode_requests)]
+        with _cwf() as cw:
+            eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                               max_seq_len=32)
+            eng.submit(prompts[0][:2], 1)       # compile + warm
+            eng.run(timeout=300)
+            st0 = eng.stats()
+            t0 = time.perf_counter()
+            for p, n in zip(prompts, news):
+                eng.submit(p, n)
+            eng.run(timeout=300)
+            dt = time.perf_counter() - t0
+        st = eng.stats()
+        gen = st["tokens_out"] - st0["tokens_out"]
+        out["decode_engine"] = {
+            "tokens_per_s": round(gen / dt, 1),
+            "token_p50_ms": st["token_latency_p50_ms"],
+            "decode_compiles": cw.total,
+            "steps": st["steps"] - st0["steps"],
+            "tokens_out": gen,
+        }
+    return {"v": 1, "suite": "smoke", "rows": out}
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--suite", default="all", choices=["headline", "all"])
+    ap.add_argument("--suite", default="all",
+                    choices=["headline", "all", "smoke"])
     ap.add_argument("--dtype", default="bfloat16",
                     choices=["bfloat16", "float32"])
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--out", default=None,
+                    help="also write the result JSON to this path "
+                         "(the smoke tier's hand-off to "
+                         "tools/bench_gate.py)")
     args = ap.parse_args()
+
+    if args.suite == "smoke":
+        # CPU smoke tier: f32, tiny shapes, count metrics — the perf
+        # regression gate's input (tools/bench_gate.py)
+        res = bench_smoke()
+        blob = json.dumps(res)
+        print(blob)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(blob + "\n")
+        return 0
 
     import jax
     import paddle_tpu as paddle

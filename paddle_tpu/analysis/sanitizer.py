@@ -27,7 +27,8 @@ import re
 from typing import Dict, Iterator, List, Optional, Tuple
 
 __all__ = ["CompileBudgetExceeded", "CompileWatch", "compile_watch",
-           "find_tracers", "no_leaked_tracers"]
+           "find_tracers", "no_leaked_tracers", "HostSyncWatch",
+           "host_sync_watch"]
 
 
 class CompileBudgetExceeded(AssertionError):
@@ -147,6 +148,58 @@ def no_leaked_tracers() -> Iterator[None]:
         yield
     finally:
         jax.config.update("jax_check_tracer_leaks", prev)
+
+
+class HostSyncWatch:
+    """Device->host synchronization counts observed while the watch was
+    active (a PROXY: it counts ``jax.device_get`` and
+    ``jax.block_until_ready`` calls through the ``jax`` module
+    attributes — the repo's own host-sync funnel, SGD._fetch_host —
+    not implicit syncs like ``float(arr)`` on a pre-bound reference).
+    The smoke bench tier (bench.py) gates syncs-per-step on it: a
+    change that starts syncing per microbatch instead of per step
+    shows up as a count regression, the docs/perf.md 'One host sync
+    per step' discipline made enforceable."""
+
+    def __init__(self):
+        self.per_kind: Dict[str, int] = {}
+
+    def _record(self, kind: str) -> None:
+        self.per_kind[kind] = self.per_kind.get(kind, 0) + 1
+
+    @property
+    def total(self) -> int:
+        return sum(self.per_kind.values())
+
+    def count(self, kind: str) -> int:
+        return self.per_kind.get(kind, 0)
+
+
+@contextlib.contextmanager
+def host_sync_watch() -> Iterator[HostSyncWatch]:
+    """Count explicit host syncs within the block (see HostSyncWatch
+    for what is and is not counted). Nest-safe: restores the previous
+    ``jax`` attributes on exit."""
+    import jax
+    watch = HostSyncWatch()
+    orig_get = jax.device_get
+    orig_block = jax.block_until_ready
+
+    def counting_get(*a, **kw):
+        watch._record("device_get")
+        return orig_get(*a, **kw)
+
+    def counting_block(*a, **kw):
+        watch._record("block_until_ready")
+        return orig_block(*a, **kw)
+
+    jax.device_get = counting_get
+    jax.block_until_ready = counting_block
+    try:
+        yield watch
+    finally:
+        jax.device_get = orig_get
+        jax.block_until_ready = orig_block
 
 
 def find_tracers(obj, _path: str = "value", _seen=None, _depth: int = 6

@@ -444,6 +444,21 @@ def _cmd_diagram(args) -> int:
     return 0
 
 
+def _cmd_events(args) -> int:
+    """`paddle_tpu events tail` — the incident-response verb: newest
+    journal records (schema-validated, filtered) as JSON lines
+    (docs/observability.md)."""
+    from paddle_tpu.obs.events import read_journal
+    if not os.path.exists(args.log):
+        raise SystemExit(f"no journal at {args.log!r}")
+    recs = [r for r in read_journal(args.log, strict=False)
+            if (args.domain is None or r["domain"] == args.domain)
+            and (args.kind is None or r["kind"] == args.kind)]
+    for r in recs[-max(args.n, 0):]:
+        print(json.dumps(r))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="paddle_tpu",
@@ -514,6 +529,17 @@ def main(argv=None) -> int:
     tr.add_argument("--init_model_path", default=None,
                     help="params.tar to start from")
     tr.add_argument("--log_period", type=int, default=100)
+    tr.add_argument("--metrics_port", type=int, default=None,
+                    help="expose GET /metrics (Prometheus) + /events "
+                         "on this port for the whole run so training "
+                         "fleets are scrapeable (0 picks a free port, "
+                         "printed as JSON; omit to disable — "
+                         "docs/observability.md)")
+    tr.add_argument("--event_log", default=None,
+                    help="append the structured event journal (faults, "
+                         "OOMs, data faults, checkpoints — schema v1 "
+                         "JSONL) to this file; inspect with "
+                         "`paddle_tpu events tail --log FILE`")
     tr.add_argument("--profile_dir", default=None,
                     help="--job=profile trace output dir "
                          "(default ./profile_out)")
@@ -564,8 +590,28 @@ def main(argv=None) -> int:
                     help="failure fraction that opens the breaker")
     sv.add_argument("--breaker_cooldown", type=float, default=2.0,
                     help="seconds open before half-open probes")
+    sv.add_argument("--event_log", default=None,
+                    help="append the structured event journal (sheds, "
+                         "breaker flips, engine preemptions) to this "
+                         "JSONL file; the ring is always served on "
+                         "GET /events")
 
     sub.add_parser("version", help="print version (paddle version parity)")
+
+    evp = sub.add_parser("events", help="inspect a structured event "
+                         "journal (docs/observability.md)")
+    evp.add_argument("action", choices=["tail"],
+                     help="tail: print the newest records as JSON lines")
+    evp.add_argument("--log", required=True,
+                     help="journal JSONL file (train/serve --event_log)")
+    evp.add_argument("-n", type=int, default=20, dest="n",
+                     help="how many records (newest last)")
+    evp.add_argument("--domain", default=None,
+                     help="filter: trainer|data|serving|engine|"
+                          "checkpoint")
+    evp.add_argument("--kind", default=None,
+                     help="filter: oom, quarantine, shed, preemption, "
+                          "...")
 
     ln = sub.add_parser("lint", help="JAX-aware static analysis "
                         "(ptlint — docs/static_analysis.md)")
@@ -609,9 +655,14 @@ def main(argv=None) -> int:
         return _cmd_infer(args)
     if args.command == "diagram":
         return _cmd_diagram(args)
+    if args.command == "events":
+        return _cmd_events(args)
     if args.command == "coordinator":
         return _cmd_coordinator(args)
     if args.command == "serve":
+        if args.event_log:
+            from paddle_tpu.obs.events import JOURNAL
+            JOURNAL.configure(args.event_log)
         return _cmd_serve(args)
     if args.command == "version":
         import paddle_tpu
@@ -628,18 +679,38 @@ def main(argv=None) -> int:
     paddle.init(use_tpu=args.use_tpu, trainer_count=args.trainer_count,
                 seed=args.seed, compute_dtype=args.dtype,
                 log_period=args.log_period)
-    ns = _load_config(args.config)
-    trainer = _build_trainer(ns, args.init_model_path)
-    if args.job == "time":
-        return _job_time(trainer, args.batch_size, args.iters,
-                         args.seq_len)
-    if args.job == "test":
-        return _job_test(trainer, ns)
-    if args.job == "checkgrad":
-        return _job_checkgrad(trainer, ns, args)
-    if args.job == "profile":
-        return _job_profile(trainer, args)
-    return _job_train(trainer, ns, args)
+    # observability wiring (docs/observability.md): the event journal's
+    # file sink and the standalone /metrics + /events endpoint cover
+    # the WHOLE run, whichever --job it is
+    from paddle_tpu.obs.events import JOURNAL
+    if args.event_log:
+        JOURNAL.configure(args.event_log)
+    obs_httpd = None
+    if args.metrics_port is not None:
+        from paddle_tpu.obs.httpd import start_obs_server
+        obs_httpd = start_obs_server(port=args.metrics_port)
+        print(json.dumps({"job": "obs", "status": "serving",
+                          "metrics_port": obs_httpd.server_address[1]}),
+              flush=True)
+    JOURNAL.emit("trainer", "run_start", job=args.job,
+                 config=args.config)
+    try:
+        ns = _load_config(args.config)
+        trainer = _build_trainer(ns, args.init_model_path)
+        if args.job == "time":
+            return _job_time(trainer, args.batch_size, args.iters,
+                             args.seq_len)
+        if args.job == "test":
+            return _job_test(trainer, ns)
+        if args.job == "checkgrad":
+            return _job_checkgrad(trainer, ns, args)
+        if args.job == "profile":
+            return _job_profile(trainer, args)
+        return _job_train(trainer, ns, args)
+    finally:
+        JOURNAL.emit("trainer", "run_end", job=args.job)
+        if obs_httpd is not None:
+            obs_httpd.shutdown()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,51 @@
+"""paddle_tpu.obs — the unified observability layer.
+
+One place the whole framework reports through (docs/observability.md):
+
+- :mod:`paddle_tpu.obs.metrics` — thread-safe metrics registry
+  (counters / gauges / histograms with labels) with ONE Prometheus
+  text exposition path; absorbs ``utils/stats`` and the serving
+  ``stats()`` plumbing.
+- :mod:`paddle_tpu.obs.events`  — versioned-schema structured event
+  journal (JSONL file + in-memory ring): faults, OOMs, data faults,
+  quarantines, sheds, breaker flips, preemptions, checkpoints.
+- :mod:`paddle_tpu.obs.trace`   — host-side step tracing with Chrome
+  trace export and XLA-compile instants.
+- :mod:`paddle_tpu.obs.httpd`   — standalone /metrics + /events
+  endpoint for trainer/coordinator processes.
+
+The perf regression gate rides on the same layer: ``bench.py``'s smoke
+tier measures through ``compile_watch`` / ``host_sync_watch``
+(analysis/sanitizer.py) and ``tools/bench_gate.py`` enforces
+``BENCH_SMOKE_BASELINE.json`` in tier-1.
+"""
+
+from paddle_tpu.obs.events import (JOURNAL, EventJournal, emit,  # noqa: F401
+                                   emit_event, read_journal, tail,
+                                   validate)
+from paddle_tpu.obs.httpd import (build_obs_http_server,  # noqa: F401
+                                  start_obs_server)
+from paddle_tpu.obs.metrics import (REGISTRY, MetricsRegistry,  # noqa: F401
+                                    stats_families)
+from paddle_tpu.obs.trace import TRACER, Tracer, span  # noqa: F401
+
+__all__ = [
+    "REGISTRY", "MetricsRegistry", "stats_families",
+    "JOURNAL", "EventJournal", "emit", "emit_event", "tail",
+    "read_journal", "validate",
+    "TRACER", "Tracer", "span",
+    "build_obs_http_server", "start_obs_server",
+    "reset_all",
+]
+
+
+def reset_all() -> None:
+    """Zero every observability surface (registry values, journal ring
+    + sink, tracer, utils/stats counters/timers) — the between-tests
+    hygiene hook (tests/conftest.py autouse fixture)."""
+    from paddle_tpu.utils.stats import global_counters, global_stat
+    REGISTRY.reset()
+    JOURNAL.reset()
+    TRACER.reset()
+    global_counters.reset()
+    global_stat.reset()

@@ -1,0 +1,253 @@
+"""Structured event journal — one queryable record of everything that
+went wrong (and the recoveries that followed).
+
+Before this module every fault stream had its own shape: FaultEvent /
+OOMEvent / DataFaultEvent objects through the trainer's event handler,
+breaker state inside ``stats()``, preemptions as counters, checkpoint
+saves as log lines. A chaos run or a production incident had no single
+artifact to query. Now every fault-ish event flows through ONE
+versioned-schema sink:
+
+- a per-process JSONL file (``JOURNAL.configure(path)`` — CLI
+  ``train --event_log`` / ``serve --event_log``), one JSON object per
+  line, append-only, crash-tolerant (a torn final line is skipped by
+  the reader);
+- an in-memory ring (``tail()``) served over HTTP as ``GET /events``
+  on both the serving front (serving/http.py) and the standalone
+  observability endpoint (obs/httpd.py), and by the CLI
+  ``paddle_tpu events tail``.
+
+Schema v1 — every record carries:
+
+    v       int     schema version (1)
+    ts      float   unix seconds
+    seq     int     per-process monotonic sequence number
+    pid     int     emitting process
+    domain  str     trainer | data | serving | engine | checkpoint
+    kind    str     e.g. nonfinite, rollback, oom, quarantine,
+                    data_budget, source_stall, worker_restart,
+                    restart_budget, shed, breaker, preemption,
+                    step_failure, save, restore, run_start, run_end
+
+plus free-form kind-specific fields (JSON scalars; non-serializable
+values are repr()'d at emit time). docs/observability.md catalogs the
+kinds per domain. Emission must NEVER take down a hot path: file-write
+failures are counted and warned once, not raised.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Iterator, List, Optional, Tuple
+
+from paddle_tpu.utils.logging import get_logger
+
+__all__ = ["SCHEMA_VERSION", "REQUIRED_FIELDS", "EventJournal", "JOURNAL",
+           "emit", "emit_event", "tail", "validate", "read_journal"]
+
+SCHEMA_VERSION = 1
+REQUIRED_FIELDS = ("v", "ts", "seq", "pid", "domain", "kind")
+
+
+def _jsonable(v):
+    """Clamp one field value to something json.dumps accepts."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    return repr(v)
+
+
+def validate(rec: dict) -> dict:
+    """Raise ValueError unless ``rec`` is a schema-valid v1 record;
+    returns it unchanged so readers can chain."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"event record must be an object, got "
+                         f"{type(rec).__name__}")
+    missing = [k for k in REQUIRED_FIELDS if k not in rec]
+    if missing:
+        raise ValueError(f"event record missing required fields "
+                         f"{missing}: {rec!r}")
+    if int(rec["v"]) != SCHEMA_VERSION:
+        raise ValueError(f"unknown event schema version {rec['v']!r} "
+                         f"(this reader speaks v{SCHEMA_VERSION})")
+    for key in ("domain", "kind"):
+        if not isinstance(rec[key], str) or not rec[key]:
+            raise ValueError(f"event {key!r} must be a non-empty "
+                             f"string: {rec!r}")
+    for key in ("ts",):
+        if not isinstance(rec[key], (int, float)):
+            raise ValueError(f"event {key!r} must be numeric: {rec!r}")
+    for key in ("seq", "pid"):
+        if not isinstance(rec[key], int):
+            raise ValueError(f"event {key!r} must be an int: {rec!r}")
+    return rec
+
+
+class EventJournal:
+    """Thread-safe ring + optional JSONL file sink (see module doc)."""
+
+    def __init__(self, ring_size: int = 2048):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(ring_size))
+        self._seq = 0
+        self._fh = None
+        self._path: Optional[str] = None
+        self._write_errors = 0
+
+    @property
+    def path(self) -> Optional[str]:
+        with self._lock:
+            return self._path
+
+    def configure(self, path: Optional[str]) -> None:
+        """Attach (or with ``None`` detach) the JSONL file sink. The
+        file opens append-mode so a resumed run extends its journal."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+            self._path = path
+            if path:
+                d = os.path.dirname(os.path.abspath(path))
+                os.makedirs(d, exist_ok=True)
+                self._fh = open(path, "a", encoding="utf-8")
+
+    def emit(self, domain: str, kind: str, **fields) -> dict:
+        """Build, ring-buffer, and (when configured) persist one
+        record. Never raises into the caller's hot path — a failed
+        file write is counted and warned once."""
+        rec = {"v": SCHEMA_VERSION, "ts": time.time(),
+               "pid": os.getpid(), "domain": str(domain),
+               "kind": str(kind)}
+        for k, v in fields.items():
+            if k not in rec:
+                rec[k] = _jsonable(v)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+            if self._fh is not None:
+                try:
+                    self._fh.write(json.dumps(rec) + "\n")
+                    self._fh.flush()
+                except (OSError, ValueError):
+                    self._write_errors += 1
+                    if self._write_errors == 1:
+                        get_logger().warning(
+                            "event journal write to %s failed; further "
+                            "failures counted silently "
+                            "(journal/write_errors)", self._path)
+        return rec
+
+    def emit_event(self, event) -> dict:
+        """Journal a trainer-event object (FaultEvent / OOMEvent /
+        DataFaultEvent — trainer/event.py) in its canonical shape."""
+        domain, kind, fields = record_fields(event)
+        return self.emit(domain, kind, **fields)
+
+    def tail(self, n: int = 100, domain: Optional[str] = None,
+             kind: Optional[str] = None) -> List[dict]:
+        """Newest-last slice of the in-memory ring, optionally
+        filtered."""
+        with self._lock:
+            recs = list(self._ring)
+        if domain is not None:
+            recs = [r for r in recs if r["domain"] == domain]
+        if kind is not None:
+            recs = [r for r in recs if r["kind"] == kind]
+        return recs[-int(n):]
+
+    @property
+    def write_errors(self) -> int:
+        with self._lock:
+            return self._write_errors
+
+    def reset(self) -> None:
+        """Detach the sink and clear the ring (between-tests hygiene —
+        tests/conftest.py)."""
+        self.configure(None)
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self._write_errors = 0
+
+
+#: the process-global journal every subsystem emits through
+JOURNAL = EventJournal()
+
+
+def emit(domain: str, kind: str, **fields) -> dict:
+    return JOURNAL.emit(domain, kind, **fields)
+
+
+def emit_event(event) -> dict:
+    return JOURNAL.emit_event(event)
+
+
+def tail(n: int = 100, domain: Optional[str] = None,
+         kind: Optional[str] = None) -> List[dict]:
+    return JOURNAL.tail(n, domain=domain, kind=kind)
+
+
+def record_fields(event) -> Tuple[str, str, dict]:
+    """(domain, kind, fields) for a trainer-event object. Import is
+    function-level so obs never becomes a hard import edge into the
+    trainer package."""
+    from paddle_tpu.trainer import event as evt
+    if isinstance(event, evt.OOMEvent):
+        return "trainer", "oom", {
+            "pass_id": event.pass_id, "batch_id": event.batch_id,
+            "microbatch": event.microbatch,
+            "accum_steps": event.accum_steps,
+            "error": _err_str(event.error)}
+    if isinstance(event, evt.DataFaultEvent):
+        return "data", event.kind, {
+            "count": event.count, "where": event.where,
+            "error": _err_str(event.error)}
+    if isinstance(event, evt.FaultEvent):
+        return "trainer", event.kind, {
+            "pass_id": event.pass_id, "batch_id": event.batch_id,
+            "bad_streak": event.bad_streak,
+            "restored_step": event.restored_step}
+    return "trainer", type(event).__name__, {
+        k: _jsonable(v) for k, v in vars(event).items()
+        if not k.startswith("_")}
+
+
+def _err_str(e) -> Optional[str]:
+    return None if e is None else repr(e)[:400]
+
+
+def read_journal(path: str, strict: bool = True) -> Iterator[dict]:
+    """Yield schema-validated records from a JSONL journal file. A torn
+    FINAL line (the process died mid-write) is always skipped; any
+    other malformed line raises with ``strict`` and is skipped with a
+    warning otherwise."""
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            yield validate(json.loads(line))
+        except (json.JSONDecodeError, ValueError) as e:
+            if i == len(lines) - 1:
+                get_logger().warning(
+                    "journal %s: skipping torn final line", path)
+                return
+            if strict:
+                raise ValueError(
+                    f"{path}:{i + 1}: malformed journal record: {e}"
+                ) from e
+            get_logger().warning("journal %s:%d: skipping malformed "
+                                 "record: %s", path, i + 1, e)

@@ -1,0 +1,86 @@
+"""Standalone observability endpoint for non-serving processes.
+
+The serving front already exposes GET /metrics and /events
+(serving/http.py); trainers and coordinators had nothing — a fleet
+scheduler could see the decode engine but not the training job next to
+it. This is the missing piece: a tiny stdlib HTTP server any process
+can start (CLI ``train --metrics_port``) exposing
+
+  GET /metrics   Prometheus text exposition of the global registry
+                 (obs/metrics.py — trainer, data-pipeline, fault and
+                 decode-engine domains via the utils/stats bridge)
+  GET /events    the event journal's in-memory ring as JSON
+                 (?n=100&domain=...&kind=... filters)
+  GET /health    {"status": "ok"} liveness probe
+
+Scrape handlers only READ snapshots; they never touch the train step.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+__all__ = ["build_obs_http_server", "start_obs_server"]
+
+
+def build_obs_http_server(host: str = "127.0.0.1",
+                          port: int = 0) -> ThreadingHTTPServer:
+    """Bound (not yet serving) observability HTTP server; port 0 picks
+    a free one (see ``.server_address``). Caller runs
+    ``.serve_forever()`` (usually on a thread) and ``.shutdown()``."""
+    from paddle_tpu.obs.events import JOURNAL
+    from paddle_tpu.obs.metrics import REGISTRY
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):       # scrapes are not news
+            pass
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            if url.path == "/metrics":
+                body = REGISTRY.exposition().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif url.path == "/events":
+                qs = parse_qs(url.query)
+                try:
+                    n = int(qs.get("n", ["100"])[0])
+                except ValueError:
+                    self._json(400, {"error": "n must be an integer"})
+                    return
+                self._json(200, {"events": JOURNAL.tail(
+                    n, domain=qs.get("domain", [None])[0],
+                    kind=qs.get("kind", [None])[0])})
+            elif url.path == "/health":
+                self._json(200, {"status": "ok"})
+            else:
+                self._json(404, {"error": f"no route {url.path}"})
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def start_obs_server(host: str = "127.0.0.1",
+                     port: int = 0) -> ThreadingHTTPServer:
+    """Build + serve on a daemon thread (named ``pt-obs-http`` per the
+    thread-hygiene convention). Returns the server; the bound port is
+    ``server.server_address[1]``; stop with ``server.shutdown()``."""
+    httpd = build_obs_http_server(host, port)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="pt-obs-http")
+    t.start()
+    return httpd

@@ -1,0 +1,258 @@
+"""Step tracing — nested host-side spans exportable as Chrome trace
+JSON, with XLA compile events attached.
+
+``jax.profiler`` already produces device-side XPlane traces
+(tools/xplane_top.py); what it cannot show is the HOST schedule a
+production trainer or decode engine lives or dies by — where the step
+loop waits on data, how long a checkpoint write holds its thread, when
+a compile lands in the middle of serving traffic. This tracer records
+exactly that:
+
+- ``span(name)`` context managers build a per-thread stack (spans know
+  their parent), recording wall-clock start/duration;
+- every ``utils.stats.stat_timer`` scope automatically becomes a span
+  while a trace is active — so ``train_step``, ``train/data_wait``,
+  ``checkpoint/write``, ``serving/forward`` and
+  ``serving/decode_step`` all show up with zero per-site wiring;
+- ``start(capture_compiles=True)`` additionally captures JAX's compile
+  log stream (the same ``jax_log_compiles`` capture
+  analysis/sanitizer.py's compile_watch uses) as instant events, so a
+  recompile appears AT its position in the timeline;
+- ``chrome_trace()`` / ``save(path)`` emit the ``traceEvents`` JSON
+  chrome://tracing and Perfetto load directly.
+
+Overhead when idle is one attribute check per stat_timer scope; the
+tracer is OFF by default and meant for bounded windows (a few steps),
+not always-on production use — spans accumulate in memory.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Tracer", "TRACER", "span", "instant"]
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanCtx:
+    __slots__ = ("_tracer", "name", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._tracer._push(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer._pop(self.name, self._t0,
+                          time.perf_counter(), self.args)
+        return False
+
+
+class _CompileLogHandler(logging.Handler):
+    """Captures 'Compiling <name> ...' records as instant events (the
+    regex is shared with analysis/sanitizer.py's compile_watch)."""
+
+    def __init__(self, tracer: "Tracer"):
+        super().__init__(level=logging.DEBUG)
+        self._tracer = tracer
+
+    def emit(self, record: logging.LogRecord) -> None:
+        from paddle_tpu.analysis.sanitizer import _COMPILE_RE
+        try:
+            msg = record.getMessage()
+        except Exception:                    # defensive: logging contract
+            return
+        m = _COMPILE_RE.match(msg)
+        if m is None or not msg.startswith("Compiling"):
+            return
+        self._tracer.instant("xla_compile", function=m.group(1))
+
+
+class Tracer:
+    """See module doc. start()/stop() bound a trace window; span() and
+    instant() are no-ops outside one."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.enabled = False
+        self._spans: List[dict] = []
+        self._instants: List[dict] = []
+        self._handler: Optional[_CompileLogHandler] = None
+        self._log_state = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, capture_compiles: bool = True) -> "Tracer":
+        with self._lock:
+            if self.enabled:
+                return self
+            self._spans = []
+            self._instants = []
+            self.enabled = True
+        if capture_compiles:
+            self._arm_compile_capture()
+        return self
+
+    def stop(self) -> "Tracer":
+        self._disarm_compile_capture()
+        with self._lock:
+            self.enabled = False
+        return self
+
+    def reset(self) -> None:
+        self.stop()
+        with self._lock:
+            self._spans = []
+            self._instants = []
+
+    def _arm_compile_capture(self) -> None:
+        import jax
+        handler = _CompileLogHandler(self)
+        jlog = logging.getLogger("jax")
+        prev_flag = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+        # keep JAX's own stream handler quiet for the window, exactly
+        # like compile_watch does (the records are WARNING level)
+        muted = [(h, h.level) for h in jlog.handlers]
+        for h, _ in muted:
+            h.setLevel(logging.ERROR)
+        jlog.addHandler(handler)
+        prev_propagate = jlog.propagate
+        jlog.propagate = False
+        with self._lock:
+            self._handler = handler
+            self._log_state = (prev_flag, muted, prev_propagate)
+
+    def _disarm_compile_capture(self) -> None:
+        with self._lock:
+            handler, state = self._handler, self._log_state
+            self._handler = None
+            self._log_state = None
+        if handler is None:
+            return
+        import jax
+        prev_flag, muted, prev_propagate = state
+        jlog = logging.getLogger("jax")
+        jlog.removeHandler(handler)
+        for h, lvl in muted:
+            h.setLevel(lvl)
+        jlog.propagate = prev_propagate
+        jax.config.update("jax_log_compiles", prev_flag)
+
+    # ------------------------------------------------------------ recording
+    def _stack(self) -> List[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    def _push(self, name: str) -> None:
+        self._stack().append(name)
+
+    def _pop(self, name: str, t0: float, t1: float, args: dict) -> None:
+        st = self._stack()
+        if st and st[-1] == name:
+            st.pop()
+        parent = st[-1] if st else None
+        rec = {"name": name, "t0": t0, "t1": t1, "parent": parent,
+               "tid": threading.get_ident(),
+               "thread": threading.current_thread().name}
+        if args:
+            rec["args"] = args
+        with self._lock:
+            if self.enabled:
+                self._spans.append(rec)
+
+    def span(self, name: str, **args):
+        """Context manager; a shared no-op object when tracing is off
+        (the hot-path cost of an inactive tracer is this one check)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _SpanCtx(self, name, args)
+
+    def instant(self, name: str, **args) -> None:
+        if not self.enabled:
+            return
+        st = self._stack()
+        rec = {"name": name, "t": time.perf_counter(),
+               "parent": st[-1] if st else None,
+               "tid": threading.get_ident(),
+               "thread": threading.current_thread().name}
+        if args:
+            rec["args"] = args
+        with self._lock:
+            if self.enabled:
+                self._instants.append(rec)
+
+    # -------------------------------------------------------------- export
+    def spans(self) -> List[dict]:
+        with self._lock:
+            return list(self._spans)
+
+    def instants(self) -> List[dict]:
+        with self._lock:
+            return list(self._instants)
+
+    def chrome_trace(self) -> Dict[str, list]:
+        """The chrome://tracing / Perfetto ``traceEvents`` format:
+        complete events (ph "X") for spans, instants (ph "i") for
+        compile events, microsecond timestamps."""
+        pid = os.getpid()
+        events = []
+        for s in self.spans():
+            ev = {"ph": "X", "name": s["name"], "pid": pid,
+                  "tid": s["tid"], "ts": s["t0"] * 1e6,
+                  "dur": (s["t1"] - s["t0"]) * 1e6,
+                  "args": {**s.get("args", {}),
+                           "parent": s["parent"],
+                           "thread": s["thread"]}}
+            events.append(ev)
+        for i in self.instants():
+            events.append({"ph": "i", "s": "t", "name": i["name"],
+                           "pid": pid, "tid": i["tid"],
+                           "ts": i["t"] * 1e6,
+                           "args": {**i.get("args", {}),
+                                    "parent": i["parent"]}})
+        events.sort(key=lambda e: e["ts"])
+        return {"traceEvents": events,
+                "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+#: the process-global tracer utils.stats.stat_timer reports through
+TRACER = Tracer()
+
+
+def span(name: str, **args):
+    return TRACER.span(name, **args)
+
+
+def instant(name: str, **args) -> None:
+    TRACER.instant(name, **args)

@@ -61,9 +61,13 @@ _STATE_KEYS = ("epoch", "shard", "chunk", "offset")
 
 def _emit(on_event, kind: str, count: int, error=None, where=None):
     """Build + deliver a DataFaultEvent (lazy import: trainer.event must
-    not be a hard import edge from the reader package)."""
+    not be a hard import edge from the reader package). Every data
+    fault also lands in the structured event journal
+    (paddle_tpu/obs/events.py) regardless of handler."""
+    from paddle_tpu.obs.events import emit_event
     from paddle_tpu.trainer.event import DataFaultEvent
     ev = DataFaultEvent(kind, count, error=error, where=where)
+    emit_event(ev)
     if on_event is not None:
         on_event(ev)
     else:
@@ -125,6 +129,9 @@ class ErrorBudget:
             if emit_exhausted:
                 self._exhausted_emitted = True
         global_counters.bump(self.stat)
+        from paddle_tpu.obs.events import emit as journal_emit
+        journal_emit("data", "quarantine", count=n, where=where,
+                     error=repr(exc)[:400])
         if n <= 3 or n % 50 == 0:
             get_logger().warning(
                 "quarantined bad sample #%d at %s: %r", n, where, exc)

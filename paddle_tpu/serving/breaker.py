@@ -19,6 +19,8 @@ import time
 from collections import deque
 from typing import Callable, Tuple
 
+from paddle_tpu.obs.events import emit as journal_emit
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
@@ -57,6 +59,7 @@ class CircuitBreaker:
             self._state = HALF_OPEN
             self._probes_in_flight = 0
             self._probe_successes = 0
+            journal_emit("serving", "breaker", state=HALF_OPEN)
 
     def allow(self) -> Tuple[bool, float]:
         """(admit?, retry_after_seconds). retry_after is 0 when admitted
@@ -84,11 +87,14 @@ class CircuitBreaker:
                 if not ok:
                     self._state = OPEN
                     self._opened_at = self._clock()
+                    journal_emit("serving", "breaker", state=OPEN,
+                                 probe_failed=True)
                     return
                 self._probe_successes += 1
                 if self._probe_successes >= self.half_open_probes:
                     self._state = CLOSED
                     self._outcomes.clear()
+                    journal_emit("serving", "breaker", state=CLOSED)
                 return
             if self._state == OPEN:
                 return          # stragglers admitted before the trip
@@ -100,6 +106,9 @@ class CircuitBreaker:
                 self._state = OPEN
                 self._opened_at = self._clock()
                 self.trips += 1
+                journal_emit(
+                    "serving", "breaker", state=OPEN, trips=self.trips,
+                    failure_rate=failures / len(self._outcomes))
 
     def snapshot(self) -> dict:
         with self._lock:

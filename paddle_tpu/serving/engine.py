@@ -45,6 +45,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from paddle_tpu.obs.events import emit as journal_emit
 from paddle_tpu.serving.server import (Expired, Rejected, ServerClosed,
                                        ServingError)
 from paddle_tpu.utils.stats import global_counters, stat_timer
@@ -343,6 +344,10 @@ class DecodeEngine:
         req.evictions += 1
         self._counters["preemptions"] += 1
         global_counters.bump("serving/decode_preemptions")
+        journal_emit("engine", "preemption",
+                     generated=req.num_generated,
+                     evictions=req.evictions,
+                     free_pages=self.pool.free_pages)
         with self._cv:
             self._waiting.appendleft(req)
 
@@ -494,6 +499,7 @@ class DecodeEngine:
         pools + free-list so fresh traffic can still be served."""
         with self._cv:
             self._counters["step_failures"] += 1
+        journal_emit("engine", "step_failure", error=repr(exc)[:400])
         err = ServingError(f"decode step failed: {exc}")
         for s in range(self.num_slots):
             if self.slots[s] is not None:

@@ -4,11 +4,17 @@ CLI's transport; stdlib-only so the serving path adds no dependency).
 Endpoints:
   GET  /health          -> InferenceServer.health()
   GET  /stats           -> InferenceServer.stats()
-  GET  /metrics         -> Prometheus text exposition of stats():
-                           serving counters/latency gauges plus, when a
-                           decode engine is attached, the KV-page and
+  GET  /metrics         -> Prometheus text exposition through the
+                           unified registry (paddle_tpu/obs/metrics.py):
+                           serving counters/latency gauges (and, with a
+                           decode engine attached, the KV-page and
                            slot-utilization gauges a fleet scheduler
-                           acts on (ROADMAP item 5 observability)
+                           acts on) PLUS the global registry — trainer,
+                           data-pipeline and fault domains — so one
+                           scrape sees the whole process
+  GET  /events          -> the structured event journal's in-memory
+                           ring (paddle_tpu/obs/events.py;
+                           ?n=100&domain=...&kind=... filters)
   POST /infer           -> body {"rows": [[f32...], ...],
                                  "deadline_ms": optional}
                            200 {"outputs": [[...], ...]}
@@ -33,33 +39,19 @@ from __future__ import annotations
 
 import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from paddle_tpu.obs.events import JOURNAL
+from paddle_tpu.obs.metrics import REGISTRY, stats_families
 from paddle_tpu.serving.server import (Expired, InferenceServer, Rejected,
                                        ServerClosed, ServingError)
 
-
-def _prom_lines(prefix: str, stats: dict, out, help_type):
-    """Flatten one stats dict into exposition lines. Counters (served,
-    rejected_*, tokens_out, ...) keep their cumulative semantics;
-    everything else numeric is a gauge. Nested dicts recurse with an
-    underscored prefix; non-numeric leaves are skipped."""
-    for key in sorted(stats):
-        val = stats[key]
-        name = f"{prefix}_{key}"
-        if isinstance(val, dict):
-            _prom_lines(name, val, out, help_type)
-            continue
-        if isinstance(val, bool) or not isinstance(val, (int, float)):
-            continue
-        kind = "counter" if key in _COUNTER_KEYS else "gauge"
-        if name not in help_type:
-            help_type[name] = kind
-            out.append(f"# TYPE {name} {kind}")
-        out.append(f"{name} {val}")
-
-
+#: stats() leaf keys with cumulative (counter) semantics; every other
+#: numeric leaf is a gauge. The flattened names these produce
+#: (paddle_tpu_serving_served, paddle_tpu_serving_engine_finished, the
+#: KV-page/slot gauges...) are test-pinned — keep them stable.
 _COUNTER_KEYS = {
     # InferenceServer counters
     "served", "rejected_full", "rejected_breaker", "rejected_oom",
@@ -74,12 +66,13 @@ _COUNTER_KEYS = {
 
 def prometheus_text(server: InferenceServer,
                     prefix: str = "paddle_tpu_serving") -> str:
-    """Render ``server.stats()`` (engine sub-dict included) as
-    Prometheus text exposition format, version 0.0.4."""
-    out: list = []
-    help_type: dict = {}
-    _prom_lines(prefix, server.stats(), out, help_type)
-    return "\n".join(out) + "\n"
+    """Render ``server.stats()`` (engine sub-dict included) PLUS the
+    global metrics registry as Prometheus text exposition 0.0.4 — the
+    ONE exposition path (paddle_tpu/obs/metrics.py); the ad-hoc PR-6
+    flattening lives on as obs.metrics.stats_families with the same
+    backward-compatible names."""
+    return REGISTRY.exposition(
+        extra=stats_families(prefix, server.stats(), _COUNTER_KEYS))
 
 
 def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
@@ -103,11 +96,12 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/health":
+            url = urlparse(self.path)
+            if url.path == "/health":
                 self._json(200, server.health())
-            elif self.path == "/stats":
+            elif url.path == "/stats":
                 self._json(200, server.stats())
-            elif self.path == "/metrics":
+            elif url.path == "/metrics":
                 body = prometheus_text(server).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
@@ -115,6 +109,16 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif url.path == "/events":
+                qs = parse_qs(url.query)
+                try:
+                    n = int(qs.get("n", ["100"])[0])
+                except ValueError:
+                    self._json(400, {"error": "n must be an integer"})
+                    return
+                self._json(200, {"events": JOURNAL.tail(
+                    n, domain=qs.get("domain", [None])[0],
+                    kind=qs.get("kind", [None])[0])})
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 

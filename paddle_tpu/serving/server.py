@@ -42,6 +42,7 @@ from typing import Callable, List, Optional, Union
 
 import numpy as np
 
+from paddle_tpu.obs.events import emit as journal_emit
 from paddle_tpu.serving.breaker import CircuitBreaker
 from paddle_tpu.utils.stats import global_counters, stat_timer
 
@@ -222,6 +223,10 @@ class InferenceServer:
                 if self._batch_limit is not None and \
                         rows > self._batch_limit:
                     self._counters["rejected_oom"] += 1
+                    journal_emit("serving", "shed",
+                                 reason="resource_exhausted",
+                                 where="admission_rows", rows=rows,
+                                 limit=self._batch_limit)
                     raise Rejected(
                         f"batch of {rows} rows exceeds the adaptive "
                         f"limit of {self._batch_limit} (a previous "
@@ -233,6 +238,11 @@ class InferenceServer:
                     est = _estimate_nbytes(samples)
                     if est > self.max_batch_memory:
                         self._counters["rejected_oom"] += 1
+                        journal_emit("serving", "shed",
+                                     reason="resource_exhausted",
+                                     where="admission_bytes",
+                                     estimated_bytes=est,
+                                     budget=self.max_batch_memory)
                         raise Rejected(
                             f"request estimated at {est} bytes exceeds "
                             f"max_batch_memory={self.max_batch_memory}; "
@@ -243,12 +253,18 @@ class InferenceServer:
                 ok, retry = self.breaker.allow()
                 if not ok:
                     self._counters["rejected_breaker"] += 1
+                    journal_emit("serving", "shed",
+                                 reason="breaker_open",
+                                 retry_after=retry)
                     raise Rejected(
                         f"circuit breaker open; retry in {retry:.2f}s",
                         retry_after=retry, reason="breaker_open")
             if len(self._queue) >= self.max_queue:
                 self._counters["rejected_full"] += 1
                 retry = self._retry_hint()
+                journal_emit("serving", "shed", reason="queue_full",
+                             queue_depth=len(self._queue),
+                             retry_after=retry)
                 raise Rejected(
                     f"queue full ({self.max_queue}); retry in "
                     f"{retry:.2f}s", retry_after=retry,
@@ -355,6 +371,10 @@ class InferenceServer:
                         else min(self._batch_limit, cap)
                     retry = self._retry_hint()
                 global_counters.bump("serving/oom_events")
+                journal_emit("serving", "shed",
+                             reason="resource_exhausted",
+                             where="forward", rows=rows,
+                             new_batch_limit=cap)
                 self._settle(req, error=Rejected(
                     f"forward hit RESOURCE_EXHAUSTED on {rows} rows; "
                     f"max batch shrunk to {cap} — split the request "

@@ -28,6 +28,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from paddle_tpu.obs.events import emit as journal_emit
+from paddle_tpu.utils.stats import stat_timer
+
 
 def _flatten(tree, prefix="") -> Dict[str, np.ndarray]:
     """Pytree (nested dicts of arrays/scalars) -> flat {path: ndarray}."""
@@ -115,12 +118,15 @@ class CheckpointManager:
              meta: Optional[Dict[str, Any]] = None) -> str:
         """Snapshot to host synchronously; write to disk (optionally in the
         background). Returns the checkpoint path."""
-        payload = {
-            "params": _to_host(params),
-            "opt_state": _to_host(opt_state) if opt_state is not None else {},
-            "state": _to_host(state) if state is not None else {},
-        }
-        flat = _flatten(payload)
+        with stat_timer("checkpoint/snapshot"):
+            # the only step-path cost: device->host copy + flatten
+            payload = {
+                "params": _to_host(params),
+                "opt_state": _to_host(opt_state)
+                if opt_state is not None else {},
+                "state": _to_host(state) if state is not None else {},
+            }
+            flat = _flatten(payload)
         path = os.path.join(self.dir, f"ckpt-{step:010d}")
         user_meta = dict(meta or {})
         # fail fast ON the caller's thread: meta rides in meta.json (the
@@ -134,19 +140,23 @@ class CheckpointManager:
                 f"checkpoint meta must be JSON-serializable: {e}") from e
 
         def write():
-            tmp = path + ".tmp"
-            os.makedirs(tmp, exist_ok=True)
-            npz = os.path.join(tmp, "state.npz")
-            _savez(npz, flat)
-            with open(npz, "rb") as f:
-                digest = hashlib.md5(f.read()).hexdigest()
-            m = {"step": step, "md5": digest, "meta": user_meta,
-                 "keys": sorted(flat)}
-            with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump(m, f)
-            if os.path.exists(path):
-                shutil.rmtree(path)
-            os.replace(tmp, path)
+            with stat_timer("checkpoint/write"):
+                tmp = path + ".tmp"
+                os.makedirs(tmp, exist_ok=True)
+                npz = os.path.join(tmp, "state.npz")
+                _savez(npz, flat)
+                with open(npz, "rb") as f:
+                    digest = hashlib.md5(f.read()).hexdigest()
+                m = {"step": step, "md5": digest, "meta": user_meta,
+                     "keys": sorted(flat)}
+                with open(os.path.join(tmp, "meta.json"), "w") as f:
+                    json.dump(m, f)
+                if os.path.exists(path):
+                    shutil.rmtree(path)
+                os.replace(tmp, path)
+            # journaled at durability (after os.replace), not at intent
+            journal_emit("checkpoint", "save", step=step, path=path,
+                         background=self.async_write)
             self._gc()
 
         def write_guarded():
@@ -258,4 +268,5 @@ class CheckpointManager:
         flat = {k: data[k] for k in data.files}
         tree = _unflatten(flat)
         tree["meta"] = m.get("meta", {})
+        journal_emit("checkpoint", "restore", step=step, path=path)
         return step, tree

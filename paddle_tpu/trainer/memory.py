@@ -247,6 +247,10 @@ class AdaptiveMicrobatcher:
         self.plan.microbatch = max(self.min_microbatch, (mb + 1) // 2)
         self.plan.accum_steps = self.plan.steps_for(batch_rows)
         self.plan.provenance = "adapted"
+        from paddle_tpu.obs.events import emit as journal_emit
+        journal_emit("trainer", "oom", microbatch=self.plan.microbatch,
+                     accum_steps=self.plan.accum_steps,
+                     batch_rows=batch_rows, error=repr(exc)[:400])
         warnings.warn(
             f"train step hit RESOURCE_EXHAUSTED at microbatch={mb}; "
             f"bisecting to {self.plan.microbatch} rows x "

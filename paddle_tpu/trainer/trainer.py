@@ -26,9 +26,10 @@ import numpy as np
 from paddle_tpu.config import global_config
 from paddle_tpu.core.registry import LayerOutput
 from paddle_tpu.core.topology import Topology
+from paddle_tpu.obs import events as obs_events
 from paddle_tpu.trainer import event as evt
 from paddle_tpu.trainer.parameters import Parameters
-from paddle_tpu.utils.stats import stat_timer
+from paddle_tpu.utils.stats import global_counters, stat_timer
 
 
 class SGD:
@@ -974,6 +975,7 @@ class SGD:
         self._merge_params(new_params)
         self.parameters.state = new_state
         self._step_count += 1
+        global_counters.bump("trainer/steps")
         loss_np, metrics_np, _ = self._fetch_host(loss, metrics)
         return loss_np, metrics_np
 
@@ -1033,7 +1035,11 @@ class SGD:
         t.start()
         try:
             while True:
-                err, feed = q.get()
+                # the wait for a converted batch IS the pipeline-bound
+                # signal: its timer/span (obs/trace.py) shows a
+                # data-starved step loop at a glance
+                with stat_timer("train/data_wait"):
+                    err, feed = q.get()
                 if err is not None:
                     raise err
                 if feed is DONE:
@@ -1071,15 +1077,22 @@ class SGD:
                     self.restore_checkpoint(checkpoint_manager):
                 restored = self._step_count
             self._bad_streak = jnp.zeros((2,), jnp.int32)
-            event_handler(evt.FaultEvent(pass_id, batch_id, "rollback",
-                                         high, restored))
+            ev = evt.FaultEvent(pass_id, batch_id, "rollback", high,
+                                restored)
+            global_counters.bump("trainer/fault_events")
+            obs_events.emit_event(ev)   # journaled BEFORE the handler:
+            # a handler that raises to abort still leaves the record
+            event_handler(ev)
         elif high > 0:
             # streak live or recently ended, below the rollback limit:
             # surface it, and lower the peak to the live value so an
             # ended streak is reported once
             self._bad_streak = jnp.asarray([cur, cur], jnp.int32)
-            event_handler(evt.FaultEvent(pass_id, batch_id, "nonfinite",
-                                         high, None))
+            ev = evt.FaultEvent(pass_id, batch_id, "nonfinite", high,
+                                None)
+            global_counters.bump("trainer/fault_events")
+            obs_events.emit_event(ev)
+            event_handler(ev)
 
     def _run_pass(self, pass_id, reader, feeder, event_handler,
                   num_batches_per_pass, checkpoint_manager=None,
@@ -1153,6 +1166,7 @@ class SGD:
             self._merge_params(new_params)
             self.parameters.state = new_state
             self._step_count += 1
+            global_counters.bump("trainer/steps")
             self._batch_in_pass = batch_id + 1
             n_batches += 1
             if lazy:

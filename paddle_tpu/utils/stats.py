@@ -21,19 +21,31 @@ import jax
 
 
 class StatItem:
-    __slots__ = ("count", "total", "max", "min")
+    # add() is a read-modify-write reached concurrently from the
+    # pt-serve / pt-data worker pools (serving/forward, pipeline
+    # timers) — the per-item lock keeps count/total consistent where
+    # the bare += used to drop updates under contention
+    __slots__ = ("count", "total", "max", "min", "_lock")
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.max = 0.0
         self.min = float("inf")
+        self._lock = threading.Lock()
 
     def add(self, dt: float):
-        self.count += 1
-        self.total += dt
-        self.max = max(self.max, dt)
-        self.min = min(self.min, dt)
+        with self._lock:
+            self.count += 1
+            self.total += dt
+            self.max = max(self.max, dt)
+            self.min = min(self.min, dt)
+
+    def snapshot(self):
+        """(count, total, max) read atomically — the obs metrics
+        bridge scrapes this (paddle_tpu/obs/metrics.py)."""
+        with self._lock:
+            return self.count, self.total, self.max
 
     def __str__(self):
         avg = self.total / self.count if self.count else 0.0
@@ -105,15 +117,33 @@ global_stat = StatSet()
 global_counters = CounterSet()
 
 
+_TRACER = None
+
+
+def _tracer():
+    """Lazy obs.trace handle (import-cycle-free: obs imports this
+    module; the first stat_timer call happens long after both are
+    loaded)."""
+    global _TRACER
+    if _TRACER is None:
+        from paddle_tpu.obs.trace import TRACER
+        _TRACER = TRACER
+    return _TRACER
+
+
 @contextlib.contextmanager
 def stat_timer(name: str):
-    """REGISTER_TIMER parity; also emits a jax.profiler named scope."""
+    """REGISTER_TIMER parity; also emits a jax.profiler named scope,
+    and — while a host trace is active (obs/trace.py) — a span, so
+    every timed scope (train_step, data wait, checkpoint write,
+    serving/decode_step) lands in the Chrome trace for free."""
     if not global_stat.enabled:
         yield
         return
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
-        yield
+        with _tracer().span(name):
+            yield
     global_stat.get(name).add(time.perf_counter() - t0)
 
 

@@ -90,7 +90,7 @@ def _no_pipeline_thread_leaks(request):
 
     def leaked():
         from paddle_tpu.reader.pipeline import THREAD_PREFIX
-        prefixes = (THREAD_PREFIX, "pt-serve")
+        prefixes = (THREAD_PREFIX, "pt-serve", "pt-obs")
         return [t for t in threading.enumerate()
                 if t.is_alive() and t.name.startswith(prefixes)]
 
@@ -139,6 +139,18 @@ def _reset_layer_names():
     """Fresh auto-name counters per test so graphs don't collide."""
     from paddle_tpu.core import registry
     registry.reset_name_counters()
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _reset_observability():
+    """Zero the observability surfaces BEFORE each test — metrics
+    registry values, event-journal ring + sink, tracer, and the
+    utils/stats global counters/timers — so no test reads another
+    test's metric bleed (paddle_tpu/obs; counter hygiene contract in
+    docs/observability.md)."""
+    from paddle_tpu.obs import reset_all
+    reset_all()
     yield
 
 

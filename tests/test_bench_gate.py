@@ -1,0 +1,174 @@
+"""Perf regression gate (tools/bench_gate.py) — the tier-1 guard.
+
+THE acceptance pair (ISSUE 7): an untouched smoke run passes against
+the committed BENCH_SMOKE_BASELINE.json, and a deliberately injected
+perf regression (forced recompile-per-step — the classic jit-in-loop
+bug ptlint R2 lints for, reproduced at runtime) makes the gate FAIL.
+Plus unit coverage of the tolerance semantics, the --write-baseline
+flow (tolerances survive re-baselining), and the output formats.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "BENCH_SMOKE_BASELINE.json")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+try:
+    import bench_gate
+finally:
+    sys.path.pop(0)
+
+
+def _baseline():
+    with open(BASELINE) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def smoke():
+    """One real smoke-tier run for the whole module (bench.py must be
+    importable from the repo root)."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    return bench.bench_smoke()
+
+
+class TestGateAcceptance:
+    def test_untouched_run_passes_committed_baseline(self, smoke):
+        res = bench_gate.compare(smoke, _baseline())
+        assert res.ok, bench_gate.format_gate(res)
+        # the tight tier really ran: count metrics were checked
+        kinds = {c.kind for c in res.checks}
+        assert "count" in kinds and "rate" in kinds
+
+    def test_forced_recompile_per_step_fails_gate(self):
+        """The injected regression: rebuilding the jitted step every
+        iteration must blow the compile-count budget (and collapse
+        steps/s below the rate floor)."""
+        sys.path.insert(0, REPO)
+        try:
+            import bench
+        finally:
+            sys.path.pop(0)
+        bad = bench.bench_smoke(train_steps=6, rows=("train_tiny",),
+                                force_recompile_per_step=True)
+        res = bench_gate.compare(bad, _baseline())
+        failed = {c.name for c in res.failures}
+        assert "train_tiny.step_compiles" in failed, \
+            bench_gate.format_gate(res)
+
+
+class TestGateSemantics:
+    BASE = {"v": 1, "rows": {"r": {
+        "step_compiles": {"value": 3, "kind": "count", "max_slack": 2},
+        "steps_per_s": {"value": 100.0, "kind": "rate",
+                        "min_ratio": 0.1},
+        "p50_ms": {"value": 10.0, "kind": "latency", "max_ratio": 4,
+                   "abs_floor_ms": 5.0},
+        "served": {"value": 7, "kind": "info"},
+    }}}
+
+    @staticmethod
+    def _results(**over):
+        row = {"step_compiles": 3, "steps_per_s": 100.0, "p50_ms": 10.0,
+               "served": 7}
+        row.update(over)
+        return {"v": 1, "rows": {"r": row}}
+
+    def test_within_tolerance_passes(self):
+        res = bench_gate.compare(
+            self._results(step_compiles=5, steps_per_s=11.0,
+                          p50_ms=39.0, served=999),
+            self.BASE)
+        assert res.ok, bench_gate.format_gate(res)
+
+    def test_count_over_slack_fails(self):
+        res = bench_gate.compare(self._results(step_compiles=6),
+                                 self.BASE)
+        assert [c.name for c in res.failures] == ["r.step_compiles"]
+
+    def test_rate_collapse_fails(self):
+        res = bench_gate.compare(self._results(steps_per_s=9.9),
+                                 self.BASE)
+        assert [c.name for c in res.failures] == ["r.steps_per_s"]
+
+    def test_latency_ceiling_uses_abs_floor(self):
+        # ceiling = max(10 * 4, 5) = 40
+        res = bench_gate.compare(self._results(p50_ms=41.0), self.BASE)
+        assert [c.name for c in res.failures] == ["r.p50_ms"]
+        # a tiny baseline never flakes below the absolute floor
+        tiny = {"v": 1, "rows": {"r": {"p50_ms": {
+            "value": 0.01, "kind": "latency", "max_ratio": 4,
+            "abs_floor_ms": 50.0}}}}
+        res = bench_gate.compare(
+            {"v": 1, "rows": {"r": {"p50_ms": 49.0}}}, tiny)
+        assert res.ok
+
+    def test_info_never_gates(self):
+        res = bench_gate.compare(self._results(served=0), self.BASE)
+        assert res.ok
+
+    def test_missing_metric_and_row_fail(self):
+        blob = self._results()
+        del blob["rows"]["r"]["step_compiles"]
+        res = bench_gate.compare(blob, self.BASE)
+        assert [c.name for c in res.failures] == ["r.step_compiles"]
+        # a whole row vanishing fails EVERY baseline metric in it,
+        # info rows included (lost coverage is itself a regression)
+        res = bench_gate.compare({"v": 1, "rows": {}}, self.BASE)
+        assert {c.name for c in res.failures} == {
+            "r.step_compiles", "r.steps_per_s", "r.p50_ms", "r.served"}
+
+    def test_uncovered_metric_is_a_note_not_a_failure(self):
+        res = bench_gate.compare(self._results(new_metric=1.0),
+                                 self.BASE)
+        assert res.ok and any("new_metric" in n for n in res.notes)
+
+    def test_write_baseline_preserves_tolerances(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        loose = json.loads(json.dumps(self.BASE))
+        loose["rows"]["r"]["steps_per_s"]["min_ratio"] = 0.5
+        bench_gate.write_baseline(path, self._results(steps_per_s=200.0),
+                                  loose)
+        with open(path) as f:
+            out = json.load(f)
+        entry = out["rows"]["r"]["steps_per_s"]
+        assert entry["value"] == 200.0
+        assert entry["min_ratio"] == 0.5       # tolerance inherited
+        assert out["rows"]["r"]["served"]["kind"] == "info"
+
+    def test_formats(self):
+        res = bench_gate.compare(self._results(step_compiles=6),
+                                 self.BASE)
+        text = bench_gate.format_gate(res, "text")
+        assert "FAIL r.step_compiles" in text and "1 regression" in text
+        gh = bench_gate.format_gate(res, "github")
+        assert gh.startswith("::error::bench_gate r.step_compiles")
+        blob = json.loads(bench_gate.format_gate(res, "json"))
+        assert blob["ok"] is False
+        assert blob["failures"] == ["r.step_compiles"]
+
+    def test_cli_exit_codes(self, tmp_path):
+        results = str(tmp_path / "res.json")
+        base = str(tmp_path / "base.json")
+        with open(results, "w") as f:
+            json.dump(self._results(), f)
+        with open(base, "w") as f:
+            json.dump(self.BASE, f)
+        assert bench_gate.main(["--results", results,
+                                "--baseline", base]) == 0
+        with open(results, "w") as f:
+            json.dump(self._results(step_compiles=99), f)
+        assert bench_gate.main(["--results", results,
+                                "--baseline", base]) == 1
+        assert bench_gate.main(["--baseline", base]) == 2   # no input
+        assert bench_gate.main(["--results", results, "--baseline",
+                                str(tmp_path / "nope.json")]) == 2

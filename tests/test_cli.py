@@ -304,3 +304,105 @@ class TestMemoryFlags:
         server, _ = cli._build_server(
             ns, FakeServer, FakeBreaker, lambda *a: None)
         assert server.kw["max_batch_memory"] is None
+
+
+class TestObservabilityFlags:
+    """ISSUE 7 satellite: train --metrics_port/--event_log wiring and
+    the `paddle_tpu events tail` subcommand."""
+
+    def _tiny_config(self, tmp_path):
+        cfg = tmp_path / "conf.py"
+        cfg.write_text(
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            "x = paddle.layer.data('x', paddle.data_type.dense_vector(4))\n"
+            "y = paddle.layer.data('y', paddle.data_type.integer_value(2))\n"
+            "out = paddle.layer.fc(x, size=2,"
+            " act=paddle.activation.Softmax())\n"
+            "cost = paddle.layer.classification_cost(out, y)\n"
+            "def train_reader():\n"
+            "    rng = np.random.RandomState(0)\n"
+            "    for _ in range(2):\n"
+            "        f = rng.randn(4, 4).astype('float32')\n"
+            "        yield [(f[i], int(rng.randint(0, 2)))"
+            " for i in range(4)]\n")
+        return str(cfg)
+
+    def test_train_event_log_writes_journal(self, tmp_path):
+        from paddle_tpu import cli
+        from paddle_tpu.obs.events import read_journal
+        log = str(tmp_path / "train.jsonl")
+        rc = cli.main(["train", "--config", self._tiny_config(tmp_path),
+                       "--num_passes", "1", "--log_period", "1",
+                       "--event_log", log])
+        assert rc == 0
+        kinds = [(r["domain"], r["kind"]) for r in read_journal(log)]
+        assert ("trainer", "run_start") in kinds
+        assert ("trainer", "run_end") in kinds
+
+    def test_train_metrics_port_starts_obs_server(self, tmp_path,
+                                                  monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu import cli
+        from paddle_tpu.obs import httpd as obs_httpd
+
+        started = []
+
+        class FakeServer:
+            server_address = ("127.0.0.1", 12345)
+
+            def shutdown(self):
+                started.append("shutdown")
+
+        def fake_start(host="127.0.0.1", port=0):
+            started.append(port)
+            return FakeServer()
+
+        monkeypatch.setattr(obs_httpd, "start_obs_server", fake_start)
+        monkeypatch.setattr(paddle.SGD, "train",
+                            lambda self, reader=None, **kw: None)
+        rc = cli.main(["train", "--config", self._tiny_config(tmp_path),
+                       "--metrics_port", "0"])
+        assert rc == 0
+        # started with the requested port, and shut down on exit
+        assert started == [0, "shutdown"]
+
+    def test_events_tail_subcommand(self, tmp_path, capsys):
+        from paddle_tpu import cli
+        from paddle_tpu.obs.events import EventJournal
+        log = str(tmp_path / "j.jsonl")
+        j = EventJournal()
+        j.configure(log)
+        for i in range(5):
+            j.emit("data", "quarantine", count=i)
+        j.emit("serving", "shed", reason="queue_full")
+        j.configure(None)
+        rc = cli.main(["events", "tail", "--log", log, "-n", "2",
+                       "--domain", "data"])
+        assert rc == 0
+        lines = [json.loads(l) for l in
+                 capsys.readouterr().out.strip().splitlines()]
+        assert [l["count"] for l in lines] == [3, 4]
+        assert all(l["domain"] == "data" for l in lines)
+        rc = cli.main(["events", "tail", "--log", log,
+                       "--kind", "shed"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert json.loads(out.strip())["reason"] == "queue_full"
+        with pytest.raises(SystemExit):
+            cli.main(["events", "tail", "--log",
+                      str(tmp_path / "missing.jsonl")])
+
+    def test_serve_event_log_configures_journal(self, tmp_path,
+                                                monkeypatch):
+        # serve --event_log must attach the journal sink before the
+        # server loop starts (the loop itself is stubbed out)
+        from paddle_tpu import cli
+        from paddle_tpu.obs.events import JOURNAL
+        log = str(tmp_path / "serve.jsonl")
+        monkeypatch.setattr(cli, "_cmd_serve", lambda args: 0)
+        rc = cli.main(["serve", "--model", "m.tar",
+                       "--event_log", log])
+        assert rc == 0
+        assert JOURNAL.path == log
+        JOURNAL.configure(None)

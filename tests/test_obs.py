@@ -1,0 +1,456 @@
+"""Unified observability subsystem (paddle_tpu/obs) — acceptance suite.
+
+Covers the ISSUE-7 contract: Prometheus exposition conformance
+(HELP/TYPE lines, label escaping, histogram bucket monotonicity),
+event-journal schema round-trip for every existing event class, the
+trace-export smoke test (spans nest, compile events attach), the
+standalone /metrics + /events endpoints, counter hygiene under
+threads, and THE chaos acceptance: a run with injected data faults /
+OOM / engine preemptions produces a schema-valid JSONL journal
+capturing every injected fault.
+"""
+
+import json
+import threading
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.obs import events as obs_events
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs import trace as obs_trace
+from paddle_tpu.obs.events import (JOURNAL, EventJournal, read_journal,
+                                   validate)
+from paddle_tpu.obs.httpd import start_obs_server
+from paddle_tpu.obs.metrics import (REGISTRY, MetricsRegistry,
+                                    stats_families)
+from paddle_tpu.trainer.event import (DataFaultEvent, FaultEvent,
+                                      OOMEvent)
+from paddle_tpu.utils.stats import global_counters, global_stat
+
+
+# ------------------------------------------------------------- registry
+
+class TestRegistry:
+    def test_counter_gauge_histogram_semantics(self):
+        r = MetricsRegistry()
+        c = r.counter("t_total", "help")
+        c.inc()
+        c.inc(2)
+        assert c.value() == 3
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = r.gauge("t_gauge")
+        g.set(5)
+        g.dec(2)
+        assert g.value() == 3
+        h = r.histogram("t_hist", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        counts, total, n = h.labels().snapshot()
+        assert counts == [1, 2] and n == 3
+        assert total == pytest.approx(5.55)
+
+    def test_labels_and_registration_conflicts(self):
+        r = MetricsRegistry()
+        c = r.counter("t_total", labelnames=("who",))
+        c.labels(who="a").inc()
+        c.labels(who="b").inc(4)
+        assert c.value(who="b") == 4
+        # idempotent re-registration returns the same family
+        assert r.counter("t_total", labelnames=("who",)) is c
+        with pytest.raises(ValueError):
+            r.gauge("t_total")                # kind conflict
+        with pytest.raises(ValueError):
+            c.labels(nope="x")                # wrong label schema
+        with pytest.raises(ValueError):
+            r.counter("bad name")             # invalid metric name
+
+    def test_counter_thread_safety_exact(self):
+        r = MetricsRegistry()
+        c = r.counter("t_total")
+        n_threads, per = 8, 500
+
+        def work():
+            for _ in range(per):
+                c.inc()
+
+        ts = [threading.Thread(target=work, name=f"pt-test-m{i}")
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.value() == n_threads * per
+
+    def test_utils_stats_counterset_thread_safety(self):
+        """The counter-hygiene satellite: global counters must count
+        EXACTLY under the pt-serve/pt-data style worker pools."""
+        n_threads, per = 8, 400
+
+        def work():
+            for _ in range(per):
+                global_counters.bump("obs-test/bump")
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    global_stat.get("obs-test/timer").add(0.001)
+
+        ts = [threading.Thread(target=work, name=f"pt-test-s{i}")
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert global_counters.value("obs-test/bump") == n_threads * per
+        count, total, _ = global_stat.get("obs-test/timer").snapshot()
+        assert count == n_threads * per
+        assert total == pytest.approx(0.001 * n_threads * per)
+
+
+# ----------------------------------------------- exposition conformance
+
+def _parse_exposition(text):
+    """{name: (kind, help)} for TYPE/HELP lines + [(name, labels-str,
+    value)] samples, asserting basic line shape along the way."""
+    types, helps, samples = {}, {}, []
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+        elif line.startswith("# HELP "):
+            _, _, name, h = line.split(" ", 3)
+            helps[name] = h
+        else:
+            head, _, val = line.rpartition(" ")
+            assert head, f"malformed sample line {line!r}"
+            name, brace, labels = head.partition("{")
+            samples.append((name, brace + labels, float(val)))
+    return types, helps, samples
+
+
+class TestExpositionConformance:
+    def test_help_type_and_line_shape(self):
+        r = MetricsRegistry()
+        r.counter("t_total", "a counter").inc(2)
+        r.gauge("t_gauge", "a gauge").set(1.5)
+        r.histogram("t_hist", "a histogram", buckets=(0.1,)).observe(0.05)
+        types, helps, samples = _parse_exposition(r.exposition())
+        assert types == {"t_total": "counter", "t_gauge": "gauge",
+                         "t_hist": "histogram"}
+        assert helps["t_total"] == "a counter"
+        names = [s[0] for s in samples]
+        assert "t_hist_bucket" in names and "t_hist_sum" in names \
+            and "t_hist_count" in names
+
+    def test_label_escaping_round_trip(self):
+        r = MetricsRegistry()
+        nasty = 'quo"te\\slash\nnewline'
+        r.counter("t_total", labelnames=("name",)) \
+            .labels(name=nasty).inc()
+        text = r.exposition()
+        line = [l for l in text.splitlines()
+                if l.startswith("t_total{")][0]
+        assert '\\"' in line and "\\n" in line and "\\\\" in line
+        assert "\n" not in line[:-1]          # literal newline escaped
+
+    def test_histogram_bucket_monotonicity_and_inf(self):
+        r = MetricsRegistry()
+        h = r.histogram("t_hist", buckets=(0.01, 0.1, 1.0, 10.0))
+        rng = np.random.RandomState(0)
+        for v in rng.exponential(0.5, size=200):
+            h.observe(float(v))
+        _, _, samples = _parse_exposition(r.exposition())
+        buckets = [(lab, v) for name, lab, v in samples
+                   if name == "t_hist_bucket"]
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), "buckets must be cumulative"
+        assert buckets[-1][0] == '{le="+Inf"}'
+        count = [v for name, _, v in samples if name == "t_hist_count"]
+        assert count == [200.0] and buckets[-1][1] == 200.0
+
+    def test_stats_families_pinned_serving_names(self):
+        """The PR-6 flattening contract: nested dicts recurse with an
+        underscored prefix, counter keys keep counter semantics,
+        non-numeric leaves are skipped."""
+        fams = stats_families(
+            "paddle_tpu_serving",
+            {"served": 3, "engine": {"kv_pages_free": 5},
+             "breaker": None, "ok": True},
+            counter_keys={"served"})
+        flat = {f.name: (f.kind, f.samples()[0][2]) for f in fams}
+        assert flat == {
+            "paddle_tpu_serving_served": ("counter", 3.0),
+            "paddle_tpu_serving_engine_kv_pages_free": ("gauge", 5.0)}
+
+    def test_global_registry_bridges_stats_domains(self):
+        """One scrape sees trainer, data-pipeline, fault and
+        decode-engine domains through the utils/stats bridge."""
+        for name in ("trainer/steps", "pipeline/quarantined",
+                     "trainer/oom_events", "serving/decode_tokens"):
+            global_counters.bump(name)
+        text = REGISTRY.exposition()
+        for name in ("trainer/steps", "pipeline/quarantined",
+                     "trainer/oom_events", "serving/decode_tokens"):
+            assert f'paddle_tpu_counter_total{{name="{name}"}} 1' \
+                in text
+
+
+# ----------------------------------------------------------- event journal
+
+class TestEventJournal:
+    def test_required_schema_fields(self):
+        rec = obs_events.emit("test", "ping", detail=1)
+        validate(rec)
+        assert rec["v"] == obs_events.SCHEMA_VERSION
+        assert rec["domain"] == "test" and rec["kind"] == "ping"
+        with pytest.raises(ValueError):
+            validate({"v": 1, "domain": "x"})
+        with pytest.raises(ValueError):
+            validate({**rec, "v": 99})
+        with pytest.raises(ValueError):
+            validate({**rec, "kind": ""})
+
+    def test_round_trip_all_event_classes(self, tmp_path):
+        """Every existing event class lands in the journal with its
+        canonical (domain, kind) and survives the JSONL round trip."""
+        path = str(tmp_path / "events.jsonl")
+        j = EventJournal()
+        j.configure(path)
+        j.emit_event(FaultEvent(0, 3, "nonfinite", 2, None))
+        j.emit_event(FaultEvent(0, 7, "rollback", 3, 40))
+        j.emit_event(OOMEvent(1, 2, microbatch=4, accum_steps=2,
+                              error=RuntimeError("RESOURCE_EXHAUSTED")))
+        j.emit_event(DataFaultEvent("source_stall", 2, where="src"))
+        j.emit_event(DataFaultEvent("worker_restart", 1,
+                                    error=ValueError("boom")))
+        j.emit("serving", "shed", reason="queue_full")
+        j.emit("engine", "preemption", generated=5)
+        j.emit("checkpoint", "save", step=10, path="/tmp/x")
+        j.configure(None)
+        recs = list(read_journal(path))
+        assert len(recs) == 8
+        assert [r["seq"] for r in recs] == list(range(1, 9))
+        by_kind = {(r["domain"], r["kind"]): r for r in recs}
+        assert by_kind[("trainer", "rollback")]["restored_step"] == 40
+        assert by_kind[("trainer", "oom")]["microbatch"] == 4
+        assert "RESOURCE_EXHAUSTED" in by_kind[("trainer",
+                                                "oom")]["error"]
+        assert by_kind[("data", "source_stall")]["count"] == 2
+        assert by_kind[("data", "worker_restart")]["error"] \
+            == repr(ValueError("boom"))
+        assert by_kind[("serving", "shed")]["reason"] == "queue_full"
+        assert by_kind[("engine", "preemption")]["generated"] == 5
+        assert by_kind[("checkpoint", "save")]["step"] == 10
+
+    def test_ring_tail_filters(self):
+        j = EventJournal(ring_size=4)
+        for i in range(6):
+            j.emit("a" if i % 2 else "b", f"k{i}")
+        recs = j.tail()
+        assert len(recs) == 4                 # ring bound
+        assert [r["seq"] for r in recs] == [3, 4, 5, 6]
+        assert [r["kind"] for r in j.tail(domain="a")] == ["k3", "k5"]
+        assert [r["kind"] for r in j.tail(kind="k4")] == ["k4"]
+
+    def test_torn_final_line_skipped(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        j = EventJournal()
+        j.configure(path)
+        j.emit("test", "ok")
+        j.configure(None)
+        with open(path, "a") as f:
+            f.write('{"v": 1, "truncat')       # the crash mid-write
+        recs = list(read_journal(path))
+        assert len(recs) == 1 and recs[0]["kind"] == "ok"
+        # a malformed MIDDLE line is a real corruption -> strict raises
+        with open(path, "a") as f:
+            f.write('\ngarbage\n{"also": "bad"}\n')
+        with pytest.raises(ValueError):
+            list(read_journal(path))
+
+    def test_non_serializable_fields_reprd(self):
+        rec = obs_events.emit("test", "odd", obj=object())
+        assert isinstance(rec["obj"], str) and "object" in rec["obj"]
+        json.dumps(rec)                        # always serializable
+
+
+# ------------------------------------------------------------ step tracing
+
+class TestTracing:
+    def test_spans_nest_and_compile_events_attach(self):
+        """ISSUE acceptance: spans nest, xla-compile instants attach,
+        and stat_timer scopes become spans with no per-site wiring."""
+        import jax
+
+        from paddle_tpu.utils.stats import stat_timer
+        tracer = obs_trace.TRACER
+        tracer.start(capture_compiles=True)
+        try:
+            with tracer.span("outer"):
+                with tracer.span("inner"):
+                    jax.jit(lambda x: x * 2 + 1)(
+                        np.float32(3.0)).block_until_ready()
+                with stat_timer("train_step"):
+                    pass
+        finally:
+            tracer.stop()
+        spans = {s["name"]: s for s in tracer.spans()}
+        assert set(spans) >= {"outer", "inner", "train_step"}
+        out, inn = spans["outer"], spans["inner"]
+        assert inn["parent"] == "outer"
+        assert spans["train_step"]["parent"] == "outer"
+        assert out["t0"] <= inn["t0"] and inn["t1"] <= out["t1"]
+        compiles = [i for i in tracer.instants()
+                    if i["name"] == "xla_compile"]
+        assert compiles, "the jit compile must appear as an instant"
+        assert any(out["t0"] <= i["t"] <= out["t1"] and
+                   i["parent"] == "inner" for i in compiles)
+
+    def test_chrome_trace_export(self, tmp_path):
+        tracer = obs_trace.TRACER
+        tracer.start(capture_compiles=False)
+        with tracer.span("step", batch=3):
+            with tracer.span("data_wait"):
+                pass
+        tracer.stop()
+        path = str(tmp_path / "trace.json")
+        tracer.save(path)
+        with open(path) as f:
+            blob = json.load(f)
+        evs = blob["traceEvents"]
+        assert all(e["ph"] in ("X", "i") for e in evs)
+        step = [e for e in evs if e["name"] == "step"][0]
+        wait = [e for e in evs if e["name"] == "data_wait"][0]
+        assert step["ts"] <= wait["ts"]
+        assert wait["ts"] + wait["dur"] <= step["ts"] + step["dur"]
+        assert step["args"]["batch"] == 3
+        assert wait["args"]["parent"] == "step"
+
+    def test_disabled_tracer_records_nothing(self):
+        tracer = obs_trace.TRACER
+        with tracer.span("ghost"):
+            pass
+        assert tracer.spans() == []
+
+
+# ------------------------------------------------- standalone obs endpoint
+
+class TestObsEndpoint:
+    def test_metrics_and_events_over_http(self):
+        global_counters.bump("trainer/steps", 5)
+        obs_events.emit("test", "ping", detail="x")
+        httpd = start_obs_server()
+        port = httpd.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        try:
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+            assert 'paddle_tpu_counter_total{name="trainer/steps"} 5' \
+                in text
+            with urllib.request.urlopen(
+                    base + "/events?n=5&domain=test", timeout=10) as r:
+                evs = json.loads(r.read())["events"]
+            assert evs and evs[-1]["kind"] == "ping"
+            with urllib.request.urlopen(base + "/health",
+                                        timeout=10) as r:
+                assert json.loads(r.read())["status"] == "ok"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_serving_front_events_route(self):
+        """serve's transport gains /events (ISSUE satellite)."""
+        from paddle_tpu.serving import InferenceServer, build_http_server
+        from paddle_tpu.trainer.inference import Inference
+        x = paddle.layer.data("ox", paddle.data_type.dense_vector(4))
+        o = paddle.layer.fc(x, size=2, act=paddle.activation.Softmax())
+        inf = Inference(output_layer=o,
+                        parameters=paddle.create_parameters(
+                            paddle.Topology(o)))
+        srv = InferenceServer(inf, workers=1, breaker=False).start()
+        httpd = build_http_server(srv, "127.0.0.1", 0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                             name="pt-test-obs-httpd")
+        t.start()
+        try:
+            obs_events.emit("serving", "shed", reason="test")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/events?kind=shed",
+                    timeout=10) as r:
+                evs = json.loads(r.read())["events"]
+            assert evs and evs[-1]["reason"] == "test"
+        finally:
+            httpd.shutdown()
+            srv.shutdown(drain=True)
+
+
+# --------------------------------------------------- chaos: one journal
+
+class TestChaosJournal:
+    """THE acceptance criterion: chaos runs produce a schema-valid
+    JSONL journal capturing every injected fault."""
+
+    @pytest.mark.chaos
+    def test_data_oom_and_preemption_faults_all_journaled(self, tmp_path):
+        from paddle_tpu.reader import ErrorBudget, supervised
+        from paddle_tpu.serving import DecodeEngine
+        from paddle_tpu.testing.faults import FaultPlan
+        from tests.test_serving_faults import tiny_decoder
+
+        path = str(tmp_path / "chaos.jsonl")
+        JOURNAL.configure(path)
+
+        # (1) data faults: 3 raising-mapper samples quarantined, budget
+        # of 1 blown -> 3 quarantine + 1 data_budget records
+        plan = FaultPlan()
+        eb = ErrorBudget(max_bad=1, on_bad="log")
+        sr = supervised(lambda: iter(range(20)),
+                        mapper=plan.raising_mapper(lambda v: v,
+                                                   [2, 5, 9]),
+                        num_workers=2, order=True, error_budget=eb)
+        assert len(list(sr())) == 17
+
+        # (2) trainer OOM: oom_at(step=1) -> adaptive microbatching
+        from tests.test_oom import _reader, _trainer
+        tr = _trainer()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with FaultPlan.oom_at(tr, step=1, n=1) as stats:
+                tr.train(_reader(batches=3), num_passes=1,
+                         event_handler=lambda e: None,
+                         microbatch="auto")
+        assert stats["injected"] == 1
+
+        # (3) engine preemption, forced deterministically: two
+        # requests of 5 pages each against a 5-usable-page pool — the
+        # younger MUST be evicted at least once while the elder grows
+        dec = tiny_decoder()
+        rng = np.random.RandomState(1)
+        p1 = rng.randint(0, 40, (4,)).astype("int32")
+        p2 = rng.randint(0, 40, (4,)).astype("int32")
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=20, num_pages=6)
+        r1, r2 = eng.submit(p1, 14), eng.submit(p2, 14)
+        eng.run(timeout=300)
+        assert len(r1.get(timeout=1)) == 14
+        assert len(r2.get(timeout=1)) == 14
+        preempts = eng.stats()["preemptions"]
+        assert preempts > 0
+
+        JOURNAL.configure(None)
+        recs = [validate(r) for r in read_journal(path)]
+        kinds = {}
+        for r in recs:
+            kinds[(r["domain"], r["kind"])] = \
+                kinds.get((r["domain"], r["kind"]), 0) + 1
+        assert kinds[("data", "quarantine")] == 3
+        assert kinds[("data", "data_budget")] == 1
+        assert kinds[("trainer", "oom")] == 1
+        assert kinds[("engine", "preemption")] == preempts

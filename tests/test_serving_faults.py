@@ -566,9 +566,10 @@ class TestServerEngineIntegration:
         assert "paddle_tpu_serving_engine_tokens_out 4" in text
         assert "paddle_tpu_serving_engine_slot_utilization" in text
         assert "paddle_tpu_serving_engine_token_latency_p99_ms" in text
-        # every line is exposition-format: comment or "name value"
+        # every line is exposition-format: HELP/TYPE comment or
+        # "name value" (the unified registry adds # HELP lines)
         for line in text.strip().splitlines():
-            assert line.startswith("# TYPE ") or \
+            assert line.startswith(("# TYPE ", "# HELP ")) or \
                 len(line.split(" ")) == 2, line
 
     def test_http_generate_and_metrics_endpoints(self):

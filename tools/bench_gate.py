@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""bench_gate — the perf regression gate over bench.py's smoke tier.
+
+The BENCH_r0x trajectory existed but nothing enforced it: a perf
+regression could land silently. This tool compares a smoke-tier result
+(``python bench.py --suite smoke`` or ``--run`` here) against the
+committed ``BENCH_SMOKE_BASELINE.json`` with PER-METRIC tolerances and
+fails the build on regression — wired into tier-1 by
+tests/test_bench_gate.py so every later scale/speed PR lands with its
+guard (ROADMAP item 5; docs/observability.md "The perf gate").
+
+Baseline schema (v1)::
+
+    {"v": 1, "rows": {"train_tiny": {
+        "step_compiles":      {"value": 3,   "kind": "count",
+                               "max_slack": 3},
+        "steps_per_s":        {"value": 1300, "kind": "rate",
+                               "min_ratio": 0.02},
+        "p50_ms":             {"value": 0.1, "kind": "latency",
+                               "max_ratio": 20, "abs_floor_ms": 50},
+        "served":             {"value": 17,  "kind": "info"}}}}
+
+Metric kinds:
+  count    lower-is-better integer-ish (compiles, host syncs/step):
+           FAIL when current > value + max_slack. The tight tier —
+           deterministic on any machine.
+  rate     higher-is-better throughput: FAIL when
+           current < value * min_ratio. Loose: catches
+           order-of-magnitude collapses, not noise.
+  latency  lower-is-better milliseconds: FAIL when
+           current > max(value * max_ratio, abs_floor_ms).
+  info     recorded, never gated.
+
+Output formats text/github/json mirror ptlint; ``--write-baseline``
+regenerates the baseline from a current run while PRESERVING each
+metric's kind/tolerance fields (re-baselining intentionally is a
+one-command workflow; see docs/observability.md for when that is
+legitimate). Exit codes: 0 clean, 1 regression/missing metric/stale
+baseline row, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+DEFAULT_BASELINE = "BENCH_SMOKE_BASELINE.json"
+
+#: tolerance defaults per kind, used when a baseline entry (or
+#: --write-baseline) does not spell its own out
+KIND_DEFAULTS = {
+    "count": {"max_slack": 3},
+    "rate": {"min_ratio": 0.02},
+    "latency": {"max_ratio": 20.0, "abs_floor_ms": 50.0},
+    "info": {},
+}
+
+
+def classify(metric: str) -> str:
+    """Default kind for a metric name (used by --write-baseline when
+    the previous baseline has no entry to inherit from)."""
+    if "compiles" in metric or metric.startswith("host_syncs"):
+        return "count"
+    if metric.endswith("_per_s"):
+        return "rate"
+    if metric.endswith("_ms"):
+        return "latency"
+    return "info"
+
+
+@dataclass
+class GateCheck:
+    row: str
+    metric: str
+    kind: str
+    baseline: Optional[float]
+    current: Optional[float]
+    limit: Optional[float]
+    ok: bool
+    message: str
+
+    @property
+    def name(self) -> str:
+        return f"{self.row}.{self.metric}"
+
+
+@dataclass
+class GateResult:
+    checks: List[GateCheck] = field(default_factory=list)
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def failures(self) -> List[GateCheck]:
+        return [c for c in self.checks if not c.ok]
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+def _rows(blob: dict) -> Dict[str, dict]:
+    if not isinstance(blob, dict) or "rows" not in blob:
+        raise ValueError("expected {'v': 1, 'rows': {...}}")
+    return blob["rows"]
+
+
+def compare(results: dict, baseline: dict) -> GateResult:
+    """Every baseline metric must be present and within tolerance in
+    ``results``; metrics present only in results are noted (uncovered),
+    not failed."""
+    res = GateResult()
+    brows, rrows = _rows(baseline), _rows(results)
+    for row_name in sorted(brows):
+        brow = brows[row_name]
+        rrow = rrows.get(row_name)
+        for metric in sorted(brow):
+            spec = brow[metric]
+            if not isinstance(spec, dict) or "value" not in spec:
+                continue                    # comment / free-form field
+            kind = spec.get("kind", classify(metric))
+            base_val = spec["value"]
+            if rrow is None or metric not in rrow:
+                res.checks.append(GateCheck(
+                    row_name, metric, kind, base_val, None, None, False,
+                    "metric missing from the current run — the smoke "
+                    "tier lost coverage (or the row failed to run)"))
+                continue
+            cur = float(rrow[metric])
+            tol = {**KIND_DEFAULTS.get(kind, {}), **spec}
+            if kind == "count":
+                limit = float(base_val) + float(tol["max_slack"])
+                ok = cur <= limit
+                msg = (f"{cur:g} <= {limit:g} "
+                       f"(baseline {base_val:g} + slack)") if ok else (
+                    f"{cur:g} exceeds {limit:g} (baseline "
+                    f"{base_val:g} + slack {tol['max_slack']:g}) — a "
+                    "count that scales with the step count means the "
+                    "hot path regressed (recompiles / extra host "
+                    "syncs)")
+            elif kind == "rate":
+                limit = float(base_val) * float(tol["min_ratio"])
+                ok = cur >= limit
+                msg = (f"{cur:g} >= floor {limit:g}") if ok else (
+                    f"{cur:g} below floor {limit:g} "
+                    f"({tol['min_ratio']:g}x of baseline "
+                    f"{base_val:g}) — throughput collapsed")
+            elif kind == "latency":
+                limit = max(float(base_val) * float(tol["max_ratio"]),
+                            float(tol["abs_floor_ms"]))
+                ok = cur <= limit
+                msg = (f"{cur:g} <= ceiling {limit:g}") if ok else (
+                    f"{cur:g} above ceiling {limit:g} "
+                    f"({tol['max_ratio']:g}x of baseline "
+                    f"{base_val:g} ms) — latency exploded")
+            else:                            # info: recorded only
+                limit, ok = None, True
+                msg = f"recorded {cur:g} (not gated)"
+            res.checks.append(GateCheck(row_name, metric, kind,
+                                        float(base_val), cur, limit,
+                                        ok, msg))
+        if rrow:
+            for metric in sorted(set(rrow) - set(brow)):
+                res.notes.append(
+                    f"{row_name}.{metric}: present in the run but not "
+                    "in the baseline — re-baseline to start gating it")
+    for row_name in sorted(set(rrows) - set(brows)):
+        res.notes.append(f"row {row_name!r}: not in the baseline — "
+                         "re-baseline to start gating it")
+    return res
+
+
+def write_baseline(path: str, results: dict,
+                   prev: Optional[dict] = None) -> dict:
+    """Regenerate the baseline from ``results``, inheriting each
+    metric's kind/tolerance fields from ``prev`` when present."""
+    prev_rows = _rows(prev) if prev else {}
+    rows: Dict[str, dict] = {}
+    for row_name, rrow in sorted(_rows(results).items()):
+        out_row: Dict[str, dict] = {}
+        for metric, val in sorted(rrow.items()):
+            if not isinstance(val, (int, float)) or \
+                    isinstance(val, bool):
+                continue
+            old = prev_rows.get(row_name, {}).get(metric, {})
+            kind = old.get("kind", classify(metric))
+            entry = {"value": val, "kind": kind}
+            for k, dflt in KIND_DEFAULTS.get(kind, {}).items():
+                entry[k] = old.get(k, dflt)
+            out_row[metric] = entry
+        rows[row_name] = out_row
+    blob = {
+        "v": 1,
+        "_note": "perf-gate smoke baseline — regenerate DELIBERATELY "
+                 "with `python tools/bench_gate.py --run "
+                 "--write-baseline` and justify the re-baseline in the "
+                 "PR (docs/observability.md)",
+        "rows": rows,
+    }
+    with open(path, "w") as f:
+        json.dump(blob, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return blob
+
+
+# ------------------------------------------------------------------ output
+def format_gate(res: GateResult, fmt: str = "text") -> str:
+    lines: List[str] = []
+    if fmt == "github":
+        for c in res.failures:
+            lines.append(f"::error::bench_gate {c.name}: {c.message}")
+        for n in res.notes:
+            lines.append(f"::notice::bench_gate: {n}")
+    elif fmt == "json":
+        lines.append(json.dumps({
+            "ok": res.ok,
+            "checks": [c.__dict__ for c in res.checks],
+            "failures": [c.name for c in res.failures],
+            "notes": res.notes}, indent=2))
+    else:
+        for c in res.checks:
+            mark = "ok  " if c.ok else "FAIL"
+            lines.append(f"{mark} {c.name} [{c.kind}]: {c.message}")
+        for n in res.notes:
+            lines.append(f"note {n}")
+        lines.append(
+            f"bench_gate: {len(res.checks)} metric(s) checked, "
+            f"{len(res.failures)} regression(s)")
+    return "\n".join(lines)
+
+
+def _run_smoke() -> dict:
+    """Import bench.py from the repo root (this file lives in tools/)
+    and run the smoke tier in-process."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import bench
+    return bench.bench_smoke()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_gate",
+        description="perf regression gate over the bench.py smoke "
+                    "tier (docs/observability.md)")
+    ap.add_argument("--results", default=None,
+                    help="smoke-result JSON file (bench.py --suite "
+                         "smoke --out ...)")
+    ap.add_argument("--run", action="store_true",
+                    help="run the smoke tier in-process instead of "
+                         "reading --results")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"baseline path (default {DEFAULT_BASELINE})")
+    ap.add_argument("--format", default="text",
+                    choices=["text", "github", "json"])
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from this run "
+                         "(keeps existing per-metric tolerances)")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.run:
+            results = _run_smoke()
+        elif args.results:
+            with open(args.results) as f:
+                results = json.load(f)
+        else:
+            print("bench_gate: need --results FILE or --run",
+                  file=sys.stderr)
+            return 2
+        prev = None
+        if os.path.exists(args.baseline):
+            with open(args.baseline) as f:
+                prev = json.load(f)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_gate: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.baseline, results, prev)
+        print(f"bench_gate: wrote baseline to {args.baseline}")
+        return 0
+
+    if prev is None:
+        print(f"bench_gate: no baseline at {args.baseline} — create "
+              "one with --write-baseline", file=sys.stderr)
+        return 2
+    res = compare(results, prev)
+    out = format_gate(res, args.format)
+    if out:
+        print(out)
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
