@@ -628,7 +628,8 @@ def bench_moe_lm(batch: int = 8, seq_len: int = 1024, d_model: int = 512,
 
 #: rows of the CPU smoke tier; tools/bench_gate.py gates them against
 #: BENCH_SMOKE_BASELINE.json in tier-1 (docs/observability.md)
-SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine")
+SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine",
+              "flight_recorder_overhead")
 
 
 def _smoke_trainer(batch: int = 16):
@@ -768,6 +769,37 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
             "decode_compiles": cw.total,
             "steps": st["steps"] - st0["steps"],
             "tokens_out": gen,
+        }
+    if "flight_recorder_overhead" in rows:
+        # the always-on cost of the flight recorder (obs/flight.py):
+        # same tiny train loop with the recorder off vs on. The gated
+        # metric is the RATIO (off/on steps/s) — machine-independent;
+        # > 2.0 means always-on recording doubled the step time and
+        # the gate fails (BENCH_SMOKE_BASELINE.json).
+        from paddle_tpu.obs.flight import FLIGHT
+        trainer, data = _smoke_trainer()
+        trainer.train_batch(data)               # compile + warm
+
+        def _steps_per_s(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                trainer.train_batch(data)
+            return n / (time.perf_counter() - t0)
+
+        prev = FLIGHT.enabled
+        try:
+            FLIGHT.enabled = False
+            _steps_per_s(4)                     # settle both modes
+            off = _steps_per_s(train_steps)
+            FLIGHT.enabled = True
+            _steps_per_s(4)
+            on = _steps_per_s(train_steps)
+        finally:
+            FLIGHT.enabled = prev
+        out["flight_recorder_overhead"] = {
+            "steps_per_s_off": round(off, 2),
+            "steps_per_s_on": round(on, 2),
+            "overhead_ratio": round(off / on, 3),
         }
     return {"v": 1, "suite": "smoke", "rows": out}
 

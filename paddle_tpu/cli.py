@@ -358,8 +358,16 @@ def _cmd_serve(args) -> int:
                                   build_http_server)
 
     stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+
+    def _on_stop_signal(*a):
+        # the SIGTERM postmortem: a bundle of the last moments before
+        # the drain, while the queue/slot state is still live
+        from paddle_tpu.obs.flight import FLIGHT
+        FLIGHT.maybe_autodump("sigterm")
+        stop.append(1)
+
+    signal.signal(signal.SIGTERM, _on_stop_signal)
+    signal.signal(signal.SIGINT, _on_stop_signal)
     t = threading.Thread(target=httpd.serve_forever, daemon=True,
                          name="pt-serve-http")
     t.start()
@@ -444,19 +452,155 @@ def _cmd_diagram(args) -> int:
     return 0
 
 
+def _iter_journal_follow(path: str, domain=None, kind=None,
+                         poll: float = 0.25, idle_timeout=None,
+                         from_pos: int = 0, stop=None):
+    """``tail -f`` over a journal JSONL file: yield each NEW
+    schema-valid (filtered) record as it is appended. A torn trailing
+    line stays buffered until its newline lands (the writer flushes
+    whole lines, so this is just the race window). Ends when
+    ``idle_timeout`` seconds pass with no new record (None: follow
+    forever) or ``stop`` (a threading.Event) is set — the testable
+    seam (tests/test_cli.py)."""
+    from paddle_tpu.obs.events import validate
+    pos = from_pos
+    buf = ""
+    last_new = time.monotonic()
+    while True:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        if size < pos:                  # truncated/rotated: restart
+            pos, buf = 0, ""
+        if size > pos:
+            with open(path, encoding="utf-8") as f:
+                f.seek(pos)
+                buf += f.read()
+                pos = f.tell()
+            lines = buf.split("\n")
+            buf = lines.pop()           # possibly-torn tail
+            for line in lines:
+                if not line.strip():
+                    continue
+                try:
+                    rec = validate(json.loads(line))
+                except (json.JSONDecodeError, ValueError):
+                    continue            # torn/corrupt mid-stream line
+                last_new = time.monotonic()
+                if domain is not None and rec["domain"] != domain:
+                    continue
+                if kind is not None and rec["kind"] != kind:
+                    continue
+                yield rec
+        if stop is not None and stop.is_set():
+            return
+        if idle_timeout is not None and \
+                time.monotonic() - last_new >= idle_timeout:
+            return
+        time.sleep(poll)
+
+
 def _cmd_events(args) -> int:
     """`paddle_tpu events tail` — the incident-response verb: newest
-    journal records (schema-validated, filtered) as JSON lines
+    journal records (schema-validated, filtered) as JSON lines; with
+    ``--follow`` keep streaming records as the run appends them
     (docs/observability.md)."""
     from paddle_tpu.obs.events import read_journal
     if not os.path.exists(args.log):
         raise SystemExit(f"no journal at {args.log!r}")
-    recs = [r for r in read_journal(args.log, strict=False)
-            if (args.domain is None or r["domain"] == args.domain)
-            and (args.kind is None or r["kind"] == args.kind)]
+    recs = list(read_journal(args.log, strict=False,
+                             domain=args.domain, kind=args.kind))
     for r in recs[-max(args.n, 0):]:
-        print(json.dumps(r))
+        print(json.dumps(r), flush=True)
+    if not args.follow:
+        return 0
+    idle = args.exit_after_idle if args.exit_after_idle > 0 else None
+    for r in _iter_journal_follow(
+            args.log, domain=args.domain, kind=args.kind,
+            idle_timeout=idle,
+            from_pos=os.path.getsize(args.log)):
+        print(json.dumps(r), flush=True)
     return 0
+
+
+def _cmd_obs(args) -> int:
+    """`paddle_tpu obs dump|selfcheck` — the flight-recorder verbs
+    (docs/observability.md "Trace context & postmortems")."""
+    from paddle_tpu.obs.flight import FLIGHT
+    if args.action == "dump":
+        if args.url:
+            # a RUNNING process's bundle over its /flight endpoint
+            # (serving front or obs httpd)
+            import urllib.request
+            with urllib.request.urlopen(
+                    args.url.rstrip("/") + "/flight", timeout=30) as r:
+                bundle = json.loads(r.read())
+            out = args.out or f"flight-remote-{os.getpid()}.json"
+            with open(out, "w", encoding="utf-8") as f:
+                json.dump(bundle, f)
+            print(json.dumps({"job": "obs_dump", "status": "ok",
+                              "source": args.url, "out": out,
+                              "ring_records":
+                                  len(bundle.get("ring", []))}))
+            return 0
+        path = FLIGHT.dump("cli", path=args.out)
+        print(json.dumps({"job": "obs_dump", "status": "ok",
+                          "out": path}))
+        return 0
+    # selfcheck: exercise every observability surface end-to-end —
+    # the tier-1 smoke step (tests/test_cli.py)
+    import tempfile
+
+    from paddle_tpu.obs.events import EventJournal, read_journal
+    from paddle_tpu.obs.metrics import REGISTRY
+    from paddle_tpu.obs.trace import TRACER
+    from paddle_tpu.utils.stats import global_counters
+    checks = {}
+    global_counters.bump("obs/selfcheck")
+    text = REGISTRY.exposition()
+    checks["metrics_scrape"] = \
+        'paddle_tpu_counter_total{name="obs/selfcheck"} ' in text
+    with tempfile.TemporaryDirectory(prefix="pt-obs-selfcheck-") as td:
+        jpath = os.path.join(td, "journal.jsonl")
+        j = EventJournal()
+        j.configure(jpath)
+        j.emit("obs", "selfcheck", probe=1)
+        j.configure(None)
+        recs = list(read_journal(jpath))
+        checks["journal_roundtrip"] = (
+            len(recs) == 1 and recs[0]["kind"] == "selfcheck"
+            and "run_id" in recs[0] and "host" in recs[0])
+        TRACER.start(capture_compiles=False)
+        with TRACER.span("obs/selfcheck"):
+            pass
+        TRACER.stop()
+        checks["trace_spans"] = any(
+            s["name"] == "obs/selfcheck" for s in TRACER.spans())
+        from paddle_tpu.obs.flight import BUNDLE_VERSION
+        FLIGHT.record("mark", "obs/selfcheck")
+        dpath = FLIGHT.dump("selfcheck",
+                            path=os.path.join(td, "flight.json"))
+        with open(dpath, encoding="utf-8") as f:
+            bundle = json.load(f)
+        checks["flight_dump"] = (
+            bundle.get("v") == BUNDLE_VERSION
+            and any(r.get("name") == "obs/selfcheck"
+                    for r in bundle.get("ring", []))
+            and "metrics" in bundle and "journal" in bundle)
+    ok = all(checks.values())
+    print(json.dumps({"job": "obs_selfcheck",
+                      "status": "ok" if ok else "fail",
+                      "checks": checks}))
+    return 0 if ok else 1
+
+
+def _cmd_trace(args) -> int:
+    """`paddle_tpu trace merge` — fuse per-host journals + chrome
+    traces into one timeline (paddle_tpu/obs/merge.py; the standalone
+    twin is tools/trace_merge.py)."""
+    from paddle_tpu.obs.merge import main as merge_main
+    return merge_main(list(args.merge_args or []))
 
 
 def main(argv=None) -> int:
@@ -540,6 +684,18 @@ def main(argv=None) -> int:
                          "OOMs, data faults, checkpoints — schema v1 "
                          "JSONL) to this file; inspect with "
                          "`paddle_tpu events tail --log FILE`")
+    tr.add_argument("--run_id", default=None,
+                    help="correlation id stamped on every journal "
+                         "record/span this run emits (default: "
+                         "generated; pass the SAME id to every worker "
+                         "of a multi-host job so `paddle_tpu trace "
+                         "merge` groups them — docs/observability.md)")
+    tr.add_argument("--flight_dir", default=None,
+                    help="arm flight-recorder auto-dump: postmortem "
+                         "bundles (recent spans/events, metrics, "
+                         "journal tail, live state) land here on "
+                         "fault streaks, OOM and fatal exceptions; "
+                         "`paddle_tpu obs dump` fetches one on demand")
     tr.add_argument("--profile_dir", default=None,
                     help="--job=profile trace output dir "
                          "(default ./profile_out)")
@@ -595,6 +751,15 @@ def main(argv=None) -> int:
                          "breaker flips, engine preemptions) to this "
                          "JSONL file; the ring is always served on "
                          "GET /events")
+    sv.add_argument("--run_id", default=None,
+                    help="correlation id stamped on every journal "
+                         "record/span (default: generated)")
+    sv.add_argument("--flight_dir", default=None,
+                    help="arm flight-recorder auto-dump: postmortem "
+                         "bundles land here on breaker-open, engine "
+                         "step failures, SIGTERM and fatal "
+                         "exceptions; GET /flight serves one on "
+                         "demand")
 
     sub.add_parser("version", help="print version (paddle version parity)")
 
@@ -612,6 +777,41 @@ def main(argv=None) -> int:
     evp.add_argument("--kind", default=None,
                      help="filter: oom, quarantine, shed, preemption, "
                           "...")
+    evp.add_argument("--follow", action="store_true",
+                     help="after printing the tail, keep streaming "
+                          "records as the run appends them "
+                          "(tail -f for the journal)")
+    evp.add_argument("--exit-after-idle", type=float, default=0,
+                     dest="exit_after_idle",
+                     help="with --follow: exit after N seconds with "
+                          "no new record (0: follow forever) — for "
+                          "scripted incident capture")
+
+    ob = sub.add_parser("obs", help="flight-recorder verbs: postmortem "
+                        "dump + observability selfcheck "
+                        "(docs/observability.md)")
+    ob.add_argument("action", choices=["dump", "selfcheck"],
+                    help="dump: write a postmortem bundle (this "
+                         "process, or --url for a running one); "
+                         "selfcheck: exercise metrics/journal/trace/"
+                         "recorder end-to-end")
+    ob.add_argument("--url", default=None,
+                    help="dump: base URL of a running process's obs "
+                         "endpoint (serving front or train "
+                         "--metrics_port) — fetches GET /flight")
+    ob.add_argument("--out", default=None,
+                    help="dump: output path (default: the configured "
+                         "dump dir or the system temp dir)")
+
+    trc = sub.add_parser("trace", help="cross-process trace tooling "
+                         "(docs/observability.md)")
+    trc.add_argument("action", choices=["merge"],
+                     help="merge: fuse N per-host journals + chrome "
+                          "traces into one timeline")
+    trc.add_argument("merge_args", nargs=argparse.REMAINDER,
+                     help="trace_merge flags: --journal FILES... "
+                          "--trace FILES... --out-journal P "
+                          "--out-trace P --offset HOST=SECONDS")
 
     ln = sub.add_parser("lint", help="JAX-aware static analysis "
                         "(ptlint — docs/static_analysis.md)")
@@ -657,12 +857,23 @@ def main(argv=None) -> int:
         return _cmd_diagram(args)
     if args.command == "events":
         return _cmd_events(args)
+    if args.command == "obs":
+        return _cmd_obs(args)
+    if args.command == "trace":
+        return _cmd_trace(args)
     if args.command == "coordinator":
         return _cmd_coordinator(args)
     if args.command == "serve":
+        from paddle_tpu.obs import context as obs_context
+        from paddle_tpu.obs.events import JOURNAL
+        from paddle_tpu.obs.flight import FLIGHT, install_excepthook
+        if args.run_id:
+            obs_context.set_run_id(args.run_id)
         if args.event_log:
-            from paddle_tpu.obs.events import JOURNAL
             JOURNAL.configure(args.event_log)
+        if args.flight_dir:
+            FLIGHT.configure(dump_dir=args.flight_dir)
+        install_excepthook()
         return _cmd_serve(args)
     if args.command == "version":
         import paddle_tpu
@@ -680,11 +891,18 @@ def main(argv=None) -> int:
                 seed=args.seed, compute_dtype=args.dtype,
                 log_period=args.log_period)
     # observability wiring (docs/observability.md): the event journal's
-    # file sink and the standalone /metrics + /events endpoint cover
-    # the WHOLE run, whichever --job it is
+    # file sink, the flight recorder and the standalone /metrics +
+    # /events endpoint cover the WHOLE run, whichever --job it is
+    from paddle_tpu.obs import context as obs_context
     from paddle_tpu.obs.events import JOURNAL
+    from paddle_tpu.obs.flight import FLIGHT, install_excepthook
+    if args.run_id:
+        obs_context.set_run_id(args.run_id)
     if args.event_log:
         JOURNAL.configure(args.event_log)
+    if args.flight_dir:
+        FLIGHT.configure(dump_dir=args.flight_dir)
+    install_excepthook()
     obs_httpd = None
     if args.metrics_port is not None:
         from paddle_tpu.obs.httpd import start_obs_server

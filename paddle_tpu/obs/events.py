@@ -29,10 +29,15 @@ Schema v1 — every record carries:
                     restart_budget, shed, breaker, preemption,
                     step_failure, save, restore, run_start, run_end
 
-plus free-form kind-specific fields (JSON scalars; non-serializable
-values are repr()'d at emit time). docs/observability.md catalogs the
-kinds per domain. Emission must NEVER take down a hot path: file-write
-failures are counted and warned once, not raised.
+plus, since observability v2 (docs/observability.md "Trace context &
+postmortems"), the correlation IDs the merge tooling keys on —
+``run_id`` and ``host`` on every record (obs/context.py), ``trace_id``
+/ ``step`` when the emitting thread has one bound — and free-form
+kind-specific fields (JSON scalars; non-serializable values are
+repr()'d at emit time). docs/observability.md catalogs the kinds per
+domain. Emission must NEVER take down a hot path: file-write failures
+are counted and warned once, not raised; observer failures (the
+flight recorder's auto-dump hook) are swallowed the same way.
 """
 
 from __future__ import annotations
@@ -42,8 +47,9 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Iterator, List, Optional, Tuple
+from typing import Callable, Iterator, List, Optional, Tuple
 
+from paddle_tpu.obs import context as obs_context
 from paddle_tpu.utils.logging import get_logger
 
 __all__ = ["SCHEMA_VERSION", "REQUIRED_FIELDS", "EventJournal", "JOURNAL",
@@ -100,6 +106,8 @@ class EventJournal:
         self._fh = None
         self._path: Optional[str] = None
         self._write_errors = 0
+        self._observers: List[Callable[[dict], None]] = []
+        self._observer_errors = 0
 
     @property
     def path(self) -> Optional[str]:
@@ -125,17 +133,24 @@ class EventJournal:
     def emit(self, domain: str, kind: str, **fields) -> dict:
         """Build, ring-buffer, and (when configured) persist one
         record. Never raises into the caller's hot path — a failed
-        file write is counted and warned once."""
+        file write is counted and warned once. Correlation IDs
+        (run_id/host always; trace_id/step when bound on the emitting
+        thread — obs/context.py) are stamped unless the caller passed
+        its own."""
         rec = {"v": SCHEMA_VERSION, "ts": time.time(),
                "pid": os.getpid(), "domain": str(domain),
                "kind": str(kind)}
+        for k, v in obs_context.current_fields().items():
+            if k not in fields:
+                rec[k] = _jsonable(v)
         for k, v in fields.items():
-            if k not in rec:
+            if k not in rec and v is not None:
                 rec[k] = _jsonable(v)
         with self._lock:
             self._seq += 1
             rec["seq"] = self._seq
             self._ring.append(rec)
+            observers = list(self._observers)
             if self._fh is not None:
                 try:
                     self._fh.write(json.dumps(rec) + "\n")
@@ -147,6 +162,19 @@ class EventJournal:
                             "event journal write to %s failed; further "
                             "failures counted silently "
                             "(journal/write_errors)", self._path)
+        # observers run OUTSIDE the lock: the flight recorder's
+        # auto-dump reads tail() back through it
+        for fn in observers:
+            try:
+                fn(rec)
+            except Exception:  # noqa: BLE001 — emission never raises
+                with self._lock:
+                    self._observer_errors += 1
+                    first = self._observer_errors == 1
+                if first:
+                    get_logger().warning(
+                        "event journal observer %r failed; further "
+                        "failures counted silently", fn)
         return rec
 
     def emit_event(self, event) -> dict:
@@ -156,16 +184,42 @@ class EventJournal:
         return self.emit(domain, kind, **fields)
 
     def tail(self, n: int = 100, domain: Optional[str] = None,
-             kind: Optional[str] = None) -> List[dict]:
+             kind: Optional[str] = None,
+             since_seq: Optional[int] = None) -> List[dict]:
         """Newest-last slice of the in-memory ring, optionally
-        filtered."""
+        filtered. With ``since_seq`` the semantics flip to a CURSOR:
+        the OLDEST ``n`` matching records with seq > since_seq, so a
+        scraper pages forward (``GET /events?since_seq=``) without
+        re-reading the ring from the start — resume from the last
+        record's seq."""
         with self._lock:
             recs = list(self._ring)
         if domain is not None:
             recs = [r for r in recs if r["domain"] == domain]
         if kind is not None:
             recs = [r for r in recs if r["kind"] == kind]
+        if since_seq is not None:
+            return [r for r in recs if r["seq"] > int(since_seq)][:int(n)]
         return recs[-int(n):]
+
+    @property
+    def last_seq(self) -> int:
+        """The newest seq handed out — the ``since_seq`` cursor a
+        scraper resumes from."""
+        with self._lock:
+            return self._seq
+
+    def add_observer(self, fn: Callable[[dict], None]) -> None:
+        """``fn(rec)`` is called after every emit (outside the journal
+        lock). The flight recorder registers here (obs/__init__)."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
+
+    def remove_observer(self, fn) -> None:
+        with self._lock:
+            if fn in self._observers:
+                self._observers.remove(fn)
 
     @property
     def write_errors(self) -> int:
@@ -174,12 +228,14 @@ class EventJournal:
 
     def reset(self) -> None:
         """Detach the sink and clear the ring (between-tests hygiene —
-        tests/conftest.py)."""
+        tests/conftest.py). Observers survive: the flight-recorder
+        wiring is process topology, not state."""
         self.configure(None)
         with self._lock:
             self._ring.clear()
             self._seq = 0
             self._write_errors = 0
+            self._observer_errors = 0
 
 
 #: the process-global journal every subsystem emits through
@@ -195,8 +251,10 @@ def emit_event(event) -> dict:
 
 
 def tail(n: int = 100, domain: Optional[str] = None,
-         kind: Optional[str] = None) -> List[dict]:
-    return JOURNAL.tail(n, domain=domain, kind=kind)
+         kind: Optional[str] = None,
+         since_seq: Optional[int] = None) -> List[dict]:
+    return JOURNAL.tail(n, domain=domain, kind=kind,
+                        since_seq=since_seq)
 
 
 def record_fields(event) -> Tuple[str, str, dict]:
@@ -228,18 +286,22 @@ def _err_str(e) -> Optional[str]:
     return None if e is None else repr(e)[:400]
 
 
-def read_journal(path: str, strict: bool = True) -> Iterator[dict]:
+def read_journal(path: str, strict: bool = True,
+                 domain: Optional[str] = None,
+                 kind: Optional[str] = None) -> Iterator[dict]:
     """Yield schema-validated records from a JSONL journal file. A torn
     FINAL line (the process died mid-write) is always skipped; any
     other malformed line raises with ``strict`` and is skipped with a
-    warning otherwise."""
+    warning otherwise. ``domain``/``kind`` filter with the SAME
+    semantics as ``EventJournal.tail`` — the parity is test-pinned
+    (tests/test_obs.py) so ring and file queries agree."""
     with open(path, encoding="utf-8") as f:
         lines = f.read().splitlines()
     for i, line in enumerate(lines):
         if not line.strip():
             continue
         try:
-            yield validate(json.loads(line))
+            rec = validate(json.loads(line))
         except (json.JSONDecodeError, ValueError) as e:
             if i == len(lines) - 1:
                 get_logger().warning(
@@ -251,3 +313,9 @@ def read_journal(path: str, strict: bool = True) -> Iterator[dict]:
                 ) from e
             get_logger().warning("journal %s:%d: skipping malformed "
                                  "record: %s", path, i + 1, e)
+            continue
+        if domain is not None and rec["domain"] != domain:
+            continue
+        if kind is not None and rec["kind"] != kind:
+            continue
+        yield rec

@@ -10,7 +10,12 @@ can start (CLI ``train --metrics_port``) exposing
                  (obs/metrics.py — trainer, data-pipeline, fault and
                  decode-engine domains via the utils/stats bridge)
   GET /events    the event journal's in-memory ring as JSON
-                 (?n=100&domain=...&kind=... filters)
+                 (?n=100&domain=...&kind=... filters; ?since_seq=N
+                 pages forward from a cursor — the response's
+                 "last_seq" is the next cursor)
+  GET /flight    the flight recorder's postmortem bundle, on demand
+                 (obs/flight.py; `paddle_tpu obs dump --url` fetches
+                 this)
   GET /health    {"status": "ok"} liveness probe
 
 Scrape handlers only READ snapshots; they never touch the train step.
@@ -60,12 +65,19 @@ def build_obs_http_server(host: str = "127.0.0.1",
                 qs = parse_qs(url.query)
                 try:
                     n = int(qs.get("n", ["100"])[0])
+                    since = qs.get("since_seq", [None])[0]
+                    since = int(since) if since is not None else None
                 except ValueError:
-                    self._json(400, {"error": "n must be an integer"})
+                    self._json(400, {"error": "n/since_seq must be "
+                                              "integers"})
                     return
                 self._json(200, {"events": JOURNAL.tail(
                     n, domain=qs.get("domain", [None])[0],
-                    kind=qs.get("kind", [None])[0])})
+                    kind=qs.get("kind", [None])[0], since_seq=since),
+                    "last_seq": JOURNAL.last_seq})
+            elif url.path == "/flight":
+                from paddle_tpu.obs.flight import FLIGHT
+                self._json(200, FLIGHT.bundle(reason="http"))
             elif url.path == "/health":
                 self._json(200, {"status": "ok"})
             else:

@@ -45,7 +45,9 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from paddle_tpu.obs import context as obs_context
 from paddle_tpu.obs.events import emit as journal_emit
+from paddle_tpu.obs.flight import FLIGHT
 from paddle_tpu.serving.server import (Expired, Rejected, ServerClosed,
                                        ServingError)
 from paddle_tpu.utils.stats import global_counters, stat_timer
@@ -116,11 +118,14 @@ class GenRequest:
 
     def __init__(self, prompt, max_new_tokens: int,
                  eos_id: Optional[int], deadline: Optional[float],
-                 now: float):
+                 now: float, trace_id: Optional[str] = None):
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new_tokens)
         self.eos_id = eos_id
         self.deadline = deadline          # absolute time.monotonic()
+        # the request's end-to-end correlation id: every flight/journal
+        # record this request touches carries it
+        self.trace_id = trace_id or obs_context.new_trace_id()
         self.tokens: List[int] = []
         self.state = "waiting"  # waiting|running|done|cancelled|failed
         self.error: Optional[ServingError] = None
@@ -239,6 +244,31 @@ class DecodeEngine:
                           "tokens_out": 0, "prefill_tokens": 0}
         import jax
         self._key0 = jax.random.PRNGKey(0)
+        # live-state provider for postmortem bundles: the slot table
+        # and wait queue by trace_id at dump time. Weakref'd so dead
+        # engines never pin themselves in the recorder.
+        import weakref
+        ref = weakref.ref(self)
+
+        def _flight_state():
+            eng = ref()
+            if eng is None:
+                return None
+            slots = [
+                None if sl is None else
+                {"trace_id": sl.req.trace_id, "pos": sl.pos,
+                 "generated": sl.req.num_generated,
+                 "pages": len(sl.pages)}
+                for sl in list(eng.slots)]
+            with eng._cv:
+                waiting = [r.trace_id for r in eng._waiting]
+                steps = eng._steps
+            return {"slots": slots, "waiting_trace_ids": waiting,
+                    "steps": steps,
+                    "pages": eng.pool.accounting()}
+
+        FLIGHT.register_state_provider(f"engine-{id(self):x}",
+                                       _flight_state)
 
     # ------------------------------------------------------------ admission
     def _pages_for(self, n_tokens: int) -> int:
@@ -251,13 +281,18 @@ class DecodeEngine:
 
     def submit(self, prompt, max_new_tokens: int, *,
                eos_id: Optional[int] = None,
-               deadline: Optional[float] = None) -> GenRequest:
+               deadline: Optional[float] = None,
+               trace_id: Optional[str] = None) -> GenRequest:
         """Admit one generation request. Raises the serving-typed
         errors at admission (``Rejected`` reasons: ``kv_capacity`` for
         a request the pool could NEVER hold, ``queue_full`` for a
         saturated wait queue); the request itself settles with tokens
-        or a typed error."""
+        or a typed error. ``trace_id`` correlates the request through
+        admission → slot → every decode step → settle (minted here
+        when the front passed none)."""
         now = self._clock()
+        trace_id = trace_id or obs_context.current().trace_id \
+            or obs_context.new_trace_id()
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt or int(max_new_tokens) < 1:
             raise ValueError("need a non-empty prompt and "
@@ -272,6 +307,8 @@ class DecodeEngine:
             if total > self.max_seq_len or \
                     self._pages_for(total) > self.pool.usable:
                 self._counters["rejected_capacity"] += 1
+                FLIGHT.record("mark", "engine/reject",
+                              trace_id=trace_id, reason="kv_capacity")
                 raise Rejected(
                     f"request needs {total} positions "
                     f"({self._pages_for(total)} KV pages) but the "
@@ -282,15 +319,20 @@ class DecodeEngine:
             if len(self._waiting) >= self.max_waiting:
                 self._counters["rejected_queue"] += 1
                 retry = self._retry_hint()
+                FLIGHT.record("mark", "engine/reject",
+                              trace_id=trace_id, reason="queue_full")
                 raise Rejected(
                     f"generation queue full ({self.max_waiting}); "
                     f"retry in {retry:.2f}s", retry_after=retry,
                     reason="queue_full")
             req = GenRequest(prompt, max_new_tokens, eos_id,
-                             abs_deadline, now)
+                             abs_deadline, now, trace_id=trace_id)
             self._counters["submitted"] += 1
             self._waiting.append(req)
             self._cv.notify_all()
+        FLIGHT.record("mark", "engine/submit", trace_id=trace_id,
+                      prompt_len=len(prompt),
+                      max_new=int(max_new_tokens))
         return req
 
     # ------------------------------------------------------------ scheduling
@@ -325,6 +367,10 @@ class DecodeEngine:
             self._counters["closed"] += 1
         if counter:
             global_counters.bump(f"serving/decode_{counter}")
+        FLIGHT.record("mark", "engine/settle",
+                      trace_id=slot.req.trace_id, state=state,
+                      slot=s, generated=slot.req.num_generated,
+                      error=repr(error)[:200] if error else None)
         self._settle(slot.req, state, error)
         with self._cv:
             self._cv.notify_all()
@@ -347,7 +393,8 @@ class DecodeEngine:
         journal_emit("engine", "preemption",
                      generated=req.num_generated,
                      evictions=req.evictions,
-                     free_pages=self.pool.free_pages)
+                     free_pages=self.pool.free_pages,
+                     trace_id=req.trace_id)
         with self._cv:
             self._waiting.appendleft(req)
 
@@ -370,9 +417,15 @@ class DecodeEngine:
             for req in self._waiting:
                 if req._cancelled:
                     self._counters["cancelled"] += 1
+                    FLIGHT.record("mark", "engine/settle",
+                                  trace_id=req.trace_id,
+                                  state="cancelled", where="waiting")
                     self._settle(req, "cancelled")
                 elif req.deadline is not None and now > req.deadline:
                     self._counters["expired"] += 1
+                    FLIGHT.record("mark", "engine/settle",
+                                  trace_id=req.trace_id,
+                                  state="expired", where="waiting")
                     self._settle(req, "failed", Expired(
                         "deadline passed while queued for a slot"))
                 else:
@@ -397,6 +450,9 @@ class DecodeEngine:
                 req.state = "running"
                 self._arrival_seq += 1
                 self.slots[s] = _Slot(req, self._arrival_seq)
+                FLIGHT.record("mark", "engine/admit",
+                              trace_id=req.trace_id, slot=s,
+                              replay=len(req.prompt) + len(req.tokens))
 
     def _ensure_pages(self) -> None:
         """Allocate each active slot's next page at its page boundary;
@@ -469,6 +525,13 @@ class DecodeEngine:
             slot = self.slots[s]
             fed = slot.pos
             slot.pos += 1
+            # one compact flight record per slot-step: the "each decode
+            # step" link of the request's trace chain — a postmortem
+            # bundle reconstructs the request's whole schedule from
+            # these by trace_id (tests/test_flight.py acceptance)
+            FLIGHT.record("mark", "engine/slot_step",
+                          trace_id=slot.req.trace_id,
+                          engine_step=self._steps, slot=s, pos=fed)
             with self._cv:
                 self._cache_tokens_read += slot.pos
             if fed < len(slot.replay) - 1:
@@ -497,20 +560,34 @@ class DecodeEngine:
         """A failed dispatch may have consumed the (donated) pools:
         settle everything in flight with a typed error, then rebuild
         pools + free-list so fresh traffic can still be served."""
+        in_flight = [self.slots[s].req.trace_id
+                     for s in range(self.num_slots)
+                     if self.slots[s] is not None]
         with self._cv:
             self._counters["step_failures"] += 1
-        journal_emit("engine", "step_failure", error=repr(exc)[:400])
+            waiting_ids = [r.trace_id for r in self._waiting]
         err = ServingError(f"decode step failed: {exc}")
         for s in range(self.num_slots):
             if self.slots[s] is not None:
                 self._finish(s, "failed", err)
         with self._cv:
             while self._waiting:
-                self._settle(self._waiting.popleft(), "failed", err)
+                req = self._waiting.popleft()
+                FLIGHT.record("mark", "engine/settle",
+                              trace_id=req.trace_id, state="failed",
+                              where="waiting")
+                self._settle(req, "failed", err)
         self.k_pool, self.v_pool = self.paged.init_pools()
         self.pool = PagePool(self.pool.num_pages)
         self._tables[:, :] = 0
         self._active[:] = False
+        # journaled AFTER the typed settles so the auto-dumped bundle
+        # (obs/flight.py trigger) contains each victim's COMPLETE chain
+        # — submit → admit → every slot_step → settle(failed) — plus
+        # this record naming the in-flight trace ids at fault time
+        journal_emit("engine", "step_failure", error=repr(exc)[:400],
+                     trace_ids=in_flight, waiting_trace_ids=waiting_ids,
+                     engine_step=self._steps)
 
     def _has_work(self) -> bool:
         return any(s is not None for s in self.slots) or \

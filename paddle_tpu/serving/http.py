@@ -14,7 +14,12 @@ Endpoints:
                            scrape sees the whole process
   GET  /events          -> the structured event journal's in-memory
                            ring (paddle_tpu/obs/events.py;
-                           ?n=100&domain=...&kind=... filters)
+                           ?n=100&domain=...&kind=... filters;
+                           ?since_seq=N pages forward from a cursor —
+                           the response's "last_seq" is the next one)
+  GET  /flight          -> the flight recorder's postmortem bundle on
+                           demand (paddle_tpu/obs/flight.py;
+                           `paddle_tpu obs dump --url` fetches this)
   POST /infer           -> body {"rows": [[f32...], ...],
                                  "deadline_ms": optional}
                            200 {"outputs": [[...], ...]}
@@ -25,6 +30,13 @@ Endpoints:
                            200 {"tokens": [int...]} — routed through
                            the continuous-batching decode engine
                            (501 when no engine is attached)
+
+Every /infer and /generate request gets ONE trace_id at this front —
+taken from an ``X-Trace-Id`` header or body ``trace_id`` field when a
+gateway propagates its own, minted fresh otherwise — which flows
+through admission, queue wait, the engine slot, every decode step and
+settle/shed (docs/observability.md "Trace context & postmortems"), and
+is echoed back in the response body + ``X-Trace-Id`` header.
 
 Admission failures map onto transport status codes:
   429 + Retry-After     queue full (backpressure)
@@ -43,6 +55,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from paddle_tpu.obs import context as obs_context
 from paddle_tpu.obs.events import JOURNAL
 from paddle_tpu.obs.metrics import REGISTRY, stats_families
 from paddle_tpu.serving.server import (Expired, InferenceServer, Rejected,
@@ -95,6 +108,16 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(body)
 
+        def _trace_id(self, req: dict) -> str:
+            """The request's end-to-end correlation id, minted HERE at
+            the front (docs/observability.md "Trace context"): an
+            ``X-Trace-Id`` header or body ``trace_id`` field wins (a
+            client/gateway propagating its own id), else a fresh one.
+            Echoed back in every response so the client can quote it
+            at the journal / flight recorder."""
+            tid = self.headers.get("X-Trace-Id") or req.get("trace_id")
+            return str(tid) if tid else obs_context.new_trace_id()
+
         def do_GET(self):
             url = urlparse(self.path)
             if url.path == "/health":
@@ -113,12 +136,19 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 qs = parse_qs(url.query)
                 try:
                     n = int(qs.get("n", ["100"])[0])
+                    since = qs.get("since_seq", [None])[0]
+                    since = int(since) if since is not None else None
                 except ValueError:
-                    self._json(400, {"error": "n must be an integer"})
+                    self._json(400, {"error": "n/since_seq must be "
+                                              "integers"})
                     return
                 self._json(200, {"events": JOURNAL.tail(
                     n, domain=qs.get("domain", [None])[0],
-                    kind=qs.get("kind", [None])[0])})
+                    kind=qs.get("kind", [None])[0], since_seq=since),
+                    "last_seq": JOURNAL.last_seq})
+            elif url.path == "/flight":
+                from paddle_tpu.obs.flight import FLIGHT
+                self._json(200, FLIGHT.bundle(reason="http"))
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 
@@ -144,27 +174,37 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 self._json(501, {"error": "no decode engine attached "
                                           "to this server"})
                 return
+            tid = self._trace_id(req)
+            hdr = [("X-Trace-Id", tid)]
             try:
-                toks = server.generate(prompt, max_new,
-                                       eos_id=eos_id,
-                                       deadline=deadline)
+                with obs_context.bind(trace_id=tid):
+                    toks = server.generate(prompt, max_new,
+                                           eos_id=eos_id,
+                                           deadline=deadline,
+                                           trace_id=tid)
             except Rejected as e:
                 code = 429 if e.reason == "queue_full" else 503
                 self._json(code, {"error": str(e), "reason": e.reason,
-                                  "retry_after": e.retry_after},
-                           headers=[("Retry-After",
-                                     f"{max(e.retry_after, 0.01):.3f}")])
+                                  "retry_after": e.retry_after,
+                                  "trace_id": tid},
+                           headers=hdr + [
+                               ("Retry-After",
+                                f"{max(e.retry_after, 0.01):.3f}")])
                 return
             except Expired as e:
-                self._json(504, {"error": str(e)})
+                self._json(504, {"error": str(e), "trace_id": tid},
+                           headers=hdr)
                 return
             except ServerClosed as e:
-                self._json(503, {"error": str(e), "reason": "draining"})
+                self._json(503, {"error": str(e), "reason": "draining",
+                                 "trace_id": tid}, headers=hdr)
                 return
             except ServingError as e:
-                self._json(500, {"error": str(e)})
+                self._json(500, {"error": str(e), "trace_id": tid},
+                           headers=hdr)
                 return
-            self._json(200, {"tokens": [int(t) for t in toks]})
+            self._json(200, {"tokens": [int(t) for t in toks],
+                             "trace_id": tid}, headers=hdr)
 
         def do_POST(self):
             if self.path == "/generate":
@@ -186,27 +226,37 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     json.JSONDecodeError) as e:
                 self._json(400, {"error": f"bad request: {e}"})
                 return
+            tid = self._trace_id(req)
+            hdr = [("X-Trace-Id", tid)]
             try:
-                out = server.infer_rows(rows, deadline)
+                with obs_context.bind(trace_id=tid):
+                    out = server.infer_rows(rows, deadline,
+                                            trace_id=tid)
             except Rejected as e:
                 code = 429 if e.reason == "queue_full" else 503
                 self._json(code, {"error": str(e), "reason": e.reason,
-                                  "retry_after": e.retry_after},
-                           headers=[("Retry-After",
-                                     f"{max(e.retry_after, 0.01):.3f}")])
+                                  "retry_after": e.retry_after,
+                                  "trace_id": tid},
+                           headers=hdr + [
+                               ("Retry-After",
+                                f"{max(e.retry_after, 0.01):.3f}")])
                 return
             except Expired as e:
-                self._json(504, {"error": str(e)})
+                self._json(504, {"error": str(e), "trace_id": tid},
+                           headers=hdr)
                 return
             except ServerClosed as e:
-                self._json(503, {"error": str(e), "reason": "draining"})
+                self._json(503, {"error": str(e), "reason": "draining",
+                                 "trace_id": tid}, headers=hdr)
                 return
             except ServingError as e:
-                self._json(500, {"error": str(e)})
+                self._json(500, {"error": str(e), "trace_id": tid},
+                           headers=hdr)
                 return
             except ValueError as e:       # ragged / non-numeric rows
                 self._json(400, {"error": f"bad request: {e}"})
                 return
-            self._json(200, {"outputs": np.asarray(out).tolist()})
+            self._json(200, {"outputs": np.asarray(out).tolist(),
+                             "trace_id": tid}, headers=hdr)
 
     return ThreadingHTTPServer((host, port), Handler)

@@ -42,7 +42,9 @@ from typing import Callable, List, Optional, Union
 
 import numpy as np
 
+from paddle_tpu.obs import context as obs_context
 from paddle_tpu.obs.events import emit as journal_emit
+from paddle_tpu.obs.flight import FLIGHT
 from paddle_tpu.serving.breaker import CircuitBreaker
 from paddle_tpu.utils.stats import global_counters, stat_timer
 
@@ -85,15 +87,19 @@ class ServerClosed(ServingError):
 
 class _Request:
     __slots__ = ("samples", "deadline", "done", "result", "error",
-                 "enqueued_at", "_settled")
+                 "enqueued_at", "trace_id", "_settled")
 
-    def __init__(self, samples, deadline: Optional[float], now: float):
+    def __init__(self, samples, deadline: Optional[float], now: float,
+                 trace_id: Optional[str] = None):
         self.samples = samples
         self.deadline = deadline
         self.done = threading.Event()
         self.result = None
         self.error: Optional[ServingError] = None
         self.enqueued_at = now
+        # one id end-to-end: admission -> queue wait -> forward ->
+        # settle all stamp it (docs/observability.md "Trace context")
+        self.trace_id = trace_id or obs_context.new_trace_id()
         self._settled = False
 
     def get(self, timeout: Optional[float] = None):
@@ -163,6 +169,25 @@ class InferenceServer:
                           "rejected_breaker": 0, "rejected_oom": 0,
                           "oom_events": 0, "expired": 0,
                           "failed": 0, "closed": 0}
+        # live-state provider for postmortem bundles: what was queued
+        # (by trace_id) when the dump fired. Weakref'd so an abandoned
+        # server never pins itself in the recorder.
+        import weakref
+        ref = weakref.ref(self)
+
+        def _flight_state():
+            srv = ref()
+            if srv is None:
+                return None
+            with srv._cv:
+                return {"queued_trace_ids":
+                        [r.trace_id for r in srv._queue],
+                        "inflight": srv._inflight,
+                        "accepting": srv._accepting,
+                        "batch_limit": srv._batch_limit}
+
+        FLIGHT.register_state_provider(f"serving-{id(self):x}",
+                                       _flight_state)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "InferenceServer":
@@ -204,13 +229,17 @@ class InferenceServer:
             self._threads = []
 
     # ------------------------------------------------------------ admission
-    def submit(self, samples,
-               deadline: Optional[float] = None) -> _Request:
+    def submit(self, samples, deadline: Optional[float] = None,
+               trace_id: Optional[str] = None) -> _Request:
         """Admit one request (a list of sample tuples, as
         Inference.infer takes). Returns a future-like _Request. Raises
         Rejected/ServerClosed at admission; the request itself settles
-        with a result or a typed error."""
+        with a result or a typed error. ``trace_id`` correlates the
+        request end-to-end (minted here when the transport passed
+        none); every shed/settle record carries it."""
         now = self._clock()
+        trace_id = trace_id or obs_context.current().trace_id \
+            or obs_context.new_trace_id()
         if deadline is None:
             deadline = self.default_deadline
         abs_deadline = (time.monotonic() + deadline) \
@@ -226,7 +255,8 @@ class InferenceServer:
                     journal_emit("serving", "shed",
                                  reason="resource_exhausted",
                                  where="admission_rows", rows=rows,
-                                 limit=self._batch_limit)
+                                 limit=self._batch_limit,
+                                 trace_id=trace_id)
                     raise Rejected(
                         f"batch of {rows} rows exceeds the adaptive "
                         f"limit of {self._batch_limit} (a previous "
@@ -242,7 +272,8 @@ class InferenceServer:
                                      reason="resource_exhausted",
                                      where="admission_bytes",
                                      estimated_bytes=est,
-                                     budget=self.max_batch_memory)
+                                     budget=self.max_batch_memory,
+                                     trace_id=trace_id)
                         raise Rejected(
                             f"request estimated at {est} bytes exceeds "
                             f"max_batch_memory={self.max_batch_memory}; "
@@ -255,7 +286,8 @@ class InferenceServer:
                     self._counters["rejected_breaker"] += 1
                     journal_emit("serving", "shed",
                                  reason="breaker_open",
-                                 retry_after=retry)
+                                 retry_after=retry,
+                                 trace_id=trace_id)
                     raise Rejected(
                         f"circuit breaker open; retry in {retry:.2f}s",
                         retry_after=retry, reason="breaker_open")
@@ -264,24 +296,30 @@ class InferenceServer:
                 retry = self._retry_hint()
                 journal_emit("serving", "shed", reason="queue_full",
                              queue_depth=len(self._queue),
-                             retry_after=retry)
+                             retry_after=retry, trace_id=trace_id)
                 raise Rejected(
                     f"queue full ({self.max_queue}); retry in "
                     f"{retry:.2f}s", retry_after=retry,
                     reason="queue_full")
-            req = _Request(samples, abs_deadline, now)
+            req = _Request(samples, abs_deadline, now,
+                           trace_id=trace_id)
+            depth = len(self._queue)
             self._queue.append(req)
             self._cv.notify()
+        FLIGHT.record("mark", "serving/admit", trace_id=trace_id,
+                      queue_depth=depth)
         return req
 
-    def infer(self, samples, deadline: Optional[float] = None):
+    def infer(self, samples, deadline: Optional[float] = None,
+              trace_id: Optional[str] = None):
         """Synchronous submit + wait."""
-        return self.submit(samples, deadline).get()
+        return self.submit(samples, deadline, trace_id=trace_id).get()
 
     # --------------------------------------------------------- generation
     def submit_generate(self, prompt, max_new_tokens: int, *,
                         eos_id: Optional[int] = None,
-                        deadline: Optional[float] = None):
+                        deadline: Optional[float] = None,
+                        trace_id: Optional[str] = None):
         """Admit one generation request into the continuous-batching
         decode engine (requires ``engine=``). Admission is the ENGINE's
         — scheduled by free KV pages, with the same typed errors as
@@ -295,15 +333,18 @@ class InferenceServer:
         if deadline is None:
             deadline = self.default_deadline
         return self.engine.submit(prompt, max_new_tokens,
-                                  eos_id=eos_id, deadline=deadline)
+                                  eos_id=eos_id, deadline=deadline,
+                                  trace_id=trace_id)
 
     def generate(self, prompt, max_new_tokens: int, *,
                  eos_id: Optional[int] = None,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         """Synchronous submit_generate + wait -> generated token ids."""
         return self.submit_generate(prompt, max_new_tokens,
                                     eos_id=eos_id,
-                                    deadline=deadline).get()
+                                    deadline=deadline,
+                                    trace_id=trace_id).get()
 
     def _retry_hint(self) -> float:
         lats = list(self._latencies)
@@ -342,18 +383,29 @@ class InferenceServer:
 
     def _serve_one(self, req: _Request):
         now = time.monotonic()
+        # the queue wait is part of the request's trace: how long
+        # admission-to-dequeue took, by trace_id
+        FLIGHT.record("mark", "serving/queue_wait",
+                      trace_id=req.trace_id,
+                      wait_s=round(now - req.enqueued_at, 6))
         if req.deadline is not None and now > req.deadline:
             # expired while queued: never runs. Pure overload — handled
             # by backpressure, so it does NOT feed the breaker.
             with self._cv:
                 self._counters["expired"] += 1
+            FLIGHT.record("mark", "serving/settle",
+                          trace_id=req.trace_id, outcome="expired",
+                          where="queued")
             self._settle(req, error=Expired(
                 "deadline passed while queued"))
             return
         t0 = time.perf_counter()
         try:
-            with stat_timer("serving/forward"):
-                result = self._forward(req.samples)
+            # the worker thread re-binds the request's trace context so
+            # the forward span (and anything it journals) carries the id
+            with obs_context.bind(trace_id=req.trace_id):
+                with stat_timer("serving/forward"):
+                    result = self._forward(req.samples)
         except Exception as e:
             from paddle_tpu.trainer.memory import is_resource_exhausted
             if is_resource_exhausted(e):
@@ -374,7 +426,8 @@ class InferenceServer:
                 journal_emit("serving", "shed",
                              reason="resource_exhausted",
                              where="forward", rows=rows,
-                             new_batch_limit=cap)
+                             new_batch_limit=cap,
+                             trace_id=req.trace_id)
                 self._settle(req, error=Rejected(
                     f"forward hit RESOURCE_EXHAUSTED on {rows} rows; "
                     f"max batch shrunk to {cap} — split the request "
@@ -385,6 +438,9 @@ class InferenceServer:
                 self._counters["failed"] += 1
             if self.breaker is not None:
                 self.breaker.record(False)
+            FLIGHT.record("mark", "serving/settle",
+                          trace_id=req.trace_id, outcome="failed",
+                          error=repr(e)[:200])
             self._settle(req, error=ServingError(f"forward failed: {e}"))
             return
         dt = time.perf_counter() - t0
@@ -403,6 +459,9 @@ class InferenceServer:
             return
         if self.breaker is not None:
             self.breaker.record(True)
+        FLIGHT.record("mark", "serving/settle",
+                      trace_id=req.trace_id, outcome="served",
+                      forward_ms=round(dt * 1e3, 3))
         self._settle(req, result=result)
         with self._cv:
             self._counters["served"] += 1
@@ -462,7 +521,8 @@ class InferenceServer:
         return out
 
     # convenience for HTTP clients sending raw dense rows
-    def infer_rows(self, rows, deadline: Optional[float] = None):
+    def infer_rows(self, rows, deadline: Optional[float] = None,
+                   trace_id: Optional[str] = None):
         samples = [(np.asarray(r, np.float32),) for r in rows]
-        out = self.infer(samples, deadline)
+        out = self.infer(samples, deadline, trace_id=trace_id)
         return np.asarray(out)

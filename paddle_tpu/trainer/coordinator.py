@@ -301,6 +301,14 @@ class Coordinator:
         with self._lock:
             return len(self._failed_dropped)
 
+    @staticmethod
+    def time() -> float:
+        """The coordinator's wall clock (unix seconds) — the reference
+        clock every worker measures its offset against (sync_clock) so
+        merged multi-host timelines share a time base
+        (tools/trace_merge.py; docs/observability.md)."""
+        return time.time()
+
     # ------------------------------------------------- read-only status
     @property
     def chunks(self) -> tuple:
@@ -425,7 +433,7 @@ class CoordinatorServer:
                                          logRequests=False)
         self.port = self.server.server_address[1]
         for name in ("get_task", "task_finished", "task_failed",
-                     "heartbeat", "request_save_model"):
+                     "heartbeat", "request_save_model", "time"):
             self.server.register_function(getattr(coordinator, name), name)
         self.server.register_function(lambda: coordinator.epoch, "epoch")
         self._thread: Optional[threading.Thread] = None
@@ -498,6 +506,40 @@ def call_with_retry(fn, *args, policy: Optional[RetryPolicy] = None,
             d = delay * (1.0 + policy.jitter * (2.0 * rng.random() - 1.0))
             _sleep(max(0.0, min(d, policy.deadline - elapsed)))
             delay = min(delay * policy.multiplier, policy.max_delay)
+
+
+def sync_clock(coordinator, samples: int = 5,
+               journal: bool = True) -> float:
+    """Measure this process's wall-clock offset against the
+    coordinator's (``offset_s`` = local − coordinator, seconds), using
+    the lowest-RTT sample of ``samples`` round trips over the existing
+    RPC channel (the NTP trick: the tightest round trip bounds the
+    skew estimate best). Works against an in-process Coordinator (a
+    trivial ~0 offset) or an xmlrpc proxy.
+
+    The offset is journaled as a ``clock_sync`` record so
+    ``paddle_tpu trace merge`` (tools/trace_merge.py) can put this
+    host's journal/trace on the coordinator's time base with no extra
+    plumbing — call it once after connecting, alongside the first
+    heartbeat."""
+    remote = getattr(coordinator, "time", None)
+    if remote is None:
+        raise TypeError("coordinator exposes no time() RPC — old "
+                        "server? (CoordinatorServer registers it)")
+    best_rtt, best_off = None, 0.0
+    for _ in range(max(1, int(samples))):
+        t0 = time.time()
+        server_t = float(remote())
+        t1 = time.time()
+        rtt = t1 - t0
+        off = (t0 + rtt / 2.0) - server_t
+        if best_rtt is None or rtt < best_rtt:
+            best_rtt, best_off = rtt, off
+    if journal:
+        from paddle_tpu.obs.events import emit as journal_emit
+        journal_emit("coordinator", "clock_sync", offset_s=best_off,
+                     rtt_s=best_rtt, samples=int(samples))
+    return best_off
 
 
 def coordinator_epoch(coordinator, retry: Optional[RetryPolicy] = None

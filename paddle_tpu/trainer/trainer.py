@@ -26,6 +26,7 @@ import numpy as np
 from paddle_tpu.config import global_config
 from paddle_tpu.core.registry import LayerOutput
 from paddle_tpu.core.topology import Topology
+from paddle_tpu.obs import context as obs_context
 from paddle_tpu.obs import events as obs_events
 from paddle_tpu.trainer import event as evt
 from paddle_tpu.trainer.parameters import Parameters
@@ -820,6 +821,9 @@ class SGD:
         from paddle_tpu.trainer.data_feeder import DataFeeder
         if event_handler is None:
             event_handler = _default_event_handler
+        # one run_id for the whole run (generated here if the CLI set
+        # none): every span/journal record the run emits carries it
+        obs_context.ensure_run_id()
         feeder = DataFeeder(self.topology.data_type(), feeding)
         if checkpoint_manager is None and checkpoint_dir:
             from paddle_tpu.trainer.checkpoint import CheckpointManager
@@ -966,6 +970,7 @@ class SGD:
         from paddle_tpu.trainer.data_feeder import DataFeeder
         feeder = DataFeeder(self.topology.data_type(), feeding)
         feed = feeder(data_batch)
+        obs_context.set_step(self._step_count)
         n_real = jnp.asarray(feed.pop("__batch_size__"), jnp.int32)
         self._rng, sub = jax.random.split(self._rng)
         (new_params, self.opt_state, new_state, loss, metrics,
@@ -1133,6 +1138,11 @@ class SGD:
                 # batch — and its RNG split happened before the save, so
                 # the batch is consumed without stepping or re-splitting
                 continue
+            # stamp the global step on the trace context: every span /
+            # journal record this iteration produces (train_step,
+            # nonfinite/rollback/oom, checkpoint writes) is then
+            # attributable to run_id + step (docs/observability.md)
+            obs_context.set_step(self._step_count)
             event_handler(evt.BeginIteration(pass_id, batch_id))
             n_real_host = int(feed.pop("__batch_size__"))
             n_real = jnp.asarray(n_real_host, jnp.int32)

@@ -406,3 +406,110 @@ class TestObservabilityFlags:
         assert rc == 0
         assert JOURNAL.path == log
         JOURNAL.configure(None)
+
+
+class TestFlightCLI:
+    """ISSUE 8 satellites: `obs selfcheck`/`obs dump`, `events tail
+    --follow`, and the serve/train flight/run_id flag wiring."""
+
+    def test_obs_selfcheck_smoke(self, capsys):
+        # the tier-1 smoke step: every observability surface
+        # exercised end-to-end in one verb
+        from paddle_tpu import cli
+        rc = cli.main(["obs", "selfcheck"])
+        out = json.loads(capsys.readouterr().out.strip())
+        assert rc == 0
+        assert out["status"] == "ok"
+        assert set(out["checks"]) == {"metrics_scrape",
+                                      "journal_roundtrip",
+                                      "trace_spans", "flight_dump"}
+        assert all(out["checks"].values())
+
+    def test_obs_dump_writes_bundle(self, tmp_path, capsys):
+        from paddle_tpu import cli
+        from paddle_tpu.obs.flight import FLIGHT
+        FLIGHT.record("mark", "cli-probe")
+        out = str(tmp_path / "bundle.json")
+        rc = cli.main(["obs", "dump", "--out", out])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["out"] == out
+        with open(out) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "cli"
+        assert any(r["name"] == "cli-probe" for r in bundle["ring"])
+
+    def test_events_follow_streams_appended_records(self, tmp_path):
+        """The --follow seam: records appended AFTER the follower
+        starts are yielded; it exits on idle timeout."""
+        import threading
+        import time as _time
+
+        from paddle_tpu import cli
+        from paddle_tpu.obs.events import EventJournal
+        log = str(tmp_path / "f.jsonl")
+        j = EventJournal()
+        j.configure(log)
+        j.emit("t", "before")
+
+        def appender():
+            _time.sleep(0.3)
+            j.emit("t", "live-1")
+            j.emit("x", "filtered-out")
+            _time.sleep(0.1)
+            j.emit("t", "live-2")
+            j.configure(None)
+
+        t = threading.Thread(target=appender, daemon=True,
+                             name="pt-test-follow")
+        t.start()
+        got = list(cli._iter_journal_follow(
+            log, domain="t", poll=0.05, idle_timeout=1.5,
+            from_pos=os.path.getsize(log)))
+        t.join()
+        assert [r["kind"] for r in got] == ["live-1", "live-2"]
+
+    def test_events_tail_follow_flag_exits_after_idle(self, tmp_path,
+                                                      capsys):
+        from paddle_tpu import cli
+        from paddle_tpu.obs.events import EventJournal
+        log = str(tmp_path / "f2.jsonl")
+        j = EventJournal()
+        j.configure(log)
+        j.emit("t", "k0")
+        j.configure(None)
+        rc = cli.main(["events", "tail", "--log", log, "--follow",
+                       "--exit-after-idle", "0.3"])
+        assert rc == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert json.loads(lines[-1])["kind"] == "k0"
+
+    def test_serve_flight_flags_arm_recorder(self, tmp_path,
+                                             monkeypatch):
+        from paddle_tpu import cli
+        from paddle_tpu.obs import context as obs_context
+        from paddle_tpu.obs.flight import FLIGHT
+        monkeypatch.setattr(cli, "_cmd_serve", lambda args: 0)
+        fdir = str(tmp_path / "flight")
+        rc = cli.main(["serve", "--model", "m.tar",
+                       "--flight_dir", fdir,
+                       "--run_id", "run-cli-test"])
+        assert rc == 0
+        assert FLIGHT.dump_dir == fdir
+        assert obs_context.get_run_id() == "run-cli-test"
+
+    def test_trace_merge_subcommand(self, tmp_path, capsys):
+        from paddle_tpu import cli
+        from paddle_tpu.obs.events import EventJournal, read_journal
+        log = str(tmp_path / "one.jsonl")
+        j = EventJournal()
+        j.configure(log)
+        j.emit("t", "a")
+        j.emit("t", "b")
+        j.configure(None)
+        out = str(tmp_path / "merged.jsonl")
+        rc = cli.main(["trace", "merge", "--journal", log,
+                       "--out-journal", out])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out.strip())
+        assert summary["records"] == 2
+        assert [r["mseq"] for r in read_journal(out)] == [1, 2]

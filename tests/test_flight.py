@@ -1,0 +1,349 @@
+"""Flight recorder + trace context (paddle_tpu/obs/flight.py,
+obs/context.py) — acceptance suite.
+
+Covers the ISSUE-8 contract: always-on bounded ring semantics,
+postmortem bundle shape (ring + metrics snapshot + journal cursor +
+live state), auto-dump on trigger journal kinds with rate limiting,
+and THE chaos acceptances — an injected mid-decode fault must produce
+a dump from which the failing request's complete span/event chain is
+reconstructable by trace_id alone, and a trainer nonfinite streak must
+produce a dump whose records carry run_id + step.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.obs import context as obs_context
+from paddle_tpu.obs.events import JOURNAL, read_journal
+from paddle_tpu.obs.flight import FLIGHT, FlightRecorder
+from paddle_tpu.serving import DecodeEngine
+from paddle_tpu.serving.server import ServingError
+from paddle_tpu.trainer.fault import FaultPolicy
+
+
+# ------------------------------------------------------------ ring + context
+
+class TestRecorderRing:
+    def test_ring_is_bounded_and_stamps_context(self):
+        r = FlightRecorder(capacity=8)
+        with obs_context.bind(trace_id="tid-1", step=7):
+            for i in range(20):
+                r.record("mark", f"m{i}", idx=i)
+        recs = r.snapshot()
+        assert len(recs) == 8                       # fixed memory
+        assert [x["name"] for x in recs] == [f"m{i}"
+                                             for i in range(12, 20)]
+        assert all(x["trace_id"] == "tid-1" and x["step"] == 7
+                   for x in recs)
+
+    def test_disabled_recorder_records_nothing(self):
+        r = FlightRecorder()
+        r.configure(enabled=False)
+        r.record("mark", "ghost")
+        assert r.snapshot() == []
+
+    def test_bind_nesting_inherits(self):
+        with obs_context.bind(trace_id="outer"):
+            with obs_context.bind(step=3):
+                f = obs_context.current_fields()
+                assert f["trace_id"] == "outer" and f["step"] == 3
+        assert "trace_id" not in obs_context.current_fields()
+
+    def test_journal_records_carry_run_and_host(self):
+        obs_context.set_run_id("run-test")
+        obs_context.set_host("host-a")
+        with obs_context.bind(trace_id="t9", step=4):
+            rec = JOURNAL.emit("test", "ping")
+        assert rec["run_id"] == "run-test" and rec["host"] == "host-a"
+        assert rec["trace_id"] == "t9" and rec["step"] == 4
+
+    def test_tracer_spans_feed_recorder_when_no_window_armed(self):
+        """The always-on contract: a stat_timer scope lands in the
+        flight ring even though no trace window was started."""
+        from paddle_tpu.utils.stats import stat_timer
+        with obs_context.bind(trace_id="always-on"):
+            with stat_timer("flight/probe"):
+                pass
+        spans = [r for r in FLIGHT.snapshot()
+                 if r.get("kind") == "span"
+                 and r["name"] == "flight/probe"]
+        assert spans and spans[-1]["trace_id"] == "always-on"
+        # ...but the exportable trace ring stayed empty (off-window)
+        from paddle_tpu.obs.trace import TRACER
+        assert TRACER.spans() == []
+
+
+# ----------------------------------------------------------------- bundles
+
+class TestBundleAndDump:
+    def test_bundle_shape(self, tmp_path):
+        from paddle_tpu.utils.stats import global_counters
+        global_counters.bump("flight/probe", 3)
+        JOURNAL.emit("test", "ping")
+        FLIGHT.record("mark", "probe")
+        FLIGHT.register_state_provider(
+            "probe", lambda: {"answer": 42})
+        FLIGHT.register_state_provider("dead", lambda: None)
+        path = FLIGHT.dump("unit", path=str(tmp_path / "b.json"))
+        with open(path) as f:
+            b = json.load(f)
+        assert b["v"] == 1 and b["reason"] == "unit"
+        assert b["run_id"] and b["host"] and b["pid"]
+        assert any(r["name"] == "probe" for r in b["ring"])
+        # journal events are mirrored into the ring by the observer
+        assert any(r["kind"] == "event" and r["name"] == "test/ping"
+                   for r in b["ring"])
+        assert 'paddle_tpu_counter_total{name="flight/probe"} 3' \
+            in b["metrics"]
+        assert b["journal"]["last_seq"] == JOURNAL.last_seq
+        assert b["state"]["probe"] == {"answer": 42}
+        assert "dead" not in b["state"]     # None providers skipped
+
+    def test_sick_state_provider_cannot_kill_a_dump(self):
+        FLIGHT.register_state_provider(
+            "sick", lambda: 1 / 0)
+        b = FLIGHT.bundle("unit")
+        assert "error" in b["state"]["sick"]
+
+    def test_autodump_on_trigger_kinds_with_rate_limit(self, tmp_path):
+        import os
+        FLIGHT.configure(dump_dir=str(tmp_path), min_dump_interval=30)
+        JOURNAL.emit("serving", "shed", reason="queue_full")  # no trigger
+        assert os.listdir(tmp_path) == []
+        JOURNAL.emit("serving", "breaker", state="half_open")  # not open
+        assert os.listdir(tmp_path) == []
+        JOURNAL.emit("serving", "breaker", state="open")
+        files = os.listdir(tmp_path)
+        assert len(files) == 1
+        # a storm of triggers inside the interval produces ONE bundle
+        JOURNAL.emit("engine", "step_failure", error="boom")
+        JOURNAL.emit("trainer", "oom")
+        assert len(os.listdir(tmp_path)) == 1
+        with open(tmp_path / files[0]) as f:
+            b = json.load(f)
+        assert b["reason"] == "serving_breaker"
+
+    def test_unarmed_recorder_never_autodumps(self):
+        assert FLIGHT.maybe_autodump("anything") is None
+
+
+# --------------------------------------- chaos: decode-engine postmortem
+
+class _FailOnce:
+    """Wrap a PagedDecoder: the Nth step raises, everything else (and
+    the pool rebuild) passes through."""
+
+    def __init__(self, paged):
+        self._paged = paged
+        self.fired = False
+
+    def step(self, *a, **kw):
+        if not self.fired:
+            self.fired = True
+            raise RuntimeError("injected mid-decode fault")
+        return self._paged.step(*a, **kw)
+
+    def init_pools(self):
+        return self._paged.init_pools()
+
+
+class TestDecodePostmortem:
+    """THE acceptance: with the flight recorder on (it always is), an
+    injected decode_script fault produces a dump from which the failing
+    request's complete span/event chain is reconstructed by trace_id
+    ALONE."""
+
+    @pytest.mark.chaos
+    def test_mid_decode_fault_chain_by_trace_id(self, tmp_path):
+        from paddle_tpu.testing.faults import FaultPlan
+        from tests.test_serving_faults import tiny_decoder
+
+        FLIGHT.configure(dump_dir=str(tmp_path), min_dump_interval=0)
+        dec = tiny_decoder()
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=24)
+        rng = np.random.RandomState(0)
+        r1 = eng.submit(rng.randint(0, 40, (3,)).astype("int32"), 8)
+        r2 = eng.submit(rng.randint(0, 40, (3,)).astype("int32"), 8)
+        # the deterministic scheduler-event seam (faults family (j)):
+        # at engine step 4 the NEXT dispatch dies mid-decode
+        with FaultPlan.decode_script(eng, at={
+                4: lambda: setattr(eng, "paged",
+                                   _FailOnce(eng.paged))}) as stats:
+            eng.run(timeout=300)
+        assert stats["fired"] == [4]
+        with pytest.raises(ServingError):
+            r1.get(timeout=1)
+        with pytest.raises(ServingError):
+            r2.get(timeout=1)
+        assert eng.stats()["step_failures"] == 1
+        assert eng.page_accounting()["leaked"] == 0
+
+        # the step_failure journal record names the in-flight trace ids
+        fails = JOURNAL.tail(kind="step_failure")
+        assert fails and r1.trace_id in fails[-1]["trace_ids"]
+
+        # auto-dump fired; reload the bundle from DISK and reconstruct
+        # the failing request's chain by trace_id alone
+        import os
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight-")]
+        assert dumps, "step_failure must auto-dump a bundle"
+        with open(tmp_path / sorted(dumps)[0]) as f:
+            bundle = json.load(f)
+        tid = r1.trace_id
+        chain = [r for r in bundle["ring"]
+                 if r.get("trace_id") == tid or
+                 tid in (r.get("trace_ids") or [])]
+        names = [r["name"] for r in chain]
+        assert names[0] == "engine/submit"
+        assert "engine/admit" in names
+        steps = [r for r in chain if r["name"] == "engine/slot_step"]
+        assert len(steps) >= 4          # each decode step, in order
+        assert [s["engine_step"] for s in steps] == \
+            sorted(s["engine_step"] for s in steps)
+        assert "engine/step_failure" in names    # the journaled fault
+        settle = [r for r in chain if r["name"] == "engine/settle"]
+        assert settle and settle[-1]["state"] == "failed"
+        # chain is time-ordered as recorded
+        ts = [r["t"] for r in chain]
+        assert ts == sorted(ts)
+
+    @pytest.mark.chaos
+    def test_preemption_rides_the_request_chain(self):
+        """An evicted request's preemption record carries its trace_id
+        (the journal + ring agree)."""
+        from tests.test_serving_faults import tiny_decoder
+        dec = tiny_decoder()
+        rng = np.random.RandomState(1)
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=20, num_pages=6)
+        r1 = eng.submit(rng.randint(0, 40, (4,)).astype("int32"), 14)
+        r2 = eng.submit(rng.randint(0, 40, (4,)).astype("int32"), 14)
+        eng.run(timeout=300)
+        assert len(r1.get(timeout=1)) == 14
+        assert len(r2.get(timeout=1)) == 14
+        pre = JOURNAL.tail(kind="preemption")
+        assert pre and all(
+            p["trace_id"] in (r1.trace_id, r2.trace_id) for p in pre)
+        ring_pre = [r for r in FLIGHT.snapshot()
+                    if r.get("name") == "engine/preemption"]
+        assert len(ring_pre) == len(pre)
+
+
+# ------------------------------------------- chaos: trainer postmortem
+
+def _trainer(seed=0):
+    from paddle_tpu.core import registry
+    registry.reset_name_counters()
+    paddle.init(use_tpu=False, seed=seed)
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(16))
+    out = paddle.layer.fc(x, size=4, act=paddle.activation.Softmax(),
+                          name="out")
+    y = paddle.layer.data("y", paddle.data_type.integer_value(4))
+    cost = paddle.layer.classification_cost(out, y, name="cost")
+    params = paddle.create_parameters(paddle.Topology(cost))
+    return paddle.SGD(cost=cost, parameters=params,
+                      update_equation=paddle.optimizer.Momentum(
+                          learning_rate=1e-2, momentum=0.9))
+
+
+def _reader(n_batches=8, batch=16):
+    rng = np.random.RandomState(3)
+    feats = rng.randn(n_batches, batch, 16).astype("float32")
+    labels = rng.randint(0, 4, (n_batches, batch))
+
+    def reader():
+        for b in range(n_batches):
+            yield [(feats[b, i], int(labels[b, i]))
+                   for i in range(batch)]
+
+    return reader
+
+
+class TestTrainerPostmortem:
+    @pytest.mark.chaos
+    def test_nonfinite_streak_dumps_with_run_and_step(self, tmp_path):
+        """The trainer half of the acceptance: a nonfinite streak
+        auto-dumps a bundle whose journal records and train_step spans
+        carry run_id + the global step."""
+        from paddle_tpu.testing.faults import FaultPlan
+
+        obs_context.set_run_id("run-nonfinite")
+        FLIGHT.configure(dump_dir=str(tmp_path), min_dump_interval=0)
+        tr = _trainer()
+        plan = FaultPlan()
+        tr.train(plan.poison_batches(_reader(), {2, 3}), num_passes=1,
+                 event_handler=lambda e: None,
+                 fault_policy=FaultPolicy(max_bad_steps=2))
+        faults = JOURNAL.tail(domain="trainer")
+        kinds = {r["kind"] for r in faults}
+        assert "rollback" in kinds or "nonfinite" in kinds
+        for r in faults:
+            assert r["run_id"] == "run-nonfinite"
+            assert isinstance(r["step"], int)
+        import os
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight-")]
+        assert dumps, "a FaultEvent streak must auto-dump"
+        with open(tmp_path / sorted(dumps)[0]) as f:
+            bundle = json.load(f)
+        assert bundle["run_id"] == "run-nonfinite"
+        spans = [r for r in bundle["ring"]
+                 if r.get("kind") == "span"
+                 and r["name"] == "train_step"]
+        assert spans and all(isinstance(s["step"], int) for s in spans)
+        # steps on the recent spans are monotone non-decreasing — the
+        # bundle reads as a timeline
+        ssteps = [s["step"] for s in spans]
+        assert ssteps == sorted(ssteps)
+
+
+# -------------------------------------------- serving front end-to-end
+
+class TestServingTraceIds:
+    def test_infer_trace_id_flows_front_to_settle(self):
+        """One trace_id minted at the HTTP front appears on admit,
+        queue-wait, the forward span (flight ring) and the settle."""
+        import threading
+        import urllib.request
+
+        from paddle_tpu.serving import InferenceServer, build_http_server
+        from paddle_tpu.trainer.inference import Inference
+        x = paddle.layer.data("fx", paddle.data_type.dense_vector(4))
+        o = paddle.layer.fc(x, size=2, act=paddle.activation.Softmax())
+        inf = Inference(output_layer=o,
+                        parameters=paddle.create_parameters(
+                            paddle.Topology(o)))
+        srv = InferenceServer(inf, workers=1, breaker=False).start()
+        httpd = build_http_server(srv, "127.0.0.1", 0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                             name="pt-test-flight-httpd")
+        t.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/infer",
+                data=json.dumps({"rows": [[0.1, 0.2, 0.3, 0.4]]})
+                .encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Trace-Id": "front-abc"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                body = json.loads(r.read())
+                assert r.headers["X-Trace-Id"] == "front-abc"
+            assert body["trace_id"] == "front-abc"
+            chain = [rec for rec in FLIGHT.snapshot()
+                     if rec.get("trace_id") == "front-abc"]
+            names = [rec["name"] for rec in chain]
+            assert "serving/admit" in names
+            assert "serving/queue_wait" in names
+            assert "serving/forward" in names       # the span
+            settles = [rec for rec in chain
+                       if rec["name"] == "serving/settle"]
+            assert settles and settles[-1]["outcome"] == "served"
+        finally:
+            httpd.shutdown()
+            srv.shutdown(drain=True)

@@ -277,6 +277,41 @@ class TestEventJournal:
         assert isinstance(rec["obj"], str) and "object" in rec["obj"]
         json.dumps(rec)                        # always serializable
 
+    def test_since_seq_cursor_pages_forward(self):
+        """ISSUE-8 satellite: ?since_seq= pages the ring without
+        re-reading from the start — oldest-first after the cursor."""
+        j = EventJournal(ring_size=100)
+        for i in range(10):
+            j.emit("t", f"k{i}")
+        assert j.last_seq == 10
+        page = j.tail(3, since_seq=4)
+        assert [r["seq"] for r in page] == [5, 6, 7]   # oldest first
+        page = j.tail(100, since_seq=page[-1]["seq"])
+        assert [r["seq"] for r in page] == [8, 9, 10]
+        assert j.tail(5, since_seq=10) == []           # caught up
+        # filters compose with the cursor
+        assert [r["seq"] for r in j.tail(100, kind="k8",
+                                         since_seq=0)] == [9]
+
+    def test_tail_and_read_journal_filter_parity(self, tmp_path):
+        """ISSUE-8 satellite: the ring's tail() filters and the file's
+        read_journal() filters agree — same records, same order — on
+        the same domain/kind queries."""
+        path = str(tmp_path / "parity.jsonl")
+        j = EventJournal(ring_size=1000)
+        j.configure(path)
+        for i in range(30):
+            j.emit("data" if i % 3 else "serving",
+                   "shed" if i % 2 else "quarantine", i=i)
+        j.configure(None)
+        for q in ({}, {"domain": "data"}, {"kind": "shed"},
+                  {"domain": "serving", "kind": "quarantine"}):
+            ring = j.tail(1000, **q)
+            file = list(read_journal(path, **q))
+            assert [r["seq"] for r in ring] == \
+                [r["seq"] for r in file], q
+            assert ring == file, q
+
 
 # ------------------------------------------------------------ step tracing
 
@@ -322,7 +357,14 @@ class TestTracing:
         with open(path) as f:
             blob = json.load(f)
         evs = blob["traceEvents"]
-        assert all(e["ph"] in ("X", "i") for e in evs)
+        assert all(e["ph"] in ("X", "i", "M") for e in evs)
+        # cross-process merge keys (obs/merge.py): process metadata +
+        # run/host identity ride the export
+        meta = blob["metadata"]
+        assert meta["run_id"] and meta["host"] and meta["pid"] == \
+            __import__("os").getpid()
+        assert any(e["ph"] == "M" and e["name"] == "process_name"
+                   for e in evs)
         step = [e for e in evs if e["name"] == "step"][0]
         wait = [e for e in evs if e["name"] == "data_wait"][0]
         assert step["ts"] <= wait["ts"]
@@ -332,9 +374,51 @@ class TestTracing:
 
     def test_disabled_tracer_records_nothing(self):
         tracer = obs_trace.TRACER
-        with tracer.span("ghost"):
-            pass
-        assert tracer.spans() == []
+        from paddle_tpu.obs.flight import FLIGHT
+        FLIGHT.configure(enabled=False)
+        try:
+            assert tracer.span("ghost") is \
+                tracer.span("ghost")          # the shared no-op object
+            with tracer.span("ghost"):
+                pass
+            assert tracer.spans() == []
+        finally:
+            FLIGHT.configure(enabled=True)
+
+    def test_span_ring_is_bounded_and_drops_are_counted(self):
+        """ISSUE-8 satellite: Tracer memory is a ring (max_spans) and
+        overflow shows up as paddle_tpu_trace_dropped_total."""
+        tracer = obs_trace.Tracer(max_spans=4)
+        tracer._flight = obs_trace.TRACER._flight_recorder()
+        before = REGISTRY.counter(
+            "paddle_tpu_trace_dropped_total").value()
+        tracer.start(capture_compiles=False)
+        for i in range(10):
+            with tracer.span(f"s{i}"):
+                pass
+        tracer.stop()
+        spans = tracer.spans()
+        assert len(spans) == 4                 # fixed memory
+        assert [s["name"] for s in spans] == ["s6", "s7", "s8", "s9"]
+        assert tracer.dropped == 6
+        assert REGISTRY.counter(
+            "paddle_tpu_trace_dropped_total").value() - before == 6
+
+    def test_spans_carry_bound_trace_context(self):
+        from paddle_tpu.obs import context as obs_context
+        tracer = obs_trace.TRACER
+        tracer.start(capture_compiles=False)
+        try:
+            with obs_context.bind(trace_id="tid-x", step=12):
+                with tracer.span("ctx_span"):
+                    pass
+        finally:
+            tracer.stop()
+        (s,) = [x for x in tracer.spans() if x["name"] == "ctx_span"]
+        assert s["trace_id"] == "tid-x" and s["step"] == 12
+        ev = [e for e in tracer.chrome_trace()["traceEvents"]
+              if e.get("name") == "ctx_span"][0]
+        assert ev["args"]["trace_id"] == "tid-x"
 
 
 # ------------------------------------------------- standalone obs endpoint
@@ -360,6 +444,26 @@ class TestObsEndpoint:
             with urllib.request.urlopen(base + "/health",
                                         timeout=10) as r:
                 assert json.loads(r.read())["status"] == "ok"
+            # the since_seq cursor pages the scrape (ISSUE-8
+            # satellite): page 1 returns a resume point, page 2 is
+            # empty once caught up
+            with urllib.request.urlopen(
+                    base + "/events?since_seq=0&n=100",
+                    timeout=10) as r:
+                blob = json.loads(r.read())
+            assert blob["events"] and blob["last_seq"] >= \
+                blob["events"][-1]["seq"]
+            cursor = blob["last_seq"]
+            with urllib.request.urlopen(
+                    base + f"/events?since_seq={cursor}",
+                    timeout=10) as r:
+                assert json.loads(r.read())["events"] == []
+            # and the flight bundle is served on demand
+            with urllib.request.urlopen(base + "/flight",
+                                        timeout=10) as r:
+                bundle = json.loads(r.read())
+            assert bundle["v"] == 1 and "ring" in bundle \
+                and "metrics" in bundle
         finally:
             httpd.shutdown()
             httpd.server_close()

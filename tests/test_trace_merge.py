@@ -1,0 +1,218 @@
+"""Cross-process trace/journal merge (paddle_tpu/obs/merge.py,
+`paddle_tpu trace merge`, tools/trace_merge.py) — acceptance suite.
+
+Unit tier: offset resolution (explicit > clock_sync > 0), monotone
+merged seq, trace fusion mechanics over hand-built inputs. Chaos tier
+(THE ISSUE-8 acceptance): two subprocess coordinator workers — one
+with an injected 2.5 s clock skew — merge into ONE journal whose step
+records interleave in true order with strictly monotone mseq, and one
+Perfetto trace containing both hosts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.obs.merge import (journal_clock_offset, merge_journals,
+                                  merge_traces)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_journal(path, host, base_ts, kinds, offset_s=None):
+    """Hand-built schema-valid journal: full control over ts/host."""
+    recs = []
+    seq = 0
+    if offset_s is not None:
+        seq += 1
+        recs.append({"v": 1, "ts": base_ts, "seq": seq, "pid": 1,
+                     "domain": "coordinator", "kind": "clock_sync",
+                     "host": host, "run_id": "r", "offset_s": offset_s})
+    for i, kind in enumerate(kinds):
+        seq += 1
+        recs.append({"v": 1, "ts": base_ts + 0.1 * i, "seq": seq,
+                     "pid": 1, "domain": "trainer", "kind": kind,
+                     "host": host, "run_id": "r", "step": i})
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return recs
+
+
+class TestMergeUnit:
+    def test_offsets_from_clock_sync_and_monotone_mseq(self, tmp_path):
+        a = str(tmp_path / "a.jsonl")
+        b = str(tmp_path / "b.jsonl")
+        # host-b's clock reads 5 s ahead; unadjusted, ALL its records
+        # sort after host-a's — the clock_sync record must fix that
+        _write_journal(a, "host-a", 100.0, ["s0", "s1", "s2"])
+        _write_journal(b, "host-b", 105.05, ["s0", "s1", "s2"],
+                       offset_s=5.0)
+        assert journal_clock_offset(b) == 5.0
+        assert journal_clock_offset(a) is None
+        merged = merge_journals([a, b])
+        assert [r["mseq"] for r in merged] == \
+            list(range(1, len(merged) + 1))
+        order = [(r["host"], r["kind"]) for r in merged
+                 if r["kind"].startswith("s")]
+        # true order interleaves: a/s0, b/s0(=100.05), a/s1, b/s1, ...
+        assert order == [("host-a", "s0"), ("host-b", "s0"),
+                         ("host-a", "s1"), ("host-b", "s1"),
+                         ("host-a", "s2"), ("host-b", "s2")]
+        ts_adj = [r["ts_adj"] for r in merged]
+        assert ts_adj == sorted(ts_adj)
+        # per-process seq survives untouched for provenance
+        assert all("seq" in r for r in merged)
+
+    def test_explicit_offset_beats_clock_sync(self, tmp_path):
+        a = str(tmp_path / "a.jsonl")
+        _write_journal(a, "host-a", 100.0, ["s0"], offset_s=5.0)
+        (rec,) = [r for r in merge_journals(
+            [a], offsets={"host-a": 50.0}) if r["kind"] == "s0"]
+        assert rec["ts_adj"] == pytest.approx(50.0)
+
+    def test_merged_journal_file_round_trips(self, tmp_path):
+        a = str(tmp_path / "a.jsonl")
+        _write_journal(a, "host-a", 100.0, ["s0", "s1"])
+        out = str(tmp_path / "merged.jsonl")
+        merge_journals([a], out=out)
+        from paddle_tpu.obs.events import read_journal
+        recs = list(read_journal(out))
+        assert [r["mseq"] for r in recs] == [1, 2]
+
+    def test_trace_fusion_relabels_processes(self, tmp_path):
+        def trace(path, host, pid, ts0):
+            blob = {"traceEvents": [
+                {"ph": "M", "name": "process_name", "pid": pid,
+                 "tid": 0, "args": {"name": "old"}},
+                {"ph": "X", "name": "step", "pid": pid, "tid": 1,
+                 "ts": ts0, "dur": 50.0, "args": {}}],
+                "metadata": {"host": host, "pid": pid, "run_id": "r"}}
+            with open(path, "w") as f:
+                json.dump(blob, f)
+            return path
+
+        # both exports claim pid 7 — the merge must give them lanes
+        t1 = trace(str(tmp_path / "t1.json"), "host-a", 7, 1000.0)
+        t2 = trace(str(tmp_path / "t2.json"), "host-b", 7, 3_500_000.0)
+        merged = merge_traces([t1, t2],
+                              offsets={"host-b": 2.5})  # 2.5 s skew
+        evs = merged["traceEvents"]
+        names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert names == {"host-a pid=7", "host-b pid=7"}
+        xs = {e["args"]["host"]: e for e in evs if e["ph"] == "X"}
+        assert len({e["pid"] for e in xs.values()}) == 2  # distinct lanes
+        # host-b's 3.5 s came back to 1.0 s on the reference clock
+        assert xs["host-b"]["ts"] == pytest.approx(1_000_000.0)
+
+
+class TestTwoWorkerAcceptance:
+    """THE acceptance: trace merge over two subprocess coordinator
+    workers yields one timeline containing both hosts' steps with
+    monotone merged seq — clock skew adjusted via the coordinator
+    heartbeat-channel offsets."""
+
+    @pytest.mark.chaos(timeout=300)
+    def test_two_skewed_workers_one_timeline(self, tmp_path):
+        from paddle_tpu.trainer.coordinator import (Coordinator,
+                                                    CoordinatorServer)
+        coord = Coordinator(list(range(4)))
+        server = CoordinatorServer(coord, port=0).start()
+        worker = os.path.join(REPO, "tests", "trace_merge_worker.py")
+        go = str(tmp_path / "go")
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH",
+                                                        "")
+        procs, journals, traces = [], [], []
+        try:
+            for i, skew in ((0, 0.0), (1, 2.5)):
+                jp = str(tmp_path / f"w{i}.jsonl")
+                tp = str(tmp_path / f"w{i}_trace.json")
+                journals.append(jp)
+                traces.append(tp)
+                procs.append(subprocess.Popen(
+                    [sys.executable, worker, str(server.port), jp, tp,
+                     f"worker-{i}", str(skew), "6", "run-merge", go],
+                    env=env, cwd=REPO, stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE, text=True))
+            # both workers up + clock-synced, then step together
+            for p in procs:
+                assert p.stdout.readline().strip() == "READY", \
+                    p.stderr.read()
+            with open(go, "w") as f:
+                f.write("go")
+            for p in procs:
+                assert p.wait(timeout=240) == 0, p.stderr.read()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            server.stop()
+
+        # worker-1's measured offset is its injected skew (the RPC
+        # round trip adds only noise)
+        off1 = journal_clock_offset(journals[1])
+        assert off1 == pytest.approx(2.5, abs=0.5)
+        assert abs(journal_clock_offset(journals[0])) < 0.5
+
+        # ---- the merged journal, via the CLI verb
+        out_j = str(tmp_path / "merged.jsonl")
+        out_t = str(tmp_path / "merged_trace.json")
+        from paddle_tpu.cli import main as cli_main
+        rc = cli_main(["trace", "merge",
+                       "--journal", journals[0], journals[1],
+                       "--trace", traces[0], traces[1],
+                       "--out-journal", out_j, "--out-trace", out_t])
+        assert rc == 0
+        from paddle_tpu.obs.events import read_journal
+        merged = list(read_journal(out_j))
+        assert {r["host"] for r in merged} == {"worker-0", "worker-1"}
+        assert {r["run_id"] for r in merged} == {"run-merge"}
+        assert [r["mseq"] for r in merged] == \
+            list(range(1, len(merged) + 1))
+        ts_adj = [r["ts_adj"] for r in merged]
+        assert ts_adj == sorted(ts_adj)
+        steps = [(r["host"], r["step"]) for r in merged
+                 if r["kind"] == "step"]
+        assert len(steps) == 12
+        # RAW ordering is disjoint (2.5 s skew > the 0.7 s step
+        # window): every worker-1 ts is later than every worker-0 ts
+        raw1 = [r["ts"] for r in read_journal(journals[1],
+                                              kind="step")]
+        raw0 = [r["ts"] for r in read_journal(journals[0],
+                                              kind="step")]
+        assert min(raw1) > max(raw0)
+        # ...but the MERGED timeline interleaves them back: worker-1
+        # steps appear before worker-0's last step
+        hosts_in_order = [h for h, _ in steps]
+        first_w1 = hosts_in_order.index("worker-1")
+        last_w0 = len(hosts_in_order) - 1 - \
+            hosts_in_order[::-1].index("worker-0")
+        assert first_w1 < last_w0, \
+            "skew adjustment failed: the merged timeline kept the " \
+            "raw (disjoint) ordering"
+        # per-host step numbering stays monotone after the merge
+        for h in ("worker-0", "worker-1"):
+            seq = [s for hh, s in steps if hh == h]
+            assert seq == sorted(seq)
+
+        # ---- the merged Perfetto trace
+        with open(out_t) as f:
+            mt = json.load(f)
+        evs = mt["traceEvents"]
+        lanes = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert len(lanes) == 2 and \
+            {n.split(" ")[0] for n in lanes} == {"worker-0", "worker-1"}
+        spans = [e for e in evs if e["ph"] == "X"
+                 and e["name"] == "worker_step"]
+        assert len(spans) == 12
+        by_host = {}
+        for e in spans:
+            by_host.setdefault(e["args"]["host"], []).append(e["ts"])
+        # adjusted span windows overlap (they ran simultaneously)
+        assert min(by_host["worker-1"]) < max(by_host["worker-0"])
+        assert min(by_host["worker-0"]) < max(by_host["worker-1"])

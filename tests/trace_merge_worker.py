@@ -1,0 +1,80 @@
+"""Subprocess worker for the cross-process trace-merge acceptance
+(tests/test_trace_merge.py).
+
+Simulates one host of a multi-host job: connects to the test's
+coordinator, measures its clock offset over the RPC channel
+(sync_clock -> journaled clock_sync record), then emits step journal
+records + tracer spans under an INJECTED wall-clock skew — the
+deterministic stand-in for two machines whose clocks disagree.
+`paddle_tpu trace merge` must put both workers back on the
+coordinator's time base.
+
+argv: <coordinator_port> <journal_path> <trace_path> <host_name>
+      <skew_s> <n_steps> <run_id> <go_file>
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    port = int(sys.argv[1])
+    journal_path = sys.argv[2]
+    trace_path = sys.argv[3]
+    host = sys.argv[4]
+    skew = float(sys.argv[5])
+    steps = int(sys.argv[6])
+    run_id = sys.argv[7]
+    go_file = sys.argv[8]
+
+    # the injected skew: this process's wall clock reads `skew` seconds
+    # ahead of true time — journal ts, tracer epoch and sync_clock's
+    # local samples all see it, exactly like a drifted host
+    real_time = time.time
+    if skew:
+        time.time = lambda: real_time() + skew
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.obs import context as obs_context
+    from paddle_tpu.obs.events import JOURNAL
+    from paddle_tpu.obs.trace import TRACER
+    from paddle_tpu.trainer.coordinator import connect, sync_clock
+
+    obs_context.set_host(host)
+    obs_context.set_run_id(run_id)
+    JOURNAL.configure(journal_path)
+    conn = connect("127.0.0.1", port)
+    offset = sync_clock(conn)         # journals the clock_sync record
+    assert int(conn.epoch()) >= 0     # plain coordinator RPC traffic
+    JOURNAL.emit("trainer", "run_start", worker=host)
+    print("READY", flush=True)
+
+    # barrier: both workers start stepping together so the TRUE
+    # timelines interleave (the raw skewed ones will not)
+    deadline = real_time() + 60
+    while not os.path.exists(go_file):
+        if real_time() > deadline:
+            print("go-file timeout", file=sys.stderr)
+            return 2
+        time.sleep(0.01)
+
+    TRACER.start(capture_compiles=False)
+    for i in range(steps):
+        obs_context.set_step(i)
+        with TRACER.span("worker_step"):
+            time.sleep(0.12)
+        JOURNAL.emit("trainer", "step", step=i)
+    TRACER.stop()
+    TRACER.save(trace_path)
+    JOURNAL.emit("trainer", "run_end", worker=host)
+    JOURNAL.configure(None)
+    print(json.dumps({"host": host, "measured_offset": offset}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

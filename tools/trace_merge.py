@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""trace_merge — fuse N per-host journals + chrome traces into one
+timeline (the standalone twin of `paddle_tpu trace merge`).
+
+    python tools/trace_merge.py --journal w0.jsonl w1.jsonl \
+        --trace w0_trace.json w1_trace.json \
+        --out-journal merged.jsonl --out-trace merged.json
+
+Clock skew between hosts is adjusted from each journal's `clock_sync`
+record (emitted by trainer/coordinator.sync_clock over the coordinator
+heartbeat channel) or an explicit `--offset host=SECONDS`. See
+docs/observability.md "Trace context & postmortems" and
+paddle_tpu/obs/merge.py for the logic.
+"""
+
+import os
+import sys
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from paddle_tpu.obs.merge import main
+    sys.exit(main())
