@@ -629,7 +629,7 @@ def bench_moe_lm(batch: int = 8, seq_len: int = 1024, d_model: int = 512,
 #: rows of the CPU smoke tier; tools/bench_gate.py gates them against
 #: BENCH_SMOKE_BASELINE.json in tier-1 (docs/observability.md)
 SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine",
-              "flight_recorder_overhead")
+              "flight_recorder_overhead", "coord_reshard")
 
 
 def _smoke_trainer(batch: int = 16):
@@ -800,6 +800,38 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
             "steps_per_s_off": round(off, 2),
             "steps_per_s_on": round(on, 2),
             "overhead_ratio": round(off / on, 3),
+        }
+    if "coord_reshard" in rows:
+        # elastic-membership control-plane latency: time from a
+        # membership change (join) to the FIRST task grant stamped with
+        # the post-reshape generation — the window during which the
+        # fleet is reorganizing instead of training. Tiny shapes, pure
+        # control plane (no XLA), gated by the latency kind's absolute
+        # floor so any machine passes unless the reshard path grows
+        # real work (docs/robustness.md "Elastic training").
+        from paddle_tpu.trainer.coordinator import Coordinator
+        coord = Coordinator(list(range(64)), chunks_per_task=4,
+                            timeout_s=60.0)
+        coord.join("bench-w0")
+        reshards = 8
+        lat = []
+        for i in range(reshards):
+            wid = f"bench-w{i + 1}"
+            t0 = time.perf_counter()
+            gen = coord.join(wid)["generation"]
+            while True:
+                grant = coord.get_task(worker_id=wid)
+                if grant is None or grant["generation"] >= gen:
+                    break
+            lat.append((time.perf_counter() - t0) * 1000.0)
+            if grant is not None:
+                coord.task_finished(grant["task_id"],
+                                    grant["generation"])
+        lat.sort()
+        out["coord_reshard"] = {
+            "reshard_latency_ms": round(lat[len(lat) // 2], 3),
+            "reshards": reshards,
+            "generation": coord.generation,
         }
     return {"v": 1, "suite": "smoke", "rows": out}
 

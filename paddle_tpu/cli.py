@@ -396,16 +396,25 @@ def _cmd_coordinator(args) -> int:
     from paddle_tpu.reader import recordio as rio
     from paddle_tpu.trainer.coordinator import (Coordinator,
                                                 CoordinatorServer,
-                                                FileStore)
+                                                FileStore, RpcStore)
     # de-dup: overlapping globs must not serve the same chunk twice
     paths = sorted({p for pat in args.data for p in _glob.glob(pat)})
     if not paths:
         raise SystemExit(f"no files match --data {args.data}")
     chunks = [d for p in paths for d in rio.chunk_descriptors(p)]
-    store = FileStore(args.snapshot) if args.snapshot else None
+    if args.snapshot and getattr(args, "snapshot_rpc", None):
+        raise SystemExit("--snapshot and --snapshot_rpc are mutually "
+                         "exclusive")
+    store = None
+    if args.snapshot:
+        store = FileStore(args.snapshot)
+    elif getattr(args, "snapshot_rpc", None):
+        host, _, port = args.snapshot_rpc.rpartition(":")
+        store = RpcStore(host or "127.0.0.1", int(port))
     coord = Coordinator(chunks, chunks_per_task=args.chunks_per_task,
                         timeout_s=args.task_timeout,
-                        failure_max=args.failure_max, store=store)
+                        failure_max=args.failure_max, store=store,
+                        worker_lease_s=args.worker_lease)
     server = CoordinatorServer(coord, host=args.host, port=args.port)
 
     stop = []
@@ -418,11 +427,15 @@ def _cmd_coordinator(args) -> int:
                       "host": args.host, "port": server.port,
                       "files": len(paths), "chunks": len(coord.chunks),
                       "chunks_per_task": coord.chunks_per_task,
-                      "recovered": coord.recovered}), flush=True)
+                      "recovered": coord.recovered,
+                      "generation": coord.generation}), flush=True)
     while not stop:
         time.sleep(0.2)
     server.stop()
-    print(json.dumps({"job": "coordinator", "status": "stopped"}))
+    # final membership/queue picture (workers, generation, stale_grants
+    # …) — the same dict the /metrics collector exports
+    print(json.dumps({"job": "coordinator", "status": "stopped",
+                      "stats": coord.stats()}))
     return 0
 
 
@@ -837,8 +850,17 @@ def main(argv=None) -> int:
                     help="0 picks a free port (printed as JSON)")
     co.add_argument("--task_timeout", type=float, default=60.0)
     co.add_argument("--failure_max", type=int, default=3)
+    co.add_argument("--worker_lease", type=float, default=None,
+                    help="elastic membership lease seconds (expiry = "
+                         "implicit leave + reshard; default: "
+                         "--task_timeout)")
     co.add_argument("--snapshot", default=None,
                     help="dir for crash-recovery snapshots (FileStore)")
+    co.add_argument("--snapshot_rpc", default=None,
+                    help="HOST:PORT of a KVStoreServer — snapshot over "
+                         "RPC instead of a shared filesystem "
+                         "(RpcStore; mutually exclusive with "
+                         "--snapshot)")
 
     dg = sub.add_parser("diagram", help="emit a Graphviz .dot of the model "
                         "(python/paddle/utils/make_model_diagram.py parity)")

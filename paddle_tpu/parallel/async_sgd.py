@@ -116,17 +116,54 @@ class AsyncSGDIsland:
     sync_group:   None = average across jax PROCESSES (each process one
                   island); or a list of Parameters objects of sibling
                   in-process islands (this trainer's included).
+    generation_source: optional zero-arg callable returning the elastic
+                  coordinator's membership generation (an int —
+                  ``lambda: coord.generation``, or the value handed to
+                  SGD.train's ``on_reshape`` hook via ``notify_reshape``).
+                  When the generation changes between batches the island
+                  reconciles IMMEDIATELY instead of waiting out its
+                  sync_period: a fleet that just grew or shrank
+                  re-synchronizes its islands at the reshape boundary,
+                  so a joiner (or the survivors of a leave) start the
+                  new membership from the common average rather than
+                  ``sync_period`` stale local steps.
     """
 
     def __init__(self, trainer, sync_period: int = 8,
-                 sync_group: Optional[Sequence] = None):
+                 sync_group: Optional[Sequence] = None,
+                 generation_source=None):
         assert sync_period >= 1
         self.trainer = trainer
         self.sync_period = sync_period
         self.sync_group = sync_group
+        self.generation_source = generation_source
         self._local_steps = 0
+        self._last_generation: Optional[int] = None
+        self.reshape_reconciles = 0
+
+    def notify_reshape(self, generation: int):
+        """Membership changed (SGD.train's ``on_reshape`` hook, or any
+        out-of-band signal): reconcile now. Idempotent per generation —
+        repeated notifications for the same reshape reconcile once."""
+        if generation == self._last_generation:
+            return
+        self._last_generation = generation
+        self.reshape_reconciles += 1
+        global_counters.bump("parallel/reshape_reconciles")
+        self.reconcile()
+
+    def _poll_generation(self):
+        if self.generation_source is None:
+            return
+        gen = self.generation_source()
+        if self._last_generation is None:
+            self._last_generation = gen      # baseline, not a reshape
+            return
+        if gen != self._last_generation:
+            self.notify_reshape(gen)
 
     def train_batch(self, batch, feeding=None):
+        self._poll_generation()
         loss, metrics = self.trainer.train_batch(batch, feeding)
         self._local_steps += 1
         if self._local_steps % self.sync_period == 0:

@@ -59,7 +59,18 @@ and, for the data pipeline (docs/robustness.md "Data pipeline"):
       :class:`WorkerCrash`, a BaseException — the restart path), and
       CORRUPT chosen pickled records before they land in a RecordIO
       shard (``corrupt_records`` — per-record corruption that passes the
-      chunk crc but fails deserialization).
+      chunk crc but fails deserialization);
+
+and, for elastic membership (docs/robustness.md "Elastic training"):
+
+  (k) run a deterministic SCHEDULE of membership events against a live
+      coordinator — join/leave/kill at exact task-grant indices
+      (``membership_script`` over the coordinator's
+      ``_grant_interceptor`` seam, so a reshape lands between two
+      grants exactly where a real scale-out/in would). The invariants
+      every script must preserve: per-record read counts stay
+      exactly-once across the reshape, and completions from superseded
+      grants are REJECTED (coordinator ``stale_grants``).
 
 Everything is deterministic given the seed and the schedule, so a chaos
 test that fails replays exactly. See ``tests/test_faults.py`` and
@@ -473,6 +484,41 @@ class FaultPlan:
                              name="pt-fault-disconnect")
         t.start()
         return t
+
+    # ----------------------------------------- (k) elastic membership
+    @staticmethod
+    @contextlib.contextmanager
+    def membership_script(coordinator, at: Dict[int, Callable]):
+        """Within the context, run ``at[i]()`` immediately AFTER the
+        coordinator's ``i``-th task grant commits (0-based, counted
+        from entering the context) — the deterministic twin of a worker
+        joining, leaving, or dying at an exact point in the dispatch
+        schedule. Actions run on the granting thread via the
+        coordinator's ``_grant_interceptor`` seam, OUTSIDE its lock, so
+        an action may itself call ``join()``/``leave()`` (or SIGKILL a
+        subprocess) without deadlocking — and the grant the action
+        follows was already stamped with the PRE-action generation,
+        which is exactly the stale-grant race the elastic tests must
+        provoke on demand. Yields a stats dict (``fired``: indices that
+        ran)."""
+        actions = {int(i): fn for i, fn in at.items()}
+        stats = {"fired": []}
+        prev = coordinator._grant_interceptor
+        base = coordinator._grants
+
+        def intercept(idx, grant):
+            if prev is not None:
+                prev(idx, grant)
+            fn = actions.get(idx - base)
+            if fn is not None:
+                stats["fired"].append(idx - base)
+                fn()
+
+        coordinator._grant_interceptor = intercept
+        try:
+            yield stats
+        finally:
+            coordinator._grant_interceptor = prev
 
     # --------------------------------------------- (h) data pipeline
     @staticmethod

@@ -36,6 +36,11 @@ class Task:
     chunks: List[Any]           # opaque chunk descriptors (paths, ranges…)
     epoch: int = 0
     num_failures: int = 0
+    #: in-flight reader position handed back by a gracefully departing
+    #: worker (task_release): {"records_consumed": n, ...} — the next
+    #: holder resumes after the consumed prefix instead of re-reading
+    #: it (exactly-once across a reshape; docs/robustness.md)
+    resume_state: Optional[Dict[str, Any]] = None
 
 
 class KVStore:
@@ -139,7 +144,133 @@ class FileStore(KVStore):
         return value
 
 
+class RpcStore(KVStore):
+    """KVStore client over XML-RPC (a :class:`KVStoreServer`) — the
+    snapshot store WITHOUT a shared filesystem: the coordinator (or a
+    standby) keeps its queue state on a remote process exactly like the
+    reference kept the master state in etcd. Values travel as
+    ``xmlrpc.client.Binary`` (JSON snapshots are bytes, not text), every
+    call retries transport blips through :func:`call_with_retry`, and a
+    lock serializes calls (a ``ServerProxy`` is not thread-safe)."""
+
+    def __init__(self, host: str, port: int,
+                 retry: Optional["RetryPolicy"] = None):
+        from xmlrpc.client import ServerProxy
+        self._proxy = ServerProxy(f"http://{host}:{port}",
+                                  allow_none=True)
+        self._retry = retry
+        self._lock = threading.Lock()
+
+    def put(self, key, value):
+        from xmlrpc.client import Binary
+        with self._lock:
+            call_with_retry(self._proxy.put, str(key), Binary(value),
+                            policy=self._retry)
+
+    def get(self, key):
+        with self._lock:
+            blob = call_with_retry(self._proxy.get, str(key),
+                                   policy=self._retry)
+        return None if blob is None else blob.data
+
+
+class KVStoreServer:
+    """Serve any :class:`KVStore` over XML-RPC for :class:`RpcStore`
+    clients (threaded; handler threads named ``pt-coord-kv-*``)."""
+
+    def __init__(self, store: Optional[KVStore] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        from xmlrpc.client import Binary
+        self.store = store or InMemStore()
+        self.server = _ThreadingXMLRPCServer(
+            (host, port), allow_none=True, logRequests=False,
+            thread_prefix="pt-coord-kv")
+        self.port = self.server.server_address[1]
+
+        def put(key, value):
+            data = value.data if isinstance(value, Binary) else \
+                bytes(value)
+            self.store.put(str(key), data)
+            return True
+
+        def get(key):
+            v = self.store.get(str(key))
+            return None if v is None else Binary(v)
+
+        self.server.register_function(put, "put")
+        self.server.register_function(get, "get")
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name="pt-coord-kv")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
 _SNAPSHOT_KEY = "coordinator/state"
+
+
+def _emit_coord(kind: str, **fields):
+    """Journal one ``coordinator/*`` membership event (join, leave,
+    lease_expired, reshard, generation) — run_id/host stamped by the
+    journal itself; never raises into the dispatch path."""
+    try:
+        from paddle_tpu.obs.events import emit
+        emit("coordinator", kind, **fields)
+    except Exception:  # noqa: BLE001 — obs must not break dispatch
+        pass
+
+
+#: weakref to the most recently constructed Coordinator — the registry
+#: collector scrapes it so /metrics shows fleet membership without the
+#: coordinator having to push gauges on every transition
+_LIVE_COORD = None
+_COLLECTOR_INSTALLED = False
+
+
+def _coord_collector():
+    from paddle_tpu.obs.metrics import SampleFamily
+    coord = _LIVE_COORD() if _LIVE_COORD is not None else None
+    if coord is None:
+        return []
+    st = coord.stats()
+    out = []
+    gauges = (
+        ("workers", "live workers holding a membership lease"),
+        ("generation", "membership generation (bumps on every reshape)"),
+        ("tasks_todo", "tasks waiting to be served"),
+        ("tasks_pending", "tasks leased out to workers"),
+        ("tasks_done", "tasks finished this epoch"),
+        ("tasks_dropped", "tasks dropped after failure_max failures"),
+        ("stale_grants", "task completions rejected for carrying a "
+                         "superseded generation"),
+        ("epoch", "current data pass"),
+    )
+    for key, help_ in gauges:
+        fam = SampleFamily(f"paddle_tpu_coord_{key}", "gauge", help_)
+        fam.add({}, float(st[key]))
+        out.append(fam)
+    return out
+
+
+def _install_coord_collector():
+    """Register the membership collector once per process (collectors
+    survive MetricsRegistry.reset(), so tests see fresh values but the
+    registration itself persists)."""
+    global _COLLECTOR_INSTALLED
+    if _COLLECTOR_INSTALLED:
+        return
+    try:
+        from paddle_tpu.obs.metrics import REGISTRY
+        REGISTRY.register_collector(_coord_collector)
+        _COLLECTOR_INSTALLED = True
+    except Exception:  # noqa: BLE001 — obs must not break dispatch
+        pass
 
 
 class Coordinator:
@@ -158,9 +289,14 @@ class Coordinator:
 
     def __init__(self, chunks: Sequence[Any], chunks_per_task: int = 1,
                  timeout_s: float = 60.0, failure_max: int = 3,
-                 store: Optional[KVStore] = None):
+                 store: Optional[KVStore] = None,
+                 worker_lease_s: Optional[float] = None):
         self.timeout_s = timeout_s
         self.failure_max = failure_max
+        #: membership lease (join/worker_heartbeat renew it; expiry is
+        #: an implicit leave) — defaults to the task lease
+        self.worker_lease_s = timeout_s if worker_lease_s is None \
+            else worker_lease_s
         self.store = store or InMemStore()
         self._lock = threading.Lock()
         self._save_lock = threading.Lock()
@@ -168,17 +304,34 @@ class Coordinator:
         self._saving_trainer: Optional[str] = None
         self._last_save_grant = float("-inf")
         self._todo: List[Task] = []
-        self._pending: Dict[int, Dict[str, Any]] = {}   # id -> {task, deadline}
+        # id -> {task, deadline, worker_id, generation}
+        self._pending: Dict[int, Dict[str, Any]] = {}
         self._done: List[Task] = []
         self._failed_dropped: List[Task] = []
         self._epoch = 0
         self._next_id = 0
         self._chunks = list(chunks)
         self._chunks_per_task = chunks_per_task
+        # ----- elastic membership (v2) -----
+        self._workers: Dict[str, Dict[str, Any]] = {}
+        self._generation = 0
+        self._memory_plan: Optional[dict] = None
+        self._stale_grants = 0
+        self._grants = 0
+        #: fault-injection seam (testing/faults.py membership_script):
+        #: called OUTSIDE the lock as (grant_index, grant_dict) right
+        #: after each successful get_task grant
+        self._grant_interceptor: \
+            Optional[Callable[[int, Dict[str, Any]], None]] = None
+        self._expiry_times: List[float] = []
         self._recovered = self._recover()
         if not self._recovered:
             self._partition()
             self._snapshot()
+        global _LIVE_COORD
+        import weakref
+        _LIVE_COORD = weakref.ref(self)
+        _install_coord_collector()
 
     # ------------------------------------------------------------- queues
     def _partition(self):
@@ -190,33 +343,101 @@ class Coordinator:
                                    self._epoch))
             self._next_id += 1
 
-    def get_task(self, epoch: Optional[int] = None
+    def get_task(self, epoch: Optional[int] = None,
+                 worker_id: Optional[str] = None
                  ) -> Optional[Dict[str, Any]]:
         """Next task (re-queueing timed-out pending tasks first). Returns
-        {task_id, chunks} or None when the queue is empty — pass the
-        `epoch` the caller is working on to also get None once that pass
-        has turned over (so per-pass readers terminate; the queue itself
-        refills every epoch like the Go master's turnover)."""
+        {task_id, chunks, generation, resume_state} or None when the
+        queue is empty — pass the `epoch` the caller is working on to
+        also get None once that pass has turned over (so per-pass readers
+        terminate; the queue itself refills every epoch like the Go
+        master's turnover). A ``worker_id`` renews that worker's
+        membership lease and ties the grant to it, so a graceful leave
+        (or lease expiry) re-queues exactly this worker's tasks."""
         with self._lock:
+            self._expire_workers_locked()
             self._requeue_timed_out()
+            if worker_id is not None and worker_id in self._workers:
+                self._workers[worker_id]["deadline"] = \
+                    time.time() + self.worker_lease_s
             if epoch is not None and self._epoch != epoch:
                 return None
             if not self._todo:
                 return None
             task = self._todo.pop(0)
             self._pending[task.task_id] = {
-                "task": task, "deadline": time.time() + self.timeout_s}
+                "task": task, "deadline": time.time() + self.timeout_s,
+                "worker_id": worker_id, "generation": self._generation}
+            grant = {"task_id": task.task_id, "chunks": task.chunks,
+                     "generation": self._generation,
+                     "resume_state": task.resume_state}
+            task.resume_state = None      # consumed by this grant
+            idx = self._grants
+            self._grants += 1
+            hook = self._grant_interceptor
             self._snapshot()
-            return {"task_id": task.task_id, "chunks": task.chunks}
+        if hook is not None:
+            # outside the lock: the hook may join()/leave() workers
+            # (testing/faults.py membership_script) without deadlocking
+            hook(idx, grant)
+        return grant
 
-    def task_finished(self, task_id: int) -> bool:
+    def _stale(self, kind: str, task_id: int, generation: int,
+               stamped: Optional[int]) -> bool:
+        """Reject a completion carrying a superseded grant — called
+        under _lock. The check is against the GENERATION STAMPED ON THE
+        GRANT (not the current one): a live worker finishing work it
+        was granted before a reshape is still accepted exactly once; a
+        zombie finishing a task that was re-queued and re-granted after
+        its membership lapsed is refused, so the record counts stay
+        exactly-once."""
+        if stamped is None or generation == stamped:
+            return False
+        self._stale_grants += 1
+        _emit_coord("stale_grant", rpc=kind, task_id=task_id,
+                    grant_generation=generation,
+                    current_generation=self._generation)
+        return True
+
+    def task_finished(self, task_id: int,
+                      generation: Optional[int] = None) -> bool:
         with self._lock:
-            ent = self._pending.pop(task_id, None)
+            ent = self._pending.get(task_id)
             if ent is None:
                 return False
+            if generation is not None and self._stale(
+                    "task_finished", task_id, generation,
+                    ent.get("generation")):
+                return False
+            self._pending.pop(task_id)
             self._done.append(ent["task"])
             if not self._todo and not self._pending:
                 self._turn_epoch()
+            self._snapshot()
+            return True
+
+    def task_release(self, task_id: int,
+                     generation: Optional[int] = None,
+                     state: Optional[Dict[str, Any]] = None) -> bool:
+        """Gracefully hand a leased task back (no failure penalty): a
+        departing worker returns the task WITH its reader position so
+        the next holder resumes after the consumed prefix — the elastic
+        counterpart of the dead-trainer lease expiry, preserving
+        exactly-once accounting across a planned reshape."""
+        with self._lock:
+            ent = self._pending.get(task_id)
+            if ent is None:
+                return False
+            if generation is not None and self._stale(
+                    "task_release", task_id, generation,
+                    ent.get("generation")):
+                return False
+            self._pending.pop(task_id)
+            task: Task = ent["task"]
+            if state:
+                task.resume_state = dict(state)
+            self._todo.append(task)
+            self._todo.sort(key=lambda t: (t.epoch, t.task_id))
             self._snapshot()
             return True
 
@@ -239,14 +460,20 @@ class Coordinator:
             ent["deadline"] = time.time() + self.timeout_s
             return True
 
-    def task_failed(self, task_id: int) -> bool:
+    def task_failed(self, task_id: int,
+                    generation: Optional[int] = None) -> bool:
         """service.go:448 + processFailedTask:313 — re-queue with bounded
         retries; after failure_max the task is dropped (bad data skipped,
         training continues)."""
         with self._lock:
-            ent = self._pending.pop(task_id, None)
+            ent = self._pending.get(task_id)
             if ent is None:
                 return False
+            if generation is not None and self._stale(
+                    "task_failed", task_id, generation,
+                    ent.get("generation")):
+                return False
+            self._pending.pop(task_id)
             task: Task = ent["task"]
             task.num_failures += 1
             if task.num_failures >= self.failure_max:
@@ -290,6 +517,188 @@ class Coordinator:
         self._done = []
         self._failed_dropped = []
         self._partition()
+
+    # -------------------------------------------- elastic membership (v2)
+    def join(self, worker_id: str,
+             info: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """A worker enters the fleet (scale-out, or a replacement for a
+        dead host). Grants a membership lease, bumps the generation
+        (stale grants from the previous membership are then rejected at
+        task_finished/task_failed), and returns everything the joiner
+        needs to start safely: the generation, the current epoch, the
+        live roster, and the published :class:`MemoryPlan` meta — a
+        replacement host with less HBM adopts the known-safe microbatch
+        plan (provenance="adopted") instead of re-OOMing through a
+        fresh probe."""
+        with self._lock:
+            self._expire_workers_locked()
+            rejoin = worker_id in self._workers
+            self._workers[worker_id] = {
+                "info": dict(info or {}),
+                "joined_at": time.time(),
+                "deadline": time.time() + self.worker_lease_s,
+            }
+            if not rejoin:
+                self._reshard_locked("join", worker_id=worker_id)
+            _emit_coord("join", worker_id=worker_id, rejoin=rejoin,
+                        generation=self._generation,
+                        workers=len(self._workers))
+            self._snapshot()
+            return {"generation": self._generation,
+                    "epoch": self._epoch,
+                    "workers": sorted(self._workers),
+                    "memory_plan": self._memory_plan}
+
+    def leave(self, worker_id: str) -> bool:
+        """Graceful departure (scale-in): the worker's leased tasks go
+        back to todo WITHOUT a failure penalty (it didn't fail — it was
+        asked to shrink), the generation bumps, and the queues reshard
+        deterministically. Tasks the worker released beforehand via
+        :meth:`task_release` carry their reader position."""
+        with self._lock:
+            if self._workers.pop(worker_id, None) is None:
+                return False
+            self._release_worker_tasks_locked(worker_id, penalty=False)
+            self._reshard_locked("leave", worker_id=worker_id)
+            _emit_coord("leave", worker_id=worker_id,
+                        generation=self._generation,
+                        workers=len(self._workers))
+            self._snapshot()
+            return True
+
+    def worker_heartbeat(self, worker_id: str) -> int:
+        """Renew a membership lease; returns the current generation so
+        workers learn about a reshape from their own heartbeat instead
+        of a broadcast channel. An unknown worker_id gets -1 — it was
+        expired (or never joined) and must re-join."""
+        with self._lock:
+            self._expire_workers_locked()
+            w = self._workers.get(worker_id)
+            if w is None:
+                return -1
+            w["deadline"] = time.time() + self.worker_lease_s
+            return self._generation
+
+    def _release_worker_tasks_locked(self, worker_id: str,
+                                     penalty: bool):
+        """Re-queue every pending task granted to ``worker_id`` —
+        failure-counted on an implicit leave (lease expiry: the worker
+        may be dead mid-record), free on a graceful one."""
+        for tid in list(self._pending):
+            if self._pending[tid].get("worker_id") != worker_id:
+                continue
+            ent = self._pending.pop(tid)
+            task: Task = ent["task"]
+            if penalty:
+                task.num_failures += 1
+                if task.num_failures >= self.failure_max:
+                    self._failed_dropped.append(task)
+                    continue
+            self._todo.append(task)
+        # the departed worker may have held the pass's last tasks and
+        # all of them dropped: the pass must still turn over
+        # (_requeue_timed_out's drain rule)
+        if not self._todo and not self._pending and \
+                (self._done or self._failed_dropped):
+            self._turn_epoch()
+
+    def _expire_workers_locked(self):
+        """Membership sweep: a worker whose lease lapsed is an IMPLICIT
+        leave — its tasks re-queue (with a failure count: it may have
+        died mid-record) and the membership generation bumps. A burst of
+        expiries is a fleet event, not one sick host: the flight
+        recorder dumps a postmortem bundle on a storm (>= 2 within
+        10s)."""
+        now = time.time()
+        expired = [w for w, ent in self._workers.items()
+                   if ent["deadline"] <= now]
+        if not expired:
+            return
+        for worker_id in expired:
+            self._workers.pop(worker_id, None)
+            self._release_worker_tasks_locked(worker_id, penalty=True)
+            self._expiry_times.append(now)
+            _emit_coord("lease_expired", worker_id=worker_id,
+                        workers=len(self._workers))
+        self._reshard_locked("lease_expired", expired=sorted(expired))
+        self._expiry_times = [t for t in self._expiry_times
+                              if now - t <= 10.0]
+        if len(self._expiry_times) >= 2:
+            # off-thread: the dump scrapes /metrics, whose coordinator
+            # collector takes _lock — dumping inline here (under _lock)
+            # would self-deadlock the sweep
+            try:
+                from paddle_tpu.obs.flight import FLIGHT
+                threading.Thread(
+                    target=FLIGHT.maybe_autodump,
+                    args=("coord-lease-expiry-storm",),
+                    daemon=True, name="pt-coord-dump").start()
+            except Exception:  # noqa: BLE001 — obs must not break sweep
+                pass
+
+    def _reshard_locked(self, reason: str, **fields):
+        """Deterministic repartition on a membership change — called
+        under _lock. The generation bumps (every later grant carries
+        the new one; completions stamped with an older grant whose task
+        was re-queued are rejected), and the todo queue is sorted into
+        the CANONICAL (epoch, task_id) order so every surviving worker
+        agrees on what is served next regardless of which host departed
+        — the same schedule a fixed-membership run would produce once
+        the departed worker's tasks are back in line."""
+        self._generation += 1
+        self._todo.sort(key=lambda t: (t.epoch, t.task_id))
+        _emit_coord("generation", generation=self._generation,
+                    reason=reason)
+        _emit_coord("reshard", reason=reason,
+                    generation=self._generation,
+                    todo=len(self._todo), pending=len(self._pending),
+                    workers=len(self._workers), **fields)
+
+    def put_memory_plan(self, meta: Optional[Dict[str, Any]]) -> bool:
+        """Publish the fleet's known-safe MemoryPlan meta
+        (MemoryPlan.to_meta()) so :meth:`join` can hand it to a
+        replacement host — checkpoint-meta parity without requiring the
+        joiner to read the checkpoint store."""
+        with self._lock:
+            self._memory_plan = dict(meta) if meta else None
+            self._snapshot()
+            return True
+
+    @property
+    def memory_plan(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return None if self._memory_plan is None \
+                else dict(self._memory_plan)
+
+    @property
+    def generation(self) -> int:
+        """Membership generation — monotonic, bumps on every join /
+        leave / lease expiry; stamped on every grant."""
+        with self._lock:
+            return self._generation
+
+    def workers(self) -> List[str]:
+        with self._lock:
+            self._expire_workers_locked()
+            return sorted(self._workers)
+
+    def stats(self) -> Dict[str, Any]:
+        """One consistent membership/queue snapshot (the /metrics
+        collector and the CLI status line read this)."""
+        with self._lock:
+            return {"workers": len(self._workers),
+                    "generation": self._generation,
+                    "epoch": self._epoch,
+                    "tasks_todo": len(self._todo),
+                    "tasks_pending": len(self._pending),
+                    "tasks_done": len(self._done),
+                    "tasks_dropped": len(self._failed_dropped),
+                    "stale_grants": self._stale_grants,
+                    "grants": self._grants}
+
+    def num_stale_grants(self) -> int:
+        with self._lock:
+            return self._stale_grants
 
     # ------------------------------------------------------ pass tracking
     @property
@@ -344,6 +753,11 @@ class Coordinator:
                         for t in self._failed_dropped],
             "chunks": self._chunks,
             "chunks_per_task": self._chunks_per_task,
+            # elastic state: the generation survives a coordinator
+            # restart (grants from before it stay rejectable); worker
+            # leases do NOT — the fleet re-joins a recovered master
+            "generation": self._generation,
+            "memory_plan": self._memory_plan,
         }
         self.store.put(_SNAPSHOT_KEY, json.dumps(state).encode())
 
@@ -374,6 +788,9 @@ class Coordinator:
         self._failed_dropped = [mk(d) for d in state["dropped"]]
         self._chunks = state["chunks"]
         self._chunks_per_task = state["chunks_per_task"]
+        # absent in pre-elastic snapshots: recover tolerantly
+        self._generation = int(state.get("generation", 0))
+        self._memory_plan = state.get("memory_plan")
         self._pending = {}
         return True
 
@@ -422,20 +839,65 @@ class Coordinator:
 # RPC wrapper (multi-process trainers; go net/rpc parity via stdlib)
 
 
+def _make_threading_server():
+    import socketserver
+    from xmlrpc.server import SimpleXMLRPCServer
+
+    class ThreadingXMLRPCServer(socketserver.ThreadingMixIn,
+                                SimpleXMLRPCServer):
+        """Concurrent request handling for the coordinator RPCs: on the
+        single-threaded stdlib server one slow get_task (a snapshot
+        write to a sluggish store) serializes behind it every other
+        worker's heartbeat — long enough and a HEALTHY worker's lease
+        expires spuriously. Handler threads are daemons named
+        ``pt-coord-rpc-*`` (R5 thread hygiene; the conftest leak
+        fixture watches the prefix) and die with their request."""
+
+        daemon_threads = True
+
+        def __init__(self, *args, thread_prefix: str = "pt-coord-rpc",
+                     **kwargs):
+            self._thread_prefix = thread_prefix
+            self._request_seq = 0
+            super().__init__(*args, **kwargs)
+
+        def process_request(self, request, client_address):
+            self._request_seq += 1
+            t = threading.Thread(
+                target=self.process_request_thread,
+                args=(request, client_address), daemon=True,
+                name=f"{self._thread_prefix}-{self._request_seq}")
+            t.start()
+
+    return ThreadingXMLRPCServer
+
+
+_ThreadingXMLRPCServer = _make_threading_server()
+
+
 class CoordinatorServer:
-    """Expose a Coordinator over XML-RPC (threaded stdlib server)."""
+    """Expose a Coordinator over XML-RPC (threaded stdlib server — one
+    handler thread per request, so a blocked RPC cannot starve another
+    worker's heartbeat into a spurious lease expiry)."""
+
+    #: RPCs forwarded verbatim to the Coordinator — dispatch +
+    #: elastic-membership surface (join/leave/…) + observability
+    _RPCS = ("get_task", "task_finished", "task_failed", "task_release",
+             "heartbeat", "request_save_model", "time",
+             "join", "leave", "worker_heartbeat", "put_memory_plan",
+             "stats", "num_dropped", "num_stale_grants", "workers")
 
     def __init__(self, coordinator: Coordinator, host: str = "127.0.0.1",
                  port: int = 0):
-        from xmlrpc.server import SimpleXMLRPCServer
         self.coordinator = coordinator
-        self.server = SimpleXMLRPCServer((host, port), allow_none=True,
-                                         logRequests=False)
+        self.server = _ThreadingXMLRPCServer(
+            (host, port), allow_none=True, logRequests=False)
         self.port = self.server.server_address[1]
-        for name in ("get_task", "task_finished", "task_failed",
-                     "heartbeat", "request_save_model", "time"):
+        for name in self._RPCS:
             self.server.register_function(getattr(coordinator, name), name)
         self.server.register_function(lambda: coordinator.epoch, "epoch")
+        self.server.register_function(lambda: coordinator.generation,
+                                      "generation")
         self._thread: Optional[threading.Thread] = None
 
     def start(self):
@@ -597,10 +1059,32 @@ class _Heartbeater:
         self._thread.join(timeout=5.0)
 
 
+def _chunk_reader_takes_state(fn) -> bool:
+    """Does ``chunk_reader`` accept a second (resume_state) positional
+    argument? Decided by signature, not by trial call — a TypeError
+    raised INSIDE the reader must not be mistaken for arity."""
+    import inspect
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    n = 0
+    for p in sig.parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            return True
+        if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                      inspect.Parameter.POSITIONAL_OR_KEYWORD):
+            n += 1
+    return n >= 2
+
+
 def task_reader(coordinator, chunk_reader: Callable[[Any], Any],
                 idle_timeout: float = 600.0, poll_interval: float = 0.2,
                 retry: Optional[RetryPolicy] = None,
-                heartbeat_interval: Optional[float] = None):
+                heartbeat_interval: Optional[float] = None,
+                worker_id: Optional[str] = None,
+                on_generation_change: Optional[Callable[[int], None]]
+                = None):
     """Reader over coordinator-dispatched tasks (master client NextRecord
     parity, go/master/client.go:232).
 
@@ -623,8 +1107,22 @@ def task_reader(coordinator, chunk_reader: Callable[[Any], Any],
     task's records are being consumed, a background heartbeat renews its
     lease every ``heartbeat_interval`` seconds (default: a third of the
     server lease when discoverable, else 5s), so a SLOW trainer keeps
-    its task while a DEAD one loses it."""
+    its task while a DEAD one loses it.
+
+    Elastic mode (docs/robustness.md "Elastic training"): with a
+    ``worker_id`` every grant is tied to this worker's membership lease
+    and stamped with the coordinator's GENERATION; finish/fail report
+    that stamp back so a completion superseded by a reshape is rejected
+    instead of double-counting records. A grant carrying
+    ``resume_state`` (a task gracefully handed back mid-read) skips the
+    already-consumed record prefix, and an ABANDONED reader (generator
+    closed mid-task — a planned scale-in) releases its task back with
+    its own position via ``task_release`` rather than letting the lease
+    lapse with a failure count. ``on_generation_change(gen)`` fires
+    when a grant reveals a new membership generation (the SGD reshape
+    hook rides on it)."""
     retry = retry or RetryPolicy()
+    takes_state = _chunk_reader_takes_state(chunk_reader)
 
     def reader():
         epoch0 = coordinator_epoch(coordinator, retry=retry)
@@ -635,9 +1133,14 @@ def task_reader(coordinator, chunk_reader: Callable[[Any], Any],
             lease = getattr(coordinator, "timeout_s", None)
             hb_every = lease / 3.0 if isinstance(lease, (int, float)) \
                 else 5.0
+        last_gen: Optional[int] = None
         while True:
-            t = call_with_retry(coordinator.get_task, epoch0,
-                                policy=retry)
+            if worker_id is not None:
+                t = call_with_retry(coordinator.get_task, epoch0,
+                                    worker_id, policy=retry)
+            else:
+                t = call_with_retry(coordinator.get_task, epoch0,
+                                    policy=retry)
             if t is None:
                 if coordinator_epoch(coordinator, retry=retry) != epoch0:
                     return                   # pass completed
@@ -653,25 +1156,59 @@ def task_reader(coordinator, chunk_reader: Callable[[Any], Any],
                 idle += poll_interval
                 continue
             idle = 0.0
+            gen = t.get("generation") if isinstance(t, dict) else None
+            if gen is not None and gen != last_gen:
+                if last_gen is not None and \
+                        on_generation_change is not None:
+                    on_generation_change(gen)
+                last_gen = gen
+            rs = t.get("resume_state") if isinstance(t, dict) else None
+            skip = int((rs or {}).get("records_consumed", 0))
+            consumed = 0
             beater = _Heartbeater(hb_conn, t["task_id"], hb_every) \
                 if hb_conn is not None else None
-            failed = False
+            failed = done = False
             try:
-                for chunk in t["chunks"]:
-                    for rec in chunk_reader(chunk):
+                for i, chunk in enumerate(t["chunks"]):
+                    it = chunk_reader(chunk, rs if i == 0 else None) \
+                        if takes_state else chunk_reader(chunk)
+                    for rec in it:
+                        if consumed < skip:
+                            consumed += 1     # handed-off prefix:
+                            continue          # already delivered once
+                        consumed += 1
                         yield rec
+                done = True
+            except GeneratorExit:
+                # consumer abandoned the reader mid-task. A worker with
+                # an identity hands the task back WITH its position
+                # (graceful scale-in: the successor resumes after the
+                # consumed prefix — no record lost, none re-read); an
+                # anonymous reader keeps the legacy behavior: the lease
+                # expires on its own and the task re-queues, exactly
+                # the dead-trainer path.
+                if worker_id is not None:
+                    if beater is not None:
+                        beater.stop()
+                        beater = None
+                    try:
+                        call_with_retry(
+                            coordinator.task_release, t["task_id"],
+                            gen, {"records_consumed": consumed},
+                            policy=retry)
+                    except Exception:  # noqa: BLE001 — best-effort:
+                        pass     # lease expiry then re-queues it
+                raise
             except Exception:
                 failed = True
             finally:
-                # also runs on GeneratorExit (consumer abandoned the
-                # reader): the lease then expires on its own and the
-                # task re-queues — exactly the dead-trainer path
                 if beater is not None:
                     beater.stop()
             if failed:
                 call_with_retry(coordinator.task_failed, t["task_id"],
-                                policy=retry)
+                                gen, policy=retry)
                 continue
-            call_with_retry(coordinator.task_finished, t["task_id"],
-                            policy=retry)
+            if done:
+                call_with_retry(coordinator.task_finished, t["task_id"],
+                                gen, policy=retry)
     return reader

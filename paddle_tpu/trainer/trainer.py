@@ -770,7 +770,8 @@ class SGD:
               checkpoint_dir: Optional[str] = None,
               auto_resume: bool = False, fault_policy=None,
               idle_timeout: float = 600.0, microbatch=None,
-              oom_probe: bool = False):
+              oom_probe: bool = False,
+              worker_id: Optional[str] = None, on_reshape=None):
         """reader: callable yielding BATCHES (lists of sample tuples), i.e.
         the output of paddle_tpu.reader.batch(...).
 
@@ -817,7 +818,20 @@ class SGD:
         oom_probe: with microbatch="auto", binary-search the largest
         safe microbatch on the first batch (against COPIES of the
         state) before stepping, instead of discovering it by failing
-        mid-pass."""
+        mid-pass.
+
+        worker_id: elastic-membership identity (coordinator mode,
+        docs/robustness.md "Elastic training"). The trainer join()s the
+        coordinator before its first task — adopting the fleet's
+        published MemoryPlan (provenance="adopted") when it has no
+        better one, so a replacement host never re-discovers the safe
+        microbatch by OOMing — and leave()s gracefully at the end, so
+        its in-flight tasks requeue with their reader position instead
+        of burning a lease timeout. Each pulled grant carries the
+        membership generation; when it changes mid-pass the trainer
+        journals a ``trainer/reshape`` event and calls
+        ``on_reshape(generation)`` if given (the hook may rebalance
+        async-SGD islands — parallel/async_sgd.py)."""
         from paddle_tpu.trainer.data_feeder import DataFeeder
         if event_handler is None:
             event_handler = _default_event_handler
@@ -861,8 +875,11 @@ class SGD:
                 "oom_probe=True needs microbatch='auto' or an int")
 
         if coordinator is not None:
+            import xmlrpc.client as _xc
+
             from paddle_tpu.reader import batch as batch_reader
             from paddle_tpu.trainer.coordinator import (RetryPolicy,
+                                                        call_with_retry,
                                                         coordinator_epoch,
                                                         task_reader)
             assert chunk_reader is not None, \
@@ -871,14 +888,59 @@ class SGD:
             # with backoff — a coordinator restarting while trainers come
             # up delays them instead of killing them
             retry = RetryPolicy()
+            joined = False
+            join_plan_meta = None
+            if worker_id is not None:
+                try:
+                    resp = call_with_retry(coordinator.join, worker_id,
+                                           policy=retry)
+                    joined = True
+                    join_plan_meta = (resp or {}).get("memory_plan")
+                except _xc.Fault:
+                    # pre-elastic server: train as an anonymous worker
+                    import warnings
+                    warnings.warn(
+                        "coordinator has no join() RPC — running "
+                        "without elastic membership (upgrade the "
+                        "coordinator for scale-out/in)")
+
+            def _on_gen_change(gen):
+                # a grant revealed a new membership generation: the
+                # fleet resharded under us. Journal it (run_id/host
+                # stamped) and let the caller rebalance.
+                from paddle_tpu.obs.events import emit as _emit
+                _emit("trainer", "reshape", generation=int(gen),
+                      worker_id=worker_id)
+                if on_reshape is not None:
+                    on_reshape(gen)
+
             rdr = task_reader(coordinator, chunk_reader,
-                              idle_timeout=idle_timeout, retry=retry)
+                              idle_timeout=idle_timeout, retry=retry,
+                              worker_id=worker_id if joined else None,
+                              on_generation_change=_on_gen_change)
             if batch_size:
                 rdr = batch_reader(rdr, batch_size)
             if checkpoint_manager is not None and \
                     self.restore_checkpoint(checkpoint_manager):
                 self._adopt_restored_plan()
+            self._adopt_fleet_plan(join_plan_meta)
 
+            def _publish_plan():
+                # share the discovered/known-safe plan with the fleet:
+                # the NEXT joiner adopts it from its join() response
+                # instead of re-probing (or re-OOMing) on its own
+                if not joined or self._memory_exec is None:
+                    return
+                pm = self._memory_exec.plan.to_meta()
+                if pm is None:
+                    return
+                try:
+                    call_with_retry(coordinator.put_memory_plan, pm,
+                                    policy=retry)
+                except (_xc.Fault, TimeoutError):
+                    pass         # pre-elastic server / coordinator gone
+
+            _publish_plan()
             try:
                 while coordinator_epoch(coordinator,
                                         retry=retry) < num_passes:
@@ -888,6 +950,7 @@ class SGD:
                                    checkpoint_period)
                     if checkpoint_manager is not None:
                         self.save_checkpoint(checkpoint_manager)
+                    _publish_plan()
                     if coordinator_epoch(coordinator, retry=retry) == \
                             pass_id:
                         # the reader gave up without the epoch turning
@@ -899,6 +962,15 @@ class SGD:
                             f"of {num_passes}: the pass never completed")
                         break
             finally:
+                if joined:
+                    # graceful scale-in: hand leased tasks back (with
+                    # their reader position) instead of burning a lease
+                    # timeout on the survivors
+                    try:
+                        call_with_retry(coordinator.leave, worker_id,
+                                        policy=retry)
+                    except (_xc.Fault, TimeoutError):
+                        pass     # coordinator gone: leases expire
                 # saves run off the step path (async writer); never leave
                 # train() — even via an exception — with a checkpoint
                 # still in flight (and surface any background write error)
@@ -1349,6 +1421,26 @@ class SGD:
                                     provenance="resumed")
         if plan is not None:
             self._memory_exec.adopt(plan)
+
+    def _adopt_fleet_plan(self, meta):
+        """Elastic join: adopt the fleet's published MemoryPlan
+        (coordinator.join() response) when this trainer has no better
+        one of its own — a replacement host starts at the known-safe
+        microbatch (provenance="adopted") instead of re-probing or
+        re-discovering it by OOM. A restored/configured/probed plan
+        always wins (same precedence as maybe_probe)."""
+        if self._memory_exec is None or not meta:
+            return
+        if self._memory_exec.plan.provenance != "full":
+            return               # it already knows better
+        from paddle_tpu.trainer.memory import MemoryPlan
+        plan = MemoryPlan.from_meta(meta, provenance="adopted")
+        if plan is None:
+            return
+        self._memory_exec.adopt(plan)
+        from paddle_tpu.obs.events import emit as _emit
+        _emit("trainer", "plan_adopted", provenance="adopted",
+              microbatch=plan.microbatch, accum_steps=plan.accum_steps)
 
     def save_parameter_to_tar(self, f):
         self.parameters.to_tar(f)

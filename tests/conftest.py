@@ -90,7 +90,7 @@ def _no_pipeline_thread_leaks(request):
 
     def leaked():
         from paddle_tpu.reader.pipeline import THREAD_PREFIX
-        prefixes = (THREAD_PREFIX, "pt-serve", "pt-obs")
+        prefixes = (THREAD_PREFIX, "pt-serve", "pt-obs", "pt-coord")
         return [t for t in threading.enumerate()
                 if t.is_alive() and t.name.startswith(prefixes)]
 

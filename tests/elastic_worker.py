@@ -2,7 +2,14 @@
 trainer of go/master's design: pulls tasks over RPC, checkpoints full
 state, restartable at any instant).
 
-argv: <coordinator_port> <ckpt_dir> <per_record_delay_s>
+argv: <coordinator_port> <ckpt_dir> <per_record_delay_s> [worker_id]
+
+With a ``worker_id`` the trainer runs in elastic-membership mode:
+join() on entry (adopting the fleet's generation + memory plan),
+generation-stamped grants, graceful leave() on exit. Each optimizer
+step prints ``STEP <k> LOSS <cost>`` so the chaos tests can both
+kill-at-marker (testing/faults.py) and digest-compare the loss
+trajectory against a fixed-membership run.
 """
 
 import sys
@@ -15,6 +22,7 @@ def main():
     port = int(sys.argv[1])
     ckpt_dir = sys.argv[2]
     delay = float(sys.argv[3])
+    worker_id = sys.argv[4] if len(sys.argv) > 4 else None
 
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -40,11 +48,15 @@ def main():
                 time.sleep(delay)
             yield (r.randn(8).astype("float32"), int(r.randint(2)))
 
+    def on_step(e):
+        if isinstance(e, paddle.event.EndIteration):
+            print(f"STEP {e.batch_id} LOSS {e.cost:.10f}", flush=True)
+
     coord = connect("127.0.0.1", port)
     mgr = CheckpointManager(ckpt_dir, keep=2)
     tr.train(coordinator=coord, chunk_reader=chunk_reader, batch_size=4,
              num_passes=2, checkpoint_manager=mgr, checkpoint_period=1,
-             event_handler=lambda e: None)
+             event_handler=on_step, worker_id=worker_id)
     print(f"WORKER DONE steps={tr._step_count}", flush=True)
     return 0
 
