@@ -380,6 +380,9 @@ def _cmd_serve(args) -> int:
         time.sleep(0.2)
     httpd.shutdown()            # stop admissions at the transport...
     server.shutdown(drain=True)  # ...then drain the queued requests
+    if args.profile_every or args.slo:
+        from paddle_tpu.obs.profile import PROFILER
+        PROFILER.disable()      # joins the pt-obs-profiler thread
     print(json.dumps({"job": "serve", "status": "stopped",
                       "stats": server.stats()}))
     return 0
@@ -474,7 +477,10 @@ def _iter_journal_follow(path: str, domain=None, kind=None,
     whole lines, so this is just the race window). Ends when
     ``idle_timeout`` seconds pass with no new record (None: follow
     forever) or ``stop`` (a threading.Event) is set — the testable
-    seam (tests/test_cli.py)."""
+    seam (tests/test_cli.py). Size-based rotation
+    (EventJournal.configure(max_bytes=...)) is spanned losslessly:
+    when the active file shrinks, the unread remainder of what is now
+    ``path.1`` is drained first, then the fresh active file from 0."""
     from paddle_tpu.obs.events import validate
     pos = from_pos
     buf = ""
@@ -484,13 +490,22 @@ def _iter_journal_follow(path: str, domain=None, kind=None,
             size = os.path.getsize(path)
         except OSError:
             size = 0
-        if size < pos:                  # truncated/rotated: restart
-            pos, buf = 0, ""
+        if size < pos:                  # truncated or rotated under us
+            try:
+                # rotation moved the active file to path.1 — drain the
+                # records appended after our cursor before restarting
+                with open(path + ".1", encoding="utf-8") as f:
+                    f.seek(pos)
+                    buf += f.read()
+            except OSError:
+                buf = ""                # plain truncation: drop the tail
+            pos = 0
         if size > pos:
             with open(path, encoding="utf-8") as f:
                 f.seek(pos)
                 buf += f.read()
                 pos = f.tell()
+        if buf:
             lines = buf.split("\n")
             buf = lines.pop()           # possibly-torn tail
             for line in lines:
@@ -616,6 +631,73 @@ def _cmd_trace(args) -> int:
     return merge_main(list(args.merge_args or []))
 
 
+def _cmd_profile(args) -> int:
+    """`paddle_tpu profile --config C --steps N` — the on-demand deep
+    window (docs/observability.md "Profiling & SLOs"): build the
+    trainer, turn the continuous profiler up to sample_every=1, arm a
+    jax.profiler trace over N steps, drive them on synthetic data and
+    print ONE JSON line: per-phase breakdown, MFU/roofline when the
+    device and cost model resolve, and where the trace artifacts
+    landed (the same dir a GET /profile?deep_steps=N caller would see
+    in later snapshots/bundles)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.obs.profile import PROFILER
+    paddle.init(use_tpu=args.use_tpu, seed=args.seed,
+                compute_dtype=args.dtype)
+    ns = _load_config(args.config)
+    trainer = _build_trainer(ns, args.init_model_path)
+    batch = _synthetic_batch(trainer, args.batch_size, args.seq_len)
+
+    def reader():
+        while True:
+            yield batch
+
+    out = args.out or os.path.join(".", "profile_out")
+    os.makedirs(out, exist_ok=True)
+    PROFILER.enable(sample_every=1)
+    try:
+        # warmup outside the window so compile time doesn't pollute it
+        trainer.train(reader, num_passes=1, event_handler=lambda e: None,
+                      num_batches_per_pass=2)
+        PROFILER.arm_window(args.steps, out_dir=out)
+        trainer.train(reader, num_passes=1, event_handler=lambda e: None,
+                      num_batches_per_pass=args.steps)
+        trace_dir = PROFILER.finish_window()
+        snap = PROFILER.snapshot()
+        train = snap["kinds"].get("train", {})
+        print(json.dumps({
+            "job": "profile", "status": "ok", "steps": args.steps,
+            "step_ms_median": train.get("step_ms_median"),
+            "phases": train.get("phases"),
+            "cost": snap.get("cost", {}).get("train"),
+            "mfu": snap.get("mfu", {}).get("train"),
+            "roofline_frac": snap.get("roofline_frac", {}).get("train"),
+            "memory": snap.get("memory"),
+            "trace_dir": trace_dir
+            or snap["window"].get("last_trace_dir")}))
+    finally:
+        PROFILER.disable()
+    return 0
+
+
+def _wire_perf_obs(args) -> None:
+    """--profile_every / --slo wiring shared by train and serve
+    (docs/observability.md "Profiling & SLOs"): the continuous step
+    profiler with its off-thread device-memory sampler, plus the SLO
+    watchdog's declarative objectives. --slo alone implies profiling
+    (the watchdog's step-time metrics come from the profiler)."""
+    every = getattr(args, "profile_every", 0) or 0
+    slo = getattr(args, "slo", None)
+    if not every and not slo:
+        return
+    from paddle_tpu.obs.profile import PROFILER
+    from paddle_tpu.obs.slo import WATCHDOG, parse_objective
+    if slo:
+        WATCHDOG.configure(
+            objectives=[parse_objective(s) for s in slo])
+    PROFILER.enable(sample_every=every or 8, memory_interval=0.5)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="paddle_tpu",
@@ -712,6 +794,26 @@ def main(argv=None) -> int:
     tr.add_argument("--profile_dir", default=None,
                     help="--job=profile trace output dir "
                          "(default ./profile_out)")
+    tr.add_argument("--profile_every", type=int, default=0,
+                    help="continuous step profiler: sample the "
+                         "per-phase breakdown every N steps and export "
+                         "live MFU/roofline + device-memory gauges "
+                         "(obs/profile.py; 0 disables — "
+                         "docs/observability.md 'Profiling & SLOs')")
+    tr.add_argument("--slo", action="append", default=None,
+                    metavar="METRIC<=TARGET[@WINDOW]",
+                    help="declarative SLO objective for the watchdog, "
+                         "repeatable (e.g. step_time_p99_ms<=250@64, "
+                         "tokens_per_s>=1000); breaches journal under "
+                         "the slo domain and auto-dump flight bundles. "
+                         "Implies --profile_every 8 when that flag is "
+                         "absent")
+    tr.add_argument("--event_log_max_bytes", type=int, default=0,
+                    help="rotate the --event_log file when it reaches "
+                         "N bytes (journal.jsonl.1 ... .K; 0: never). "
+                         "`events tail --follow` spans rotations")
+    tr.add_argument("--event_log_keep", type=int, default=3,
+                    help="rotated journal segments to keep (default 3)")
     tr.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
     tr.add_argument("--seed", type=int, default=0)
@@ -773,6 +875,41 @@ def main(argv=None) -> int:
                          "step failures, SIGTERM and fatal "
                          "exceptions; GET /flight serves one on "
                          "demand")
+    sv.add_argument("--profile_every", type=int, default=0,
+                    help="continuous decode-step profiler: per-phase "
+                         "breakdown + device-memory/KV-pool gauges, "
+                         "served on GET /profile (0 disables)")
+    sv.add_argument("--slo", action="append", default=None,
+                    metavar="METRIC<=TARGET[@WINDOW]",
+                    help="declarative SLO objective, repeatable (e.g. "
+                         "decode_step_time_p99_ms<=50, "
+                         "shed_rate<=0.05, tokens_per_s>=500); "
+                         "breaches journal under the slo domain and "
+                         "auto-dump flight bundles. Implies "
+                         "--profile_every 8 when that flag is absent")
+    sv.add_argument("--event_log_max_bytes", type=int, default=0,
+                    help="rotate the --event_log file at N bytes "
+                         "(0: never)")
+    sv.add_argument("--event_log_keep", type=int, default=3,
+                    help="rotated journal segments to keep (default 3)")
+
+    pf = sub.add_parser("profile", help="on-demand deep profile window: "
+                        "N traced steps + per-phase/MFU summary "
+                        "(docs/observability.md 'Profiling & SLOs')")
+    pf.add_argument("--config", required=True,
+                    help=".py config script or serialized topology .json")
+    pf.add_argument("--steps", type=int, default=10,
+                    help="steps inside the jax.profiler trace window")
+    pf.add_argument("--batch_size", type=int, default=128)
+    pf.add_argument("--seq_len", type=int, default=16)
+    pf.add_argument("--init_model_path", default=None,
+                    help="params.tar to start from")
+    pf.add_argument("--out", default=None,
+                    help="trace artifact dir (default ./profile_out)")
+    pf.add_argument("--use_tpu", action="store_true", default=None)
+    pf.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    pf.add_argument("--seed", type=int, default=0)
 
     sub.add_parser("version", help="print version (paddle version parity)")
 
@@ -786,7 +923,7 @@ def main(argv=None) -> int:
                      help="how many records (newest last)")
     evp.add_argument("--domain", default=None,
                      help="filter: trainer|data|serving|engine|"
-                          "checkpoint")
+                          "checkpoint|slo|profile")
     evp.add_argument("--kind", default=None,
                      help="filter: oom, quarantine, shed, preemption, "
                           "...")
@@ -885,6 +1022,8 @@ def main(argv=None) -> int:
         return _cmd_trace(args)
     if args.command == "coordinator":
         return _cmd_coordinator(args)
+    if args.command == "profile":
+        return _cmd_profile(args)
     if args.command == "serve":
         from paddle_tpu.obs import context as obs_context
         from paddle_tpu.obs.events import JOURNAL
@@ -892,10 +1031,13 @@ def main(argv=None) -> int:
         if args.run_id:
             obs_context.set_run_id(args.run_id)
         if args.event_log:
-            JOURNAL.configure(args.event_log)
+            JOURNAL.configure(args.event_log,
+                              max_bytes=args.event_log_max_bytes or None,
+                              keep=args.event_log_keep)
         if args.flight_dir:
             FLIGHT.configure(dump_dir=args.flight_dir)
         install_excepthook()
+        _wire_perf_obs(args)
         return _cmd_serve(args)
     if args.command == "version":
         import paddle_tpu
@@ -921,10 +1063,13 @@ def main(argv=None) -> int:
     if args.run_id:
         obs_context.set_run_id(args.run_id)
     if args.event_log:
-        JOURNAL.configure(args.event_log)
+        JOURNAL.configure(args.event_log,
+                          max_bytes=args.event_log_max_bytes or None,
+                          keep=args.event_log_keep)
     if args.flight_dir:
         FLIGHT.configure(dump_dir=args.flight_dir)
     install_excepthook()
+    _wire_perf_obs(args)
     obs_httpd = None
     if args.metrics_port is not None:
         from paddle_tpu.obs.httpd import start_obs_server
@@ -949,6 +1094,9 @@ def main(argv=None) -> int:
         return _job_train(trainer, ns, args)
     finally:
         JOURNAL.emit("trainer", "run_end", job=args.job)
+        if args.profile_every or args.slo:
+            from paddle_tpu.obs.profile import PROFILER
+            PROFILER.disable()      # joins the pt-obs-profiler thread
         if obs_httpd is not None:
             obs_httpd.shutdown()
 

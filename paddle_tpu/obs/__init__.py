@@ -23,7 +23,13 @@ One place the whole framework reports through (docs/observability.md):
   journals + chrome traces into one timeline
   (``paddle_tpu trace merge`` / tools/trace_merge.py).
 - :mod:`paddle_tpu.obs.httpd`   — standalone /metrics + /events +
-  /flight endpoint for trainer/coordinator processes.
+  /flight + /profile endpoint for trainer/coordinator processes.
+- :mod:`paddle_tpu.obs.profile` — continuous step profiler (per-phase
+  breakdown, live MFU/roofline gauges, device-memory telemetry on the
+  ``pt-obs-profiler`` thread, deep ``jax.profiler.trace`` windows).
+- :mod:`paddle_tpu.obs.slo`     — SLO watchdog: declarative objectives
+  over rolling windows + step-regression detection with per-phase
+  attribution, journaled under the ``slo`` domain.
 
 The perf regression gate rides on the same layer: ``bench.py``'s smoke
 tier measures through ``compile_watch`` / ``host_sync_watch``
@@ -36,21 +42,26 @@ from paddle_tpu.obs import context  # noqa: F401
 from paddle_tpu.obs.context import (bind, current_fields,  # noqa: F401
                                     new_trace_id)
 from paddle_tpu.obs.events import (JOURNAL, EventJournal, emit,  # noqa: F401
-                                   emit_event, read_journal, tail,
-                                   validate)
+                                   emit_event, journal_segments,
+                                   read_journal, tail, validate)
 from paddle_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
 from paddle_tpu.obs.httpd import (build_obs_http_server,  # noqa: F401
                                   start_obs_server)
 from paddle_tpu.obs.metrics import (REGISTRY, MetricsRegistry,  # noqa: F401
                                     stats_families)
+from paddle_tpu.obs.profile import PROFILER, StepProfiler  # noqa: F401
+from paddle_tpu.obs.slo import (WATCHDOG, Objective,  # noqa: F401
+                                SLOWatchdog, parse_objective)
 from paddle_tpu.obs.trace import TRACER, Tracer, span  # noqa: F401
 
 __all__ = [
     "REGISTRY", "MetricsRegistry", "stats_families",
     "JOURNAL", "EventJournal", "emit", "emit_event", "tail",
-    "read_journal", "validate",
+    "read_journal", "journal_segments", "validate",
     "TRACER", "Tracer", "span",
     "FLIGHT", "FlightRecorder",
+    "PROFILER", "StepProfiler",
+    "WATCHDOG", "SLOWatchdog", "Objective", "parse_objective",
     "context", "bind", "current_fields", "new_trace_id",
     "build_obs_http_server", "start_obs_server",
     "reset_all",
@@ -72,6 +83,8 @@ def reset_all() -> None:
     JOURNAL.reset()
     TRACER.reset()
     FLIGHT.reset()
+    PROFILER.reset()
+    WATCHDOG.reset()
     context.reset()
     global_counters.reset()
     global_stat.reset()

@@ -23,11 +23,13 @@ Schema v1 — every record carries:
     ts      float   unix seconds
     seq     int     per-process monotonic sequence number
     pid     int     emitting process
-    domain  str     trainer | data | serving | engine | checkpoint
+    domain  str     trainer | data | serving | engine | checkpoint |
+                    slo | profile
     kind    str     e.g. nonfinite, rollback, oom, quarantine,
                     data_budget, source_stall, worker_restart,
                     restart_budget, shed, breaker, preemption,
-                    step_failure, save, restore, run_start, run_end
+                    step_failure, save, restore, run_start, run_end,
+                    step_regression, breach, window
 
 plus, since observability v2 (docs/observability.md "Trace context &
 postmortems"), the correlation IDs the merge tooling keys on —
@@ -53,7 +55,8 @@ from paddle_tpu.obs import context as obs_context
 from paddle_tpu.utils.logging import get_logger
 
 __all__ = ["SCHEMA_VERSION", "REQUIRED_FIELDS", "EventJournal", "JOURNAL",
-           "emit", "emit_event", "tail", "validate", "read_journal"]
+           "emit", "emit_event", "tail", "validate", "read_journal",
+           "journal_segments"]
 
 SCHEMA_VERSION = 1
 REQUIRED_FIELDS = ("v", "ts", "seq", "pid", "domain", "kind")
@@ -96,15 +99,32 @@ def validate(rec: dict) -> dict:
     return rec
 
 
-class EventJournal:
-    """Thread-safe ring + optional JSONL file sink (see module doc)."""
+#: configure() sentinel — "leave this rotation knob as it was"
+_UNSET = object()
 
-    def __init__(self, ring_size: int = 2048):
+
+class EventJournal:
+    """Thread-safe ring + optional JSONL file sink (see module doc).
+
+    With ``max_bytes`` set the file sink rotates size-based with
+    bounded retention: when the active file exceeds ``max_bytes`` it is
+    renamed to ``<path>.1`` (existing segments shift to ``.2``…,
+    anything past ``keep`` is deleted) and a fresh active file opens —
+    a long serving run's journal is bounded at roughly
+    ``(keep + 1) * max_bytes``. ``read_journal`` and the CLI
+    ``events tail --follow`` transparently span the rotated segments."""
+
+    def __init__(self, ring_size: int = 2048,
+                 max_bytes: Optional[int] = None, keep: int = 3):
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=int(ring_size))
         self._seq = 0
         self._fh = None
         self._path: Optional[str] = None
+        self._max_bytes = int(max_bytes) if max_bytes else None
+        self._keep = max(0, int(keep))
+        self._sink_bytes = 0
+        self._rotations = 0
         self._write_errors = 0
         self._observers: List[Callable[[dict], None]] = []
         self._observer_errors = 0
@@ -114,9 +134,17 @@ class EventJournal:
         with self._lock:
             return self._path
 
-    def configure(self, path: Optional[str]) -> None:
+    @property
+    def rotations(self) -> int:
+        with self._lock:
+            return self._rotations
+
+    def configure(self, path: Optional[str],
+                  max_bytes=_UNSET, keep=_UNSET) -> None:
         """Attach (or with ``None`` detach) the JSONL file sink. The
-        file opens append-mode so a resumed run extends its journal."""
+        file opens append-mode so a resumed run extends its journal.
+        ``max_bytes``/``keep`` set the rotation policy when passed and
+        are left untouched otherwise."""
         with self._lock:
             if self._fh is not None:
                 try:
@@ -124,11 +152,52 @@ class EventJournal:
                 except OSError:
                     pass
                 self._fh = None
+            if max_bytes is not _UNSET:
+                self._max_bytes = int(max_bytes) if max_bytes else None
+            if keep is not _UNSET:
+                self._keep = max(0, int(keep))
             self._path = path
+            self._sink_bytes = 0
             if path:
                 d = os.path.dirname(os.path.abspath(path))
                 os.makedirs(d, exist_ok=True)
                 self._fh = open(path, "a", encoding="utf-8")
+                try:
+                    self._sink_bytes = os.path.getsize(path)
+                except OSError:
+                    self._sink_bytes = 0
+
+    def _rotate_locked(self) -> None:
+        """Shift ``path -> path.1 -> … -> path.keep`` (dropping the
+        oldest) and reopen a fresh active file. Called with the lock
+        held, right after the write that crossed ``max_bytes``; any
+        filesystem failure is absorbed into write_errors (journal
+        emission never raises into a hot path)."""
+        path = self._path
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        self._fh = None
+        try:
+            drop = f"{path}.{self._keep}" if self._keep else path
+            if os.path.exists(drop):
+                os.remove(drop)
+            for i in range(self._keep - 1, 0, -1):
+                src = f"{path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{path}.{i + 1}")
+            if self._keep:
+                os.replace(path, f"{path}.1")
+            self._rotations += 1
+        except OSError:
+            self._write_errors += 1
+        try:
+            self._fh = open(path, "a", encoding="utf-8")
+            self._sink_bytes = os.path.getsize(path)
+        except OSError:
+            self._write_errors += 1
+            self._sink_bytes = 0
 
     def emit(self, domain: str, kind: str, **fields) -> dict:
         """Build, ring-buffer, and (when configured) persist one
@@ -153,8 +222,13 @@ class EventJournal:
             observers = list(self._observers)
             if self._fh is not None:
                 try:
-                    self._fh.write(json.dumps(rec) + "\n")
+                    line = json.dumps(rec) + "\n"
+                    self._fh.write(line)
                     self._fh.flush()
+                    self._sink_bytes += len(line)
+                    if self._max_bytes is not None \
+                            and self._sink_bytes >= self._max_bytes:
+                        self._rotate_locked()
                 except (OSError, ValueError):
                     self._write_errors += 1
                     if self._write_errors == 1:
@@ -236,6 +310,9 @@ class EventJournal:
             self._seq = 0
             self._write_errors = 0
             self._observer_errors = 0
+            self._rotations = 0
+            self._max_bytes = None
+            self._keep = 3
 
 
 #: the process-global journal every subsystem emits through
@@ -286,36 +363,59 @@ def _err_str(e) -> Optional[str]:
     return None if e is None else repr(e)[:400]
 
 
+def journal_segments(path: str) -> List[str]:
+    """Every on-disk file of a (possibly rotated) journal, oldest
+    first: ``path.N … path.1`` then the active ``path``. Segments are
+    contiguous by construction (EventJournal._rotate_locked)."""
+    rotated: List[str] = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        rotated.append(f"{path}.{i}")
+        i += 1
+    return list(reversed(rotated)) + [path]
+
+
 def read_journal(path: str, strict: bool = True,
                  domain: Optional[str] = None,
                  kind: Optional[str] = None) -> Iterator[dict]:
-    """Yield schema-validated records from a JSONL journal file. A torn
-    FINAL line (the process died mid-write) is always skipped; any
-    other malformed line raises with ``strict`` and is skipped with a
-    warning otherwise. ``domain``/``kind`` filter with the SAME
-    semantics as ``EventJournal.tail`` — the parity is test-pinned
+    """Yield schema-validated records from a JSONL journal, spanning
+    rotated segments (``path.N`` oldest … ``path``) transparently. A
+    torn FINAL line (the process died mid-write; only possible in the
+    active file) is always skipped; any other malformed line raises
+    with ``strict`` and is skipped with a warning otherwise.
+    ``domain``/``kind`` filter with the SAME semantics as
+    ``EventJournal.tail`` — the parity is test-pinned
     (tests/test_obs.py) so ring and file queries agree."""
-    with open(path, encoding="utf-8") as f:
-        lines = f.read().splitlines()
-    for i, line in enumerate(lines):
-        if not line.strip():
-            continue
+    segments = journal_segments(path)
+    for seg in segments:
+        last_seg = seg == path
         try:
-            rec = validate(json.loads(line))
-        except (json.JSONDecodeError, ValueError) as e:
-            if i == len(lines) - 1:
+            with open(seg, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except FileNotFoundError:
+            if last_seg:
+                raise
+            continue  # rotated away between listing and open
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                rec = validate(json.loads(line))
+            except (json.JSONDecodeError, ValueError) as e:
+                if last_seg and i == len(lines) - 1:
+                    get_logger().warning(
+                        "journal %s: skipping torn final line", seg)
+                    return
+                if strict:
+                    raise ValueError(
+                        f"{seg}:{i + 1}: malformed journal record: {e}"
+                    ) from e
                 get_logger().warning(
-                    "journal %s: skipping torn final line", path)
-                return
-            if strict:
-                raise ValueError(
-                    f"{path}:{i + 1}: malformed journal record: {e}"
-                ) from e
-            get_logger().warning("journal %s:%d: skipping malformed "
-                                 "record: %s", path, i + 1, e)
-            continue
-        if domain is not None and rec["domain"] != domain:
-            continue
-        if kind is not None and rec["kind"] != kind:
-            continue
-        yield rec
+                    "journal %s:%d: skipping malformed record: %s",
+                    seg, i + 1, e)
+                continue
+            if domain is not None and rec["domain"] != domain:
+                continue
+            if kind is not None and rec["kind"] != kind:
+                continue
+            yield rec

@@ -75,7 +75,10 @@ class FlightRecorder:
         self.enabled = True
         self._dump_dir: Optional[str] = None
         self._min_dump_interval = float(min_dump_interval)
-        self._last_dump_t: Optional[float] = None
+        # rate limit is PER REASON: an SLO-breach dump must not be
+        # suppressed because an unrelated breaker-open dumped seconds
+        # ago — each distinct reason gets its own interval clock
+        self._last_dump_t: Dict[str, float] = {}
         self._providers: Dict[str, Callable[[], Optional[dict]]] = {}
         self._dumps = 0
         self._dump_errors = 0
@@ -209,21 +212,24 @@ class FlightRecorder:
             json.dump(b, f)
         with self._lock:
             self._dumps += 1
-            self._last_dump_t = time.monotonic()
+            self._last_dump_t[str(reason)] = time.monotonic()
         return path
 
     def maybe_autodump(self, reason: str) -> Optional[str]:
         """Rate-limited dump into the configured dump_dir; a no-op
         (returns None) when auto-dump is unarmed, the recorder is off,
-        or a bundle was written within ``min_dump_interval``. Never
-        raises — the recorder must not take down the path that
-        triggered it."""
+        or a bundle was written for this SAME ``reason`` within
+        ``min_dump_interval`` (distinct reasons never suppress each
+        other — a storm of one incident kind produces one bundle
+        without hiding a different concurrent incident). Never raises
+        — the recorder must not take down the path that triggered
+        it."""
         with self._lock:
             if not self.enabled or self._dump_dir is None:
                 return None
-            if self._last_dump_t is not None and \
-                    time.monotonic() - self._last_dump_t < \
-                    self._min_dump_interval:
+            last = self._last_dump_t.get(str(reason))
+            if last is not None and \
+                    time.monotonic() - last < self._min_dump_interval:
                 return None
         try:
             path = self.dump(reason)
@@ -283,7 +289,7 @@ class FlightRecorder:
             self._ring.clear()
             self._providers.clear()
             self._dump_dir = None
-            self._last_dump_t = None
+            self._last_dump_t.clear()
             self._dumps = 0
             self._dump_errors = 0
             self.enabled = True

@@ -16,6 +16,12 @@ can start (CLI ``train --metrics_port``) exposing
   GET /flight    the flight recorder's postmortem bundle, on demand
                  (obs/flight.py; `paddle_tpu obs dump --url` fetches
                  this)
+  GET /profile   the continuous profiler's live snapshot (per-phase
+                 breakdown, MFU/roofline, memory + page-pool
+                 telemetry — obs/profile.py) plus the SLO watchdog
+                 state; ?deep_steps=N arms a jax.profiler.trace
+                 window over the next N observed steps (the artifact
+                 dir rides in subsequent snapshots/bundles)
   GET /health    {"status": "ok"} liveness probe
 
 Scrape handlers only READ snapshots; they never touch the train step.
@@ -78,6 +84,23 @@ def build_obs_http_server(host: str = "127.0.0.1",
             elif url.path == "/flight":
                 from paddle_tpu.obs.flight import FLIGHT
                 self._json(200, FLIGHT.bundle(reason="http"))
+            elif url.path == "/profile":
+                from paddle_tpu.obs.profile import PROFILER
+                from paddle_tpu.obs.slo import WATCHDOG
+                qs = parse_qs(url.query)
+                payload = {}
+                deep = qs.get("deep_steps", [None])[0]
+                if deep is not None:
+                    try:
+                        payload["armed_trace_dir"] = \
+                            PROFILER.arm_window(int(deep))
+                    except ValueError:
+                        self._json(400, {"error": "deep_steps must "
+                                                  "be an integer"})
+                        return
+                payload["profile"] = PROFILER.snapshot()
+                payload["slo"] = WATCHDOG.snapshot()
+                self._json(200, payload)
             elif url.path == "/health":
                 self._json(200, {"status": "ok"})
             else:

@@ -48,6 +48,7 @@ import numpy as np
 from paddle_tpu.obs import context as obs_context
 from paddle_tpu.obs.events import emit as journal_emit
 from paddle_tpu.obs.flight import FLIGHT
+from paddle_tpu.obs.profile import PROFILER
 from paddle_tpu.serving.server import (Expired, Rejected, ServerClosed,
                                        ServingError)
 from paddle_tpu.utils.stats import global_counters, stat_timer
@@ -269,6 +270,34 @@ class DecodeEngine:
 
         FLIGHT.register_state_provider(f"engine-{id(self):x}",
                                        _flight_state)
+
+        # performance plane (obs/profile.py + obs/slo.py): page-pool
+        # occupancy rides the off-thread memory sampler, and stats()
+        # (with a derived tokens_per_s) feeds the watchdog's
+        # declarative objectives. Same weakref discipline as above.
+        def _pool_accounting():
+            eng = ref()
+            return None if eng is None else eng.pool.accounting()
+
+        PROFILER.register_pool(f"engine-{id(self):x}", _pool_accounting)
+
+        rate_state = {"t": None, "tokens": 0}
+
+        def _slo_stats():
+            eng = ref()
+            if eng is None:
+                return None
+            s = eng.stats()
+            now = eng._clock()
+            t0, tok0 = rate_state["t"], rate_state["tokens"]
+            tokens = s.get("tokens_out", 0)
+            rate_state["t"], rate_state["tokens"] = now, tokens
+            if t0 is not None and now > t0:
+                s["tokens_per_s"] = (tokens - tok0) / (now - t0)
+            return s
+
+        from paddle_tpu.obs.slo import WATCHDOG
+        WATCHDOG.add_source(f"engine-{id(self):x}", _slo_stats)
 
     # ------------------------------------------------------------ admission
     def _pages_for(self, n_tokens: int) -> int:
@@ -521,6 +550,8 @@ class DecodeEngine:
         with self._cv:
             self._steps += 1
             self._active_steps_sum += len(active_idx)
+        if PROFILER.enabled:
+            PROFILER.on_step("decode")
         for s in active_idx:
             slot = self.slots[s]
             fed = slot.pos

@@ -149,6 +149,26 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
             elif url.path == "/flight":
                 from paddle_tpu.obs.flight import FLIGHT
                 self._json(200, FLIGHT.bundle(reason="http"))
+            elif url.path == "/profile":
+                # live per-phase/MFU/memory snapshot + SLO state;
+                # ?deep_steps=N arms a jax.profiler.trace window over
+                # the next N decode steps (obs/profile.py)
+                from paddle_tpu.obs.profile import PROFILER
+                from paddle_tpu.obs.slo import WATCHDOG
+                qs = parse_qs(url.query)
+                payload = {}
+                deep = qs.get("deep_steps", [None])[0]
+                if deep is not None:
+                    try:
+                        payload["armed_trace_dir"] = \
+                            PROFILER.arm_window(int(deep))
+                    except ValueError:
+                        self._json(400, {"error": "deep_steps must "
+                                                  "be an integer"})
+                        return
+                payload["profile"] = PROFILER.snapshot()
+                payload["slo"] = WATCHDOG.snapshot()
+                self._json(200, payload)
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 

@@ -189,6 +189,24 @@ class InferenceServer:
         FLIGHT.register_state_provider(f"serving-{id(self):x}",
                                        _flight_state)
 
+        # SLO-watchdog source (obs/slo.py): the server's stats() plus a
+        # derived shed_rate, so declarative objectives like
+        # "shed_rate<=0.05" or "p99_ms<=250" evaluate over live numbers
+        def _slo_stats():
+            srv = ref()
+            if srv is None:
+                return None
+            s = srv.stats()
+            shed = (s.get("rejected_full", 0)
+                    + s.get("rejected_breaker", 0)
+                    + s.get("rejected_oom", 0))
+            total = shed + s.get("served", 0)
+            s["shed_rate"] = shed / total if total else 0.0
+            return s
+
+        from paddle_tpu.obs.slo import WATCHDOG
+        WATCHDOG.add_source(f"serving-{id(self):x}", _slo_stats)
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "InferenceServer":
         with self._cv:

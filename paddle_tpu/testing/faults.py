@@ -61,6 +61,18 @@ and, for the data pipeline (docs/robustness.md "Data pipeline"):
       shard (``corrupt_records`` — per-record corruption that passes the
       chunk crc but fails deserialization);
 
+and, for performance observability (docs/observability.md "Profiling &
+SLOs"):
+
+  (l) make training or decode steps SLOW on demand — ``slow_step``
+      injects a (factor-1)x-baseline stall into chosen optimizer steps
+      INSIDE the jitted-dispatch scope (the profiler's "compute"
+      phase), and ``slow_phase`` slows a chosen engine phase by a fixed
+      number of milliseconds inside that phase's timer — the
+      deterministic stragglers the SLO watchdog's step-regression
+      detector and phase attribution must catch
+      (tests/test_profile.py chaos acceptance);
+
 and, for elastic membership (docs/robustness.md "Elastic training"):
 
   (k) run a deterministic SCHEDULE of membership events against a live
@@ -484,6 +496,109 @@ class FaultPlan:
                              name="pt-fault-disconnect")
         t.start()
         return t
+
+    # ------------------------------------- (l) performance stragglers
+    @staticmethod
+    @contextlib.contextmanager
+    def slow_step(trainer, step: int, factor: float = 5.0, n: int = 4):
+        """Within the context, optimizer steps [step, step+n) run
+        ~``factor``x slower: a sleep of (factor-1)x the measured
+        per-step baseline is injected through the trainer's
+        ``_step_interceptor`` seam, INSIDE the jitted-dispatch scope —
+        so the continuous profiler books the stall under its "compute"
+        phase and the SLO watchdog's regression detector must both fire
+        AND attribute it there (the deterministic twin of a straggling
+        device / thermal throttling). The baseline is the median
+        inter-dispatch gap over the healthy steps before ``step``
+        (fallback 20 ms when the stall lands first). The seam fires on
+        the microbatcher path — train with ``microbatch=`` set (e.g.
+        "auto"). Yields a stats dict (``injected``, ``baseline_ms``,
+        ``slept_ms``)."""
+        stats = {"injected": 0, "baseline_ms": None, "slept_ms": 0.0}
+        dts: list = []
+        t_last = [None]
+        prev = trainer._step_interceptor
+
+        def intercept(k, mb):
+            if prev is not None:
+                prev(k, mb)
+            now = time.perf_counter()
+            sc = trainer._step_count
+            if step <= sc < step + n:
+                base = sorted(dts)[len(dts) // 2] if dts else 0.020
+                stats["baseline_ms"] = round(base * 1e3, 3)
+                pause = max(factor - 1.0, 0.0) * base
+                stats["injected"] += 1
+                stats["slept_ms"] += pause * 1e3
+                time.sleep(pause)
+                t_last[0] = None     # stalled gaps are not baseline
+                return
+            if t_last[0] is not None:
+                dts.append(now - t_last[0])
+            t_last[0] = now
+
+        trainer._step_interceptor = intercept
+        try:
+            yield stats
+        finally:
+            trainer._step_interceptor = prev
+
+    @staticmethod
+    @contextlib.contextmanager
+    def slow_phase(engine, phase: str = "decode_step", ms: float = 50.0,
+                   at: int = 0, n: Optional[int] = None):
+        """Within the context, the engine's ``phase`` runs ``ms``
+        milliseconds slow from its ``at``-th step after entry (0-based,
+        the decode_script convention) for ``n`` steps (None: until
+        exit). ``decode_step`` — the jitted dispatch — is slowed INSIDE
+        the ``serving/decode_step`` timer by a sleeping proxy over
+        ``engine.paged``, so the profiler's per-phase breakdown books
+        the stall there and the watchdog's attribution must name it;
+        any other name sleeps under a ``serving/<phase>`` timer via the
+        ``_step_interceptor`` seam. Yields a stats dict
+        (``injected``)."""
+        stats = {"injected": 0}
+        base = engine._steps
+        lo = base + int(at)
+        hi = lo + (int(n) if n is not None else (1 << 62))
+        pause = ms / 1e3
+
+        if phase == "decode_step":
+            real = engine.paged
+
+            class _SlowPaged:
+                def __getattr__(self, name):
+                    return getattr(real, name)
+
+                def step(self, *a, **kw):
+                    if lo <= engine._steps < hi:
+                        stats["injected"] += 1
+                        time.sleep(pause)
+                    return real.step(*a, **kw)
+
+            engine.paged = _SlowPaged()
+            try:
+                yield stats
+            finally:
+                engine.paged = real
+            return
+
+        from paddle_tpu.utils.stats import stat_timer
+        prev = engine._step_interceptor
+
+        def intercept(step_idx):
+            if prev is not None:
+                prev(step_idx)
+            if lo <= step_idx < hi:
+                stats["injected"] += 1
+                with stat_timer(f"serving/{phase}"):
+                    time.sleep(pause)
+
+        engine._step_interceptor = intercept
+        try:
+            yield stats
+        finally:
+            engine._step_interceptor = prev
 
     # ----------------------------------------- (k) elastic membership
     @staticmethod

@@ -44,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.trainer import event as evt
-from paddle_tpu.utils.stats import global_counters
+from paddle_tpu.utils.stats import global_counters, stat_timer
 
 __all__ = ["MemoryPlan", "AdaptiveMicrobatcher", "plan_memory",
            "is_resource_exhausted", "resource_exhausted_error"]
@@ -218,8 +218,13 @@ class AdaptiveMicrobatcher:
         while True:
             b = _leading_rows(feed)
             k = self.plan.steps_for(b)
-            run_feed, mb = (feed, b) if k == 1 else _pad_to_multiple(
-                feed, k)
+            if k == 1:
+                run_feed, mb = feed, b
+            else:
+                # host-side repack before dispatch — part of the
+                # profiler's h2d phase (obs/profile.py breakdown)
+                with stat_timer("train/h2d"):
+                    run_feed, mb = _pad_to_multiple(feed, k)
             self.plan.accum_steps = k
             fn = trainer._get_memory_step(k, guarded)
             args = (trainer._own_params(), trainer.opt_state,
@@ -241,6 +246,11 @@ class AdaptiveMicrobatcher:
         minimal microbatch — there is nothing left to shrink)."""
         self.oom_events += 1
         global_counters.bump("trainer/oom_events")
+        from paddle_tpu.obs.profile import PROFILER
+        if PROFILER.enabled:
+            # the allocator just failed: the most informative moment to
+            # refresh the live-bytes / HBM-watermark gauges
+            PROFILER.sample_memory()
         _check_buffers_alive(self.trainer)
         if mb <= self.min_microbatch:
             raise exc

@@ -28,6 +28,7 @@ from paddle_tpu.core.registry import LayerOutput
 from paddle_tpu.core.topology import Topology
 from paddle_tpu.obs import context as obs_context
 from paddle_tpu.obs import events as obs_events
+from paddle_tpu.obs.profile import PROFILER
 from paddle_tpu.trainer import event as evt
 from paddle_tpu.trainer.parameters import Parameters
 from paddle_tpu.utils.stats import global_counters, stat_timer
@@ -173,6 +174,11 @@ class SGD:
         # immediately before each jitted step the memory executor or
         # probe dispatches; may raise RESOURCE_EXHAUSTED
         self._step_interceptor = None
+        # continuous-profiler seam (obs/profile.py): the latest step's
+        # concrete args, stored only while the profiler is enabled so
+        # its lazy cost source can AOT-compile the live executable
+        self._profile_feed = None
+        self._profile_cost_armed = False
         self._test_step = self._build_test_step()
 
     # ------------------------------------------------------------------
@@ -1053,8 +1059,37 @@ class SGD:
         self.parameters.state = new_state
         self._step_count += 1
         global_counters.bump("trainer/steps")
+        if PROFILER.enabled:
+            self._profile_feed = (feed, sub, n_real)
+            self._arm_profile_cost()
+            PROFILER.on_step("train")
         loss_np, metrics_np, _ = self._fetch_host(loss, metrics)
         return loss_np, metrics_np
+
+    def _arm_profile_cost(self) -> None:
+        """(Re-)register the continuous profiler's lazy FLOPs+bytes
+        source: a weakref closure that AOT-compiles the plain train
+        step with the trainer's CURRENT args (obs/profile.py invokes
+        it at most once per enable, off a sampled step — never per
+        step). Microbatched runs approximate with the un-accumulated
+        executable."""
+        if self._profile_cost_armed:
+            return
+        self._profile_cost_armed = True
+        import weakref
+        ref = weakref.ref(self)
+
+        def _cost():
+            tr = ref()
+            if tr is None or tr._profile_feed is None:
+                return None, None
+            from paddle_tpu.obs.profile import cost_of
+            feed, sub, n_real = tr._profile_feed
+            return cost_of(tr._train_step, tr._own_params(),
+                           tr.opt_state, tr.parameters.state,
+                           feed, sub, n_real)
+
+        PROFILER.set_cost_source("train", _cost)
 
     @staticmethod
     def _fetch_host(loss, metrics, eval_outs=None):
@@ -1062,9 +1097,12 @@ class SGD:
         outputs. Keep every per-step read inside this call: a separate
         float(x)/int(x) on a device array costs a full round-trip, which
         a remote/tunneled device turns into the step-time floor
-        (docs/perf.md 'One host sync per step': 434.9 -> 120.6 ms)."""
-        loss_np, metrics_host, eval_host = jax.device_get(
-            (loss, metrics, {} if eval_outs is None else eval_outs))
+        (docs/perf.md 'One host sync per step': 434.9 -> 120.6 ms).
+        The scope is the continuous profiler's 'settle' phase — time
+        spent waiting for the device to drain into host floats."""
+        with stat_timer("train/settle"):
+            loss_np, metrics_host, eval_host = jax.device_get(
+                (loss, metrics, {} if eval_outs is None else eval_outs))
         return (float(loss_np),
                 {k: float(v) for k, v in metrics_host.items()},
                 eval_host)
@@ -1101,7 +1139,12 @@ class SGD:
         def work():
             try:
                 for item in reader():
-                    if not put((None, feeder(item))):
+                    # feed conversion/packing is the host half of the
+                    # h2d phase (the device copy itself rides the next
+                    # dispatch) — timed for the profiler's breakdown
+                    with stat_timer("train/h2d"):
+                        converted = feeder(item)
+                    if not put((None, converted)):
                         return
                 put((None, DONE))
             except BaseException as e:      # surfaced in the main thread
@@ -1249,6 +1292,10 @@ class SGD:
             self.parameters.state = new_state
             self._step_count += 1
             global_counters.bump("trainer/steps")
+            if PROFILER.enabled:
+                self._profile_feed = (feed, sub, n_real)
+                self._arm_profile_cost()
+                PROFILER.on_step("train")
             self._batch_in_pass = batch_id + 1
             n_batches += 1
             if lazy:

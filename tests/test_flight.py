@@ -118,13 +118,26 @@ class TestBundleAndDump:
         JOURNAL.emit("serving", "breaker", state="open")
         files = os.listdir(tmp_path)
         assert len(files) == 1
-        # a storm of triggers inside the interval produces ONE bundle
+        # the rate limit is PER REASON: a repeat of the same trigger
+        # inside the interval is suppressed...
+        JOURNAL.emit("serving", "breaker", state="open")
+        assert len(os.listdir(tmp_path)) == 1
+        # ...but DIFFERENT trigger kinds each get their own first
+        # bundle — a recent breaker dump must not swallow the first
+        # OOM's postmortem (per-reason _last_dump_t, obs/flight.py)
         JOURNAL.emit("engine", "step_failure", error="boom")
         JOURNAL.emit("trainer", "oom")
-        assert len(os.listdir(tmp_path)) == 1
-        with open(tmp_path / files[0]) as f:
-            b = json.load(f)
-        assert b["reason"] == "serving_breaker"
+        names = sorted(os.listdir(tmp_path))
+        assert len(names) == 3
+        reasons = set()
+        for name in names:
+            with open(tmp_path / name) as f:
+                reasons.add(json.load(f)["reason"])
+        assert reasons == {"serving_breaker", "engine_step_failure",
+                           "trainer_oom"}
+        # and a repeat of any of them is still suppressed
+        JOURNAL.emit("trainer", "oom")
+        assert len(os.listdir(tmp_path)) == 3
 
     def test_unarmed_recorder_never_autodumps(self):
         assert FLIGHT.maybe_autodump("anything") is None

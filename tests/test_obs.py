@@ -313,6 +313,161 @@ class TestEventJournal:
             assert ring == file, q
 
 
+class TestJournalRotation:
+    """ISSUE-11 satellite: size-based JSONL rotation — a long-running
+    job's journal must not grow without bound, and every reader
+    (read_journal, events tail --follow) must span the segment
+    boundary losslessly."""
+
+    def test_rotation_keeps_segments_and_read_spans_them(self, tmp_path):
+        from paddle_tpu.obs.events import journal_segments
+        path = str(tmp_path / "events.jsonl")
+        j = EventJournal()
+        # every record is ~190 bytes -> a 1 KiB cap rotates every ~5
+        j.configure(path, max_bytes=1024, keep=3)
+        for i in range(40):
+            j.emit("test", "tick", i=i)
+        j.configure(None)
+        assert j.rotations > 0
+        segs = journal_segments(path)
+        assert segs[-1] == path and 2 <= len(segs) <= 4
+        # oldest-first: path.N ... path.2, path.1, path
+        import os
+        assert [os.path.basename(s) for s in segs] == sorted(
+            [os.path.basename(s) for s in segs],
+            key=lambda n: -int(n.rsplit(".", 1)[-1])
+            if n.rsplit(".", 1)[-1].isdigit() else 0)
+        recs = list(read_journal(path))
+        # the newest records are all present, in order, no duplicates
+        idx = [r["i"] for r in recs]
+        assert idx == sorted(idx) and len(set(idx)) == len(idx)
+        assert idx[-1] == 39
+        # keep=3 bounds what survives: pruning dropped the oldest
+        assert 8 <= len(recs) < 40
+
+    def test_keep_prunes_oldest(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        j = EventJournal()
+        j.configure(path, max_bytes=256, keep=1)
+        for i in range(50):
+            j.emit("test", "tick", i=i)
+        j.configure(None)
+        import os
+        assert os.path.exists(path + ".1")
+        assert not os.path.exists(path + ".2")
+
+    def test_follow_spans_rotation(self, tmp_path):
+        """The tail -f loop must drain the rotated-away remainder of
+        what is now ``path.1`` before restarting at the fresh active
+        file — no record lost, none duplicated. Deterministic: the
+        rotation happens between two polls of a single-threaded
+        generator drive."""
+        import os
+
+        from paddle_tpu.cli import _iter_journal_follow
+        path = str(tmp_path / "events.jsonl")
+        j = EventJournal()
+        j.configure(path, max_bytes=4096, keep=2)
+        for _ in range(20):                     # preamble: pushes the
+            j.emit("test", "tick", i=-1)        # follow-from cursor up
+        start = os.path.getsize(path)
+        # fill until ONE rotation lands: everything after `start` is
+        # unread when the active file is swapped out to path.1
+        n = 0
+        while j.rotations == 0:
+            j.emit("test", "tick", i=n)
+            n += 1
+            assert n < 200, "rotation never triggered"
+        j.emit("test", "tick", i=n)             # one post-rotation
+        n += 1
+        j.configure(None)
+        assert os.path.getsize(path) < start    # the detection window
+        got = [rec["i"] for rec in _iter_journal_follow(
+            path, poll=0.01, idle_timeout=0.3, from_pos=start)]
+        assert got == list(range(n))
+
+
+# ------------------------------------- profiler gauges + slo journal parity
+
+PROFILE_GAUGES = (
+    "paddle_tpu_profile_step_ms",
+    "paddle_tpu_profile_phase_ms",
+    "paddle_tpu_profile_mfu",
+    "paddle_tpu_profile_roofline_frac",
+    "paddle_tpu_profile_device_bytes_in_use",
+    "paddle_tpu_profile_hbm_watermark_bytes",
+    "paddle_tpu_profile_page_pool_occupancy",
+    "paddle_tpu_profile_page_pool_occupancy_trend",
+)
+
+
+class TestProfileObservability:
+    def test_profile_gauge_families_always_exported(self):
+        """All eight profiler families register at import time, so one
+        scrape carries their HELP/TYPE before the first sampled step."""
+        text = REGISTRY.exposition()
+        for fam in PROFILE_GAUGES:
+            assert f"# HELP {fam} " in text, fam
+            assert f"# TYPE {fam} gauge" in text, fam
+
+    def test_sampled_steps_populate_live_gauges(self):
+        """Driving the profiler through stat_timer scopes lands the
+        step/phase/MFU/roofline gauges in the exposition with the same
+        labels the docs pin."""
+        import time
+
+        from paddle_tpu.obs.profile import PROFILER
+        from paddle_tpu.utils.stats import stat_timer
+        PROFILER.configure(peak_flops=1e12, hbm_gbps=100.0,
+                           assume_mxu=False)
+        PROFILER.set_cost_source("train", lambda: (2.0e6, 1.0e6))
+        PROFILER.enable(sample_every=2)
+        try:
+            for _ in range(6):
+                with stat_timer("train_step"):
+                    time.sleep(0.002)
+                PROFILER.on_step("train")
+        finally:
+            PROFILER.disable()
+        snap = PROFILER.snapshot()
+        assert snap["kinds"]["train"]["phases"]["compute"] > 0
+        assert snap["cost"]["train"] == {"flops": 2.0e6, "bytes": 1.0e6}
+        text = REGISTRY.exposition()
+        assert 'paddle_tpu_profile_step_ms{kind="train"} ' in text
+        assert ('paddle_tpu_profile_phase_ms{kind="train",'
+                'phase="compute"} ') in text
+        assert 'paddle_tpu_profile_mfu{kind="train"} ' in text
+        assert 'paddle_tpu_profile_roofline_frac{kind="train"} ' in text
+        # snapshot reads the same numbers back from the gauges
+        assert snap["mfu"]["train"] > 0
+        assert snap["roofline_frac"]["train"] > 0
+
+    def test_slo_domain_ring_file_filter_parity(self, tmp_path):
+        """slo-domain breach records obey the same ring/file filter
+        contract as every other domain — tail(domain=\"slo\") and
+        read_journal(domain=\"slo\") agree record-for-record."""
+        path = str(tmp_path / "slo.jsonl")
+        j = EventJournal(ring_size=1000)
+        j.configure(path)
+        for i in range(12):
+            if i % 3 == 0:
+                j.emit("slo", "step_regression", step_kind="train",
+                       phase="compute", step_ms=42.0, i=i)
+            elif i % 3 == 1:
+                j.emit("slo", "breach", objective="p99_ms<=5", i=i)
+            else:
+                j.emit("trainer", "step", i=i)
+        j.configure(None)
+        for q in ({"domain": "slo"},
+                  {"domain": "slo", "kind": "step_regression"},
+                  {"kind": "breach"}):
+            ring = j.tail(1000, **q)
+            file = list(read_journal(path, **q))
+            assert ring == file and ring, q
+        regs = j.tail(1000, domain="slo", kind="step_regression")
+        assert all(r["phase"] == "compute" for r in regs)
+
+
 # ------------------------------------------------------------ step tracing
 
 class TestTracing:
