@@ -573,7 +573,7 @@ def bench_moe_lm(batch: int = 8, seq_len: int = 1024, d_model: int = 512,
 #: BENCH_SMOKE_BASELINE.json in tier-1 (docs/observability.md)
 SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine",
               "flight_recorder_overhead", "profiler_overhead",
-              "coord_reshard")
+              "lockdep_overhead", "coord_reshard")
 
 
 def _smoke_trainer(batch: int = 16):
@@ -788,6 +788,41 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
             "steps_per_s_off": round(off, 2),
             "steps_per_s_on": round(on, 2),
             "overhead_ratio": round(off / on, 3),
+        }
+    if "lockdep_overhead" in rows:
+        # the lockdep witness's cost (analysis/lockdep.py): an
+        # uncontended with-lock loop over a raw threading.Lock vs an
+        # InstrumentedLock. Every hot shared lock in the framework is
+        # instrumented, so this ratio bounds what the deadlock witness
+        # adds to every critical section; the RATIO is
+        # machine-independent and gated like the profiler
+        # (BENCH_SMOKE_BASELINE.json). Medians of alternating reps for
+        # the same jitter reasons as profiler_overhead.
+        import threading as _threading
+        from paddle_tpu.analysis.lockdep import InstrumentedLock
+        n_ops = 20000
+
+        def _ops_per_s(lk, n=n_ops):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with lk:
+                    pass
+            return n / (time.perf_counter() - t0)
+
+        raw_lk = _threading.Lock()
+        inst_lk = InstrumentedLock("bench.lockdep")
+        _ops_per_s(raw_lk, 2000)                # warm both paths
+        _ops_per_s(inst_lk, 2000)
+        raws, insts = [], []
+        for _ in range(5):
+            raws.append(_ops_per_s(raw_lk))
+            insts.append(_ops_per_s(inst_lk))
+        raw = sorted(raws)[len(raws) // 2]
+        inst = sorted(insts)[len(insts) // 2]
+        out["lockdep_overhead"] = {
+            "ops_per_s_raw": round(raw, 0),
+            "ops_per_s_instrumented": round(inst, 0),
+            "overhead_ratio": round(raw / inst, 3),
         }
     if "coord_reshard" in rows:
         # elastic-membership control-plane latency: time from a

@@ -18,11 +18,18 @@ future PR is gated on both.
 
 from paddle_tpu.analysis.core import (Finding, Rule, all_rules,  # noqa: F401
                                       iter_suppressions, register_rule)
+from paddle_tpu.analysis.lockdep import (LOCKDEP,  # noqa: F401
+                                         InstrumentedLock,
+                                         LockOrderInversion, find_lock,
+                                         named_condition, named_lock,
+                                         named_rlock)
 from paddle_tpu.analysis.runner import (LintConfig, lint_paths,  # noqa: F401
                                         load_config, main)
 from paddle_tpu.analysis.sanitizer import (CompileBudgetExceeded,  # noqa: F401
                                            CompileWatch, compile_watch,
                                            find_tracers, no_leaked_tracers)
 
-# importing rules registers R1..R6 with the registry
+# importing the rule modules registers R1..R7 + the lock-discipline
+# rules R8..R10 with the registry
 import paddle_tpu.analysis.rules  # noqa: F401,E402  isort:skip
+import paddle_tpu.analysis.lockrules  # noqa: F401,E402  isort:skip

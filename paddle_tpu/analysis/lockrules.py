@@ -1,0 +1,684 @@
+"""ptlint lock-discipline rules (R8–R10) — the static half of
+ptlockdep (docs/static_analysis.md "Lock discipline").
+
+All three rules share one per-file *lock index*: every assignment of a
+``named_lock("x")`` / ``named_rlock`` / ``named_condition`` /
+``InstrumentedLock`` (named nodes, identified across files by their
+string name) or a plain ``threading.Lock/RLock/Condition`` (pseudo
+nodes, file-qualified) is mapped from the attribute/variable it lands
+in, so ``with self._lock:`` / ``lock.acquire()`` nests resolve to
+graph nodes.
+
+- **R8 lock-order**: acquisition edges (held -> newly acquired) are
+  accumulated ACROSS files during ``check()`` and the global digraph
+  is cycle-checked in ``finalize()`` (the runner calls it after the
+  file walk) — a cycle means two code paths take the same locks in
+  opposite orders, the static twin of the runtime witness in
+  analysis/lockdep.py.
+- **R9 blocking-under-lock**: a blocking call — RPC/xmlrpc,
+  ``queue.get/put`` without timeout, ``time.sleep``, ``Thread.join``,
+  flight ``dump``/``maybe_autodump``, jitted dispatch — made while a
+  lock is held. Exactly the PR 9 bug class: the coordinator used to
+  dump a flight bundle while holding its state lock, and the /metrics
+  collector takes that same lock.
+- **R10 shared-state-without-lock**: attributes annotated
+  ``# ptlint: guarded-by(lockname)`` must only be mutated with that
+  named lock held (``__init__``/``__post_init__`` and the
+  ``*_locked`` method convention are exempt — their callers hold it).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from paddle_tpu.analysis.core import (FileContext, Finding, Rule,
+                                      register_rule)
+from paddle_tpu.analysis.rules import _Names, _dotted
+
+__all__ = ["LockIndex", "LockOrderRule", "BlockingUnderLockRule",
+           "GuardedByRule"]
+
+#: factory tails producing WITNESS-NAMED locks (analysis/lockdep.py)
+NAMED_LOCK_TAILS = {"named_lock", "named_rlock", "named_condition",
+                    "InstrumentedLock"}
+#: plain stdlib lock factories — pseudo-named, file-local graph nodes
+PLAIN_LOCK_CANON = {"threading.Lock", "threading.RLock",
+                    "threading.Condition"}
+
+_GUARDED_RE = re.compile(r"#\s*ptlint:\s*guarded-by\(([^)]+)\)")
+
+
+class _LockDef:
+    """One lock node: its graph name and whether that name is a
+    cross-file witness name or a file-qualified pseudo-name."""
+    __slots__ = ("name", "named")
+
+    def __init__(self, name: str, named: bool):
+        self.name = name
+        self.named = named
+
+    def __repr__(self):
+        return f"<lock {self.name!r}{'' if self.named else ' (plain)'}>"
+
+
+def _named_lock_from_value(value: ast.AST) -> Optional[str]:
+    """The string name when ``value`` contains a named-lock factory
+    call (including ``threading.Condition(lock=named_lock('x'))``)."""
+    for n in ast.walk(value):
+        if isinstance(n, ast.Call):
+            tail = None
+            if isinstance(n.func, ast.Attribute):
+                tail = n.func.attr
+            elif isinstance(n.func, ast.Name):
+                tail = n.func.id
+            if tail in NAMED_LOCK_TAILS:
+                for a in n.args:
+                    if isinstance(a, ast.Constant) and \
+                            isinstance(a.value, str):
+                        return a.value
+    return None
+
+
+class LockIndex:
+    """Per-file map from lock-holding attributes/variables to
+    :class:`_LockDef` nodes, plus ``guarded-by`` annotations."""
+
+    def __init__(self, ctx: FileContext, names: _Names):
+        self.ctx = ctx
+        self.attr: Dict[Tuple[str, str], _LockDef] = {}
+        self.attr_any: Dict[str, List[_LockDef]] = {}
+        self.var: Dict[str, _LockDef] = {}
+        # (class, attr) -> guarding lock name, from annotations
+        self.guarded: Dict[Tuple[str, str], str] = {}
+        self._collect(ctx, names)
+
+    # ------------------------------------------------------- building
+    def _add(self, cls: Optional[str], key: str, d: _LockDef,
+             is_attr: bool) -> None:
+        if is_attr:
+            self.attr.setdefault((cls or "", key), d)
+            self.attr_any.setdefault(key, []).append(d)
+        else:
+            self.var.setdefault(key, d)
+
+    def _collect(self, ctx: FileContext, names: _Names) -> None:
+        guard_lines = self._guard_lines(ctx)
+        for cls_name, node in _class_scopes(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            value = node.value
+            # guarded-by annotation riding this assignment's line
+            lockname = guard_lines.get(node.lineno)
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    if lockname:
+                        self.guarded.setdefault((cls_name or "", t.attr),
+                                                lockname)
+                    if value is not None:
+                        self._maybe_lock(cls_name, t.attr, True,
+                                         value, names, ctx)
+                elif isinstance(t, ast.Name):
+                    if value is not None:
+                        self._maybe_lock(cls_name, t.id, False,
+                                         value, names, ctx)
+
+    def _maybe_lock(self, cls: Optional[str], key: str, is_attr: bool,
+                    value: ast.AST, names: _Names,
+                    ctx: FileContext) -> None:
+        nm = _named_lock_from_value(value)
+        if nm is not None:
+            self._add(cls, key, _LockDef(nm, True), is_attr)
+            return
+        if isinstance(value, ast.Call):
+            c = names.canon(value.func)
+            if c in PLAIN_LOCK_CANON:
+                pseudo = f"{ctx.path}:{cls + '.' if cls else ''}{key}"
+                self._add(cls, key, _LockDef(pseudo, False), is_attr)
+
+    @staticmethod
+    def _guard_lines(ctx: FileContext) -> Dict[int, str]:
+        """line -> lock name for ``# ptlint: guarded-by(x)`` comments;
+        a comment alone on its line applies to the next code line."""
+        out: Dict[int, str] = {}
+        for i, line in enumerate(ctx.lines, start=1):
+            m = _GUARDED_RE.search(line)
+            if not m:
+                continue
+            row = i
+            if line.lstrip().startswith("#"):
+                row = i + 1
+                while row <= len(ctx.lines) and (
+                        not ctx.lines[row - 1].strip() or
+                        ctx.lines[row - 1].lstrip().startswith("#")):
+                    row += 1
+            out[row] = m.group(1).strip()
+        return out
+
+    # ------------------------------------------------------ resolving
+    def resolve(self, expr: ast.AST,
+                cls: Optional[str]) -> Optional[_LockDef]:
+        """The lock a ``with expr:`` / ``expr.acquire()`` refers to,
+        or None when it cannot be tied to a known lock."""
+        if isinstance(expr, ast.Call):
+            nm = _named_lock_from_value(expr)
+            if nm is not None:
+                return _LockDef(nm, True)
+            return None
+        if isinstance(expr, ast.Name):
+            return self.var.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self":
+                d = self.attr.get((cls or "", expr.attr))
+                if d is not None:
+                    return d
+            defs = self.attr_any.get(expr.attr, [])
+            if len(defs) == 1:      # unique attr name across classes
+                return defs[0]
+        return None
+
+
+def _class_scopes(tree: ast.AST):
+    """Yield (enclosing class name or None, statement) for every
+    statement in the module, entering class and function bodies."""
+
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name)
+                continue
+            if isinstance(child, ast.stmt):
+                yield cls, child
+            yield from walk(child, cls)
+
+    yield from walk(tree, None)
+
+
+def _functions(tree: ast.AST):
+    """Top-to-bottom (class name or None, function node) pairs —
+    methods carry their class, nested defs their own scope."""
+    out = []
+
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                out.append((cls, child))
+                walk(child, cls)
+            else:
+                walk(child, cls)
+
+    walk(tree, None)
+    return out
+
+
+_BODY_FIELDS = {"body", "orelse", "finalbody", "handlers"}
+
+
+def _headers(st: ast.stmt):
+    """The statement's non-body child nodes (test/iter/targets/...)."""
+    for fname, val in ast.iter_fields(st):
+        if fname in _BODY_FIELDS:
+            continue
+        if isinstance(val, ast.AST):
+            yield val
+        elif isinstance(val, list):
+            for v in val:
+                if isinstance(v, ast.AST):
+                    yield v
+
+
+def walk_held(fn: ast.AST, cls: Optional[str], index: LockIndex,
+              on_edge=None, on_call=None, on_stmt=None) -> None:
+    """Walk one function body tracking the held-lock stack through
+    ``with`` nests and statement-level ``.acquire()``/``.release()``
+    pairs. ``on_edge(held_def, acquired_def, node)`` fires per nested
+    acquisition; ``on_call(call, held, stmt)`` per call made with
+    locks held; ``on_stmt(stmt, held)`` per statement."""
+
+    def body_walk(body, held):
+        base = len(held)
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue            # separate scope / thread context
+            if on_stmt is not None:
+                on_stmt(st, held)
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                acq = []
+                for item in st.items:
+                    d = index.resolve(item.context_expr, cls)
+                    if d is not None:
+                        acq.append((d, st))
+                if on_edge is not None:
+                    for d, node in acq:
+                        for h, _ in held:
+                            on_edge(h, d, node)
+                held.extend(acq)
+                body_walk(st.body, held)
+                if acq:
+                    del held[-len(acq):]
+                continue
+            if isinstance(st, ast.Expr) and \
+                    isinstance(st.value, ast.Call) and \
+                    isinstance(st.value.func, ast.Attribute):
+                tail = st.value.func.attr
+                if tail == "acquire":
+                    d = index.resolve(st.value.func.value, cls)
+                    if d is not None:
+                        if on_edge is not None:
+                            for h, _ in held:
+                                on_edge(h, d, st)
+                        held.append((d, st))
+                        continue
+                elif tail == "release":
+                    d = index.resolve(st.value.func.value, cls)
+                    if d is not None:
+                        for i in range(len(held) - 1, -1, -1):
+                            if held[i][0].name == d.name:
+                                del held[i]
+                                break
+                        continue
+            if on_call is not None and held:
+                for hdr in _headers(st):
+                    for n in ast.walk(hdr):
+                        if isinstance(n, ast.Call):
+                            on_call(n, held, st)
+            for fname in ("body", "orelse", "finalbody"):
+                sub = getattr(st, fname, None)
+                if sub:
+                    body_walk(sub, held)
+            for h in getattr(st, "handlers", None) or []:
+                body_walk(h.body, held)
+        del held[base:]
+
+    body_walk(fn.body, [])
+
+
+# ================================================================== R8
+@register_rule
+class LockOrderRule(Rule):
+    id = "R8"
+    name = "lock-order"
+    description = ("acquisition-order cycle in the global lock graph "
+                   "(two code paths nest the same locks in opposite "
+                   "orders — a deadlock under the right interleaving)")
+
+    #: calls KNOWN to acquire a named lock inside (the repo's obs
+    #: conventions) — matched on the canonicalized name's trailing two
+    #: segments, so ``journal_emit(...)`` (an ``emit`` import alias),
+    #: ``JOURNAL.emit(...)`` and ``FLIGHT.record(...)`` all resolve.
+    #: This is what makes the graph CROSS-file: a subsystem holding
+    #: its own lock while journaling contributes the
+    #: ``subsystem -> obs.journal`` edge even though the acquisition
+    #: happens in obs/events.py.
+    ACQUIRING_CALLS = (
+        (("JOURNAL", "emit"), "obs.journal"),
+        (("JOURNAL", "emit_event"), "obs.journal"),
+        (("events", "emit"), "obs.journal"),
+        (("events", "emit_event"), "obs.journal"),
+        (("FLIGHT", "record"), "obs.flight"),
+        (("FLIGHT", "record_raw"), "obs.flight"),
+        (("FLIGHT", "dump"), "obs.flight"),
+        (("FLIGHT", "maybe_autodump"), "obs.flight"),
+        (("flight", "record"), "obs.flight"),
+        (("REGISTRY", "exposition"), "obs.metrics.registry"),
+        (("REGISTRY", "collect"), "obs.metrics.registry"),
+    )
+
+    def __init__(self, options: Optional[dict] = None):
+        super().__init__(options)
+        # (a, b) -> first site dict; insertion-ordered
+        self._edges: Dict[Tuple[str, str], dict] = {}
+        extra = self.options.get("acquiring_calls", {})
+        self._acquiring = list(self.ACQUIRING_CALLS) + [
+            (tuple(k.split(".")), v) for k, v in extra.items()]
+
+    def _call_lock(self, call: ast.Call,
+                   names: _Names) -> Optional[str]:
+        canon = names.canon(call.func)
+        if canon is None:
+            return None
+        parts = tuple(canon.split("."))
+        for key, lock in self._acquiring:
+            if parts[-len(key):] == key:
+                return lock
+        return None
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        names = _Names(ctx.tree)
+        index = LockIndex(ctx, names)
+
+        def add_edge(a: str, b: str, node: ast.AST) -> None:
+            if a == b:
+                return              # same-name nesting: one graph node
+            site = self._edges.get((a, b))
+            if site is None:
+                line = getattr(node, "lineno", 0)
+                self._edges[(a, b)] = {
+                    "path": ctx.path, "line": line,
+                    "col": getattr(node, "col_offset", 0) + 1,
+                    "source": ctx.source_line(line), "count": 1}
+            else:
+                site["count"] += 1
+
+        def on_edge(h: _LockDef, d: _LockDef, node: ast.AST) -> None:
+            add_edge(h.name, d.name, node)
+
+        def on_call(call: ast.Call, held, stmt) -> None:
+            lock = self._call_lock(call, names)
+            if lock is not None:
+                for h, _ in held:
+                    add_edge(h.name, lock, call)
+
+        for cls, fn in _functions(ctx.tree):
+            walk_held(fn, cls, index, on_edge=on_edge, on_call=on_call)
+        return []
+
+    def finalize(self) -> Iterable[Finding]:
+        """Cycle-check the accumulated cross-file graph; the runner
+        calls this once after the file walk."""
+        adj: Dict[str, Set[str]] = {}
+        findings: List[Finding] = []
+        reported: Set[frozenset] = set()
+        for (a, b), site in self._edges.items():
+            path = _find_path(adj, b, a)
+            if path is None:
+                adj.setdefault(a, set()).add(b)
+                continue
+            cyc = frozenset([a, b, *path])
+            if cyc in reported:
+                continue
+            reported.add(cyc)
+            rev = self._edges.get((path[0], path[1]), {})
+            findings.append(Finding(
+                self.id, self.name, site["path"], site["line"],
+                site["col"],
+                f"lock-order cycle: '{a}' -> '{b}' here, but the "
+                f"reverse order {' -> '.join(path)} is taken at "
+                f"{rev.get('path', '?')}:{rev.get('line', 0)} — one "
+                "interleaving of the two paths deadlocks (runtime "
+                "twin: analysis/lockdep.py would journal "
+                "lockdep/inversion)",
+                source=site["source"]))
+        return findings
+
+    # --------------------------------------------- --locks graph dump
+    def graph_text(self) -> str:
+        lines = [f"ptlint lock graph ({len(self._edges)} edges):"]
+        for (a, b), site in sorted(self._edges.items()):
+            lines.append(f"  {a} -> {b}  "
+                         f"[{site['path']}:{site['line']} "
+                         f"x{site['count']}]")
+        return "\n".join(lines)
+
+    def graph_dot(self) -> str:
+        lines = ["digraph ptlint_locks {"]
+        for (a, b), site in sorted(self._edges.items()):
+            lines.append(f'  "{a}" -> "{b}" '
+                         f'[label="{site["path"]}:{site["line"]}"];')
+        lines.append("}")
+        return "\n".join(lines)
+
+
+def _find_path(adj: Dict[str, Set[str]], src: str,
+               dst: str) -> Optional[List[str]]:
+    """BFS path src -> ... -> dst, or None."""
+    if src not in adj:
+        return None
+    parent: Dict[str, str] = {src: src}
+    frontier = [src]
+    while frontier:
+        nxt: List[str] = []
+        for node in frontier:
+            for succ in adj.get(node, ()):
+                if succ in parent:
+                    continue
+                parent[succ] = node
+                if succ == dst:
+                    out = [dst]
+                    while out[-1] != src:
+                        out.append(parent[out[-1]])
+                    out.reverse()
+                    return out
+                nxt.append(succ)
+        frontier = nxt
+    return None
+
+
+# ================================================================== R9
+@register_rule
+class BlockingUnderLockRule(Rule):
+    id = "R9"
+    name = "blocking-under-lock"
+    description = ("blocking call (RPC, un-timed queue get/put, sleep, "
+                   "join, flight dump, jitted dispatch) while holding "
+                   "a lock — every other thread on that lock stalls "
+                   "for the call's full latency (the PR 9 bug class)")
+
+    #: jitted-dispatch tails — shared vocabulary with R7
+    JIT_TAILS = {"_train_step", "_train_step_guarded", "_test_step",
+                 "_fwd", "_forward", "forward_batch"}
+    QUEUE_TAILS = {"q", "inq", "outq", "in_q", "out_q", "work_q",
+                   "task_q"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        names = _Names(ctx.tree)
+        index = LockIndex(ctx, names)
+        jit_tails = self.JIT_TAILS | set(self.options.get("jit_tails",
+                                                          []))
+        rpc_vars = self._rpc_vars(ctx.tree, names)
+        jit_vars = self._jit_vars(ctx.tree, names)
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+
+        def on_call(call: ast.Call, held, stmt) -> None:
+            if id(call) in seen:
+                return
+            reason = self._blocking_reason(
+                call, names, held, index, jit_tails, rpc_vars,
+                jit_vars)
+            if reason is None:
+                return
+            seen.add(id(call))
+            lock = held[-1][0].name
+            findings.append(self._ctx.finding(
+                self, call,
+                f"blocking call ({reason}) while holding lock "
+                f"'{lock}': every other thread on that lock stalls "
+                "for the call's full latency — move the call outside "
+                "the critical section (snapshot under the lock, act "
+                "after)"))
+
+        self._ctx = ctx
+        for cls, fn in _functions(ctx.tree):
+            self._cls = cls
+            walk_held(fn, cls, index, on_call=on_call)
+        return findings
+
+    # ------------------------------------------------------- helpers
+    @staticmethod
+    def _rpc_vars(tree: ast.AST, names: _Names) -> Set[str]:
+        """Attrs/vars assigned from xmlrpc ServerProxy — any method
+        call through them is a network round-trip."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            c = names.canon(node.value.func) or ""
+            if not (c.endswith("ServerProxy") or "xmlrpc" in c):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    out.add(t.attr)
+                elif isinstance(t, ast.Name):
+                    out.add(t.id)
+        return out
+
+    @staticmethod
+    def _jit_vars(tree: ast.AST, names: _Names) -> Set[str]:
+        """Attrs/vars assigned from jax.jit(...) — calling them is a
+        device dispatch (trace + compile on first hit)."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            if not names.is_jit(node.value.func):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    out.add(t.attr)
+                elif isinstance(t, ast.Name):
+                    out.add(t.id)
+        return out
+
+    def _blocking_reason(self, call, names, held, index, jit_tails,
+                         rpc_vars, jit_vars) -> Optional[str]:
+        func = call.func
+        canon = names.canon(func) or ""
+        kwnames = {k.arg for k in call.keywords}
+        if canon == "time.sleep":
+            return "time.sleep"
+        if isinstance(func, ast.Name):
+            if func.id in jit_vars or func.id in jit_tails:
+                return "jitted dispatch"
+            if func.id == "call_with_retry" or \
+                    canon.endswith(".call_with_retry"):
+                return "RPC round-trip"
+            # xmlrpc *method* calls block; Binary()/ServerProxy()/
+            # Fault() are constructors, not network round-trips
+            if "xmlrpc" in canon and not func.id[:1].isupper():
+                return "RPC round-trip"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        tail = func.attr
+        recv = func.value
+        recv_tail = recv.attr if isinstance(recv, ast.Attribute) else \
+            (recv.id if isinstance(recv, ast.Name) else "")
+        if tail == "join":
+            # exclude str.join: flag only join() / join(<number>) /
+            # join(timeout=...)
+            if not call.args and not kwnames:
+                return "Thread.join without timeout"
+            if kwnames <= {"timeout"} and all(
+                    isinstance(a, ast.Constant) and
+                    isinstance(a.value, (int, float))
+                    for a in call.args):
+                return "Thread.join"
+            return None
+        if tail in ("get", "put"):
+            queueish = "queue" in recv_tail.lower() or \
+                recv_tail in self.QUEUE_TAILS
+            if queueish and "timeout" not in kwnames and \
+                    len(call.args) < 2:
+                return f"queue.{tail} without timeout"
+        if tail == "maybe_autodump":
+            return "flight auto-dump (bundle write)"
+        if tail == "dump" and len(call.args) <= 1:
+            return "flight/journal dump (bundle write)"
+        if tail == "wait":
+            d = index.resolve(recv, self._cls)
+            held_names = {h.name for h, _ in held}
+            if d is not None and d.name in held_names:
+                return None     # Condition.wait releases its own lock
+            if not call.args and "timeout" not in kwnames:
+                return "wait() without timeout"
+            return None
+        if tail == "call_with_retry" or \
+                ("xmlrpc" in canon and not tail[:1].isupper()):
+            return "RPC round-trip"
+        if recv_tail in rpc_vars:
+            return "RPC via ServerProxy"
+        if tail in jit_tails or tail in jit_vars:
+            return "jitted dispatch"
+        return None
+
+
+# ================================================================= R10
+@register_rule
+class GuardedByRule(Rule):
+    id = "R10"
+    name = "guarded-by"
+    description = ("mutation of an attribute annotated '# ptlint: "
+                   "guarded-by(lock)' without that lock held "
+                   "(__init__/__post_init__ and *_locked methods are "
+                   "exempt — their callers hold it)")
+
+    MUTATORS = {"append", "appendleft", "extend", "add", "insert",
+                "update", "pop", "popleft", "popitem", "remove",
+                "discard", "clear", "setdefault", "rotate", "sort",
+                "reverse"}
+    EXEMPT = {"__init__", "__post_init__"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        names = _Names(ctx.tree)
+        index = LockIndex(ctx, names)
+        if not index.guarded:
+            return []
+        findings: List[Finding] = []
+
+        for cls, fn in _functions(ctx.tree):
+            if fn.name in self.EXEMPT or fn.name.endswith("_locked"):
+                continue
+
+            def on_stmt(st, held, _cls=cls):
+                held_names = {h.name for h, _ in held}
+                for attr, node in self._mutations(st):
+                    lock = index.guarded.get((_cls or "", attr))
+                    if lock is None or lock in held_names:
+                        continue
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"'self.{attr}' is guarded-by('{lock}') but "
+                        "mutated here without it — take the lock, or "
+                        "move the mutation into a *_locked helper"))
+
+            walk_held(fn, cls, index, on_stmt=on_stmt)
+        return findings
+
+    def _mutations(self, st: ast.stmt):
+        """(attr, node) pairs for self.<attr> mutations in this
+        statement (not descending into sub-statement bodies)."""
+        targets: List[ast.AST] = []
+        if isinstance(st, ast.Assign):
+            targets = list(st.targets)
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            targets = [st.target]
+        elif isinstance(st, ast.Delete):
+            targets = list(st.targets)
+        for t in targets:
+            attr = self._self_attr(t)
+            if attr is not None:
+                yield attr, st
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            func = st.value.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in self.MUTATORS:
+                attr = self._self_attr(func.value)
+                if attr is not None:
+                    yield attr, st.value
+
+    @staticmethod
+    def _self_attr(t: ast.AST) -> Optional[str]:
+        if isinstance(t, (ast.Subscript, ast.Starred)):
+            t = t.value
+        if isinstance(t, ast.Tuple):
+            for el in t.elts:
+                a = GuardedByRule._self_attr(el)
+                if a is not None:
+                    return a
+            return None
+        if isinstance(t, ast.Attribute) and \
+                isinstance(t.value, ast.Name) and t.value.id == "self":
+            return t.attr
+        return None

@@ -637,6 +637,11 @@ class ThreadHygieneRule(Rule):
     description = ("threading.Thread outside the pt-* naming/stop-event "
                    "convention, or bare Lock.acquire()")
 
+    #: lifecycle evidence a daemon thread's enclosing scope must show:
+    #: a stop flag/event, a shutdown/close/drain path, or a join —
+    #: lowercase substrings so ``StopIteration`` does not count
+    LIFECYCLE_MARKERS = ("stop", "shutdown", "close", "drain", "join")
+
     def _name_ok(self, kw_value: ast.AST) -> bool:
         """name= must start with 'pt-' when statically known."""
         if isinstance(kw_value, ast.Constant) and \
@@ -649,6 +654,37 @@ class ThreadHygieneRule(Rule):
                 return first.value.startswith("pt-")
             return True         # leading {THREAD_PREFIX}-style: accept
         return True             # dynamic expression: accept
+
+    @staticmethod
+    def _enclosing_scope(ctx: FileContext,
+                         node: ast.AST) -> Tuple[int, int]:
+        """(lineno, end_lineno) of the region scanned for lifecycle
+        evidence: the innermost enclosing CLASS (a stop()/shutdown()
+        usually lives in a sibling method), else the innermost
+        function, else the whole module."""
+        line = getattr(node, "lineno", 0)
+        best_cls: Optional[ast.AST] = None
+        best_fn: Optional[ast.AST] = None
+        for scope in ast.walk(ctx.tree):
+            lo = getattr(scope, "lineno", None)
+            hi = getattr(scope, "end_lineno", None)
+            if lo is None or hi is None or not lo <= line <= hi:
+                continue
+            if isinstance(scope, ast.ClassDef):
+                if best_cls is None or lo >= best_cls.lineno:
+                    best_cls = scope
+            elif isinstance(scope, _FUNCS):
+                if best_fn is None or lo >= best_fn.lineno:
+                    best_fn = scope
+        best = best_cls or best_fn
+        if best is None:
+            return 1, len(ctx.lines)
+        return best.lineno, getattr(best, "end_lineno", best.lineno)
+
+    def _has_lifecycle(self, ctx: FileContext, node: ast.AST) -> bool:
+        lo, hi = self._enclosing_scope(ctx, node)
+        segment = "\n".join(ctx.lines[lo - 1:hi])
+        return any(m in segment for m in self.LIFECYCLE_MARKERS)
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         names = _Names(ctx.tree)
@@ -671,6 +707,17 @@ class ThreadHygieneRule(Rule):
                         "thread name must start with 'pt-' (the "
                         "pt-* naming + stop-event convention, "
                         "reader/pipeline.py)")
+                daemon = kw.get("daemon")
+                if isinstance(daemon, ast.Constant) and \
+                        daemon.value is True and \
+                        not self._has_lifecycle(ctx, node):
+                    yield ctx.finding(
+                        self, node,
+                        "daemon thread with no visible stop/join "
+                        "lifecycle in its scope: daemon=True hides "
+                        "the leak, it does not manage it — add a "
+                        "stop event (or join in a finally) so "
+                        "shutdown is deterministic")
             elif isinstance(node.func, ast.Attribute) and \
                     node.func.attr == "acquire" and \
                     isinstance(node.func.value, (ast.Name,

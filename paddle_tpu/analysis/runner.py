@@ -159,6 +159,8 @@ def lint_paths(cfg: LintConfig,
 
     res = LintResult()
     raw: List[Finding] = []
+    texts: Dict[str, str] = {}      # rel -> text, for finalize sups
+    has_finalize = any(hasattr(r, "finalize") for r in rules)
     for full, rel in _iter_py_files(cfg):
         res.files += 1
         ctx = parse_file(full, rel)
@@ -166,6 +168,8 @@ def lint_paths(cfg: LintConfig,
             res.errors.append(f"{rel}: syntax error — ptlint cannot "
                               "parse it (neither can the interpreter)")
             continue
+        if has_finalize:
+            texts[rel] = ctx.text
         file_findings: List[Finding] = []
         for rule in rules:
             file_findings.extend(rule.check(ctx))
@@ -175,6 +179,24 @@ def lint_paths(cfg: LintConfig,
         for f in sorted(file_findings, key=lambda f: (f.line, f.col,
                                                       f.rule)):
             sup = next((s for s in sups if s.covers(f)), None)
+            if sup is not None:
+                res.suppressed.append((f, sup.reason))
+            else:
+                raw.append(f)
+
+    # cross-file rules (R8 lock-order) emit after the whole walk; their
+    # findings go through the same suppression + baseline funnel
+    sup_cache: Dict[str, list] = {}
+    for rule in rules:
+        finalize = getattr(rule, "finalize", None)
+        if finalize is None:
+            continue
+        for f in finalize():
+            if f.path not in sup_cache:
+                sup_cache[f.path] = list(
+                    iter_suppressions(texts.get(f.path, "")))
+            sup = next((s for s in sup_cache[f.path] if s.covers(f)),
+                       None)
             if sup is not None:
                 res.suppressed.append((f, sup.reason))
             else:
@@ -190,8 +212,26 @@ def lint_paths(cfg: LintConfig,
 
 
 # ------------------------------------------------------------------ output
+def _stale_entry_line(root: str, entry: dict) -> int:
+    """Best-effort line anchor for a stale baseline entry: the first
+    line in the (still-existing) file matching the baselined source,
+    else 0 (entry rendered file-level)."""
+    src = (entry.get("source") or "").strip()
+    if not src:
+        return 0
+    try:
+        with open(os.path.join(root, entry["path"]),
+                  encoding="utf-8", errors="replace") as f:
+            for i, line in enumerate(f, start=1):
+                if line.strip() == src:
+                    return i
+    except OSError:
+        return 0
+    return 0
+
+
 def format_findings(res: LintResult, fmt: str = "text",
-                    verbose: bool = False) -> str:
+                    verbose: bool = False, root: str = ".") -> str:
     lines: List[str] = []
     if fmt == "github":
         # GitHub Actions annotation commands — render as inline PR
@@ -201,10 +241,14 @@ def format_findings(res: LintResult, fmt: str = "text",
             lines.append(f"::error file={f.path},line={f.line},"
                          f"col={f.col}::{msg}")
         for e in res.stale_baseline:
-            lines.append(f"::error file={e['path']}::stale ptlint "
-                         f"baseline entry {e['rule']} "
-                         f"('{e['source'][:60]}') — the finding is "
-                         "gone; delete the entry")
+            # stale entries are hygiene debt, not failures of the
+            # touched code — annotate as ::warning, anchored to the
+            # baselined source line when it still exists in the file
+            line = _stale_entry_line(root, e)
+            loc = f"file={e['path']}" + (f",line={line}" if line else "")
+            lines.append(f"::warning {loc}::stale ptlint baseline "
+                         f"entry {e['rule']} ('{e['source'][:60]}') — "
+                         "the finding is gone; delete the entry")
         for err in res.errors:
             lines.append(f"::error::{err}")
     elif fmt == "json":
@@ -240,6 +284,18 @@ def format_findings(res: LintResult, fmt: str = "text",
     return "\n".join(lines)
 
 
+def _lock_graph(cfg: LintConfig, fmt: str = "text") -> str:
+    """The `--locks` view: run R8's edge collection over the
+    configured tree and render the global acquisition graph."""
+    from paddle_tpu.analysis.lockrules import LockOrderRule
+    rule = LockOrderRule(cfg.rule_options.get("R8"))
+    for full, rel in _iter_py_files(cfg):
+        ctx = parse_file(full, rel)
+        if ctx is not None:
+            list(rule.check(ctx))
+    return rule.graph_dot() if fmt == "dot" else rule.graph_text()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ptlint",
@@ -262,6 +318,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "findings (keeps existing justifications)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also list suppressed/baselined findings")
+    ap.add_argument("--locks", nargs="?", const="text",
+                    choices=["text", "dot"],
+                    help="print the global lock-acquisition graph "
+                         "discovered by R8 (text or DOT) and exit")
     args = ap.parse_args(argv)
 
     try:
@@ -270,6 +330,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             cfg.paths = args.paths
         if args.rules:
             cfg.rules = [r.strip() for r in args.rules.split(",")]
+        if args.locks:
+            print(_lock_graph(cfg, args.locks))
+            return 0
         res = lint_paths(cfg, use_baseline=not args.no_baseline
                          and not args.write_baseline)
     except (ValueError, OSError) as e:
@@ -284,7 +347,8 @@ def main(argv: Optional[List[str]] = None) -> int:
               " — fill in every TODO 'why' before committing")
         return 0
 
-    out = format_findings(res, args.format, verbose=args.verbose)
+    out = format_findings(res, args.format, verbose=args.verbose,
+                          root=args.root)
     if out:
         print(out)
     return 0 if res.ok else 1

@@ -458,6 +458,8 @@ def _cmd_lint(args) -> int:
         argv.append("--no-baseline")
     if args.verbose:
         argv.append("--verbose")
+    if getattr(args, "locks", None):
+        argv += ["--locks", args.locks]
     return lint_main(argv)
 
 
@@ -976,6 +978,10 @@ def main(argv=None) -> int:
                     help="report baselined findings too")
     ln.add_argument("-v", "--verbose", action="store_true",
                     help="also list suppressed/baselined findings")
+    ln.add_argument("--locks", nargs="?", const="text",
+                    choices=["text", "dot"],
+                    help="print the global lock-acquisition graph "
+                         "discovered by R8 (text or DOT) and exit")
 
     co = sub.add_parser("coordinator", help="run the elastic-training "
                         "coordinator daemon (go/cmd/master parity)")

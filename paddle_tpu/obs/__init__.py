@@ -78,6 +78,7 @@ def reset_all() -> None:
     + sink, tracer, flight recorder, trace context, utils/stats
     counters/timers) — the between-tests hygiene hook
     (tests/conftest.py autouse fixture)."""
+    from paddle_tpu.analysis.lockdep import LOCKDEP
     from paddle_tpu.utils.stats import global_counters, global_stat
     REGISTRY.reset()
     JOURNAL.reset()
@@ -88,3 +89,4 @@ def reset_all() -> None:
     context.reset()
     global_counters.reset()
     global_stat.reset()
+    LOCKDEP.reset()

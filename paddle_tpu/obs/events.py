@@ -52,6 +52,7 @@ from collections import deque
 from typing import Callable, Iterator, List, Optional, Tuple
 
 from paddle_tpu.obs import context as obs_context
+from paddle_tpu.analysis.lockdep import named_lock
 from paddle_tpu.utils.logging import get_logger
 
 __all__ = ["SCHEMA_VERSION", "REQUIRED_FIELDS", "EventJournal", "JOURNAL",
@@ -116,7 +117,7 @@ class EventJournal:
 
     def __init__(self, ring_size: int = 2048,
                  max_bytes: Optional[int] = None, keep: int = 3):
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.journal")
         self._ring: deque = deque(maxlen=int(ring_size))
         self._seq = 0
         self._fh = None

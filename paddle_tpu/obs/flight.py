@@ -45,6 +45,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from paddle_tpu.obs import context as obs_context
+from paddle_tpu.analysis.lockdep import named_lock
 from paddle_tpu.utils.logging import get_logger
 
 __all__ = ["FlightRecorder", "FLIGHT", "record", "install_excepthook",
@@ -61,6 +62,7 @@ AUTO_DUMP_TRIGGERS = {
     ("trainer", "oom"),
     ("engine", "step_failure"),
     ("serving", "breaker"),
+    ("lockdep", "inversion"),   # would-be deadlock witnessed
 }
 
 
@@ -70,8 +72,8 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = 4096,
                  min_dump_interval: float = 30.0):
-        self._lock = threading.Lock()
-        self._ring: deque = deque(maxlen=int(capacity))
+        self._lock = named_lock("obs.flight")
+        self._ring: deque = deque(maxlen=int(capacity))  # ptlint: guarded-by(obs.flight)
         self.enabled = True
         self._dump_dir: Optional[str] = None
         self._min_dump_interval = float(min_dump_interval)

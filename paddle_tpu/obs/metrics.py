@@ -30,6 +30,8 @@ from __future__ import annotations
 import math
 import re
 import threading
+
+from paddle_tpu.analysis.lockdep import named_lock
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["MetricsRegistry", "MetricFamily", "SampleFamily", "REGISTRY",
@@ -168,7 +170,7 @@ class MetricFamily:
         self.labelnames = tuple(labelnames)
         self._buckets = tuple(buckets if buckets is not None
                               else DEFAULT_BUCKETS)
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.metrics.family")
         self._children: Dict[Tuple[str, ...], object] = {}
 
     def _make_child(self):
@@ -264,7 +266,7 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.metrics.registry")
         self._families: Dict[str, MetricFamily] = {}
         self._collectors: List[Callable[[], Iterable]] = []
 
@@ -427,3 +429,47 @@ def _stats_bridge() -> List[SampleFamily]:
 
 
 REGISTRY.register_collector(_stats_bridge)
+
+
+def _lockdep_bridge() -> List[SampleFamily]:
+    """Scrape-time view of the ptlockdep witness
+    (analysis/lockdep.py): order-graph size, inversions, and per-name
+    contention / hold-time telemetry.  Imported lazily — lockdep is
+    the module the obs plane builds its OWN locks from."""
+    from paddle_tpu.analysis.lockdep import LOCKDEP
+    snap = LOCKDEP.metrics_snapshot()
+    fams: List[SampleFamily] = [
+        SampleFamily(
+            "paddle_tpu_lockdep_edges", "gauge",
+            "distinct acquisition-order edges in the lockdep graph",
+            [("paddle_tpu_lockdep_edges", {}, float(snap["edges"]))]),
+        SampleFamily(
+            "paddle_tpu_lockdep_inversions_total", "counter",
+            "lock-order inversions witnessed since reset",
+            [("paddle_tpu_lockdep_inversions_total", {},
+              float(snap["inversions"]))]),
+    ]
+    if snap["contentions"]:
+        fams.append(SampleFamily(
+            "paddle_tpu_lockdep_contentions_total", "counter",
+            "acquires that found the named lock already held",
+            [("paddle_tpu_lockdep_contentions_total", {"name": k},
+              float(v))
+             for k, v in sorted(snap["contentions"].items())]))
+    if snap["hold_ms"]:
+        fams.append(SampleFamily(
+            "paddle_tpu_lockdep_hold_time_ms", "gauge",
+            "cumulative milliseconds the named lock was held",
+            [("paddle_tpu_lockdep_hold_time_ms", {"name": k}, float(v))
+             for k, v in sorted(snap["hold_ms"].items())]))
+    if snap["acquisitions"]:
+        fams.append(SampleFamily(
+            "paddle_tpu_lockdep_acquisitions_total", "counter",
+            "acquisitions of the named lock since reset",
+            [("paddle_tpu_lockdep_acquisitions_total", {"name": k},
+              float(v))
+             for k, v in sorted(snap["acquisitions"].items())]))
+    return fams
+
+
+REGISTRY.register_collector(_lockdep_bridge)

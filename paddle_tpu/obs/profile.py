@@ -43,6 +43,7 @@ from statistics import median
 from typing import Callable, Dict, List, Optional, Tuple
 
 from paddle_tpu.obs.metrics import REGISTRY
+from paddle_tpu.analysis.lockdep import named_lock
 
 __all__ = [
     "PEAK_FLOPS", "PEAK_HBM_GBPS", "device_lookup", "device_peak_flops",
@@ -221,7 +222,7 @@ class StepProfiler:
     returns after one attribute read when disabled."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.profile")
         self._enabled = False
         self._sample_every = 8
         self._kinds: Dict[str, _KindState] = {}

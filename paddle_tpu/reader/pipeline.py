@@ -47,6 +47,7 @@ import time
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
 from paddle_tpu.utils.logging import get_logger
+from paddle_tpu.analysis.lockdep import named_lock
 from paddle_tpu.utils.stats import global_counters
 
 __all__ = ["ErrorBudget", "ErrorBudgetExceeded", "supervised",
@@ -108,7 +109,7 @@ class ErrorBudget:
         self.on_bad = on_bad
         self.stat = stat
         self.on_event = on_event
-        self._lock = threading.Lock()
+        self._lock = named_lock("data.error_budget")
         self.bad = 0
         self.last_errors: collections.deque = collections.deque(maxlen=16)
         self._exhausted_emitted = False

@@ -19,6 +19,7 @@ import time
 from collections import deque
 from typing import Callable, Tuple
 
+from paddle_tpu.analysis.lockdep import named_lock
 from paddle_tpu.obs.events import emit as journal_emit
 
 CLOSED = "closed"
@@ -39,7 +40,7 @@ class CircuitBreaker:
         self.cooldown = float(cooldown)
         self.half_open_probes = max(1, int(half_open_probes))
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.breaker")
         self._outcomes: deque = deque(maxlen=self.window)  # True = ok
         self._state = CLOSED
         self._opened_at = 0.0

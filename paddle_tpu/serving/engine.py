@@ -46,6 +46,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from paddle_tpu.obs import context as obs_context
+from paddle_tpu.analysis.lockdep import named_condition
 from paddle_tpu.obs.events import emit as journal_emit
 from paddle_tpu.obs.flight import FLIGHT
 from paddle_tpu.obs.profile import PROFILER
@@ -225,8 +226,8 @@ class DecodeEngine:
         self._positions = np.zeros((S,), np.int32)
         self._tables = np.zeros((S, P), np.int32)
         self._active = np.zeros((S,), np.bool_)
-        self._waiting: deque = deque()
-        self._cv = threading.Condition()
+        self._waiting: deque = deque()  # ptlint: guarded-by(serving.engine)
+        self._cv = named_condition("serving.engine")
         self._accepting = True
         self._stopping = False
         self._close_now = False

@@ -43,6 +43,7 @@ from typing import Callable, List, Optional, Union
 import numpy as np
 
 from paddle_tpu.obs import context as obs_context
+from paddle_tpu.analysis.lockdep import named_condition
 from paddle_tpu.obs.events import emit as journal_emit
 from paddle_tpu.obs.flight import FLIGHT
 from paddle_tpu.serving.breaker import CircuitBreaker
@@ -157,8 +158,8 @@ class InferenceServer:
                                  if max_batch_memory else None)
         self._batch_limit: Optional[int] = None
         self._clock = clock
-        self._cv = threading.Condition()
-        self._queue: deque = deque()
+        self._cv = named_condition("serving.server")
+        self._queue: deque = deque()  # ptlint: guarded-by(serving.server)
         self._threads: List[threading.Thread] = []
         self._accepting = False
         self._stopping = False

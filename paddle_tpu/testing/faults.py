@@ -82,7 +82,17 @@ and, for elastic membership (docs/robustness.md "Elastic training"):
       grants exactly where a real scale-out/in would). The invariants
       every script must preserve: per-record read counts stay
       exactly-once across the reshape, and completions from superseded
-      grants are REJECTED (coordinator ``stale_grants``).
+      grants are REJECTED (coordinator ``stale_grants``);
+
+and, for lock discipline (docs/static_analysis.md "Lock discipline"):
+
+  (m) GRAB a named instrumented lock from inside the step path
+      (``hold_lock`` — resolves the witness name via
+      ``analysis.lockdep.find_lock`` and holds it for ``ms``
+      milliseconds at chosen interceptor firings) — the deterministic
+      twin of a background thread contending on a hot shared lock, so
+      contention/hold-time telemetry and the lockdep order graph can be
+      driven on demand.
 
 Everything is deterministic given the seed and the schedule, so a chaos
 test that fails replays exactly. See ``tests/test_faults.py`` and
@@ -599,6 +609,54 @@ class FaultPlan:
             yield stats
         finally:
             engine._step_interceptor = prev
+
+    # --------------------------------------------- (m) lock discipline
+    @staticmethod
+    @contextlib.contextmanager
+    def hold_lock(target, name: str, at: int = 0, ms: float = 50.0,
+                  n: int = 1):
+        """Within the context, grab the named instrumented lock (e.g.
+        ``"coord.state"``, ``"obs.flight"`` — any live
+        :func:`paddle_tpu.analysis.lockdep.named_lock`) from inside
+        ``target``'s ``_step_interceptor`` seam and HOLD it for ``ms``
+        milliseconds, starting at the ``at``-th firing after entry
+        (0-based) for ``n`` firings. The deterministic twin of a
+        background thread squatting on a hot shared lock: every other
+        thread contending on it stalls for the full hold, which the
+        lockdep witness books as contention + hold-time telemetry
+        (``paddle_tpu_lockdep_contentions_total`` /
+        ``_hold_time_ms``) and, when the step path itself holds
+        another lock, as an order-graph edge. The lock must already
+        exist (``find_lock`` raises KeyError otherwise, so a typo'd
+        name fails loudly instead of silently holding nothing). Yields
+        a stats dict (``injected``, ``held_ms``)."""
+        from paddle_tpu.analysis.lockdep import find_lock
+        lock = find_lock(name)
+        if lock is None:
+            raise KeyError(f"no live instrumented lock named {name!r}")
+        stats = {"injected": 0, "held_ms": 0.0}
+        fired = [0]
+        pause = ms / 1e3
+        prev = target._step_interceptor
+
+        def intercept(*args, **kw):
+            if prev is not None:
+                prev(*args, **kw)
+            idx = fired[0]
+            fired[0] += 1
+            if at <= idx < at + n:
+                t0 = time.perf_counter()
+                with lock:
+                    # ptlint: disable=R9(deliberate: this fault injector EXISTS to stall a hot lock on demand)
+                    time.sleep(pause)
+                stats["injected"] += 1
+                stats["held_ms"] += (time.perf_counter() - t0) * 1e3
+
+        target._step_interceptor = intercept
+        try:
+            yield stats
+        finally:
+            target._step_interceptor = prev
 
     # ----------------------------------------- (k) elastic membership
     @staticmethod

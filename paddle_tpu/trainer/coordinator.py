@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from paddle_tpu.analysis.lockdep import named_lock
+
 
 @dataclasses.dataclass
 class Task:
@@ -58,7 +60,7 @@ class InMemStore(KVStore):
 
     def __init__(self):
         self._data: Dict[str, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("coord.store")
 
     def put(self, key, value):
         with self._lock:
@@ -159,16 +161,18 @@ class RpcStore(KVStore):
         self._proxy = ServerProxy(f"http://{host}:{port}",
                                   allow_none=True)
         self._retry = retry
-        self._lock = threading.Lock()
+        self._lock = named_lock("coord.rpcstore")
 
     def put(self, key, value):
         from xmlrpc.client import Binary
         with self._lock:
+            # ptlint: disable=R9(the lock serializes the non-thread-safe ServerProxy; the RPC IS the critical section)
             call_with_retry(self._proxy.put, str(key), Binary(value),
                             policy=self._retry)
 
     def get(self, key):
         with self._lock:
+            # ptlint: disable=R9(the lock serializes the non-thread-safe ServerProxy; the RPC IS the critical section)
             blob = call_with_retry(self._proxy.get, str(key),
                                    policy=self._retry)
         return None if blob is None else blob.data
@@ -298,8 +302,8 @@ class Coordinator:
         self.worker_lease_s = timeout_s if worker_lease_s is None \
             else worker_lease_s
         self.store = store or InMemStore()
-        self._lock = threading.Lock()
-        self._save_lock = threading.Lock()
+        self._lock = named_lock("coord.state")
+        self._save_lock = named_lock("coord.save")
         self._saving_for_epoch = -1
         self._saving_trainer: Optional[str] = None
         self._last_save_grant = float("-inf")
@@ -863,6 +867,7 @@ def _make_threading_server():
 
         def process_request(self, request, client_address):
             self._request_seq += 1
+            # ptlint: disable=R5(per-request handler; dies with the request, server.shutdown() is the lifecycle)
             t = threading.Thread(
                 target=self.process_request_thread,
                 args=(request, client_address), daemon=True,

@@ -19,6 +19,8 @@ from typing import Dict
 
 import jax
 
+from paddle_tpu.analysis.lockdep import named_lock
+
 
 class StatItem:
     # add() is a read-modify-write reached concurrently from the
@@ -32,7 +34,7 @@ class StatItem:
         self.total = 0.0
         self.max = 0.0
         self.min = float("inf")
-        self._lock = threading.Lock()
+        self._lock = named_lock("stats.item")
 
     def add(self, dt: float):
         with self._lock:
@@ -56,7 +58,7 @@ class StatItem:
 
 class StatSet:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("stats.statset")
         self._stats: Dict[str, StatItem] = {}
         self.enabled = True
 
@@ -87,7 +89,7 @@ class CounterSet:
     fault counts around an epoch."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("stats.counters")
         self._counts: Dict[str, int] = {}
 
     def bump(self, name: str, n: int = 1) -> int:

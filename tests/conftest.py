@@ -45,6 +45,12 @@ def pytest_configure(config):
         "budget — the test fails if any single jitted function "
         "compiles more than max_compiles times while it runs "
         "(paddle_tpu/analysis/sanitizer.py; docs/static_analysis.md)")
+    config.addinivalue_line(
+        "markers",
+        "lockdep_allow_inversion: this test deliberately provokes a "
+        "lock-order inversion (chaos/deadlock-witness tests) — skip "
+        "the autouse zero-inversions assertion "
+        "(paddle_tpu/analysis/lockdep.py)")
 
 
 @pytest.fixture(autouse=True)
@@ -152,6 +158,32 @@ def _reset_observability():
     from paddle_tpu.obs import reset_all
     reset_all()
     yield
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_witness(request):
+    """Deadlock witness for tier-1: every test runs under the lockdep
+    runtime (paddle_tpu/analysis/lockdep.py — instrumented locks feed a
+    global acquisition-order graph) and FAILS at teardown if any
+    lock-order inversion was observed, unless it is marked
+    ``lockdep_allow_inversion`` (chaos tests that provoke one on
+    purpose). The graph is reset per-test by _reset_observability
+    (obs.reset_all -> LOCKDEP.reset), so an inversion is attributed to
+    the test that created it."""
+    yield
+    if request.node.get_closest_marker("lockdep_allow_inversion"):
+        return
+    rep = getattr(request.node, "rep_call", None)
+    if rep is None or not rep.passed:
+        return
+    from paddle_tpu.analysis.lockdep import LOCKDEP
+    count = LOCKDEP.inversion_count
+    assert count == 0, (
+        f"lockdep witness observed {count} lock-order inversion(s) "
+        "during this test — two locks were taken in opposite orders "
+        "on different paths (one interleaving deadlocks). The journal "
+        "holds a lockdep/inversion record with both stacks; see "
+        "docs/static_analysis.md 'Lock discipline'")
 
 
 @pytest.fixture

@@ -31,7 +31,8 @@ def test_configured_paths_cover_the_tree():
     assert "paddle_tpu" in cfg.paths
     assert "tools" in cfg.paths
     assert "tests" in cfg.paths
-    assert cfg.rules == ["R1", "R2", "R3", "R4", "R5", "R6", "R7"]
+    assert cfg.rules == ["R1", "R2", "R3", "R4", "R5", "R6", "R7",
+                         "R8", "R9", "R10"]
 
 
 def test_repo_is_lint_clean():
@@ -94,3 +95,36 @@ def test_github_format_renders_annotations(tmp_path):
     assert out.startswith("::error file=")
     assert ",line=4," in out
     assert "R2[recompile]" in out
+
+
+def test_github_format_renders_stale_baseline_as_warning(tmp_path):
+    """A baseline entry whose finding was fixed renders as a
+    ``::warning`` annotation (hygiene debt) anchored to the surviving
+    source line — new findings stay ``::error``."""
+    from paddle_tpu.analysis.baseline import write_baseline
+
+    bad = tmp_path / "hot.py"
+    bad.write_text(
+        "import jax\n"
+        "def train(xs):\n"
+        "    for x in xs:\n"
+        "        jax.jit(lambda v: v)(x)\n")
+    cfg = load_config(ROOT)
+    cfg.paths = [str(bad)]
+    cfg.baseline = str(tmp_path / "baseline.json")
+    res = lint_paths(cfg, use_baseline=False)
+    assert len(res.new) == 1
+    write_baseline(cfg.baseline, res.new, [])
+
+    # fix the finding but keep the identical source text at module
+    # level, so the stale entry can still be anchored to a line
+    bad.write_text(
+        "import jax\n"
+        "x = 1\n"
+        "jax.jit(lambda v: v)(x)\n")
+    res2 = lint_paths(cfg)
+    assert not res2.new and res2.stale_baseline
+    out = format_findings(res2, "github", root=str(tmp_path))
+    assert out.startswith("::warning file=")
+    assert ",line=3" in out
+    assert "stale ptlint baseline entry" in out

@@ -374,6 +374,240 @@ class TestBroadExceptJit:
         """)
 
 
+# ====================================================== R5 (daemon)
+class TestDaemonLifecycle:
+    def test_catches_daemon_thread_with_no_lifecycle(self):
+        hits = run_rule(R.ThreadHygieneRule, """
+            import threading
+            class Poller:
+                def start(self):
+                    self._t = threading.Thread(
+                        target=self._run, name="pt-x-poll", daemon=True)
+                    self._t.start()
+                def _run(self):
+                    pass
+        """)
+        assert len(hits) == 1 and "daemon" in hits[0].message
+
+    def test_quiet_when_scope_has_stop_lifecycle(self):
+        assert not run_rule(R.ThreadHygieneRule, """
+            import threading
+            class Poller:
+                def start(self):
+                    self._stop = threading.Event()
+                    self._t = threading.Thread(
+                        target=self._run, name="pt-x-poll", daemon=True)
+                    self._t.start()
+                def close(self):
+                    self._stop.set()
+                    self._t.join(timeout=5)
+                def _run(self):
+                    pass
+        """)
+
+
+# ================================================================== R8
+class TestLockOrder:
+    def _finalize(self, src):
+        import paddle_tpu.analysis.lockrules as LK
+        ctx = parse_file("<mem>", "paddle_tpu/mod.py",
+                         text=textwrap.dedent(src))
+        rule = LK.LockOrderRule()
+        assert not list(rule.check(ctx))     # findings come from finalize
+        return rule, list(rule.finalize())
+
+    def test_catches_in_file_order_cycle(self):
+        _, hits = self._finalize("""
+            from paddle_tpu.analysis.lockdep import named_lock
+            class S:
+                def __init__(self):
+                    self._a = named_lock("t8.a")
+                    self._b = named_lock("t8.b")
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """)
+        assert len(hits) == 1 and hits[0].rule == "R8"
+        assert "t8.a" in hits[0].message and "t8.b" in hits[0].message
+
+    def test_quiet_on_consistent_order_and_graph_dump(self):
+        rule, hits = self._finalize("""
+            from paddle_tpu.analysis.lockdep import named_lock
+            class S:
+                def __init__(self):
+                    self._a = named_lock("t8.a")
+                    self._b = named_lock("t8.b")
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """)
+        assert not hits
+        assert "t8.a -> t8.b" in rule.graph_text()
+        assert '"t8.a" -> "t8.b"' in rule.graph_dot()
+
+    def test_catches_cross_file_cycle_through_runner(self, tmp_path):
+        """The acquisition graph is GLOBAL: file one orders a->b, file
+        two orders b->a, neither file alone has a cycle."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "one.py").write_text(textwrap.dedent("""
+            from paddle_tpu.analysis.lockdep import named_lock
+            A = named_lock("x8.a")
+            B = named_lock("x8.b")
+            def fwd():
+                with A:
+                    with B:
+                        pass
+        """))
+        (pkg / "two.py").write_text(textwrap.dedent("""
+            from paddle_tpu.analysis.lockdep import named_lock
+            A = named_lock("x8.a")
+            B = named_lock("x8.b")
+            def rev():
+                with B:
+                    with A:
+                        pass
+        """))
+        cfg = LintConfig(root=str(tmp_path), paths=["pkg"],
+                         rules=["R8"], baseline="")
+        res = lint_paths(cfg, use_baseline=False)
+        assert len(res.new) == 1 and res.new[0].rule == "R8"
+        assert "x8.a" in res.new[0].message
+        assert "one.py" in res.new[0].message or \
+            "two.py" in res.new[0].message
+
+    def test_journal_emit_under_lock_is_a_graph_edge(self):
+        """The PR 9 shape: JOURNAL.emit while holding an app lock is an
+        edge app-lock -> obs.journal even with no syntactic nesting."""
+        rule, _ = self._finalize("""
+            from paddle_tpu.analysis.lockdep import named_lock
+            from paddle_tpu.obs.events import JOURNAL
+            class S:
+                def __init__(self):
+                    self._lock = named_lock("t8.app")
+                def work(self):
+                    with self._lock:
+                        JOURNAL.emit("x", "y")
+        """)
+        assert ("t8.app", "obs.journal") in rule._edges
+
+
+# ================================================================== R9
+class TestBlockingUnderLock:
+    def _run(self, src):
+        import paddle_tpu.analysis.lockrules as LK
+        return run_rule(LK.BlockingUnderLockRule, src)
+
+    def test_catches_sleep_join_queue_rpc_dump_under_lock(self):
+        hits = self._run("""
+            import time
+            import queue
+            from paddle_tpu.analysis.lockdep import named_lock
+            from paddle_tpu.obs.flight import FLIGHT
+            from paddle_tpu.utils.net import call_with_retry
+            class S:
+                def __init__(self):
+                    self._lock = named_lock("t9.lock")
+                    self.q = queue.Queue()
+                def work(self, t):
+                    with self._lock:
+                        time.sleep(0.5)
+                        t.join()
+                        self.q.get()
+                        call_with_retry(print, 1)
+                        FLIGHT.dump("reason")
+        """)
+        reasons = sorted(h.message.split("(")[1].split(")")[0]
+                         for h in hits)
+        assert len(hits) == 5, reasons
+        assert any("time.sleep" in r for r in reasons)
+        assert any("queue.get" in r for r in reasons)
+        assert any("RPC" in r for r in reasons)
+        assert any("dump" in r for r in reasons)
+
+    def test_catches_jitted_dispatch_under_lock(self):
+        hits = self._run("""
+            import jax
+            from paddle_tpu.analysis.lockdep import named_lock
+            class S:
+                def __init__(self):
+                    self._lock = named_lock("t9.lock")
+                    self._step = jax.jit(lambda x: x)
+                def work(self, mb):
+                    with self._lock:
+                        self._train_step(mb)
+        """)
+        assert len(hits) == 1 and "jitted dispatch" in hits[0].message
+
+    def test_quiet_on_safe_variants(self):
+        assert not self._run("""
+            import time
+            import queue
+            from paddle_tpu.analysis.lockdep import (named_condition,
+                                                     named_lock)
+            class S:
+                def __init__(self):
+                    self._lock = named_lock("t9.lock")
+                    self._cv = named_condition("t9.cv")
+                    self.q = queue.Queue()
+                def work(self):
+                    time.sleep(0.1)             # not under a lock
+                    with self._lock:
+                        self.q.get(timeout=1.0)  # bounded wait
+                        parts = ",".join(["a"])  # str.join, not Thread
+                    with self._cv:
+                        self._cv.wait(0.2)  # releases its own lock
+        """)
+
+
+# ================================================================= R10
+class TestGuardedBy:
+    def _run(self, src):
+        import paddle_tpu.analysis.lockrules as LK
+        return run_rule(LK.GuardedByRule, src)
+
+    def test_catches_unguarded_mutation(self):
+        hits = self._run("""
+            from paddle_tpu.analysis.lockdep import named_lock
+            class S:
+                def __init__(self):
+                    self._lock = named_lock("t10.lock")
+                    self._items = []  # ptlint: guarded-by(t10.lock)
+                def bad_append(self, x):
+                    self._items.append(x)
+                def bad_assign(self):
+                    self._items = []
+        """)
+        assert len(hits) == 2
+        assert all("guarded-by('t10.lock')" in h.message for h in hits)
+
+    def test_quiet_under_lock_init_and_locked_helpers(self):
+        assert not self._run("""
+            from paddle_tpu.analysis.lockdep import named_lock
+            class S:
+                def __init__(self):
+                    self._lock = named_lock("t10.lock")
+                    self._items = []  # ptlint: guarded-by(t10.lock)
+                def good(self, x):
+                    with self._lock:
+                        self._items.append(x)
+                def _drain_locked(self):
+                    self._items = []     # caller holds it by contract
+                def read(self):
+                    return len(self._items)   # reads are not checked
+        """)
+
+
 # ==================================================== suppressions
 class TestSuppression:
     def test_inline_and_preceding_line_forms(self):

@@ -1,0 +1,295 @@
+"""ptlockdep runtime-witness tests (paddle_tpu/analysis/lockdep.py).
+
+The static half (ptlint R8-R10) is covered fixture-by-fixture in
+tests/test_lint_rules.py; this file proves the RUNTIME half — named
+instrumented locks feeding a global acquisition-order graph with
+inversion detection, contention/hold-time telemetry, the journal /
+flight-recorder integration — and closes with the chaos acceptance:
+the PR 9 coordinator/metrics deadlock shape reconstructed and caught
+BOTH statically (ptlint finds the fixture) and dynamically (the
+witness journals the inversion with both stacks and the flight
+recorder auto-dumps a postmortem bundle), while the SHIPPED code stays
+clean (the tier-1 witness fixture in conftest asserts zero inversions
+for every other test).
+"""
+
+import glob
+import json
+import textwrap
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.analysis.lockdep import (LOCKDEP, InstrumentedLock,
+                                         LockOrderInversion, find_lock,
+                                         named_condition, named_lock,
+                                         named_rlock)
+
+
+# ==================================================== the lock itself
+class TestInstrumentedLock:
+    def test_lock_protocol(self):
+        lk = named_lock("t.basic")
+        assert not lk.locked()
+        with lk:
+            assert lk.locked()
+            assert "t.basic" in LOCKDEP.held_names()
+        assert not lk.locked()
+        assert "t.basic" not in LOCKDEP.held_names()
+        assert lk.acquire(timeout=1.0)  # ptlint: disable=R5(the acquire API under test; released on the next line)
+        lk.release()
+
+    def test_rlock_reentrancy_is_one_witness_entry(self):
+        lk = named_rlock("t.rlock")
+        with lk:
+            with lk:            # reentrant: no self-deadlock
+                # the witness sees ONE outermost acquire, not two
+                # (one graph node per name; nesting is not an edge)
+                assert LOCKDEP.held_names().count("t.rlock") == 1
+            assert lk.locked()
+        assert not lk.locked()
+
+    def test_non_reentrant_lock_refuses_double_acquire(self):
+        lk = named_lock("t.nonreent")
+        with lk:
+            assert not lk.acquire(blocking=False)  # ptlint: disable=R5(non-blocking probe under test; returns False, nothing to release)
+
+    def test_condition_is_a_drop_in(self):
+        cv = named_condition("t.cv")
+        ready = []
+
+        def waiter():
+            with cv:
+                while not ready:
+                    cv.wait(timeout=2.0)
+
+        t = threading.Thread(target=waiter, name="pt-test-cvwait")
+        t.start()
+        time.sleep(0.05)
+        with cv:
+            ready.append(1)
+            cv.notify()
+        t.join(timeout=2.0)
+        assert not t.is_alive()
+        # wait() released and re-acquired through the instrumented
+        # protocol: the held stack is balanced afterwards
+        assert "t.cv" not in LOCKDEP.held_names()
+
+    def test_find_lock_resolves_the_live_instance(self):
+        lk = named_lock("t.findme")
+        assert find_lock("t.findme") is lk
+        assert find_lock("t.no-such-lock") is None
+
+
+# ==================================================== the order graph
+class TestOrderGraph:
+    def test_consistent_order_records_edge_no_inversion(self):
+        a, b = named_lock("t.g.a"), named_lock("t.g.b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert ("t.g.a", "t.g.b", 3) in LOCKDEP.snapshot_edges()
+        assert LOCKDEP.inversion_count == 0
+        assert "t.g.a -> t.g.b" in LOCKDEP.format_text()
+        assert '"t.g.a" -> "t.g.b"' in LOCKDEP.to_dot()
+
+    @pytest.mark.lockdep_allow_inversion
+    def test_opposite_order_is_an_inversion_with_both_stacks(self):
+        a, b = named_lock("t.i.a"), named_lock("t.i.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:                 # closes the cycle
+                pass
+        assert LOCKDEP.inversion_count == 1
+        rec = LOCKDEP.inversions[0]
+        assert rec["acquiring"] == "t.i.a"
+        assert rec["while_holding"] == "t.i.b"
+        assert "t.i.a" in rec["cycle"] and "t.i.b" in rec["cycle"]
+        assert rec["this_stack"] and rec["other_stack"]
+        # journaled under the lockdep domain with the full record
+        from paddle_tpu.obs.events import tail
+        recs = tail(domain="lockdep", kind="inversion")
+        assert recs and recs[-1]["acquiring"] == "t.i.a"
+
+    @pytest.mark.lockdep_allow_inversion
+    def test_inversion_reported_once_per_cycle(self):
+        a, b = named_lock("t.once.a"), named_lock("t.once.b")
+        with a:
+            with b:
+                pass
+        for _ in range(5):
+            with b:
+                with a:
+                    pass
+        assert LOCKDEP.inversion_count == 1
+
+    @pytest.mark.lockdep_allow_inversion
+    def test_raise_mode_raises_into_the_acquiring_thread(self):
+        a, b = named_lock("t.r.a"), named_lock("t.r.b")
+        with a:
+            with b:
+                pass
+        LOCKDEP.configure(on_inversion="raise")
+        try:
+            with pytest.raises(LockOrderInversion, match="t.r.a"):
+                with b:
+                    with a:
+                        pass
+        finally:
+            LOCKDEP.configure(on_inversion="journal")
+
+
+# ======================================================== telemetry
+class TestTelemetry:
+    def test_contention_and_hold_time_counted(self):
+        lk = named_lock("t.tel")
+        entered = threading.Event()
+
+        def holder():
+            with lk:
+                entered.set()
+                # ptlint: disable=R9(deliberate hold: this thread exists to create the contention under test)
+                time.sleep(0.08)
+
+        t = threading.Thread(target=holder, name="pt-test-holder")
+        t.start()
+        assert entered.wait(2.0)
+        with lk:                    # contends with the holder
+            pass
+        t.join(timeout=2.0)
+        snap = LOCKDEP.metrics_snapshot()
+        assert snap["contentions"].get("t.tel", 0) >= 1
+        assert snap["hold_ms"].get("t.tel", 0.0) >= 80.0 * 0.5
+        assert snap["acquisitions"].get("t.tel", 0) >= 2
+
+    def test_hold_lock_fault_injector_drives_contention(self):
+        """faults family (m): hold_lock squats on a live named lock
+        through the _step_interceptor seam, deterministically."""
+        from paddle_tpu.testing.faults import FaultPlan
+
+        class FakeTrainer:
+            _step_interceptor = None
+
+        lk = named_lock("t.faults")
+        target = FakeTrainer()
+        with pytest.raises(KeyError):
+            with FaultPlan.hold_lock(target, "t.faults-typo"):
+                pass
+        with FaultPlan.hold_lock(target, "t.faults", at=1, ms=30,
+                                 n=1) as stats:
+            fired = threading.Event()
+
+            def step_path():
+                for k in range(3):
+                    target._step_interceptor(k, None)
+                fired.set()
+
+            t = threading.Thread(target=step_path,
+                                 name="pt-test-steps")
+            t.start()
+            time.sleep(0.01)
+            with lk:                # contends during firing index 1
+                pass
+            assert fired.wait(2.0)
+            t.join(timeout=2.0)
+        assert stats["injected"] == 1
+        assert stats["held_ms"] >= 30.0 * 0.5
+        assert target._step_interceptor is None      # seam restored
+        assert LOCKDEP.metrics_snapshot()["acquisitions"].get(
+            "t.faults", 0) >= 2
+
+
+# ================================================== chaos acceptance
+#: the PR 9 deadlock, reduced: a coordinator-shaped worker that emits
+#: telemetry while holding its state lock, racing a metrics-shaped
+#: scraper that reads state while holding the metrics lock — the two
+#: threads take {chaos.coord, chaos.metrics} in opposite orders.
+_PR9_FIXTURE = """
+    import time
+    from paddle_tpu.analysis.lockdep import named_lock
+    from paddle_tpu.obs.events import JOURNAL
+    from paddle_tpu.obs.flight import FLIGHT
+
+    class Coordinator:
+        def __init__(self):
+            self._lock = named_lock("chaos.coord")
+
+        def heartbeat(self):
+            with self._lock:
+                # blocking telemetry inside the critical section —
+                # the exact PR 9 bug class (ptlint R9)
+                FLIGHT.maybe_autodump("lease_expired")
+                time.sleep(0.2)
+"""
+
+
+class TestChaosDeadlockWitness:
+    def test_static_twin_flags_the_fixture(self, tmp_path):
+        """ptlint catches the PR 9 shape BEFORE it runs: R9 flags the
+        blocking flight dump + sleep under chaos.coord."""
+        from paddle_tpu.analysis.runner import LintConfig, lint_paths
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "repro.py").write_text(textwrap.dedent(_PR9_FIXTURE))
+        cfg = LintConfig(root=str(tmp_path), paths=["pkg"],
+                         rules=["R9"], baseline="")
+        res = lint_paths(cfg, use_baseline=False)
+        assert len(res.new) == 2, [f.format() for f in res.new]
+        assert all(f.rule == "R9" and "chaos.coord" in f.message
+                   for f in res.new)
+
+    @pytest.mark.lockdep_allow_inversion
+    def test_runtime_witness_catches_and_dumps_the_inversion(
+            self, tmp_path):
+        """The dynamic half: two threads close the coord/metrics cycle;
+        the witness journals lockdep/inversion with BOTH stacks and the
+        armed flight recorder auto-dumps a postmortem bundle."""
+        from paddle_tpu.obs.events import tail
+        from paddle_tpu.obs.flight import FLIGHT
+        FLIGHT.configure(dump_dir=str(tmp_path), min_dump_interval=0.0)
+
+        coord = named_lock("chaos.coord")
+        metrics = named_lock("chaos.metrics")
+
+        def heartbeat():            # coord -> metrics
+            with coord:
+                with metrics:
+                    pass
+
+        def scrape():               # metrics -> coord: the inversion
+            with metrics:
+                # ptlint: disable=R8(the PR 9 order cycle this chaos test exists to provoke)
+                with coord:
+                    pass
+
+        # serialized, NOT interleaved: the witness flags the ORDER
+        # cycle from the acquisition graph alone, without the test
+        # having to win the race into an actual deadlock — that is the
+        # whole point of a lock-order witness
+        t1 = threading.Thread(target=heartbeat, name="pt-test-coord")
+        t1.start()
+        t1.join(timeout=5.0)
+        t2 = threading.Thread(target=scrape, name="pt-test-scrape")
+        t2.start()
+        t2.join(timeout=5.0)
+        assert not t1.is_alive() and not t2.is_alive()
+
+        assert LOCKDEP.inversion_count >= 1
+        rec = LOCKDEP.inversions[0]
+        cyc = {rec["acquiring"], rec["while_holding"]}
+        assert cyc == {"chaos.coord", "chaos.metrics"}
+        assert rec["this_stack"] and rec["other_stack"]
+        assert rec["this_thread"] != rec["other_thread"]
+
+        recs = tail(domain="lockdep", kind="inversion")
+        assert recs and recs[-1]["this_stack"]
+
+        bundles = glob.glob(str(tmp_path / "flight-*lockdep*"))
+        assert bundles, "inversion did not auto-dump a flight bundle"
+        with open(bundles[0], encoding="utf-8") as f:
+            bundle = json.load(f)
+        assert "lockdep_inversion" in bundle["reason"]

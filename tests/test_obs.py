@@ -468,6 +468,61 @@ class TestProfileObservability:
         assert all(r["phase"] == "compute" for r in regs)
 
 
+# --------------------------------------------------- lockdep telemetry
+
+class TestLockdepObservability:
+    def test_lockdep_gauges_always_in_exposition(self):
+        """The witness bridge (obs/metrics._lockdep_bridge) exports the
+        graph size and inversion count on every scrape, even idle."""
+        text = REGISTRY.exposition()
+        assert "# TYPE paddle_tpu_lockdep_edges gauge" in text
+        assert ("# TYPE paddle_tpu_lockdep_inversions_total "
+                "counter") in text
+        assert "paddle_tpu_lockdep_inversions_total 0" in text
+
+    def test_contention_and_hold_time_reach_metrics_and_reset(self):
+        """Driving real contention on a named lock lands the per-name
+        contention/hold-time samples in /metrics exposition, and
+        obs.reset_all (the per-test conftest reset) zeroes them."""
+        import time
+
+        from paddle_tpu.analysis.lockdep import named_lock
+        from paddle_tpu.obs import reset_all
+        lk = named_lock("obs.test.lk")
+        entered = threading.Event()
+
+        def holder():
+            with lk:
+                entered.set()
+                # ptlint: disable=R9(deliberate hold: this thread exists to create the contention under test)
+                time.sleep(0.05)
+
+        t = threading.Thread(target=holder, name="pt-test-obs-holder")
+        t.start()
+        assert entered.wait(2.0)
+        with lk:
+            pass
+        t.join(timeout=2.0)
+
+        text = REGISTRY.exposition()
+        assert ('paddle_tpu_lockdep_contentions_total'
+                '{name="obs.test.lk"} ') in text
+        assert ('paddle_tpu_lockdep_hold_time_ms'
+                '{name="obs.test.lk"} ') in text
+        assert ('paddle_tpu_lockdep_acquisitions_total'
+                '{name="obs.test.lk"} ') in text
+
+        reset_all()
+        # snapshot BEFORE scraping: exposition() itself nests the
+        # registry and family locks, legitimately re-growing the graph
+        from paddle_tpu.analysis.lockdep import LOCKDEP
+        snap = LOCKDEP.metrics_snapshot()
+        assert snap["edges"] == 0 and snap["inversions"] == 0
+        assert "obs.test.lk" not in snap["contentions"]
+        text = REGISTRY.exposition()
+        assert "obs.test.lk" not in text
+
+
 # ------------------------------------------------------------ step tracing
 
 class TestTracing:
