@@ -572,6 +572,7 @@ def bench_moe_lm(batch: int = 8, seq_len: int = 1024, d_model: int = 512,
 #: rows of the CPU smoke tier; tools/bench_gate.py gates them against
 #: BENCH_SMOKE_BASELINE.json in tier-1 (docs/observability.md)
 SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine",
+              "decode_prefix_hit", "decode_speculative",
               "flight_recorder_overhead", "profiler_overhead",
               "lockdep_overhead", "coord_reshard")
 
@@ -713,6 +714,87 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
             "decode_compiles": cw.total,
             "steps": st["steps"] - st0["steps"],
             "tokens_out": gen,
+        }
+    if "decode_prefix_hit" in rows:
+        # ISSUE 13 tentpole (a): warm-prefix TTFT vs cold. The same
+        # prompts run twice through one engine — the first pass
+        # prefills and indexes its pages in the radix trie, the second
+        # attaches the cached pages and feeds only the final token, so
+        # both the step count (deterministic) and the wall time
+        # collapse. ``warm_step_ratio`` and ``hit_pages`` are the
+        # gated metrics: prefix reuse silently breaking drives the
+        # ratio to ~1 and the hits to 0.
+        from paddle_tpu.serving import DecodeEngine
+        dec = _smoke_decoder()
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=32)
+        rng = np.random.RandomState(1)
+        hit_prompts = [rng.randint(0, 40, (13,)).astype("int32")
+                       for _ in range(4)]
+        eng.submit(hit_prompts[0][:2], 1)       # compile + warm
+        eng.run(timeout=300)
+        st0 = eng.stats()
+        t0 = time.perf_counter()
+        cold = [eng.submit(p, 1) for p in hit_prompts]
+        eng.run(timeout=300)
+        dt_cold = time.perf_counter() - t0
+        st1 = eng.stats()
+        t0 = time.perf_counter()
+        warm = [eng.submit(p, 1) for p in hit_prompts]
+        eng.run(timeout=300)
+        dt_warm = time.perf_counter() - t0
+        st2 = eng.stats()
+        for r in cold + warm:
+            r.get(timeout=1)                    # surface failures
+        steps_cold = st1["steps"] - st0["steps"]
+        steps_warm = st2["steps"] - st1["steps"]
+        out["decode_prefix_hit"] = {
+            "ttft_cold_ms": round(dt_cold / len(hit_prompts) * 1e3, 3),
+            "ttft_warm_ms": round(dt_warm / len(hit_prompts) * 1e3, 3),
+            "steps_cold": steps_cold, "steps_warm": steps_warm,
+            "warm_step_ratio": round(steps_cold / max(steps_warm, 1),
+                                     2),
+            "hit_pages": sum(r.prefix_hit_pages for r in warm),
+        }
+    if "decode_speculative" in rows:
+        # ISSUE 13 tentpole (b): speculative decoding with a
+        # same-weights draft (the acceptance best case at smoke
+        # scale) — spec_k proposals verified per [S, W] target step.
+        # ``tokens_per_step`` is the gated acceptance metric: the
+        # ISSUE contract is > 1.0 committed tokens per target
+        # dispatch; a broken verify path degrades it to <= 1.
+        from paddle_tpu.analysis.sanitizer import compile_watch as _cws
+        from paddle_tpu.serving import DecodeEngine
+        dec = _smoke_decoder()
+        draft = _smoke_decoder()
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, 40, (int(rng.randint(3, 8)),))
+                   .astype("int32") for _ in range(decode_requests)]
+        news = [int(rng.randint(6, 12)) for _ in range(decode_requests)]
+        with _cws() as cw:
+            eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                               max_seq_len=32, draft=draft, spec_k=2)
+            eng.submit(prompts[0][:2], 1)       # compile + warm
+            eng.run(timeout=300)
+            st0 = eng.stats()
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, n) for p, n in zip(prompts, news)]
+            eng.run(timeout=300)
+            dt = time.perf_counter() - t0
+        for r in reqs:
+            r.get(timeout=1)
+        st = eng.stats()
+        gen = st["tokens_out"] - st0["tokens_out"]
+        steps = st["steps"] - st0["steps"]
+        out["decode_speculative"] = {
+            "tokens_per_s": round(gen / dt, 1),
+            "tokens_per_step": round(gen / max(steps, 1), 2),
+            "accepted_tokens": st["spec_accepted_tokens"]
+            - st0["spec_accepted_tokens"],
+            "proposed_tokens": st["spec_proposed_tokens"]
+            - st0["spec_proposed_tokens"],
+            "spec_compiles": cw.total,
+            "steps": steps, "tokens_out": gen,
         }
     if "flight_recorder_overhead" in rows:
         # the always-on cost of the flight recorder (obs/flight.py):

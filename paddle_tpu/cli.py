@@ -39,11 +39,13 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 
-def _load_config(path: str) -> Dict[str, Any]:
-    """Execute a .py config (namespace dict) or load a topology .json."""
+def _load_config(path: str, require_cost: bool = True) -> Dict[str, Any]:
+    """Execute a .py config (namespace dict) or load a topology .json.
+    Training configs must define ``cost``; serving decode configs
+    (``require_cost=False``) define ``decoder`` instead."""
     if path.endswith(".py"):
         ns = runpy.run_path(path)
-        if "cost" not in ns:
+        if require_cost and "cost" not in ns:
             raise SystemExit(f"config {path!r} defines no `cost`")
         return ns
     with open(path) as f:
@@ -325,19 +327,51 @@ def _cmd_infer(args) -> int:
     return 0
 
 
+def _build_engine(args):
+    """--decode_config wiring for `paddle_tpu serve`: the config script
+    must define ``decoder`` (a models.TransformerDecoder over merged
+    params); ``--draft_config`` names a second script whose (smaller)
+    ``decoder`` proposes ``--spec_k`` tokens per step, and
+    ``--prefix_cache off`` disables shared-prefix KV reuse. Split from
+    _build_server so tests can assert the flag plumbing without a
+    model artifact (tests/test_cli.py)."""
+    from paddle_tpu.serving.engine import DecodeEngine
+
+    ns = _load_config(args.decode_config, require_cost=False)
+    decoder = ns.get("decoder")
+    if decoder is None:
+        raise SystemExit("--decode_config must define `decoder` "
+                         "(a models.TransformerDecoder)")
+    draft = None
+    if getattr(args, "draft_config", None):
+        dns = _load_config(args.draft_config, require_cost=False)
+        draft = dns.get("draft_decoder") or dns.get("decoder")
+        if draft is None:
+            raise SystemExit("--draft_config must define `decoder` "
+                             "(or `draft_decoder`)")
+    return DecodeEngine(
+        decoder, num_slots=args.gen_slots,
+        page_size=args.gen_page_size,
+        draft=draft, spec_k=args.spec_k,
+        prefix_cache=args.prefix_cache == "on")
+
+
 def _build_server(args, InferenceServer, CircuitBreaker,
-                  build_http_server):
+                  build_http_server, engine_builder=None):
     """serve-flag wiring, split from the signal loop so tests can
     assert the flags reach InferenceServer (tests/test_cli.py)."""
     breaker = CircuitBreaker(window=args.breaker_window,
                              failure_threshold=args.breaker_threshold,
                              cooldown=args.breaker_cooldown)
+    engine = None
+    if getattr(args, "decode_config", None):
+        engine = (engine_builder or _build_engine)(args)
     server = InferenceServer(
         args.model, max_queue=args.max_queue, workers=args.workers,
         default_deadline=(args.deadline_ms / 1e3
                           if args.deadline_ms else None),
         max_batch_memory=args.max_batch_memory or None,
-        breaker=breaker).start()
+        breaker=breaker, engine=engine).start()
     httpd = build_http_server(server, args.host, args.port)
     return server, httpd
 
@@ -863,6 +897,26 @@ def main(argv=None) -> int:
                     help="failure fraction that opens the breaker")
     sv.add_argument("--breaker_cooldown", type=float, default=2.0,
                     help="seconds open before half-open probes")
+    sv.add_argument("--decode_config", default=None,
+                    help=".py script defining `decoder` (a "
+                         "models.TransformerDecoder): attaches the "
+                         "continuous-batching decode engine and the "
+                         "POST /generate route")
+    sv.add_argument("--draft_config", default=None,
+                    help=".py script defining the DRAFT `decoder` for "
+                         "speculative decoding (requires "
+                         "--decode_config and --spec_k >= 1)")
+    sv.add_argument("--spec_k", type=int, default=0,
+                    help="draft tokens proposed per decode step "
+                         "(greedy verify; 0 disables speculation)")
+    sv.add_argument("--prefix_cache", choices=["on", "off"],
+                    default="on",
+                    help="shared-prefix KV page reuse across requests "
+                         "(docs/perf.md 'Prefix reuse')")
+    sv.add_argument("--gen_slots", type=int, default=4,
+                    help="decode engine slot count")
+    sv.add_argument("--gen_page_size", type=int, default=16,
+                    help="KV page size in tokens")
     sv.add_argument("--event_log", default=None,
                     help="append the structured event journal (sheds, "
                          "breaker flips, engine preemptions) to this "

@@ -447,13 +447,16 @@ class TransformerDecoder:
 
     def paged(self, *, num_slots: int, page_size: int,
               num_pages: int, max_pages_per_slot: int,
-              temperature: Optional[float] = None) -> "PagedDecoder":
+              temperature: Optional[float] = None,
+              window: int = 1,
+              attention: str = "auto") -> "PagedDecoder":
         """A fixed-shape paged-KV decode step over this decoder's
         parameter table (the serving engine's hot path)."""
         return PagedDecoder(self, num_slots=num_slots,
                             page_size=page_size, num_pages=num_pages,
                             max_pages_per_slot=max_pages_per_slot,
-                            temperature=temperature)
+                            temperature=temperature, window=window,
+                            attention=attention)
 
     def generate(self, prompt, max_len: int,
                  temperature: Optional[float] = None,
@@ -512,33 +515,67 @@ class PagedDecoder:
     class is only the device step. Physical page 0 is RESERVED as the
     null page: inactive slots write their (discarded) K/V there and
     unassigned page-table entries point at it, which keeps the scatter
-    and gather unconditional — no shape-changing branches."""
+    and gather unconditional — no shape-changing branches.
+
+    ``window`` > 1 widens the step to W tokens PER SLOT per dispatch —
+    one fixed [S, W] shape that serves three schedules with zero extra
+    compiles: multi-token prompt teacher-forcing, the speculative
+    verify window (feed the pending token + k draft proposals, read W
+    argmaxes, accept the token-identical prefix — serving/engine.py),
+    and the classic one-token step (W = 1, or masked columns).
+    In-window causality holds because every window token's K/V is
+    scattered into the pool BEFORE attention and each token's kv_len
+    masks later positions. ``attention`` selects the cache-read path:
+    "gather" (the exact einsum over the full page view), "kernel" (the
+    allocated-pages Pallas kernel — ops/pallas_decode.py), or "auto"
+    (kernel on TPU when supported, gather elsewhere)."""
 
     def __init__(self, dense: TransformerDecoder, *, num_slots: int,
                  page_size: int, num_pages: int,
                  max_pages_per_slot: int,
-                 temperature: Optional[float] = None):
+                 temperature: Optional[float] = None,
+                 window: int = 1, attention: str = "auto"):
         assert num_pages >= 2, "need at least the null page + one real"
         assert max_pages_per_slot * page_size <= \
             dense.p[f"_{dense.name}_pos_emb.w0"].shape[0], (
             "slot capacity exceeds the position table — positions past "
             "it would silently clamp to its last row")
+        assert window >= 1, window
+        assert attention in ("auto", "kernel", "gather"), attention
         self.dense = dense
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.num_pages = int(num_pages)
         self.max_pages_per_slot = int(max_pages_per_slot)
         self.temperature = temperature
+        self.window = int(window)
         n, h = dense.name, dense.n_heads
         d = dense.p[f"_{n}_tok_emb.w0"].shape[1]
         self.head_dim = d // h
         self.kv_heads = dense.p[f"_{n}_l0_k.w0"].shape[1] // self.head_dim
         self.dtype = dense.p[f"_{n}_tok_emb.w0"].dtype
+        from paddle_tpu.ops import pallas_decode as paged_ops
+        probe_q = jax.ShapeDtypeStruct(
+            (self.num_slots, self.window, h, self.head_dim), self.dtype)
+        probe_k = jax.ShapeDtypeStruct(
+            (self.num_pages, self.page_size, self.kv_heads,
+             self.head_dim), self.dtype)
+        on_tpu = jax.default_backend() == "tpu"
+        if attention == "kernel":
+            self.use_kernel = True
+        elif attention == "gather":
+            self.use_kernel = False
+        else:
+            self.use_kernel = on_tpu and \
+                paged_ops.paged_kernel_supported(probe_q, probe_k)
+        self.kernel_interpret = self.use_kernel and not on_tpu
         # donating the pools lets XLA update pages in place (the pools
         # ARE the device memory budget); the CPU backend has no donation
         # and would warn on every dispatch
         donate = () if jax.default_backend() == "cpu" else (1, 2)
         self._step = jax.jit(self._step_impl, donate_argnums=donate)
+        self._copy = jax.jit(self._copy_page_impl,
+                             donate_argnums=() if not donate else (0, 1))
 
     def init_pools(self):
         """Zeroed (k_pool, v_pool), each [L, n_pages, page_size, g, dh]."""
@@ -556,38 +593,50 @@ class PagedDecoder:
         from paddle_tpu.ops import pallas_decode as paged_ops
         d0 = self.dense
         n, h = d0.name, d0.n_heads
+        S, W = x.shape[0], x.shape[1]
         ln1 = _ln(x, p[f"_{n}_l{i}_ln1.w0"], p[f"_{n}_l{i}_ln1.wbias"])
-        q = _heads(ln1 @ p[f"_{n}_l{i}_q.w0"], h)       # [S, 1, h, dh]
+        q = _heads(ln1 @ p[f"_{n}_l{i}_q.w0"], h)       # [S, W, h, dh]
         g = self.kv_heads
-        k = _heads(ln1 @ p[f"_{n}_l{i}_k.w0"], g)[:, 0]  # [S, g, dh]
-        v = _heads(ln1 @ p[f"_{n}_l{i}_v.w0"], g)[:, 0]
-        # unconditional scatter: every slot writes its current token's
-        # K/V at (physical page, in-page offset); inactive slots were
-        # routed to the null page by the caller
-        k_pool = k_pool.at[i, page_idx, offs].set(k.astype(k_pool.dtype))
-        v_pool = v_pool.at[i, page_idx, offs].set(v.astype(v_pool.dtype))
-        attn = paged_ops.paged_attention(q[:, 0], k_pool[i], v_pool[i],
-                                         page_tables, kv_lens)
+        k = _heads(ln1 @ p[f"_{n}_l{i}_k.w0"], g)        # [S, W, g, dh]
+        v = _heads(ln1 @ p[f"_{n}_l{i}_v.w0"], g)
+        # unconditional scatter: every window token writes its K/V at
+        # (physical page, in-page offset) — BEFORE attention, so later
+        # window tokens attend to earlier ones (in-window causality via
+        # each token's kv_len). Masked tokens were routed to the null
+        # page by the caller.
+        k_pool = k_pool.at[i, page_idx.reshape(-1), offs.reshape(-1)
+                           ].set(k.reshape(S * W, g, -1)
+                                 .astype(k_pool.dtype))
+        v_pool = v_pool.at[i, page_idx.reshape(-1), offs.reshape(-1)
+                           ].set(v.reshape(S * W, g, -1)
+                                 .astype(v_pool.dtype))
+        attn = paged_ops.paged_window_attention(
+            q, k_pool[i], v_pool[i], page_tables, kv_lens,
+            use_kernel=self.use_kernel,
+            interpret=self.kernel_interpret)
         x = x + attn.reshape(x.shape) @ p[f"_{n}_l{i}_proj.w0"]
         return d0._ffn(p, i, x), k_pool, v_pool
 
     def _step_impl(self, p, k_pool, v_pool, tokens, positions,
                    page_tables, active, key):
-        """tokens/positions/active [S]; page_tables [S, P] int32 ->
-        (next_tokens [S] int32, k_pool', v_pool')."""
+        """tokens/positions/active [S, W]; page_tables [S, P] int32 ->
+        (next_tokens [S, W] int32, k_pool', v_pool'). Output column w
+        is the model's next-token choice after feeding window tokens
+        0..w — the teacher-forced continuation AND the speculative
+        verify verdict in one read."""
         d0 = self.dense
         ps = self.page_size
-        x = d0._embed(p, tokens[:, None], positions[:, None])  # [S,1,d]
+        x = d0._embed(p, tokens, positions)             # [S, W, d]
         page_idx = jnp.take_along_axis(
-            page_tables, (positions // ps)[:, None], axis=1)[:, 0]
+            page_tables, positions // ps, axis=1)       # [S, W]
         page_idx = jnp.where(active, page_idx, 0)       # null the dead
-        offs = positions % ps
+        offs = jnp.where(active, positions % ps, 0)
         kv_lens = positions + 1
         for i in range(d0.n_layers):
             x, k_pool, v_pool = self._paged_block(
                 p, i, x, k_pool, v_pool, page_idx, offs, page_tables,
                 kv_lens)
-        logits = d0._logits(p, x)[:, 0]                 # [S, V]
+        logits = d0._logits(p, x)                       # [S, W, V]
         if self.temperature is None:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
@@ -596,15 +645,138 @@ class PagedDecoder:
                 self.temperature).astype(jnp.int32)
         return nxt, k_pool, v_pool
 
+    def _copy_page_impl(self, k_pool, v_pool, src, dst):
+        """Device-side page copy (all layers) — the copy-on-write step
+        behind partial-page prefix reuse (serving/prefix.py). src/dst
+        are TRACED int32 scalars, so every (src, dst) pair shares ONE
+        compilation."""
+        L = k_pool.shape[0]
+        tail = k_pool.shape[2:]
+
+        def cp(pool):
+            page = jax.lax.dynamic_slice(
+                pool, (0, src, 0, 0, 0), (L, 1) + tail)
+            return jax.lax.dynamic_update_slice(
+                pool, page, (0, dst, 0, 0, 0))
+
+        return cp(k_pool), cp(v_pool)
+
+    def copy_page(self, k_pool, v_pool, src: int, dst: int):
+        """Copy physical page ``src`` -> ``dst`` in both pools."""
+        return self._copy(k_pool, v_pool, jnp.int32(src),
+                          jnp.int32(dst))
+
     def step(self, k_pool, v_pool, tokens, positions, page_tables,
              active, key=None):
-        """Dispatch one decode step (all arrays already device-shaped;
-        see _step_impl). Compiles exactly once for the engine's
-        lifetime — joins/evictions only change VALUES."""
+        """Dispatch one decode step. Accepts the classic [S] one-token
+        arrays (returns next tokens [S]) or the [S, W] window contract
+        (returns [S, W]). Compiles exactly once for the engine's
+        lifetime — joins/evictions/window occupancy only change
+        VALUES."""
         if key is None:
             key = jax.random.PRNGKey(0)
-        return self._step(self.dense.p, k_pool, v_pool,
+        tokens = jnp.asarray(tokens, jnp.int32)
+        squeeze = tokens.ndim == 1
+        if squeeze:
+            assert self.window == 1, (
+                "one-token [S] arrays only drive a window=1 decoder")
+            tokens = tokens[:, None]
+            positions = jnp.asarray(positions, jnp.int32)[:, None]
+            active = jnp.asarray(active, jnp.bool_)[:, None]
+        nxt, k_pool, v_pool = self._step(
+            self.dense.p, k_pool, v_pool, tokens,
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(page_tables, jnp.int32),
+            jnp.asarray(active, jnp.bool_), key)
+        if squeeze:
+            nxt = nxt[:, 0]
+        return nxt, k_pool, v_pool
+
+
+class DraftDecoder:
+    """The DRAFT side of speculative decoding: a small decoder over
+    slot-PRIVATE dense caches, window-batched like PagedDecoder.
+
+    The draft never shares the paged pool or the prefix trie — each
+    slot owns a [T+1]-row dense cache lane (row T is the null row,
+    mirroring the paged null page), and the engine teacher-forces the
+    slot's committed tokens through it before asking for proposals.
+    That keeps draft-cache coherence trivially correct under prefix
+    hits, CoW, eviction and rejected speculation: the engine only
+    tracks how many committed tokens the draft has FED (draft_pos),
+    rolls it back past rejected proposals, and re-feeds — every cache
+    row is rewritten before any query's kv_len can reach it. Greedy
+    argmax only: proposals must be deterministic for the target's
+    token-identity acceptance rule to compose (serving/engine.py).
+
+    ONE jitted [S, W] step serves catch-up (feed up to W committed
+    tokens) and proposal (feed 1 token, read its argmax) — zero extra
+    compiles under churn, same contract as the target step."""
+
+    def __init__(self, dense: TransformerDecoder, *, num_slots: int,
+                 max_seq_len: int, window: int = 1):
+        pos_rows = dense.p[f"_{dense.name}_pos_emb.w0"].shape[0]
+        assert max_seq_len <= pos_rows, (max_seq_len, pos_rows)
+        self.dense = dense
+        self.num_slots = int(num_slots)
+        self.max_seq_len = int(max_seq_len)
+        self.window = int(window)
+        n, h = dense.name, dense.n_heads
+        d = dense.p[f"_{n}_tok_emb.w0"].shape[1]
+        self.head_dim = d // h
+        self.kv_heads = dense.p[f"_{n}_l0_k.w0"].shape[1] // self.head_dim
+        self.dtype = dense.p[f"_{n}_tok_emb.w0"].dtype
+        donate = () if jax.default_backend() == "cpu" else (1, 2)
+        self._step = jax.jit(self._step_impl, donate_argnums=donate)
+
+    def init_caches(self):
+        """Zeroed (k, v), each [L, S, T+1, g, dh] — row T is the null
+        row masked tokens write to (never read: kv_len <= T)."""
+        shape = (self.dense.n_layers, self.num_slots,
+                 self.max_seq_len + 1, self.kv_heads, self.head_dim)
+        return jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype)
+
+    def cache_bytes(self) -> int:
+        return 2 * int(jnp.dtype(self.dtype).itemsize) * \
+            self.dense.n_layers * self.num_slots * \
+            (self.max_seq_len + 1) * self.kv_heads * self.head_dim
+
+    def _step_impl(self, p, kc, vc, tokens, positions, active):
+        """tokens/positions/active [S, W] -> (argmax [S, W], kc', vc')."""
+        d0 = self.dense
+        n, h, g = d0.name, d0.n_heads, self.kv_heads
+        S, W = tokens.shape
+        T1 = self.max_seq_len + 1
+        rep = h // g
+        rows = jnp.arange(S)[:, None]
+        wpos = jnp.where(active, positions, self.max_seq_len)
+        x = d0._embed(p, tokens, jnp.where(active, positions, 0))
+        kv_lens = positions + 1                          # [S, W]
+        tpos = jnp.arange(T1)
+        mask = tpos[None, None, :] < kv_lens[:, :, None]  # [S, W, T1]
+        for i in range(d0.n_layers):
+            ln1 = _ln(x, p[f"_{n}_l{i}_ln1.w0"],
+                      p[f"_{n}_l{i}_ln1.wbias"])
+            q = _heads(ln1 @ p[f"_{n}_l{i}_q.w0"], h)    # [S, W, h, dh]
+            k = _heads(ln1 @ p[f"_{n}_l{i}_k.w0"], g)
+            v = _heads(ln1 @ p[f"_{n}_l{i}_v.w0"], g)
+            kc = kc.at[i, rows, wpos].set(k.astype(kc.dtype))
+            vc = vc.at[i, rows, wpos].set(v.astype(vc.dtype))
+            dh = q.shape[-1]
+            q5 = q.reshape(S, W, g, rep, dh)
+            logits = jnp.einsum("swgrd,stgd->sgrwt", q5,
+                                kc[i].astype(q.dtype)) * (dh ** -0.5)
+            logits = jnp.where(mask[:, None, None], logits, -1e30)
+            w_ = jax.nn.softmax(logits, axis=-1)
+            attn = jnp.einsum("sgrwt,stgd->swgrd", w_,
+                              vc[i].astype(q.dtype))
+            x = x + attn.reshape(x.shape) @ p[f"_{n}_l{i}_proj.w0"]
+            x = d0._ffn(p, i, x)
+        logits = d0._logits(p, x)                        # [S, W, V]
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), kc, vc
+
+    def step(self, kc, vc, tokens, positions, active):
+        return self._step(self.dense.p, kc, vc,
                           jnp.asarray(tokens, jnp.int32),
                           jnp.asarray(positions, jnp.int32),
-                          jnp.asarray(page_tables, jnp.int32),
-                          jnp.asarray(active, jnp.bool_), key)
+                          jnp.asarray(active, jnp.bool_))

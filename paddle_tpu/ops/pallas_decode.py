@@ -40,7 +40,23 @@ exact einsum formulation above (token-identical to the dense cache by
 construction, pinned in tests/test_paged_decode.py), or composes with
 the recorded-experiment kernel via ``use_kernel=True`` — both paths
 take PER-ROW kv lengths, which is what lets one fixed-shape jitted
-step serve ragged sequences (serving/engine.py)."""
+step serve ragged sequences (serving/engine.py).
+
+Round 9 replaces the gather's traffic profile with
+:func:`paged_window_attention` + the ALLOCATED-PAGES kernel
+(:func:`_paged_window_kernel`): the gather path reads every slot's full
+page-table width (P * page_size positions — ``max_seq_len`` traffic per
+slot per step regardless of actual length), which docs/perf.md "Known
+headroom" names as the decode-roofline lever. The kernel walks the
+page axis with the page table SCALAR-PREFETCHED: the block index map
+clamps the page-axis grid index to the slot's last allocated page, so
+every out-of-range grid step repeats the previous block index and
+Pallas SKIPS the DMA — HBM cache reads scale with the slot's TRUE
+ragged length (rounded up to a page). The query carries a W-token
+verify window per slot (speculative decoding + multi-token prefill,
+serving/engine.py), accumulated with the online-softmax recurrence
+across pages. Parity vs. the gather/einsum reference is pinned in
+tests/test_paged_decode.py (GQA/MQA, ragged lengths, W > 1)."""
 
 from __future__ import annotations
 
@@ -182,3 +198,148 @@ def paged_attention(q, k_pages, v_pages, page_table, kv_lens, *,
     w = jax.nn.softmax(logits, axis=-1)
     attn = jnp.einsum("bgrqk,bkgd->bqgrd", w, v.astype(q.dtype))
     return attn.reshape(b, h, dh)
+
+
+# ------------------------------------------- allocated-pages kernel
+def _paged_window_kernel(tables_ref, used_ref, lens_ref, q_ref, k_ref,
+                         v_ref, out_ref, m_ref, l_ref, acc_ref, *,
+                         scale, rep, page_size, window):
+    """Grid (S, P), page axis fastest. Block p of slot s is the page
+    the CLAMPED index map selected — for p >= used[s] that is the same
+    physical page as step p-1, so Pallas skips the DMA (the
+    allocated-pages traffic contract) and ``pl.when`` skips the math.
+    Online softmax carries (m, l, acc) per (kv group, window row)
+    across the page axis in VMEM scratch."""
+    p = pl.program_id(1)
+    s = pl.program_id(0)
+    used = used_ref[s]
+    g = m_ref.shape[0]
+    wr = m_ref.shape[1]                                # window * rep
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    @pl.when(p < used)
+    def _accumulate():
+        k = k_ref[0].astype(jnp.float32)               # [ps, g, dh]
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)               # [W, h, dh]
+        lens = lens_ref[0]                             # [W] int32
+        # per-token causal/ragged mask against ABSOLUTE positions:
+        # page p covers [p*ps, (p+1)*ps); token w sees < lens[w]
+        lens_rep = jnp.repeat(lens, rep)               # [W*rep]
+        cols = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (wr, page_size), 1)
+        live = cols < lens_rep[:, None]
+        for gi in range(g):
+            kg = k[:, gi, :]                           # [ps, dh]
+            vg = v[:, gi, :]
+            qg = q[:, gi * rep:(gi + 1) * rep, :].reshape(wr, -1)
+            sc = jax.lax.dot_general(
+                qg, kg, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * (scale * LOG2E)
+            sc = jnp.where(live, sc, NEG_INF)          # [wr, ps]
+            m_prev = m_ref[gi]                         # [wr, 1]
+            m_cur = jnp.maximum(m_prev,
+                                jnp.max(sc, axis=1, keepdims=True))
+            alpha = jnp.exp2(m_prev - m_cur)
+            pm = jnp.exp2(sc - m_cur)                  # [wr, ps]
+            l_ref[gi] = l_ref[gi] * alpha + \
+                jnp.sum(pm, axis=1, keepdims=True)
+            acc_ref[gi] = acc_ref[gi] * alpha + jax.lax.dot_general(
+                pm, vg, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[gi] = m_cur
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _finalize():
+        # fully-masked rows (lens 0 never happens live; engine clamps
+        # masked tokens to kv_len >= 1) still divide by a finite l
+        l = jnp.maximum(l_ref[...], 1e-30)             # [g, wr, 1]
+        o = acc_ref[...] / l                           # [g, wr, dh]
+        dh = o.shape[-1]
+        w = wr // rep
+        o = o.reshape(g, w, rep, dh).transpose(1, 0, 2, 3)
+        out_ref[0] = o.reshape(w, g * rep, dh).astype(out_ref.dtype)
+
+
+def paged_kernel_supported(q, k_pages) -> bool:
+    """Gate for the allocated-pages kernel: tile-friendly head dim and
+    a per-page K+V block inside the VMEM budget."""
+    ps, g, dh = k_pages.shape[1:]
+    esize = jnp.dtype(k_pages.dtype).itemsize
+    return dh % 8 == 0 and 2 * ps * g * dh * esize <= _VMEM_BYTES
+
+
+def paged_window_attention(q, k_pages, v_pages, page_tables, kv_lens,
+                           *, scale=None, use_kernel=False,
+                           interpret=False):
+    """Decode attention over the paged pool for a W-token window per
+    slot (W = 1 is the classic one-token step; the speculative engine
+    feeds W = spec_k + 1 — serving/engine.py).
+
+    q [S, W, h, dh]; k_pages/v_pages [n_pages, page_size, g, dh];
+    page_tables [S, P] int32; kv_lens [S, W] int32 per-TOKEN valid
+    lengths (token w of slot s is the query at position
+    kv_lens[s, w] - 1 — the mask is causal within the window too,
+    because earlier window tokens' K/V were scattered before this
+    call). Returns [S, W, h, dh].
+
+    ``use_kernel=False`` flattens the window into the gather/einsum
+    reference (:func:`paged_attention` — exact, reads the full table
+    width). ``use_kernel=True`` runs the allocated-pages Pallas kernel:
+    page tables and per-slot used-page counts are scalar-prefetched,
+    the page-axis block index is clamped to the last allocated page so
+    revisited blocks skip their DMA, and cache-read traffic is
+    ceil(len/page_size) pages instead of P."""
+    S, W, h, dh = q.shape
+    n_pages, ps, g, _ = k_pages.shape
+    P = page_tables.shape[1]
+    assert h % g == 0, (h, g)
+    rep = h // g
+    if scale is None:
+        scale = dh ** -0.5
+    lens = jnp.asarray(kv_lens, jnp.int32).reshape(S, W)
+    if not use_kernel:
+        out = paged_attention(
+            q.reshape(S * W, h, dh), k_pages, v_pages,
+            jnp.repeat(page_tables, W, axis=0), lens.reshape(-1),
+            scale=scale)
+        return out.reshape(S, W, h, dh)
+    # pages actually holding live KV for each slot (>= 1 so the null
+    # page still feeds the pipeline for idle slots)
+    used = jnp.clip(-(-jnp.max(lens, axis=1) // ps), 1, P)
+
+    def _table_map(si, pi, tables, used_):
+        return (tables[si, jnp.minimum(pi, used_[si] - 1)], 0, 0, 0)
+
+    kernel = functools.partial(
+        _paged_window_kernel, scale=scale, rep=rep, page_size=ps,
+        window=W)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, P),
+        in_specs=[
+            pl.BlockSpec((1, W), lambda si, pi, tables, used_: (si, 0)),
+            pl.BlockSpec((1, W, h, dh),
+                         lambda si, pi, tables, used_: (si, 0, 0, 0)),
+            pl.BlockSpec((1, ps, g, dh), _table_map),
+            pl.BlockSpec((1, ps, g, dh), _table_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, W, h, dh),
+            lambda si, pi, tables, used_: (si, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, W * rep, 1), jnp.float32),
+            pltpu.VMEM((g, W * rep, 1), jnp.float32),
+            pltpu.VMEM((g, W * rep, dh), jnp.float32),
+        ])
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, W, h, dh), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(page_tables, jnp.int32), used.astype(jnp.int32),
+      lens, q, k_pages, v_pages)

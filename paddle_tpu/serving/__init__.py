@@ -14,9 +14,11 @@ KV pages — docs/perf.md "Continuous batching"."""
 from paddle_tpu.serving.breaker import CircuitBreaker
 from paddle_tpu.serving.engine import DecodeEngine, GenRequest, PagePool
 from paddle_tpu.serving.http import build_http_server, prometheus_text
+from paddle_tpu.serving.prefix import PrefixIndex, PrefixMatch
 from paddle_tpu.serving.server import (Expired, InferenceServer, Rejected,
                                        ServerClosed, ServingError)
 
 __all__ = ["CircuitBreaker", "InferenceServer", "ServingError",
            "Rejected", "Expired", "ServerClosed", "build_http_server",
-           "prometheus_text", "DecodeEngine", "GenRequest", "PagePool"]
+           "prometheus_text", "DecodeEngine", "GenRequest", "PagePool",
+           "PrefixIndex", "PrefixMatch"]

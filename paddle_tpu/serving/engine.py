@@ -8,32 +8,60 @@ finished sequence's slot idles until the whole batch drains. This
 module is the production loop those papers (Orca's iteration-level
 scheduling, PagedAttention's block-pooled KV) built for serving LLMs:
 
-- ``PagePool``: a host-side free-list over preallocated device page
-  pools ([L, n_pages, page_size, g, dh] — models/decode.PagedDecoder).
-  KV memory is pooled across ALL requests in fixed-size pages, so
-  admission is a pages-free check, not a worst-case-length reservation.
+- ``PagePool``: a host-side REFCOUNTED free-list over preallocated
+  device page pools ([L, n_pages, page_size, g, dh] —
+  models/decode.PagedDecoder). KV memory is pooled across ALL requests
+  in fixed-size pages, so admission is a pages-free check, not a
+  worst-case-length reservation. A page may be owned by several slots
+  AND the prefix trie at once; it returns to the free list only at
+  refcount zero.
 - ``DecodeEngine``: a persistent decode loop over a FIXED slot batch.
-  Each iteration feeds every active slot one token (prompt tokens
-  teacher-forced first — prefill interleaves with other slots'
-  decoding, no whole-batch barrier), dispatches ONE jitted step, and
-  does host-side bookkeeping: requests join free slots mid-flight,
+  Each iteration feeds every active slot a WINDOW of up to W tokens
+  (prompt tokens teacher-forced first — prefill interleaves with other
+  slots' decoding, no whole-batch barrier), dispatches ONE jitted step,
+  and does host-side bookkeeping: requests join free slots mid-flight,
   finished/cancelled/expired requests free their pages immediately, and
-  page-pool exhaustion PREEMPTS the youngest request (pages back to the
-  pool, request re-queued; greedy decode replays prompt + generated
-  tokens, so its final output is unchanged). Joins/evictions only edit
-  small int32 inputs — the step never recompiles.
+  page-pool exhaustion first reclaims cold prefix-cache pages, then
+  PREEMPTS the youngest request (pages back to the pool, request
+  re-queued; greedy decode replays prompt + generated tokens, so its
+  final output is unchanged). Joins/evictions only edit small int32
+  inputs — the step never recompiles.
 - Admission control by FREE KV PAGES: a request that could never fit
   the pool is rejected outright (``kv_capacity``); the queue head only
-  takes a slot when enough pages are free to reach its first new token;
-  the wait queue itself is bounded (``queue_full``).
+  takes a slot when enough NOVEL pages are free to reach its first new
+  token (shared-prefix pages are free to attach); the wait queue
+  itself is bounded (``queue_full``).
+
+Round 9 stacks the three decode-speed multipliers on that loop:
+
+- **Shared-prefix KV reuse** (serving/prefix.py): finished/evicted
+  slots leave their complete pages in a radix index; a new request
+  whose prompt walks the same token path attaches those pages instead
+  of recomputing them — admission charges only novel pages, warm-
+  prefix TTFT drops the whole shared prefill, and divergence inside a
+  page is copy-on-write via ``PagedDecoder.copy_page``.
+- **Speculative decoding** (models/decode.DraftDecoder): a small draft
+  proposes up to k tokens per slot; the target VERIFIES them in the
+  same [S, W] jitted step it uses for prefill (W = spec_k + 1 fixed at
+  construction — zero new compiles under churn). Greedy token-identity
+  is the acceptance rule, so output is token-exact vs. the dense
+  baseline; rejected rows are dead weight the kv_len mask never reads
+  and the next feed overwrites.
+- **Allocated-pages attention** (ops/pallas_decode.py): the paged step
+  walks only each slot's allocated pages on the TPU kernel path,
+  cutting cache reads from ``max_seq_len`` to true ragged lengths.
 
 ``stats()`` exports KV-page occupancy, slot utilization, per-token
-latency percentiles and the scheduling counters; serving/http.py
-re-exports them as Prometheus gauges on GET /metrics. Faults for the
-chaos suite (mid-decode join/evict/cancel, client disconnect) drive the
-``_step_interceptor`` seam — see testing/faults.py (j) and
-tests/test_serving_faults.py. docs/perf.md ("Continuous batching") has
-the measured before/after; docs/robustness.md the fault family.
+latency percentiles, prefix-hit and speculation accounting and the
+scheduling counters; serving/http.py re-exports them as Prometheus
+gauges on GET /metrics, alongside the module-level
+``paddle_tpu_prefix_*`` / ``paddle_tpu_spec_*`` families registered
+here. Faults for the chaos suite (mid-decode join/evict/cancel, CoW
+churn, cancel-mid-verify) drive the ``_step_interceptor`` seam — see
+testing/faults.py (j)+(n) and tests/test_serving_faults.py.
+docs/perf.md ("Continuous batching", "Prefix reuse + speculative
+decoding") has the measured before/after; docs/robustness.md the
+fault families.
 """
 
 from __future__ import annotations
@@ -41,70 +69,141 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from paddle_tpu.obs import context as obs_context
-from paddle_tpu.analysis.lockdep import named_condition
+from paddle_tpu.analysis.lockdep import named_condition, named_lock
 from paddle_tpu.obs.events import emit as journal_emit
 from paddle_tpu.obs.flight import FLIGHT
+from paddle_tpu.obs.metrics import REGISTRY as _METRICS
 from paddle_tpu.obs.profile import PROFILER
+from paddle_tpu.serving.prefix import PrefixIndex
 from paddle_tpu.serving.server import (Expired, Rejected, ServerClosed,
                                        ServingError)
 from paddle_tpu.utils.stats import global_counters, stat_timer
 
+# /metrics families for the round-9 multipliers (idempotent: the
+# registry returns the existing family on re-registration)
+_PREFIX_HIT = _METRICS.counter(
+    "paddle_tpu_prefix_hit_pages",
+    "KV pages attached from the shared-prefix index instead of "
+    "recomputed")
+_PREFIX_MISS = _METRICS.counter(
+    "paddle_tpu_prefix_miss_pages",
+    "prompt pages admitted with no shared-prefix match")
+_PREFIX_COW = _METRICS.counter(
+    "paddle_tpu_prefix_cow_copies",
+    "copy-on-write page copies on intra-page prefix divergence")
+_PREFIX_SHARED = _METRICS.gauge(
+    "paddle_tpu_prefix_shared_pages",
+    "physical pages currently referenced by more than one owner")
+_SPEC_PROPOSED = _METRICS.counter(
+    "paddle_tpu_spec_proposed_tokens_total",
+    "draft-model tokens proposed for target verification")
+_SPEC_ACCEPTED = _METRICS.counter(
+    "paddle_tpu_spec_accepted_tokens_total",
+    "draft proposals the target model accepted (greedy token match)")
+
 
 class PagePool:
-    """Host-side allocator over the device page pools.
+    """Host-side refcounted allocator over the device page pools.
 
     Physical page 0 is RESERVED as the null page (inactive slots write
     there; unassigned page-table entries point there) and is never
-    handed out. ``free()`` double-free / foreign-page checks make page
-    leaks loud — the chaos suite asserts ``leaked == 0`` after every
-    fault storm."""
+    handed out. ``alloc()`` hands a page out at refcount 1; the prefix
+    trie and additional slots take further refs with ``ref()``;
+    ``free()`` decrements and only returns the page to the free list
+    at zero. Freeing a page that holds no refs raises — refcount
+    UNDERFLOWS are as loud as double frees, and the chaos suite
+    asserts ``leaked == 0`` after every fault storm."""
 
     def __init__(self, num_pages: int):
         assert num_pages >= 2, num_pages
         self.num_pages = int(num_pages)
         self.usable = self.num_pages - 1
+        self._lock = named_lock("serving.pagepool")
         # pop() hands out page 1 first — deterministic layouts in tests
         self._free_list = list(range(self.num_pages - 1, 0, -1))
-        self._allocated: set = set()
+        self._allocated: set = set()     # ptlint: guarded-by(serving.pagepool)
+        self._refs: Dict[int, int] = {}  # ptlint: guarded-by(serving.pagepool)
         self.high_water = 0
 
     @property
     def free_pages(self) -> int:
-        return len(self._free_list)
+        with self._lock:
+            return len(self._free_list)
 
     @property
     def used_pages(self) -> int:
-        return len(self._allocated)
+        with self._lock:
+            return len(self._allocated)
+
+    @property
+    def shared_pages(self) -> int:
+        with self._lock:
+            return sum(1 for c in self._refs.values() if c > 1)
 
     def alloc(self) -> Optional[int]:
-        if not self._free_list:
-            return None
-        p = self._free_list.pop()
-        self._allocated.add(p)
-        self.high_water = max(self.high_water, len(self._allocated))
-        return p
+        with self._lock:
+            if not self._free_list:
+                return None
+            p = self._free_list.pop()
+            self._allocated.add(p)
+            self._refs[p] = 1
+            self.high_water = max(self.high_water, len(self._allocated))
+            return p
+
+    def ref(self, page: int) -> None:
+        """Take one more reference on an allocated page (a slot
+        attaching a shared prefix page, the trie indexing a slot's
+        page, a CoW-source pin)."""
+        with self._lock:
+            if page not in self._allocated:
+                raise ValueError(
+                    f"page {page} ref'd but not allocated — the "
+                    "refcount plumbing lost track of it")
+            self._refs[page] += 1
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs.get(page, 0)
+
+    def refcount_histogram(self) -> Dict[int, int]:
+        """{refcount: page count} over allocated pages — the flight
+        bundle's sharing picture."""
+        with self._lock:
+            hist: Dict[int, int] = {}
+            for c in self._refs.values():
+                hist[c] = hist.get(c, 0) + 1
+            return hist
 
     def free(self, pages) -> None:
-        for p in pages:
-            if p not in self._allocated:
-                raise ValueError(
-                    f"page {p} returned to the pool but not allocated "
-                    "— double free or foreign page id")
-            self._allocated.discard(p)
-            self._free_list.append(p)
+        with self._lock:
+            for p in pages:
+                if p not in self._allocated:
+                    raise ValueError(
+                        f"page {p} returned to the pool but not "
+                        "allocated — double free, refcount underflow "
+                        "or foreign page id")
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    del self._refs[p]
+                    self._allocated.discard(p)
+                    self._free_list.append(p)
 
     def accounting(self) -> dict:
-        return {"total_usable": self.usable,
-                "free": self.free_pages,
-                "allocated": self.used_pages,
-                "leaked": self.usable - self.free_pages
-                - self.used_pages,
-                "high_water": self.high_water}
+        with self._lock:
+            return {"total_usable": self.usable,
+                    "free": len(self._free_list),
+                    "allocated": len(self._allocated),
+                    "leaked": self.usable - len(self._free_list)
+                    - len(self._allocated),
+                    "refs_total": sum(self._refs.values()),
+                    "shared": sum(1 for c in self._refs.values()
+                                  if c > 1),
+                    "high_water": self.high_water}
 
 
 class GenRequest:
@@ -116,7 +215,9 @@ class GenRequest:
     stream semantics. Deadline expiry / server shutdown settle with the
     typed serving errors. ``cancel()`` is safe from any thread at any
     time; the engine observes it at the next iteration and returns the
-    request's pages to the pool."""
+    request's pages to the pool. ``prefix_hit_pages`` /
+    ``accepted_tokens`` carry the round-9 per-request accounting into
+    the /generate response (serving/http.py)."""
 
     def __init__(self, prompt, max_new_tokens: int,
                  eos_id: Optional[int], deadline: Optional[float],
@@ -136,6 +237,8 @@ class GenRequest:
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.evictions = 0
+        self.prefix_hit_pages = 0
+        self.accepted_tokens = 0
         self._cancelled = False
 
     @property
@@ -160,7 +263,7 @@ class _Slot:
     """Host bookkeeping for one occupied decode slot."""
 
     __slots__ = ("req", "replay", "pos", "pages", "arrival",
-                 "last_tok", "last_token_t")
+                 "last_tok", "last_token_t", "draft_pos")
 
     def __init__(self, req: GenRequest, arrival: int):
         self.req = req
@@ -173,6 +276,10 @@ class _Slot:
         self.arrival = arrival
         self.last_tok = 0
         self.last_token_t: Optional[float] = None
+        # committed tokens already teacher-forced through the DRAFT
+        # cache lane (speculative decoding); rolled back past rejected
+        # proposals every verify
+        self.draft_pos = 0
 
     def next_input(self) -> int:
         if self.pos < len(self.replay):
@@ -188,8 +295,11 @@ class DecodeEngine:
     parameter table. ``num_pages`` defaults to full capacity (every
     slot can reach ``max_seq_len``) — size it SMALLER to serve more
     slots than worst-case memory would allow and let preemption absorb
-    the tail. Construction is cheap; the single XLA compile happens on
-    the first step.
+    the tail. ``draft``/``spec_k`` turn on speculative decoding (a
+    second, smaller TransformerDecoder proposing ``spec_k`` tokens per
+    step — greedy only); ``prefix_cache`` toggles shared-prefix KV
+    reuse. Construction is cheap; the single XLA compile per jitted
+    function happens on first use.
 
     Drive it synchronously (``step()`` / ``run()`` — deterministic, the
     test/bench mode) or as a background thread (``start()`` /
@@ -201,31 +311,53 @@ class DecodeEngine:
                  max_waiting: int = 64,
                  temperature: Optional[float] = None,
                  latency_window: int = 2048,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 draft=None, spec_k: int = 0,
+                 prefix_cache: bool = True,
+                 attention: str = "auto"):
         pos_rows = decoder.p[f"_{decoder.name}_pos_emb.w0"].shape[0]
         if max_seq_len is None:
             max_seq_len = pos_rows
         self.max_seq_len = min(int(max_seq_len), pos_rows)
         self.page_size = int(page_size)
         self.num_slots = int(num_slots)
+        self.spec_k = max(int(spec_k), 0) if draft is not None else 0
+        if self.spec_k and temperature is not None:
+            raise ValueError(
+                "speculative decoding is greedy-only: the acceptance "
+                "rule is token identity, which sampling breaks")
+        # W = spec_k + 1: one pending token + k proposals per dispatch.
+        # Fixed at construction so churn never changes the jitted shape.
+        self.window = 1 + self.spec_k
         pages_per_slot = -(-self.max_seq_len // self.page_size)
         if num_pages is None:
             num_pages = self.num_slots * pages_per_slot + 1
         self.paged = decoder.paged(
             num_slots=self.num_slots, page_size=self.page_size,
             num_pages=int(num_pages),
-            max_pages_per_slot=pages_per_slot, temperature=temperature)
+            max_pages_per_slot=pages_per_slot, temperature=temperature,
+            window=self.window, attention=attention)
         self.pool = PagePool(int(num_pages))
         self.k_pool, self.v_pool = self.paged.init_pools()
+        self.prefix: Optional[PrefixIndex] = (
+            PrefixIndex(self.pool, self.page_size) if prefix_cache
+            else None)
+        self.draft = None
+        if draft is not None and self.spec_k > 0:
+            from paddle_tpu.models.decode import DraftDecoder
+            self.draft = DraftDecoder(
+                draft, num_slots=self.num_slots,
+                max_seq_len=self.max_seq_len, window=self.window)
+            self._draft_kc, self._draft_vc = self.draft.init_caches()
         self.max_waiting = int(max_waiting)
         self.temperature = temperature
         self._clock = clock
-        S, P = self.num_slots, pages_per_slot
+        S, P, W = self.num_slots, pages_per_slot, self.window
         self.slots: List[Optional[_Slot]] = [None] * S
-        self._tokens = np.zeros((S,), np.int32)
-        self._positions = np.zeros((S,), np.int32)
+        self._tokens = np.zeros((S, W), np.int32)
+        self._positions = np.zeros((S, W), np.int32)
         self._tables = np.zeros((S, P), np.int32)
-        self._active = np.zeros((S,), np.bool_)
+        self._active = np.zeros((S, W), np.bool_)
         self._waiting: deque = deque()  # ptlint: guarded-by(serving.engine)
         self._cv = named_condition("serving.engine")
         self._accepting = True
@@ -243,7 +375,13 @@ class DecodeEngine:
                           "expired": 0, "preemptions": 0,
                           "rejected_queue": 0, "rejected_capacity": 0,
                           "closed": 0, "step_failures": 0,
-                          "tokens_out": 0, "prefill_tokens": 0}
+                          "tokens_out": 0, "prefill_tokens": 0,
+                          "prefix_hit_pages": 0, "prefix_miss_pages": 0,
+                          "prefix_cow_copies": 0,
+                          "prefix_evicted_pages": 0,
+                          "spec_proposed_tokens": 0,
+                          "spec_accepted_tokens": 0,
+                          "draft_failures": 0}
         import jax
         self._key0 = jax.random.PRNGKey(0)
         # live-state provider for postmortem bundles: the slot table
@@ -265,9 +403,14 @@ class DecodeEngine:
             with eng._cv:
                 waiting = [r.trace_id for r in eng._waiting]
                 steps = eng._steps
+            # prefix summary AFTER _cv release: lock order is
+            # engine -> prefix -> pagepool, never held together here
+            prefix = eng.prefix.summary() \
+                if eng.prefix is not None else None
             return {"slots": slots, "waiting_trace_ids": waiting,
                     "steps": steps,
-                    "pages": eng.pool.accounting()}
+                    "pages": eng.pool.accounting(),
+                    "prefix": prefix}
 
         FLIGHT.register_state_provider(f"engine-{id(self):x}",
                                        _flight_state)
@@ -373,17 +516,32 @@ class DecodeEngine:
         req.finished_at = self._clock()
         req.done.set()
 
+    def _index_slot_pages(self, slot: _Slot) -> None:
+        """Leave the slot's COMPLETE teacher-forced pages behind in the
+        prefix index (finish AND evict paths). Only rows the slot has
+        actually FED are covered — ``seq[:pos]`` excludes rejected
+        speculation rows and the not-yet-fed pending token."""
+        if self.prefix is None or not slot.pages:
+            return
+        seq = slot.req.prompt + slot.req.tokens
+        self.prefix.insert(seq[:slot.pos], slot.pages)
+
     def _finish(self, s: int, state: str,
                 error: Optional[ServingError] = None) -> None:
-        """Release slot ``s``: pages back to the pool FIRST (the no-leak
-        invariant), then settle the request."""
+        """Release slot ``s``: pages to the prefix index then back to
+        the pool FIRST (the no-leak invariant), then settle the
+        request."""
         slot = self.slots[s]
+        if state in ("done", "cancelled"):
+            # failed/closed slots may hold garbage KV (step failure) —
+            # never index those pages
+            self._index_slot_pages(slot)
         self.pool.free(slot.pages)
         slot.pages = []
         self._tables[s, :] = 0
-        self._active[s] = False
-        self._tokens[s] = 0
-        self._positions[s] = 0
+        self._active[s, :] = False
+        self._tokens[s, :] = 0
+        self._positions[s, :] = 0
         self.slots[s] = None
         counter = {"done": "finished", "cancelled": "cancelled",
                    "failed": "failed", "closed": "closed"}.get(state)
@@ -406,14 +564,17 @@ class DecodeEngine:
             self._cv.notify_all()
 
     def _evict(self, s: int) -> None:
-        """Preempt slot ``s``: pages to the pool, request back to the
+        """Preempt slot ``s``: complete pages into the prefix index
+        (re-admission walks them right back — preemption cost shrinks
+        to the incomplete tail), refs to the pool, request back to the
         FRONT of the wait queue (it keeps its generated tokens and
         replays them on re-admission — greedy output is unchanged)."""
         slot = self.slots[s]
+        self._index_slot_pages(slot)
         self.pool.free(slot.pages)
         slot.pages = []
         self._tables[s, :] = 0
-        self._active[s] = False
+        self._active[s, :] = False
         self.slots[s] = None
         req = slot.req
         req.state = "waiting"
@@ -462,41 +623,125 @@ class DecodeEngine:
                     keep.append(req)
             self._waiting = keep
 
+    def _alloc_page(self) -> Optional[int]:
+        """One page from the pool, reclaiming cold prefix-cache leaves
+        (LRU, trie-only refcount) when the free list is dry — the trie
+        gives pages back BEFORE any running request is preempted."""
+        page = self.pool.alloc()
+        while page is None and self.prefix is not None:
+            freed = self.prefix.evict_lru(1)
+            if not freed:
+                return None
+            self._counters["prefix_evicted_pages"] += len(freed)
+            journal_emit("engine", "prefix_evict", pages=freed,
+                         free_pages=self.pool.free_pages,
+                         engine_step=self._steps)
+            page = self.pool.alloc()
+        return page
+
+    def _attach_prefix(self, s: int, slot: _Slot, match) -> None:
+        """Wire a PrefixMatch into slot ``s``: one slot ref per shared
+        page, copy-on-write for an intra-page divergence, and the
+        slot's feed position jumps past every matched token."""
+        req = slot.req
+        for p in match.pages:
+            self.pool.ref(p)
+            slot.pages.append(p)
+        matched = match.matched
+        if match.cow is not None:
+            src, rows = match.cow
+            # pin the source: the dst alloc below may reclaim trie
+            # leaves, and the source IS a refcount-1 leaf right now
+            self.pool.ref(src)
+            dst = self._alloc_page()
+            if dst is not None:
+                try:
+                    self.k_pool, self.v_pool = self.paged.copy_page(
+                        self.k_pool, self.v_pool, src, dst)
+                except Exception as e:  # pools rebuilt on next dispatch
+                    self.pool.free([dst])
+                    journal_emit("engine", "cow_copy_failure",
+                                 error=repr(e)[:200],
+                                 trace_id=req.trace_id)
+                else:
+                    slot.pages.append(dst)
+                    matched += rows
+                    self._counters["prefix_cow_copies"] += 1
+                    _PREFIX_COW.inc()
+                    if self.prefix is not None:
+                        self.prefix.cow_hits += 1
+            self.pool.free([src])       # unpin
+        for j, p in enumerate(slot.pages):
+            self._tables[s, j] = p
+        slot.pos = matched
+        hit = len(match.pages)
+        miss = self._pages_for(len(slot.replay)) - hit
+        self._counters["prefix_hit_pages"] += hit
+        self._counters["prefix_miss_pages"] += max(miss, 0)
+        if hit:
+            _PREFIX_HIT.inc(hit)
+        if miss > 0:
+            _PREFIX_MISS.inc(miss)
+        if self.prefix is not None:
+            self.prefix.hit_pages += hit
+            self.prefix.miss_pages += max(miss, 0)
+        req.prefix_hit_pages = hit
+        if matched:
+            FLIGHT.record("mark", "engine/prefix_attach",
+                          trace_id=req.trace_id, slot=s,
+                          shared_pages=hit, matched_tokens=matched,
+                          cow=match.cow is not None)
+
     def _admit(self) -> None:
         """Waiting -> free slots, gated on FREE PAGES: the queue head
-        takes a slot only when the pool can carry it to its first new
-        token (pages allocate lazily after that; preemption is the
-        backstop when concurrent growth outruns the pool)."""
+        takes a slot only when the pool can carry its NOVEL pages to
+        its first new token — shared-prefix pages cost nothing, and
+        reclaimable trie leaves count as free (minus the pages this
+        very match would pin)."""
         with self._cv:
             for s in range(self.num_slots):
                 if self.slots[s] is not None or not self._waiting:
                     continue
                 req = self._waiting[0]
-                need_now = self._pages_for(len(req.prompt)
-                                           + len(req.tokens) + 1)
-                if need_now > self.pool.free_pages:
+                replay = req.prompt + req.tokens
+                match = self.prefix.match(replay) \
+                    if self.prefix is not None else None
+                shared = len(match.pages) if match is not None else 0
+                need_now = self._pages_for(len(replay) + 1) - shared
+                avail = self.pool.free_pages
+                if self.prefix is not None:
+                    avail += max(
+                        0, self.prefix.reclaimable_pages() - shared)
+                if need_now > avail:
                     break              # page-aware: head waits for pages
                 self._waiting.popleft()
                 req.state = "running"
                 self._arrival_seq += 1
-                self.slots[s] = _Slot(req, self._arrival_seq)
+                slot = _Slot(req, self._arrival_seq)
+                self.slots[s] = slot
+                if match is not None and \
+                        (match.pages or match.cow is not None):
+                    self._attach_prefix(s, slot, match)
                 FLIGHT.record("mark", "engine/admit",
                               trace_id=req.trace_id, slot=s,
-                              replay=len(req.prompt) + len(req.tokens))
+                              replay=len(replay),
+                              prefix_tokens=slot.pos)
 
-    def _ensure_pages(self) -> None:
-        """Allocate each active slot's next page at its page boundary;
-        on pool exhaustion preempt the YOUNGEST slot (LIFO — oldest
-        requests keep their progress) until the allocation succeeds."""
+    def _ensure_pages(self, plan: Dict[int, List[int]]) -> None:
+        """Allocate each planned slot's pages through the LAST position
+        its window will write; on pool exhaustion reclaim trie leaves
+        first, then preempt the YOUNGEST slot (LIFO — oldest requests
+        keep their progress) until the allocation succeeds."""
         for s in sorted(
                 (i for i in range(self.num_slots)
-                 if self.slots[i] is not None),
+                 if self.slots[i] is not None and i in plan),
                 key=lambda i: self.slots[i].arrival):
             slot = self.slots[s]
             if slot is None:           # evicted by an earlier iteration
                 continue
-            while len(slot.pages) * self.page_size <= slot.pos:
-                page = self.pool.alloc()
+            last = slot.pos + len(plan[s]) - 1
+            while len(slot.pages) * self.page_size <= last:
+                page = self._alloc_page()
                 if page is None:
                     victims = sorted(
                         (i for i in range(self.num_slots)
@@ -510,29 +755,130 @@ class DecodeEngine:
                 slot.pages.append(page)
                 self._tables[s, len(slot.pages) - 1] = page
 
+    # ----------------------------------------------------------- speculation
+    def _draft_propose(self, active_idx: List[int]) -> Dict[int, List[int]]:
+        """Run the draft model for up to spec_k proposals per caught-up
+        slot: bounded rounds of the draft's own [S, W] jitted step,
+        each round teacher-forcing committed tokens the draft hasn't
+        seen (up to W per round) or chaining one proposal. Slots still
+        prefilling the TARGET are skipped — their draft lanes catch up
+        across later steps at W tokens a round."""
+        if self.draft is None:
+            return {}
+        S, W = self.num_slots, self.window
+        props: Dict[int, List[int]] = {}
+        want: Dict[int, int] = {}
+        for s in active_idx:
+            slot = self.slots[s]
+            if slot.pos < len(slot.replay) - 1:
+                continue               # target still prefilling
+            req = slot.req
+            seq_len = len(req.prompt) + len(req.tokens)
+            k_eff = min(self.spec_k,
+                        req.max_new - req.num_generated - 1,
+                        self.max_seq_len - 1 - slot.pos,
+                        self.max_seq_len + 1 - seq_len)
+            if k_eff > 0:
+                props[s] = []
+                want[s] = k_eff
+        if not props:
+            return {}
+        toks = np.zeros((S, W), np.int32)
+        poss = np.zeros((S, W), np.int32)
+        act = np.zeros((S, W), np.bool_)
+        for _ in range(self.spec_k + 2):
+            toks[:, :] = 0
+            poss[:, :] = 0
+            act[:, :] = False
+            fed: Dict[int, int] = {}   # slot -> tokens fed this round
+            for s, got in props.items():
+                slot = self.slots[s]
+                if len(got) >= want[s]:
+                    continue
+                seq = slot.req.prompt + slot.req.tokens
+                dp = slot.draft_pos
+                if dp < len(seq):      # catch-up: feed committed chunk
+                    c = min(W, len(seq) - dp)
+                    toks[s, :c] = seq[dp:dp + c]
+                else:                  # chain: feed the last proposal
+                    c = 1
+                    toks[s, 0] = got[-1]
+                poss[s, :c] = np.arange(dp, dp + c)
+                act[s, :c] = True
+                fed[s] = c
+            if not fed:
+                break
+            try:
+                out, self._draft_kc, self._draft_vc = self.draft.step(
+                    self._draft_kc, self._draft_vc, toks, poss, act)
+                out = np.asarray(out)
+            # ptlint: disable=R7(draft failures must not kill the serving loop — the target path continues unassisted)
+            except Exception as e:
+                self._counters["draft_failures"] += 1
+                journal_emit("engine", "draft_failure",
+                             error=repr(e)[:400],
+                             engine_step=self._steps)
+                self._draft_kc, self._draft_vc = \
+                    self.draft.init_caches()
+                for s in props:
+                    if self.slots[s] is not None:
+                        self.slots[s].draft_pos = 0
+                return {}
+            for s, c in fed.items():
+                slot = self.slots[s]
+                seq_len = len(slot.req.prompt) + len(slot.req.tokens)
+                slot.draft_pos += c
+                if slot.draft_pos >= seq_len:
+                    # the last fed row predicts the next token: the
+                    # first/next proposal in the chain
+                    props[s].append(int(out[s, c - 1]))
+        return {s: p for s, p in props.items() if p}
+
     # ------------------------------------------------------------- the loop
     def step(self) -> bool:
-        """One engine iteration: reap, admit, page-ensure, ONE jitted
-        dispatch, bookkeep. Returns True iff a device step ran.
-        Single-threaded by contract: the engine thread in serving mode,
-        the caller in sync mode."""
+        """One engine iteration: reap, admit, draft-propose, window-
+        plan, page-ensure, ONE jitted target dispatch, bookkeep.
+        Returns True iff a device step ran. Single-threaded by
+        contract: the engine thread in serving mode, the caller in
+        sync mode."""
         interceptor = self._step_interceptor
         if interceptor is not None:
             interceptor(self._steps)
         now = self._clock()
         self._reap(now)
         self._admit()
-        self._ensure_pages()
         active_idx = [s for s in range(self.num_slots)
                       if self.slots[s] is not None]
         if not active_idx:
             return False
-        self._active[:] = False
+        props = self._draft_propose(active_idx)
+        # window plan: a replay chunk (multi-token prefill) or the
+        # pending token + the draft's proposals (speculative verify)
+        W = self.window
+        plan: Dict[int, List[int]] = {}
         for s in active_idx:
             slot = self.slots[s]
-            self._tokens[s] = slot.next_input()
-            self._positions[s] = slot.pos
-            self._active[s] = True
+            if slot.pos < len(slot.replay) - 1:
+                wlen = min(W, len(slot.replay) - slot.pos)
+                plan[s] = slot.replay[slot.pos:slot.pos + wlen]
+            else:
+                p_s = props.get(s, [])[:W - 1]
+                room = self.max_seq_len - 1 - slot.pos
+                plan[s] = [slot.next_input()] + p_s[:max(room, 0)]
+        self._ensure_pages(plan)
+        live = [s for s in active_idx
+                if self.slots[s] is not None and s in plan]
+        if not live:
+            return False
+        self._active[:, :] = False
+        self._tokens[:, :] = 0
+        self._positions[:, :] = 0
+        for s in live:
+            slot = self.slots[s]
+            w = len(plan[s])
+            self._tokens[s, :w] = plan[s]
+            self._positions[s, :w] = np.arange(slot.pos, slot.pos + w)
+            self._active[s, :w] = True
         key = self._key0
         if self.temperature is not None:
             import jax
@@ -550,48 +896,97 @@ class DecodeEngine:
         t_after = self._clock()
         with self._cv:
             self._steps += 1
-            self._active_steps_sum += len(active_idx)
+            self._active_steps_sum += len(live)
         if PROFILER.enabled:
             PROFILER.on_step("decode")
-        for s in active_idx:
+        for s in live:
             slot = self.slots[s]
+            toks = plan[s]
+            w = len(toks)
             fed = slot.pos
-            slot.pos += 1
+            req = slot.req
             # one compact flight record per slot-step: the "each decode
             # step" link of the request's trace chain — a postmortem
             # bundle reconstructs the request's whole schedule from
             # these by trace_id (tests/test_flight.py acceptance)
             FLIGHT.record("mark", "engine/slot_step",
-                          trace_id=slot.req.trace_id,
-                          engine_step=self._steps, slot=s, pos=fed)
+                          trace_id=req.trace_id,
+                          engine_step=self._steps, slot=s, pos=fed,
+                          width=w)
             with self._cv:
-                self._cache_tokens_read += slot.pos
+                self._cache_tokens_read += sum(
+                    fed + j + 1 for j in range(w))
             if fed < len(slot.replay) - 1:
+                # replay chunk: all rows teacher-forced; the last row
+                # commits one token iff it reached the replay tail
+                commits = []
+                n_prefill = min(w, len(slot.replay) - 1 - fed)
                 with self._cv:
-                    self._counters["prefill_tokens"] += 1
+                    self._counters["prefill_tokens"] += n_prefill
+                slot.pos = fed + w
+                if fed + w == len(slot.replay):
+                    commits = [int(nxt[s, w - 1])]
+            else:
+                # speculative verify: outs[j] is the target's choice
+                # after feeding tokens 0..j. Proposal j (toks[j+1]) is
+                # accepted iff it IS that choice; the first rejection
+                # ends the run and its row becomes dead weight the
+                # kv_len mask never reads.
+                m = w - 1
+                outs = [int(nxt[s, j]) for j in range(w)]
+                commits = [outs[0]]
+                a = 0
+                while a < m and toks[a + 1] == commits[-1]:
+                    commits.append(outs[a + 1])
+                    a += 1
+                if m:
+                    with self._cv:
+                        self._counters["spec_proposed_tokens"] += m
+                        self._counters["spec_accepted_tokens"] += a
+                    _SPEC_PROPOSED.inc(m)
+                    if a:
+                        _SPEC_ACCEPTED.inc(a)
+                    req.accepted_tokens += a
+                seq_before = len(req.prompt) + len(req.tokens)
+                slot.draft_pos = min(slot.draft_pos, seq_before + a)
+            if not commits:
                 continue
-            tok = int(nxt[s])
-            req = slot.req
+            done = False
+            n_commit = 0
             with self._cv:
                 if req.first_token_at is None:
                     req.first_token_at = t_after
                     self._ttft.append(t_after - req.submitted_at)
-                if slot.last_token_t is not None:
-                    self._lat.append(t_after - slot.last_token_t)
+                dt = (t_after - slot.last_token_t) \
+                    if slot.last_token_t is not None else None
                 slot.last_token_t = t_after
-                req.tokens.append(tok)
-                slot.last_tok = tok
-                self._counters["tokens_out"] += 1
-            global_counters.bump("serving/decode_tokens")
-            if (req.eos_id is not None and tok == req.eos_id) or \
-                    req.num_generated >= req.max_new:
+                for tok in commits:
+                    req.tokens.append(tok)
+                    slot.last_tok = tok
+                    n_commit += 1
+                    self._counters["tokens_out"] += 1
+                    if (req.eos_id is not None and tok == req.eos_id) \
+                            or req.num_generated >= req.max_new:
+                        done = True
+                        break
+                if dt is not None:
+                    for _ in range(n_commit):
+                        self._lat.append(dt / n_commit)
+            global_counters.bump("serving/decode_tokens", n_commit)
+            if fed >= len(slot.replay) - 1:
+                # keep only the fed rows that match the committed
+                # sequence: pending token + (n_commit - 1) accepted
+                slot.pos = fed + n_commit
+                slot.draft_pos = min(slot.draft_pos, fed + n_commit)
+            if done:
                 self._finish(s, "done")
         return True
 
     def _recover_from_step_failure(self, exc: Exception) -> None:
         """A failed dispatch may have consumed the (donated) pools:
         settle everything in flight with a typed error, then rebuild
-        pools + free-list so fresh traffic can still be served."""
+        pools + free-list + prefix index + draft caches so fresh
+        traffic can still be served."""
         in_flight = [self.slots[s].req.trace_id
                      for s in range(self.num_slots)
                      if self.slots[s] is not None]
@@ -611,8 +1006,15 @@ class DecodeEngine:
                 self._settle(req, "failed", err)
         self.k_pool, self.v_pool = self.paged.init_pools()
         self.pool = PagePool(self.pool.num_pages)
+        if self.prefix is not None:
+            # the trie indexed pages of the DEAD pool: forget them all
+            # and repoint at the rebuilt allocator
+            self.prefix.reset()
+            self.prefix.pool = self.pool
+        if self.draft is not None:
+            self._draft_kc, self._draft_vc = self.draft.init_caches()
         self._tables[:, :] = 0
-        self._active[:] = False
+        self._active[:, :] = False
         # journaled AFTER the typed settles so the auto-dumped bundle
         # (obs/flight.py trigger) contains each victim's COMPLETE chain
         # — submit → admit → every slot_step → settle(failed) — plus
@@ -707,12 +1109,15 @@ class DecodeEngine:
         return s[idx]
 
     def page_accounting(self) -> dict:
-        """Pool truth vs slot holdings — the chaos suite's no-leak
-        assertion reads ``leaked`` (== 0 always) and cross-checks
-        ``held_by_slots`` == ``allocated``."""
+        """Pool truth vs slot + trie holdings — the chaos suite's
+        no-leak assertion reads ``leaked`` (== 0 always) and
+        cross-checks ``refs_total`` == ``held_by_slots`` +
+        ``held_by_trie`` (zero refcount underflows)."""
         acc = self.pool.accounting()
         acc["held_by_slots"] = sum(
             len(s.pages) for s in self.slots if s is not None)
+        acc["held_by_trie"] = self.prefix.page_count() \
+            if self.prefix is not None else 0
         return acc
 
     def stats(self) -> dict:
@@ -726,6 +1131,8 @@ class DecodeEngine:
             cache_read = self._cache_tokens_read
         active = sum(1 for s in self.slots if s is not None)
         util = (active_sum / (steps * self.num_slots)) if steps else 0.0
+        shared = self.pool.shared_pages
+        _PREFIX_SHARED.set(shared)
         out = dict(counters)
         out.update({
             "slots": self.num_slots,
@@ -735,8 +1142,13 @@ class DecodeEngine:
             "kv_pages_total": self.pool.usable,
             "kv_pages_free": self.pool.free_pages,
             "kv_pages_used": self.pool.used_pages,
+            "kv_pages_shared": shared,
             "kv_page_high_water": self.pool.high_water,
             "page_size": self.page_size,
+            "window": self.window,
+            "spec_k": self.spec_k,
+            "prefix_nodes": self.prefix.page_count()
+            if self.prefix is not None else 0,
             "steps": steps,
             "active_slot_steps": active_sum,
             "cache_tokens_read": cache_read,

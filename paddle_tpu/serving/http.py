@@ -27,8 +27,13 @@ Endpoints:
                                  "max_new_tokens": int,
                                  "eos_id": optional,
                                  "deadline_ms": optional}
-                           200 {"tokens": [int...]} — routed through
-                           the continuous-batching decode engine
+                           200 {"tokens": [int...],
+                                "prefix_hit_pages": int,
+                                "accepted_tokens": int} — routed
+                           through the continuous-batching decode
+                           engine; the two extra fields report KV
+                           pages reused from the shared-prefix cache
+                           and draft tokens the target accepted
                            (501 when no engine is attached)
 
 Every /infer and /generate request gets ONE trace_id at this front —
@@ -74,6 +79,10 @@ _COUNTER_KEYS = {
     "rejected_queue", "rejected_capacity", "step_failures",
     "tokens_out", "prefill_tokens", "steps", "cache_tokens_read",
     "trips",
+    # round-9 prefix-cache / speculative-decoding counters
+    "prefix_hit_pages", "prefix_miss_pages", "prefix_cow_copies",
+    "prefix_evicted_pages", "spec_proposed_tokens",
+    "spec_accepted_tokens", "draft_failures",
 }
 
 
@@ -198,10 +207,11 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
             hdr = [("X-Trace-Id", tid)]
             try:
                 with obs_context.bind(trace_id=tid):
-                    toks = server.generate(prompt, max_new,
-                                           eos_id=eos_id,
-                                           deadline=deadline,
-                                           trace_id=tid)
+                    gen = server.submit_generate(prompt, max_new,
+                                                 eos_id=eos_id,
+                                                 deadline=deadline,
+                                                 trace_id=tid)
+                    toks = gen.get()
             except Rejected as e:
                 code = 429 if e.reason == "queue_full" else 503
                 self._json(code, {"error": str(e), "reason": e.reason,
@@ -224,6 +234,8 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                            headers=hdr)
                 return
             self._json(200, {"tokens": [int(t) for t in toks],
+                             "prefix_hit_pages": gen.prefix_hit_pages,
+                             "accepted_tokens": gen.accepted_tokens,
                              "trace_id": tid}, headers=hdr)
 
         def do_POST(self):

@@ -1,0 +1,268 @@
+"""Shared-prefix KV reuse: a page-granularity radix index over the
+paged pool (the vLLM/SGLang prefix-cache design on the PR-6 engine).
+
+Every request that finishes (or is evicted) leaves its COMPLETE KV
+pages behind in a trie keyed by page-size token runs: node ``(t_0..t_{ps-1})
+-> (t_ps..)`` holds the physical page whose rows are the teacher-forced
+K/V of exactly those tokens at exactly those positions. A later request
+whose prompt walks the same token path ATTACHES those pages instead of
+recomputing them — admission charges only the *novel* pages, and the
+attached prefill steps disappear (the ``decode_prefix_hit`` bench row
+measures warm vs. cold TTFT).
+
+Correctness leans on two invariants:
+
+- KV is a pure function of (token run, positions): pages are only
+  inserted for fully teacher-forced token runs starting at position 0,
+  so an attached page is bit-identical to what the slot would have
+  written itself — greedy token-identity is preserved by construction
+  (pinned in tests/test_paged_decode.py).
+- Attached pages are never written: a slot admitted with ``matched``
+  tokens starts scattering at position ``matched``, which lands in its
+  first PRIVATE page. Divergence INSIDE a page is handled by
+  copy-on-write: the shared page is device-copied into a fresh page
+  (PagedDecoder.copy_page — one compile, traced src/dst) and the match
+  extends to the common rows of the copy.
+
+Ownership is reference counting in :class:`~paddle_tpu.serving.engine.PagePool`:
+the trie holds ONE ref per indexed page, every slot using it holds
+another; ``free()`` only returns a page to the free list at refcount
+zero, and ``page_accounting()`` extends the zero-leak invariant to
+``refs_total == held_by_slots + held_by_trie`` (the chaos suite's
+zero-underflow assertion — tests/test_serving_faults.py family (n)).
+
+Under pool pressure the engine reclaims least-recently-used LEAF nodes
+(refcount 1 — trie-only) BEFORE preempting a running slot, journaled as
+``engine/prefix_evict``. All trie state is guarded by the named
+``serving.prefix`` InstrumentedLock (analysis/lockdep.py): mutation
+happens on the engine's stepping thread, but stats()/flight providers
+read from arbitrary threads. Lock order is engine -> prefix -> pagepool
+(never the reverse), witnessed by the autouse lockdep fixture.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from paddle_tpu.analysis.lockdep import named_lock
+
+__all__ = ["PrefixIndex", "PrefixMatch"]
+
+
+class _Node:
+    __slots__ = ("key", "page", "parent", "children", "last_used")
+
+    def __init__(self, key, page, parent):
+        self.key = key                   # page_size token tuple
+        self.page = page                 # physical page id (None: root)
+        self.parent = parent
+        self.children: Dict[tuple, "_Node"] = {}
+        self.last_used = 0
+
+
+class PrefixMatch:
+    """One lookup's result: ``pages`` are fully-shared physical pages
+    (in logical order), ``matched`` counts their tokens, and ``cow``
+    (when set) is ``(physical_page, rows)`` — the best partially-
+    matching child page whose first ``rows`` tokens agree, a
+    copy-on-write candidate."""
+
+    __slots__ = ("pages", "matched", "cow")
+
+    def __init__(self, pages: List[int], matched: int,
+                 cow: Optional[Tuple[int, int]]):
+        self.pages = pages
+        self.matched = matched
+        self.cow = cow
+
+
+class PrefixIndex:
+    """Radix/trie index of shared KV pages (see module doc). All
+    public methods take the named ``serving.prefix`` lock; the engine
+    calls the mutators from its stepping thread only."""
+
+    def __init__(self, pool, page_size: int):
+        self.pool = pool
+        self.page_size = int(page_size)
+        self._lock = named_lock("serving.prefix")
+        # the radix trie + LRU clock  # ptlint: guarded-by(serving.prefix)
+        self._root = _Node(None, None, None)
+        self._seq = 0                  # ptlint: guarded-by(serving.prefix)
+        self._nodes = 0                # ptlint: guarded-by(serving.prefix)
+        self.hit_pages = 0
+        self.miss_pages = 0
+        self.cow_hits = 0
+        self.inserted_pages = 0
+        self.evicted_pages = 0
+
+    # --------------------------------------------------------------- lookup
+    def match(self, tokens) -> PrefixMatch:
+        """Longest shared-page walk for ``tokens`` (prompt + replayed
+        generation). The match is capped at ``len(tokens) - 1`` so the
+        slot always has at least one token left to feed — the step
+        needs a query to produce the next token."""
+        ps = self.page_size
+        toks = [int(t) for t in tokens]
+        limit = len(toks) - 1
+        pages: List[int] = []
+        cow = None
+        with self._lock:
+            node = self._root
+            i = 0
+            while i + ps <= limit:
+                child = node.children.get(tuple(toks[i:i + ps]))
+                if child is None:
+                    break
+                self._seq += 1
+                child.last_used = self._seq
+                pages.append(child.page)
+                node = child
+                i += ps
+            # partial-page (copy-on-write) candidate: the child sharing
+            # the longest leading token run inside the next page
+            best = 0
+            best_child = None
+            remaining = toks[i:]
+            for key, child in node.children.items():
+                j = 0
+                cap = min(ps, limit - i)
+                while j < cap and key[j] == remaining[j]:
+                    j += 1
+                if j > best:
+                    best = j
+                    best_child = child
+                    cow = (child.page, j)
+            if best_child is not None:
+                self._seq += 1
+                best_child.last_used = self._seq
+        return PrefixMatch(pages, i, cow)
+
+    # -------------------------------------------------------------- insert
+    def insert(self, tokens, pages: List[int]) -> int:
+        """Register the COMPLETE pages of a finished/evicted slot's
+        teacher-forced token run. ``pages`` is the slot's physical page
+        list (logical order). Existing nodes are touched, novel ones
+        take one pool ref each. Returns the number of new nodes."""
+        ps = self.page_size
+        toks = [int(t) for t in tokens]
+        new = 0
+        with self._lock:
+            node = self._root
+            i = 0
+            while i + ps <= len(toks) and i // ps < len(pages):
+                key = tuple(toks[i:i + ps])
+                child = node.children.get(key)
+                if child is None:
+                    page = pages[i // ps]
+                    self.pool.ref(page)
+                    child = _Node(key, page, node)
+                    node.children[key] = child
+                    self._nodes += 1
+                    new += 1
+                self._seq += 1
+                child.last_used = self._seq
+                node = child
+                i += ps
+            self.inserted_pages += new
+        return new
+
+    # ------------------------------------------------------------ eviction
+    def evict_lru(self, n: int = 1) -> List[int]:
+        """Free up to ``n`` least-recently-used LEAF pages whose only
+        owner is the trie (pool refcount 1). Returns the freed physical
+        pages — inner nodes become leaves as their children go, so a
+        caller looping this reclaims whole cold branches."""
+        freed: List[int] = []
+        with self._lock:
+            while len(freed) < n:
+                victim = None
+                stack = [self._root]
+                while stack:
+                    nd = stack.pop()
+                    if nd.page is not None and not nd.children and \
+                            self.pool.refcount(nd.page) == 1:
+                        if victim is None or \
+                                nd.last_used < victim.last_used:
+                            victim = nd
+                    stack.extend(nd.children.values())
+                if victim is None:
+                    break
+                del victim.parent.children[victim.key]
+                self._nodes -= 1
+                self.pool.free([victim.page])
+                freed.append(victim.page)
+            self.evicted_pages += len(freed)
+        return freed
+
+    def reclaimable_pages(self) -> int:
+        """Pages an eviction loop could eventually return to the free
+        list: trie pages no slot is also holding (refcount 1)."""
+        with self._lock:
+            count = 0
+            stack = [self._root]
+            while stack:
+                nd = stack.pop()
+                if nd.page is not None and \
+                        self.pool.refcount(nd.page) == 1:
+                    count += 1
+                stack.extend(nd.children.values())
+            return count
+
+    # ------------------------------------------------------------ lifecycle
+    def flush(self) -> int:
+        """Drop the whole index, returning every trie ref to the pool
+        (shared pages stay allocated for the slots still holding them).
+        Returns the number of dropped nodes."""
+        with self._lock:
+            dropped = self._collect_pages()
+            for page in dropped:
+                self.pool.free([page])
+            n = self._nodes
+            self._root = _Node(None, None, None)
+            self._nodes = 0
+        return n
+
+    def reset(self) -> None:
+        """Forget every node WITHOUT touching the pool — the step-
+        failure recovery path, where the engine has already rebuilt the
+        PagePool from scratch."""
+        with self._lock:
+            self._root = _Node(None, None, None)
+            self._nodes = 0
+
+    def _collect_pages(self) -> List[int]:
+        pages = []
+        stack = [self._root]
+        while stack:
+            nd = stack.pop()
+            if nd.page is not None:
+                pages.append(nd.page)
+            stack.extend(nd.children.values())
+        return pages
+
+    # ------------------------------------------------------------ snapshots
+    def page_count(self) -> int:
+        with self._lock:
+            return self._nodes
+
+    def summary(self) -> dict:
+        """Flight-bundle / stats() view: trie shape + cumulative hit
+        accounting + the pool's refcount histogram."""
+        with self._lock:
+            depth = 0
+            stack = [(self._root, 0)]
+            while stack:
+                nd, d = stack.pop()
+                depth = max(depth, d)
+                stack.extend((c, d + 1) for c in nd.children.values())
+            return {
+                "nodes": self._nodes,
+                "pages": self._nodes,
+                "max_depth_pages": depth,
+                "hit_pages": self.hit_pages,
+                "miss_pages": self.miss_pages,
+                "cow_copies": self.cow_hits,
+                "inserted_pages": self.inserted_pages,
+                "evicted_pages": self.evicted_pages,
+                "refcount_histogram": self.pool.refcount_histogram(),
+            }

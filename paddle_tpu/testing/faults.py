@@ -94,6 +94,23 @@ and, for lock discipline (docs/static_analysis.md "Lock discipline"):
       contention/hold-time telemetry and the lockdep order graph can be
       driven on demand.
 
+and, for prefix-cache / speculative decoding (docs/robustness.md
+"Prefix reuse & speculation"):
+
+  (n) drive COPY-ON-WRITE and trie-eviction churn against the prefix-
+      cached engine — ``divergent_twins`` submits request pairs whose
+      prompts share a prefix but diverge INSIDE a KV page (every
+      admission after the first takes the CoW path),
+      ``prefix_evict_storm`` joins waves of distinct-prefix requests
+      until admission must reclaim LRU trie leaves (journaled
+      ``engine/prefix_evict``), and ``cancel_mid_verify`` is a
+      decode_script fragment cancelling a request between a draft
+      proposal round and its verify dispatch. The invariants every
+      storm must preserve: zero page leaks AND zero refcount
+      underflows (``page_accounting()``), and every surviving request
+      token-exact vs the dense reference
+      (tests/test_serving_faults.py family (n) acceptance);
+
 Everything is deterministic given the seed and the schedule, so a chaos
 test that fails replays exactly. See ``tests/test_faults.py`` and
 ``tests/test_serving_faults.py`` for the tests that drive these against
@@ -506,6 +523,69 @@ class FaultPlan:
                              name="pt-fault-disconnect")
         t.start()
         return t
+
+    # ------------------------------------- (n) prefix-cache / CoW chaos
+    def divergent_twins(self, engine, *, diverge_at: Optional[int] = None,
+                        tail: int = 3, max_new: int = 4,
+                        pairs: int = 2, vocab: int = 32):
+        """Submit ``pairs`` request pairs sharing a ``diverge_at``-token
+        prompt prefix that splits INSIDE a KV page (default: mid-page
+        of the engine's second page) — every admission after the first
+        walks the radix index and exercises the copy-on-write path.
+        Returns ``[(request, prompt), ...]``; drive the engine, then
+        assert each settled output token-exact vs the dense reference
+        and ``page_accounting()`` zero leaks / zero underflows."""
+        rng = np.random.RandomState(self.seed)
+        ps = engine.page_size
+        if diverge_at is None:
+            diverge_at = ps + max(1, ps // 2)   # mid-page, page 1
+        shared = [int(t) for t in rng.randint(0, vocab, diverge_at)]
+        out = []
+        for _ in range(2 * pairs):
+            prompt = shared + [int(t)
+                               for t in rng.randint(0, vocab, tail)]
+            out.append((engine.submit(prompt, max_new), prompt))
+        return out
+
+    def prefix_evict_storm(self, engine, *, waves: int = 4,
+                           per_wave: int = 2, gap: int = 3,
+                           prompt_len: int = 8, max_new: int = 3,
+                           vocab: int = 32):
+        """Join ``per_wave`` requests with DISTINCT prompts every
+        ``gap`` engine steps: finished requests stack their pages into
+        the radix index until admission must reclaim LRU trie leaves
+        (journaled ``engine/prefix_evict``) before any slot preemption.
+        The first wave submits immediately (so ``run()`` has work);
+        later waves are a decode_script schedule. Returns
+        ``(schedule, submitted)`` — ``submitted`` fills with
+        ``(request, prompt)`` as waves fire; run the engine under
+        ``decode_script(engine, schedule)``."""
+        rng = np.random.RandomState(self.seed + 1)
+        submitted: list = []
+
+        def fire():
+            for _ in range(per_wave):
+                prompt = [int(t)
+                          for t in rng.randint(0, vocab, prompt_len)]
+                submitted.append((engine.submit(prompt, max_new),
+                                  prompt))
+
+        schedule: Dict[int, Callable] = {
+            w * gap: fire for w in range(1, waves)}
+        fire()
+        return schedule, submitted
+
+    @staticmethod
+    def cancel_mid_verify(request, at: int = 2) -> Dict[int, Callable]:
+        """A decode_script fragment cancelling ``request`` immediately
+        before engine step ``at`` dispatches — with speculation on, the
+        cancel lands BETWEEN a draft proposal round and the target's
+        verify of those proposals: the engine must reap it before the
+        next dispatch, return every page (and shared-prefix ref) to
+        the pool, and leave the other slots' outputs token-exact.
+        Merge into a larger schedule or pass straight to
+        ``decode_script``."""
+        return {int(at): request.cancel}
 
     # ------------------------------------- (l) performance stragglers
     @staticmethod

@@ -10,6 +10,7 @@ as long as no computation ran yet).
 """
 
 import os
+import tempfile
 
 if os.environ.get("PADDLE_TPU_SMOKE"):
     # real-hardware lane (tests/test_tpu_smoke.py): keep the default
@@ -25,6 +26,24 @@ else:
     import jax  # noqa: E402
 
     jax.config.update("jax_platforms", "cpu")
+
+    # Persistent XLA compile cache: scores of tests rebuild the same tiny
+    # models, and every fresh jit wrapper re-pays the identical XLA
+    # compile — the dominant share of tier-1 wall clock. The disk cache
+    # is keyed by HLO hash, so it dedupes within one run as well as
+    # across runs. Cache HITS still log "Compiling <name>", so
+    # compile_watch / recompile_budget counts are unaffected.
+    # Deliberately process-local (jax.config, NOT env): the SIGKILL
+    # chaos tests time their kills against a worker subprocess's
+    # compile-dominated startup, so spawned workers must stay cold.
+    # PADDLE_TPU_COMPILE_CACHE=0 disables; any other value overrides
+    # the directory.
+    _cache_dir = os.environ.get("PADDLE_TPU_COMPILE_CACHE") or \
+        os.path.join(tempfile.gettempdir(), "paddle_tpu_xla_cache")
+    if _cache_dir != "0":
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.05)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

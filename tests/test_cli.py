@@ -408,6 +408,129 @@ class TestObservabilityFlags:
         JOURNAL.configure(None)
 
 
+class TestDecodeEngineFlags:
+    """ISSUE 13 satellite: serve --decode_config/--draft_config/
+    --spec_k/--prefix_cache wiring down to DecodeEngine."""
+
+    DEC_SRC = (
+        "import jax\n"
+        "import paddle_tpu as paddle\n"
+        "from paddle_tpu import models\n"
+        "from paddle_tpu.core.registry import reset_name_counters\n"
+        "paddle.init(use_tpu=False, seed=0)\n"
+        "reset_name_counters()\n"
+        "spec = models.transformer_lm(vocab_size=40, d_model=16,\n"
+        "                             n_heads=2, n_layers=2, d_ff=32,\n"
+        "                             max_len=32)\n"
+        "costs = (spec.cost if isinstance(spec.cost, list)\n"
+        "         else [spec.cost])\n"
+        "topo = paddle.Topology(costs, extra_outputs=[spec.output])\n"
+        "params = topo.init_params(jax.random.PRNGKey({seed}))\n"
+        "{name} = models.TransformerDecoder(params, n_layers=2,\n"
+        "                                   n_heads=2)\n")
+
+    def test_serve_flags_parse_with_defaults(self, monkeypatch):
+        from paddle_tpu import cli
+        seen = {}
+        monkeypatch.setattr(cli, "_cmd_serve",
+                            lambda args: seen.update(vars(args)) or 0)
+        assert cli.main(["serve", "--model", "m.tar"]) == 0
+        assert seen["decode_config"] is None
+        assert seen["draft_config"] is None
+        assert seen["spec_k"] == 0
+        assert seen["prefix_cache"] == "on"
+        assert seen["gen_slots"] == 4 and seen["gen_page_size"] == 16
+        assert cli.main(["serve", "--model", "m.tar",
+                        "--decode_config", "dec.py",
+                         "--draft_config", "draft.py",
+                         "--spec_k", "3", "--prefix_cache", "off",
+                         "--gen_slots", "2",
+                         "--gen_page_size", "8"]) == 0
+        assert seen["decode_config"] == "dec.py"
+        assert seen["draft_config"] == "draft.py"
+        assert seen["spec_k"] == 3
+        assert seen["prefix_cache"] == "off"
+        assert seen["gen_slots"] == 2 and seen["gen_page_size"] == 8
+
+    def test_build_server_attaches_engine_via_builder(self):
+        import argparse
+
+        from paddle_tpu import cli
+
+        class FakeServer:
+            def __init__(self, model, **kw):
+                self.kw = kw
+
+            def start(self):
+                return self
+
+        class FakeBreaker:
+            def __init__(self, **kw):
+                pass
+
+        sentinel = object()
+        built = []
+
+        def builder(a):
+            built.append(a)
+            return sentinel
+
+        ns = argparse.Namespace(
+            model="m.tar", max_queue=8, workers=1, deadline_ms=0,
+            max_batch_memory=0, breaker_window=4,
+            breaker_threshold=0.5, breaker_cooldown=1.0,
+            host="127.0.0.1", port=0, decode_config="dec.py")
+        server, _ = cli._build_server(
+            ns, FakeServer, FakeBreaker, lambda *a: None,
+            engine_builder=builder)
+        assert built == [ns]
+        assert server.kw["engine"] is sentinel
+        # no --decode_config -> no engine construction at all
+        ns2 = argparse.Namespace(
+            model="m.tar", max_queue=8, workers=1, deadline_ms=0,
+            max_batch_memory=0, breaker_window=4,
+            breaker_threshold=0.5, breaker_cooldown=1.0,
+            host="127.0.0.1", port=0)
+        server2, _ = cli._build_server(
+            ns2, FakeServer, FakeBreaker, lambda *a: None,
+            engine_builder=builder)
+        assert server2.kw["engine"] is None and len(built) == 1
+
+    def test_build_engine_from_config_scripts(self, tmp_path):
+        import argparse
+
+        from paddle_tpu import cli
+        dec = tmp_path / "dec.py"
+        dec.write_text(self.DEC_SRC.format(seed=7, name="decoder"))
+        dr = tmp_path / "draft.py"
+        dr.write_text(self.DEC_SRC.format(seed=11,
+                                          name="draft_decoder"))
+        ns = argparse.Namespace(
+            decode_config=str(dec), draft_config=str(dr), spec_k=2,
+            prefix_cache="on", gen_slots=2, gen_page_size=4)
+        eng = cli._build_engine(ns)
+        st = eng.stats()
+        assert st["slots"] == 2 and st["page_size"] == 4
+        assert st["spec_k"] == 2 and st["window"] == 3
+        assert eng.prefix is not None
+        # prefix off + no draft: classic one-token window
+        ns2 = argparse.Namespace(
+            decode_config=str(dec), draft_config=None, spec_k=2,
+            prefix_cache="off", gen_slots=2, gen_page_size=4)
+        eng2 = cli._build_engine(ns2)
+        st2 = eng2.stats()
+        assert eng2.prefix is None
+        assert st2["spec_k"] == 0 and st2["window"] == 1
+        # a config without `decoder` is a typed CLI error
+        bad = tmp_path / "bad.py"
+        bad.write_text("x = 1\n")
+        ns3 = argparse.Namespace(
+            decode_config=str(bad), draft_config=None, spec_k=0,
+            prefix_cache="on", gen_slots=2, gen_page_size=4)
+        with pytest.raises(SystemExit):
+            cli._build_engine(ns3)
+
+
 class TestFlightCLI:
     """ISSUE 8 satellites: `obs selfcheck`/`obs dump`, `events tail
     --follow`, and the serve/train flight/run_id flag wiring."""

@@ -32,6 +32,18 @@ from paddle_tpu.utils.stats import global_counters
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _no_persistent_compile_cache():
+    """OOM injection races the allocator against executables that the
+    persistent compile cache (tests/conftest.py) would deserialize from
+    disk; keep this module on freshly-compiled executables."""
+    import jax
+    prev = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", prev)
+
+
 def _trainer(lr=0.05):
     from paddle_tpu.core import registry
     registry.reset_name_counters()     # identical auto-names per build

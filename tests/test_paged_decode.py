@@ -51,6 +51,18 @@ def _ragged(rng, n, lo=3, hi=9):
             for _ in range(n)]
 
 
+def _balanced(eng):
+    """Zero leaks, zero refcount drift. With the prefix cache on (the
+    default) a drained engine parks finished pages in the trie, so the
+    balance is free + trie-held == usable and refs == slots + trie."""
+    acc = eng.page_accounting()
+    assert acc["leaked"] == 0
+    assert acc["free"] + acc["held_by_trie"] == acc["total_usable"]
+    assert acc["refs_total"] == \
+        acc["held_by_slots"] + acc["held_by_trie"]
+    return acc
+
+
 class TestPagedAttentionUnit:
     """ops/pallas_decode.paged_attention vs a straight dense reference,
     including GQA widths, per-row ragged lengths, and the composition
@@ -133,6 +145,66 @@ class TestPagedAttentionUnit:
                                        rtol=2e-5, atol=2e-6)
 
 
+class TestPagedWindowKernel:
+    """Round 9 allocated-pages kernel (ops/pallas_decode.py
+    paged_window_attention) vs the gather/einsum reference: W-token
+    verify windows, GQA/MQA widths, ragged lengths whose trailing
+    page-table entries the clamped index map must never read."""
+
+    @pytest.mark.parametrize("h,g", [(4, 4), (4, 2), (4, 1)])
+    def test_window_parity_gqa(self, h, g):
+        from paddle_tpu.ops.pallas_decode import paged_window_attention
+        rng = np.random.RandomState(3)
+        S, W, dh, ps, npages = 3, 3, 8, 4, 12
+        k_pages = rng.randn(npages, ps, g, dh).astype(np.float32)
+        v_pages = rng.randn(npages, ps, g, dh).astype(np.float32)
+        q = rng.randn(S, W, h, dh).astype(np.float32)
+        # out-of-order physical pages; rows past the allocation point
+        # are the null page and must be SKIPPED, not gathered
+        tables = np.array([[3, 1, 7, 0, 0],
+                           [2, 9, 4, 11, 8],
+                           [5, 6, 0, 0, 0]], np.int32)
+        base = np.array([9, 15, 5], np.int32)     # ragged, mid-page
+        lens = (base[:, None] + np.arange(W)[None, :]).astype(np.int32)
+        args = [jax.numpy.asarray(a) for a in
+                (q, k_pages, v_pages, tables, lens)]
+        want = np.asarray(paged_window_attention(*args))
+        got = np.asarray(paged_window_attention(
+            *args, use_kernel=True, interpret=True))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_w1_matches_paged_attention(self):
+        """W = 1 is the classic one-token step — same numbers as the
+        round-6 paged_attention path."""
+        from paddle_tpu.ops.pallas_decode import (paged_attention,
+                                                  paged_window_attention)
+        rng = np.random.RandomState(4)
+        S, h, g, dh, ps, npages = 2, 4, 2, 8, 4, 8
+        k_pages = jax.numpy.asarray(
+            rng.randn(npages, ps, g, dh).astype(np.float32))
+        v_pages = jax.numpy.asarray(
+            rng.randn(npages, ps, g, dh).astype(np.float32))
+        q = jax.numpy.asarray(rng.randn(S, h, dh).astype(np.float32))
+        tables = jax.numpy.asarray(
+            np.array([[1, 4, 2, 0], [3, 5, 0, 0]], np.int32))
+        lens = jax.numpy.asarray(np.array([10, 7], np.int32))
+        want = np.asarray(
+            paged_attention(q, k_pages, v_pages, tables, lens))
+        got = np.asarray(paged_window_attention(
+            q[:, None], k_pages, v_pages, tables, lens[:, None],
+            use_kernel=True, interpret=True))[:, 0]
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_kernel_gate(self):
+        from paddle_tpu.ops.pallas_decode import paged_kernel_supported
+        q = jax.numpy.zeros((2, 2, 4, 8), np.float32)
+        k = jax.numpy.zeros((8, 4, 2, 8), np.float32)
+        assert paged_kernel_supported(q, k)
+        # head dim off the sublane multiple -> fall back to XLA
+        k_odd = jax.numpy.zeros((8, 4, 2, 6), np.float32)
+        assert not paged_kernel_supported(q, k_odd)
+
+
 class TestPagePool:
     def test_alloc_free_accounting(self):
         pool = PagePool(8)              # 7 usable, page 0 reserved
@@ -147,7 +219,7 @@ class TestPagePool:
         pool.free(pages[3:])
         assert pool.accounting() == {
             "total_usable": 7, "free": 7, "allocated": 0, "leaked": 0,
-            "high_water": 7, }
+            "refs_total": 0, "shared": 0, "high_water": 7, }
 
     def test_double_free_is_loud(self):
         pool = PagePool(4)
@@ -157,6 +229,51 @@ class TestPagePool:
             pool.free([p])
         with pytest.raises(ValueError):
             pool.free([99])
+
+    def test_refcounted_sharing(self):
+        """Round 9: alloc() hands a page out at refcount 1, ref() adds
+        holders (shared-prefix attach / trie indexing), and free() only
+        returns the page to the free list at zero."""
+        pool = PagePool(5)
+        p = pool.alloc()
+        assert pool.refcount(p) == 1 and pool.shared_pages == 0
+        pool.ref(p)
+        pool.ref(p)
+        assert pool.refcount(p) == 3 and pool.shared_pages == 1
+        pool.free([p])                      # one holder lets go
+        assert pool.refcount(p) == 2
+        assert pool.used_pages == 1         # still allocated
+        pool.free([p, p])                   # last holders release
+        assert pool.refcount(p) == 0
+        assert pool.free_pages == pool.usable
+        acc = pool.accounting()
+        assert acc["leaked"] == 0 and acc["refs_total"] == 0
+
+    def test_refcount_underflow_is_loud(self):
+        """Freeing past zero is indistinguishable from a lost page —
+        both raise rather than silently corrupting shared KV."""
+        pool = PagePool(5)
+        p = pool.alloc()
+        pool.ref(p)
+        pool.free([p, p])
+        with pytest.raises(ValueError, match="underflow|double free"):
+            pool.free([p])
+        with pytest.raises(ValueError, match="not allocated"):
+            pool.ref(p)                     # ref after full release
+
+    def test_refcount_histogram(self):
+        pool = PagePool(8)
+        a, b, c = pool.alloc(), pool.alloc(), pool.alloc()
+        pool.ref(b)
+        pool.ref(c)
+        pool.ref(c)
+        assert pool.refcount_histogram() == {1: 1, 2: 1, 3: 1}
+        assert pool.accounting()["refs_total"] == 6
+        assert pool.accounting()["shared"] == 2
+        pool.free([b, c, c])
+        assert pool.refcount_histogram() == {1: 3}
+        pool.free([a, b, c])
+        assert pool.refcount_histogram() == {}
 
 
 class TestTokenIdentity:
@@ -183,8 +300,7 @@ class TestTokenIdentity:
         eng.run(timeout=300)
         for i, r in enumerate(reqs):
             assert r.get(timeout=1) == [int(t) for t in want[i]], i
-        acc = eng.page_accounting()
-        assert acc["leaked"] == 0 and acc["free"] == acc["total_usable"]
+        _balanced(eng)
         st = eng.stats()
         assert st["finished"] == len(prompts)
         assert st["tokens_out"] == sum(max_news)
@@ -256,8 +372,7 @@ class TestTokenIdentity:
         req = eng.submit(prompt, 12, eos_id=int(eos))
         eng.run(timeout=120)
         assert req.get(timeout=1) == [int(t) for t in dense_trim]
-        assert eng.page_accounting()["free"] == \
-            eng.page_accounting()["total_usable"]
+        _balanced(eng)
 
 
 class TestScheduling:
@@ -344,6 +459,203 @@ class TestScheduling:
         assert len(big.get(timeout=1)) == 4
         assert len(rival.get(timeout=1)) == 4
         assert eng.page_accounting()["leaked"] == 0
+
+
+class TestSpeculativeDecoding:
+    """ISSUE 13 tentpole (b): a draft model proposes spec_k tokens per
+    round and the target verifies them in ONE fixed-shape [S, W] paged
+    step. Greedy token-identity acceptance means the OUTPUT never
+    depends on the draft — only the step count does."""
+
+    def test_same_weights_draft_multi_token_commits(self):
+        params = _model()
+        dec = _decoder(params)
+        rng = np.random.RandomState(5)
+        prompts = _ragged(rng, 3, lo=3, hi=8)
+        max_news = [10, 8, 12]
+        want = _dense_rows(dec, prompts, max_news)
+        eng = DecodeEngine(dec, num_slots=3, page_size=4,
+                           max_seq_len=CFG["max_len"],
+                           draft=_decoder(_model()), spec_k=2)
+        reqs = [eng.submit(p, mn) for p, mn in zip(prompts, max_news)]
+        eng.run(timeout=300)
+        for i, r in enumerate(reqs):
+            assert r.get(timeout=1) == [int(t) for t in want[i]], i
+        st = eng.stats()
+        assert st["window"] == 3 and st["spec_k"] == 2
+        assert st["spec_proposed_tokens"] > 0
+        assert st["spec_accepted_tokens"] > 0
+        # a perfect draft makes multi-token commits the norm: strictly
+        # more tokens out than target dispatches (accepted/step > 1)
+        assert st["tokens_out"] > st["steps"]
+        assert sum(r.accepted_tokens for r in reqs) == \
+            st["spec_accepted_tokens"]
+        _balanced(eng)
+
+    def test_disagreeing_draft_still_token_identical(self):
+        """A draft with different weights proposes mostly-wrong tokens:
+        acceptance filters them; rejected speculation rows are masked
+        by kv_len and overwritten before they can be read."""
+        params = _model()
+        dec = _decoder(params)
+        rng = np.random.RandomState(6)
+        prompts = _ragged(rng, 3, lo=3, hi=8)
+        max_news = [8, 10, 6]
+        want = _dense_rows(dec, prompts, max_news)
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=CFG["max_len"],
+                           draft=_decoder(_model(seed=11)), spec_k=2)
+        reqs = [eng.submit(p, mn) for p, mn in zip(prompts, max_news)]
+        eng.run(timeout=300)
+        for i, r in enumerate(reqs):
+            assert r.get(timeout=1) == [int(t) for t in want[i]], i
+        st = eng.stats()
+        assert st["spec_proposed_tokens"] >= st["spec_accepted_tokens"]
+        _balanced(eng)
+
+    def test_mqa_spec_identity(self):
+        """Speculation over the narrow MQA cache: the [S, W] verify
+        window reads the cache at stored width."""
+        params = _model(seed=3, n_kv_heads=1)
+        dec = _decoder(params)
+        draft = _decoder(_model(seed=3, n_kv_heads=1))
+        rng = np.random.RandomState(7)
+        prompts = _ragged(rng, 2, lo=3, hi=7)
+        want = _dense_rows(dec, prompts, [9, 7])
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=CFG["max_len"], draft=draft,
+                           spec_k=2)
+        reqs = [eng.submit(p, mn) for p, mn in zip(prompts, [9, 7])]
+        eng.run(timeout=300)
+        for i, r in enumerate(reqs):
+            assert r.get(timeout=1) == [int(t) for t in want[i]], i
+        assert eng.stats()["spec_accepted_tokens"] > 0
+        _balanced(eng)
+
+    def test_speculation_requires_greedy(self):
+        params = _model()
+        with pytest.raises(ValueError, match="greedy|temperature"):
+            DecodeEngine(_decoder(params), draft=_decoder(params),
+                         spec_k=2, temperature=0.8, max_seq_len=16)
+
+
+class TestPrefixReuse:
+    """ISSUE 13 tentpole (a): radix-indexed shared-prefix KV attach
+    with per-page refcounts and copy-on-write on divergence."""
+
+    def test_warm_prefix_attaches_pages_and_skips_prefill(self):
+        params = _model()
+        dec = _decoder(params)
+        rng = np.random.RandomState(8)
+        prompt = rng.randint(0, 40, (13,)).astype("int32")
+        want = dec.generate(prompt[None, :], max_len=13 + 5)[0]
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=CFG["max_len"])
+        cold = eng.submit(prompt, 5)
+        eng.run(timeout=120)
+        steps_cold = eng.stats()["steps"]
+        assert cold.get(timeout=1) == [int(t) for t in want]
+        assert cold.prefix_hit_pages == 0
+        warm = eng.submit(prompt, 5)
+        eng.run(timeout=120)
+        steps_warm = eng.stats()["steps"] - steps_cold
+        # same tokens, but the shared prefill never re-runs: the warm
+        # request attaches the cached pages and feeds only the tail
+        assert warm.get(timeout=1) == [int(t) for t in want]
+        assert warm.prefix_hit_pages >= 2
+        assert steps_warm < steps_cold
+        st = eng.stats()
+        assert st["prefix_hit_pages"] >= 2
+        assert st["kv_pages_shared"] >= 0
+        _balanced(eng)
+
+    def test_page_straddling_divergence_cow_identity(self):
+        """Divergence INSIDE a shared page forces a copy-on-write: the
+        matched rows are copied into a private page, the source page
+        keeps its other holders, and both outputs stay exact."""
+        params = _model()
+        dec = _decoder(params)
+        rng = np.random.RandomState(9)
+        shared = rng.randint(0, 40, (6,)).astype("int32")
+        a = np.concatenate([shared, rng.randint(0, 40, (4,))]) \
+            .astype("int32")
+        b = np.concatenate([shared, rng.randint(0, 40, (4,))]) \
+            .astype("int32")
+        b[6] = (a[6] + 1) % 40          # diverge mid-page-1, always
+        want_a = dec.generate(a[None, :], max_len=len(a) + 6)[0]
+        want_b = dec.generate(b[None, :], max_len=len(b) + 6)[0]
+        eng = DecodeEngine(dec, num_slots=1, page_size=4,
+                           max_seq_len=CFG["max_len"])
+        ra = eng.submit(a, 6)
+        eng.run(timeout=120)
+        rb = eng.submit(b, 6)
+        eng.run(timeout=120)
+        assert ra.get(timeout=1) == [int(t) for t in want_a]
+        assert rb.get(timeout=1) == [int(t) for t in want_b]
+        st = eng.stats()
+        assert rb.prefix_hit_pages >= 1     # page 0 attached whole
+        assert st["prefix_cow_copies"] >= 1  # page 1 copied on write
+        _balanced(eng)
+
+    def test_prefix_cache_off_frees_everything(self):
+        params = _model()
+        dec = _decoder(params)
+        eng = DecodeEngine(dec, num_slots=1, page_size=4,
+                           max_seq_len=16, prefix_cache=False)
+        r = eng.submit(np.zeros((5,), "int32"), 4)
+        eng.run(timeout=120)
+        assert len(r.get(timeout=1)) == 4
+        r2 = eng.submit(np.zeros((5,), "int32"), 4)
+        eng.run(timeout=120)
+        assert len(r2.get(timeout=1)) == 4
+        acc = eng.page_accounting()
+        assert acc["held_by_trie"] == 0
+        assert acc["free"] == acc["total_usable"]
+        assert eng.stats()["prefix_hit_pages"] == 0
+
+    @pytest.mark.recompile_budget(max_compiles=12)
+    def test_spec_prefix_churn_zero_recompiles(self):
+        """Round-9 zero-recompile pin: with the [S, W] verify step, the
+        draft step AND the CoW page copy warmed, a storm of
+        shared-prefix joins (each walking the radix index and copying
+        on write) plus a cancel cause ZERO XLA compilations."""
+        from paddle_tpu.analysis.sanitizer import compile_watch
+        from paddle_tpu.testing import FaultPlan
+        params = _model()
+        dec = _decoder(params)
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=20, draft=_decoder(_model()),
+                           spec_k=2)
+        rng = np.random.RandomState(10)
+        base = rng.randint(0, 40, (9,)).astype("int32")
+
+        def twin():
+            t = np.concatenate([base[:6], rng.randint(0, 40, (3,))]) \
+                .astype("int32")
+            t[6] = (base[6] + 1 + int(rng.randint(38))) % 40
+            return t
+
+        warm = eng.submit(base, 3)
+        eng.run(timeout=120)             # target + draft steps compile
+        warm2 = eng.submit(twin(), 3)    # CoW warms the page copy
+        eng.run(timeout=120)
+        assert warm.get(timeout=1) and warm2.get(timeout=1)
+        assert eng.stats()["prefix_cow_copies"] >= 1
+        joined = []
+        r0 = eng.submit(twin(), 8)
+        with compile_watch() as watch:
+            with FaultPlan.decode_script(eng, {
+                    2: lambda: joined.append(eng.submit(twin(), 6)),
+                    4: lambda: joined.append(eng.submit(twin(), 6)),
+                    6: lambda: joined[0].cancel()}) as script:
+                eng.run(timeout=300)
+            assert script["fired"] == [2, 4, 6]
+        assert watch.total == 0, (
+            f"prefix/spec churn recompiled: {watch.per_function}")
+        assert len(r0.get(timeout=1)) == 8
+        assert joined[0].state in ("cancelled", "done")
+        assert len(joined[1].get(timeout=1)) == 6
+        _balanced(eng)
 
 
 class TestBenchSmoke:

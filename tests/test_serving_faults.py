@@ -64,6 +64,20 @@ def samples(batch=2, dim=8, seed=0):
     return [(rng.randn(dim).astype(np.float32),) for _ in range(batch)]
 
 
+def assert_pool_balanced(eng):
+    """Round-9 pool invariant: zero leaks AND zero refcount drift.
+    With the prefix cache on (the default) a drained engine may park
+    finished sequences' pages in the trie, so "everything returned"
+    means free + trie-held covers every usable page, and the live
+    refcounts are exactly the slot-table + trie references."""
+    acc = eng.page_accounting()
+    assert acc["leaked"] == 0
+    assert acc["free"] + acc["held_by_trie"] == acc["total_usable"]
+    assert acc["refs_total"] == \
+        acc["held_by_slots"] + acc["held_by_trie"]
+    return acc
+
+
 class TestServerBasics:
     def test_serves_and_snapshots(self):
         inf = tiny_inference()
@@ -410,8 +424,7 @@ class TestDecodeEngineChaos:
         # the survivors are token-identical to solo runs
         assert joined[0].get(timeout=1) == [int(t) for t in want1]
         assert joined[1].get(timeout=1) == [int(t) for t in want2]
-        acc = eng.page_accounting()
-        assert acc["leaked"] == 0 and acc["free"] == acc["total_usable"]
+        assert_pool_balanced(eng)
         st = eng.stats()
         assert st["cancelled"] == 1 and st["finished"] == 2
 
@@ -433,8 +446,7 @@ class TestDecodeEngineChaos:
         eng.run(timeout=300)
         for i, r in enumerate(reqs):
             assert r.get(timeout=1) == [int(t) for t in want[i]], i
-        acc = eng.page_accounting()
-        assert acc["leaked"] == 0 and acc["free"] == acc["total_usable"]
+        assert_pool_balanced(eng)
 
     def test_client_disconnect_during_generation(self):
         """A client that walks away mid-stream (disconnect_after): the
@@ -459,8 +471,7 @@ class TestDecodeEngineChaos:
             assert ra.num_generated >= 4
         finally:
             eng.shutdown(drain=True, timeout=60)
-        acc = eng.page_accounting()
-        assert acc["leaked"] == 0 and acc["free"] == acc["total_usable"]
+        assert_pool_balanced(eng)
         assert eng.stats()["cancelled"] == 1
 
     def test_burst_overload_typed_rejections_only(self):
@@ -494,8 +505,7 @@ class TestDecodeEngineChaos:
                 assert len(r) == 4, i
         assert all(e.reason == "queue_full" and e.retry_after > 0
                    for e in rejected)
-        acc = eng.page_accounting()
-        assert acc["leaked"] == 0 and acc["free"] == acc["total_usable"]
+        assert_pool_balanced(eng)
 
     def test_deadline_and_shutdown_are_typed(self):
         dec = tiny_decoder()
@@ -514,8 +524,7 @@ class TestDecodeEngineChaos:
             blocker.get(timeout=5)
         with pytest.raises(ServerClosed):
             eng.submit(np.zeros((3,), "int32"), 2)
-        acc = eng.page_accounting()
-        assert acc["leaked"] == 0 and acc["free"] == acc["total_usable"]
+        assert_pool_balanced(eng)
         assert eng.stats()["expired"] == 1
 
 
@@ -542,8 +551,7 @@ class TestServerEngineIntegration:
             st = srv.stats()
             assert st["engine"]["finished"] == 1
             assert st["engine"]["kv_pages_total"] > 0
-            assert st["engine"]["kv_pages_free"] == \
-                st["engine"]["kv_pages_total"]
+            assert_pool_balanced(eng)
         finally:
             srv.shutdown(drain=True)
         # shutdown drained the engine thread too
@@ -597,6 +605,10 @@ class TestServerEngineIntegration:
             with urllib.request.urlopen(req, timeout=60) as r:
                 body = json.loads(r.read())
             assert body["tokens"] == [int(x) for x in want]
+            # round-9 response fields: prefix-cache reuse + speculation
+            # telemetry ride every /generate reply
+            assert body["prefix_hit_pages"] >= 0
+            assert body["accepted_tokens"] >= 0
             with urllib.request.urlopen(base + "/metrics",
                                         timeout=10) as r:
                 assert r.headers["Content-Type"].startswith("text/plain")
@@ -641,3 +653,110 @@ class TestServerEngineIntegration:
         finally:
             httpd.shutdown()
             srv.shutdown(drain=True)
+
+
+class TestPrefixSpecChaos:
+    """FaultPlan family (n): prefix-cache / CoW / speculation chaos
+    (ISSUE 13). The round-9 invariants under every scenario: zero page
+    leaks AND zero refcount underflows (``refs_total`` ==
+    ``held_by_slots`` + ``held_by_trie``), and unfaulted sequences stay
+    TOKEN-IDENTICAL to undisturbed dense runs — shared-prefix attach,
+    copy-on-write and rejected speculation must never corrupt KV."""
+
+    def _want(self, dec, prompt, max_new):
+        p = np.asarray(prompt, "int32")
+        return [int(t) for t in
+                dec.generate(p[None, :], max_len=len(p) + max_new)[0]]
+
+    def test_divergent_twins_cow_token_identity(self):
+        """Request pairs sharing a prefix that splits mid-page: the
+        late joiners attach the shared full page and CoW the split
+        page; every stream is token-exact vs a solo dense run."""
+        dec = tiny_decoder()
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=DEC_CFG["max_len"])
+        plan = FaultPlan(seed=21)
+        twins = plan.divergent_twins(eng, max_new=4, pairs=2, vocab=40)
+        eng.run(timeout=300)
+        for i, (req, prompt) in enumerate(twins):
+            assert req.get(timeout=1) == self._want(dec, prompt, 4), i
+        st = eng.stats()
+        # the first pair misses (cold trie); the second pair walks the
+        # radix index: at least one full shared page attaches and the
+        # mid-page divergence copies-on-write
+        assert st["prefix_hit_pages"] >= 1
+        assert st["prefix_cow_copies"] >= 1
+        assert st["finished"] == 4
+        assert_pool_balanced(eng)
+
+    def test_prefix_evict_storm_reclaims_trie_not_slots(self):
+        """Distinct-prompt waves stack finished pages into the trie
+        until admission must reclaim LRU leaves; every request still
+        completes token-exact and the pool balances."""
+        dec = tiny_decoder()
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=20, num_pages=9)
+        plan = FaultPlan(seed=22)
+        schedule, submitted = plan.prefix_evict_storm(
+            eng, waves=4, per_wave=2, gap=3, prompt_len=8, max_new=3,
+            vocab=40)
+        with FaultPlan.decode_script(eng, schedule) as script:
+            eng.run(timeout=300)
+        assert script["fired"] == sorted(schedule)
+        assert len(submitted) == 8
+        for i, (req, prompt) in enumerate(submitted):
+            assert req.get(timeout=1) == self._want(dec, prompt, 3), i
+        st = eng.stats()
+        assert st["finished"] == 8
+        # the storm actually forced trie reclamation (journaled as
+        # engine/prefix_evict), not just slot preemption
+        assert st["prefix_evicted_pages"] >= 1
+        assert_pool_balanced(eng)
+
+    def test_cancel_mid_verify_returns_shared_refs(self):
+        """With speculation on, a cancel lands between a draft
+        proposal and the target's verify: the victim's pages AND its
+        shared-prefix refs return, the survivor is token-exact."""
+        dec = tiny_decoder()
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=DEC_CFG["max_len"],
+                           draft=tiny_decoder(), spec_k=2)
+        rng = np.random.RandomState(23)
+        shared = [int(t) for t in rng.randint(0, 40, 6)]
+        victim_p = shared + [int(t) for t in rng.randint(0, 40, 3)]
+        surv_p = shared + [int(t) for t in rng.randint(0, 40, 3)]
+        victim = eng.submit(victim_p, 12)
+        surv = eng.submit(surv_p, 8)
+        with FaultPlan.decode_script(
+                eng, FaultPlan.cancel_mid_verify(victim, at=2)) as s:
+            eng.run(timeout=300)
+        assert s["fired"] == [2]
+        assert victim.state == "cancelled"
+        assert victim.get(timeout=1) == victim.tokens
+        assert surv.get(timeout=1) == self._want(dec, surv_p, 8)
+        st = eng.stats()
+        # the same-weights draft means speculation genuinely committed
+        # multi-token steps before/around the cancel
+        assert st["spec_proposed_tokens"] > 0
+        assert st["spec_accepted_tokens"] > 0
+        assert st["cancelled"] == 1 and st["finished"] == 1
+        assert_pool_balanced(eng)
+
+    def test_spec_identity_with_disagreeing_draft(self):
+        """A draft with DIFFERENT weights proposes mostly-wrong tokens:
+        acceptance filters them and the output is still token-exact —
+        rejected speculation rows never become readable KV."""
+        dec = tiny_decoder()
+        eng = DecodeEngine(dec, num_slots=2, page_size=4,
+                           max_seq_len=DEC_CFG["max_len"],
+                           draft=tiny_decoder(seed=11), spec_k=2)
+        rng = np.random.RandomState(24)
+        prompts = [[int(t) for t in rng.randint(0, 40, n)]
+                   for n in (5, 7)]
+        reqs = [eng.submit(p, 8) for p in prompts]
+        eng.run(timeout=300)
+        for i, (req, p) in enumerate(zip(reqs, prompts)):
+            assert req.get(timeout=1) == self._want(dec, p, 8), i
+        st = eng.stats()
+        assert st["spec_proposed_tokens"] > 0
+        assert_pool_balanced(eng)
