@@ -574,7 +574,8 @@ def bench_moe_lm(batch: int = 8, seq_len: int = 1024, d_model: int = 512,
 SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine",
               "decode_prefix_hit", "decode_speculative",
               "flight_recorder_overhead", "profiler_overhead",
-              "lockdep_overhead", "coord_reshard")
+              "lockdep_overhead", "coord_reshard", "embed_lookup",
+              "embed_update")
 
 
 def _smoke_trainer(batch: int = 16):
@@ -938,6 +939,56 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
             "reshards": reshards,
             "generation": coord.generation,
         }
+    if "embed_lookup" in rows or "embed_update" in rows:
+        # the sharded embedding store (paddle_tpu/embed): pure host/RPC
+        # control plane, no XLA. embed_lookup gates the serving gather
+        # path (rows/s + per-gather latency through the XML-RPC plane);
+        # embed_update gates the async-SGD push path (acked update
+        # rows/s through the exactly-once ledger). Latencies carry the
+        # latency kind's absolute floor; rates are loose like every
+        # timing metric here (docs/observability.md "The perf gate").
+        from paddle_tpu.embed import EmbedService
+        n_keys, n_dim = 256, 16
+        with EmbedService(2, n_dim, seed=0) as esvc:
+            with esvc.client(client_id="bench-embed") as ecl:
+                if "embed_lookup" in rows:
+                    rng = np.random.RandomState(0)
+                    ecl.gather(np.arange(n_keys, dtype="int64"))  # warm
+                    lats = []
+                    t_all = time.perf_counter()
+                    reps = 12
+                    for _ in range(reps):
+                        keys = rng.randint(0, 100000, n_keys) \
+                            .astype("int64")
+                        t0 = time.perf_counter()
+                        ecl.gather(keys, max_stale_s=0.0)  # forced RPC
+                        lats.append((time.perf_counter() - t0) * 1e3)
+                    dt = time.perf_counter() - t_all
+                    lats.sort()
+                    out["embed_lookup"] = {
+                        "rows_per_s": round(reps * n_keys / dt, 1),
+                        "gather_p50_ms": round(lats[len(lats) // 2], 3),
+                        "gather_p99_ms": round(lats[-1], 3),
+                        "gathers": reps,
+                    }
+                if "embed_update" in rows:
+                    rng = np.random.RandomState(1)
+                    n_batches = 12
+                    t0 = time.perf_counter()
+                    for _ in range(n_batches):
+                        keys = rng.randint(0, 100000, n_keys) \
+                            .astype("int64")
+                        ecl.push(keys,
+                                 np.ones((n_keys, n_dim), "float32"),
+                                 lr=0.1)
+                    ecl.flush(timeout=60.0)
+                    dt = time.perf_counter() - t0
+                    st = ecl.stats()
+                    out["embed_update"] = {
+                        "updates_per_s": round(st["pushed_rows"] / dt, 1),
+                        "push_failures": st["push_failures"],
+                        "batches": n_batches,
+                    }
     return {"v": 1, "suite": "smoke", "rows": out}
 
 

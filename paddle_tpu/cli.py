@@ -476,6 +476,58 @@ def _cmd_coordinator(args) -> int:
     return 0
 
 
+def _cmd_pserver(args) -> int:
+    """Run one embedding shard as a daemon — the 2017 `paddle pserver`
+    binary's role reborn (docs/robustness.md "Sharded embedding
+    service"): serve row-gather/scatter-update RPCs for this shard's
+    key range, keep a membership lease on the coordinator so clients
+    resolve (and fail over) through the directory, and persist
+    WAL+snapshots to --snapshot_dir so a replacement started with the
+    same flags restores the range digest-stable. SIGTERM snapshots,
+    leaves the membership plane, and drains cleanly."""
+    import signal
+
+    from paddle_tpu.embed import (EmbeddingShard, EmbeddingShardServer,
+                                  ShardRegistration)
+    from paddle_tpu.trainer.coordinator import FileStore, connect
+
+    store = FileStore(args.snapshot_dir) if args.snapshot_dir else None
+    shard = EmbeddingShard(args.shard_id, args.shards, args.dim,
+                           seed=args.seed, store=store)
+    restored = shard.restore_from_store() if store is not None else False
+    server = EmbeddingShardServer(shard, host=args.host,
+                                  port=args.port).start()
+    registration = None
+    if args.coordinator:
+        host, _, port = args.coordinator.rpartition(":")
+        registration = ShardRegistration(
+            connect(host or "127.0.0.1", int(port)), shard,
+            server.endpoint, heartbeat_s=args.heartbeat).join()
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    print(json.dumps({"job": "pserver", "status": "serving",
+                      "shard_id": shard.shard_id, "shards": shard.num_shards,
+                      "dim": shard.dim, "endpoint": server.endpoint,
+                      "port": server.port, "restored": restored,
+                      "generation": registration.generation
+                      if registration else None}), flush=True)
+    while not stop:
+        time.sleep(0.2)
+    # orderly exit: durable state first, then the goodbye, then the
+    # socket — a client mid-retry sees the directory lose the entry
+    # before the endpoint stops answering
+    if store is not None:
+        shard.save_snapshot()
+    if registration is not None:
+        registration.stop(leave=True)
+    server.stop()
+    print(json.dumps({"job": "pserver", "status": "stopped",
+                      "stats": shard.stats()}))
+    return 0
+
+
 def _cmd_lint(args) -> int:
     """ptlint — JAX-aware static analysis over the tree
     (docs/static_analysis.md): host syncs in hot paths, jit-in-loop
@@ -1059,6 +1111,34 @@ def main(argv=None) -> int:
                          "(RpcStore; mutually exclusive with "
                          "--snapshot)")
 
+    ps = sub.add_parser("pserver", help="run one embedding shard daemon "
+                        "(the 2017 `paddle pserver` reborn — "
+                        "docs/robustness.md 'Sharded embedding service')")
+    ps.add_argument("--shard_id", type=int, required=True,
+                    help="this shard's index in [0, --shards)")
+    ps.add_argument("--shards", type=int, required=True,
+                    help="total shard count (the hash-partition modulus "
+                         "— every pserver of one table must agree)")
+    ps.add_argument("--dim", type=int, default=64,
+                    help="embedding row width")
+    ps.add_argument("--host", default="127.0.0.1")
+    ps.add_argument("--port", type=int, default=0,
+                    help="0 picks a free port (printed as JSON)")
+    ps.add_argument("--coordinator", default=None,
+                    help="HOST:PORT of a `paddle_tpu coordinator` daemon "
+                         "— register on the membership plane so clients "
+                         "resolve endpoints (and fail over) through the "
+                         "directory")
+    ps.add_argument("--snapshot_dir", default=None,
+                    help="dir for WAL + snapshots (FileStore): a "
+                         "replacement started with the same flags "
+                         "restores this shard's key range digest-stable")
+    ps.add_argument("--heartbeat", type=float, default=1.0,
+                    help="membership lease heartbeat seconds")
+    ps.add_argument("--seed", type=int, default=0,
+                    help="row-init seed (every pserver of one table "
+                         "must agree)")
+
     dg = sub.add_parser("diagram", help="emit a Graphviz .dot of the model "
                         "(python/paddle/utils/make_model_diagram.py parity)")
     dg.add_argument("--config", required=True,
@@ -1082,6 +1162,8 @@ def main(argv=None) -> int:
         return _cmd_trace(args)
     if args.command == "coordinator":
         return _cmd_coordinator(args)
+    if args.command == "pserver":
+        return _cmd_pserver(args)
     if args.command == "profile":
         return _cmd_profile(args)
     if args.command == "serve":

@@ -35,6 +35,9 @@ class ParamAttr:
     l2_rate: Optional[float] = None
     is_static: bool = False
     sparse: bool = False            # row-sparse gradient (embedding tables)
+    remote: bool = False            # table lives in the sharded embed store
+                                    # (paddle_tpu/embed) — no local param;
+                                    # rows arrive via ctx.sparse_sub
     initializer: Optional[Any] = None
     initial_std: Optional[float] = None
     initial_mean: float = 0.0

@@ -1,0 +1,42 @@
+"""paddle_tpu.embed — hash-partitioned embedding/parameter store.
+
+PAPER.md layer 8 (`paddle/pserver/`: ParameterServer2/ParameterClient2,
+the sharded KV store for sparse parameters behind 2017-era production
+CTR ranking), rebuilt on this repo's elastic plane:
+
+- :mod:`shard` — :class:`EmbeddingShard` (row-sparse slice, WAL +
+  snapshot durability, exactly-once applied ledger) and its XML-RPC
+  server; splitmix64 ``shard_of`` routing.
+- :mod:`client` — :class:`EmbeddingClient`: consistent-hash routing,
+  batched gather with a bounded-staleness cache (violations journaled),
+  async-SGD sparse pushes with reconcile-guard semantics.
+- :mod:`service` — membership-plane registration (leases + failover
+  directory) and the in-process multi-shard harness.
+- :mod:`lookup` — :class:`RemoteLookup`: `layers.embedding(remote=True)`
+  routes through the store via the existing ``sparse_sub`` seam.
+- :mod:`online` — continuous training: serving journal -> self-healing
+  reader pipeline -> live sparse updates while lookups continue.
+- :mod:`obs` — ``paddle_tpu_embed_*`` gauges + flight-bundle provider.
+
+Chaos family (o) in :mod:`paddle_tpu.testing.faults` drives SIGKILL'd
+shards, stale reads and slow shards against all of it
+(tests/test_embed_faults.py; docs/robustness.md "Sharded embedding
+service").
+"""
+
+from paddle_tpu.embed.client import EmbeddingClient, EmbedUnavailable
+from paddle_tpu.embed.lookup import RemoteLookup
+from paddle_tpu.embed.online import (OnlineTrainer, journal_sample_reader,
+                                     log_sample, run_online,
+                                     serving_sample_log)
+from paddle_tpu.embed.service import EmbedService, ShardRegistration
+from paddle_tpu.embed.shard import (EmbeddingShard, EmbeddingShardServer,
+                                    ShardKilled, shard_of, stable_hash64)
+
+__all__ = [
+    "EmbeddingClient", "EmbedUnavailable", "RemoteLookup",
+    "OnlineTrainer", "journal_sample_reader", "log_sample", "run_online",
+    "serving_sample_log", "EmbedService", "ShardRegistration",
+    "EmbeddingShard", "EmbeddingShardServer", "ShardKilled", "shard_of",
+    "stable_hash64",
+]

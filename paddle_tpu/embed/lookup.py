@@ -1,0 +1,67 @@
+"""RemoteLookup — route `paddle_tpu.layers.embedding` through the store.
+
+The transparency contract: a model config that says
+``layers.embedding(input=ids, size=64, remote=True)`` keeps its exact
+layer graph, but the `[vocab, 64]` table never materializes on device.
+Before each forward, :class:`RemoteLookup` reads the batch's ids
+HOST-side from the feed, gathers just the touched rows from the sharded
+store through an :class:`EmbeddingClient` (bounded-staleness cache and
+failover included), and hands them to the forward as the same
+``sparse_sub={param: (uids, rows)}`` row blocks the local row-sparse
+trainer path already consumes (``ops.embedding.row_sub_lookup``). The
+layer cannot tell a remote table from a prefetched local one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["RemoteLookup"]
+
+
+class RemoteLookup:
+    """Per-batch sparse_sub builder for every remote table in a topology.
+
+    topology: a core.topology.Topology (or anything with
+    ``remote_tables() -> {param_name: ids_layer_name}``).
+    client: the :class:`EmbeddingClient` all tables share.
+    """
+
+    def __init__(self, topology, client):
+        self.client = client
+        self.tables: Dict[str, str] = topology.remote_tables()
+        self.gathered_batches = 0
+
+    def sparse_sub(self, feed: Dict[str, Any],
+                   max_stale_s: Optional[float] = None) -> Dict[str, Any]:
+        """Gather the row blocks this batch touches.
+
+        feed: the feeder's name->array dict (ids may be [b] or [b, T];
+        pad id -1 is skipped — `row_sub_lookup` maps it to a zero row).
+        Returns {param_name: (uids [k], rows [k, dim])} as numpy — the
+        jitted forward stages them in with the batch."""
+        sub: Dict[str, Any] = {}
+        for pname, src in sorted(self.tables.items()):
+            ids = np.asarray(self._ids(feed[src])).reshape(-1)
+            uids = np.unique(ids[ids >= 0]).astype(np.int64)
+            rows = self.client.gather(uids, max_stale_s=max_stale_s)
+            sub[pname] = (uids, rows)
+        self.gathered_batches += 1
+        return sub
+
+    @staticmethod
+    def _ids(value):
+        # feeds may carry SequenceBatch-like wrappers; ids are the payload
+        return getattr(value, "data", value)
+
+    def push_grads(self, sub: Dict[str, Any],
+                   grads: Dict[str, np.ndarray],
+                   lr: Optional[float] = None):
+        """Push the row-block gradients a training step produced back to
+        the store: ``grads[param]`` is d(loss)/d(rows) aligned with the
+        ``sub[param]`` uids — the async-SGD write half of the loop."""
+        for pname, g in grads.items():
+            uids, _ = sub[pname]
+            self.client.push(uids, np.asarray(g), lr=lr)

@@ -74,9 +74,17 @@ fc_layer = fc
 
 
 def embedding(input, size: int, name: Optional[str] = None, param_attr=None,
-              **kw) -> LayerOutput:
-    return make_layer("embedding", name, [input], size=size,
-                      param_attr=param_attr)
+              remote: bool = False, **kw) -> LayerOutput:
+    """``remote=True`` (or ``ParamAttr(remote=True)``) places the table
+    in the sharded embedding store (:mod:`paddle_tpu.embed`) instead of
+    a local parameter — same config surface, tables bigger than one
+    device."""
+    # only record ``remote`` when set — keeps the serialized topology
+    # (and the golden files gating it) byte-identical for local tables
+    kw = dict(size=size, param_attr=param_attr)
+    if remote:
+        kw["remote"] = True
+    return make_layer("embedding", name, [input], **kw)
 
 
 embedding_layer = embedding

@@ -167,25 +167,43 @@ class EmbeddingLayer:
         size = cfg["size"]
         a = ParamAttr.of(cfg.get("param_attr"))
         pname = a.name or f"_{name}.w0"
+        cfg["_w_name"] = pname
+        if cfg.get("remote") or a.remote:
+            # table lives in the sharded embedding store
+            # (paddle_tpu/embed): NO local ParamSpec — the [vocab, size]
+            # array never materializes on device; rows arrive per batch
+            # through ctx.sparse_sub (embed.lookup.RemoteLookup)
+            cfg["_remote"] = True
+            cfg["_vocab"] = m.size
+            return LayerMeta(size=size, seq_level=m.seq_level), [], []
         init = a.initializer or (initializers.normal(a.initial_std or 0.01))
         specs = [ParamSpec(pname, (m.size, size), init, a)]
-        cfg["_w_name"] = pname
         return LayerMeta(size=size, seq_level=m.seq_level), specs, []
 
     @staticmethod
     def apply(ctx, name, cfg, params, inputs):
         pname = cfg["_w_name"]
-        table = params[pname]
         val = inputs[0]
         ids = _payload(val)
         sub = getattr(ctx, "sparse_sub", None)
-        if sub and pname in sub:
+        if cfg.get("_remote"):
+            if not sub or pname not in sub:
+                raise KeyError(
+                    f"embedding layer {name!r} uses a REMOTE table "
+                    f"({pname}); pass sparse_sub={{...}} built by "
+                    "paddle_tpu.embed.lookup.RemoteLookup for this batch")
+            uids, rows = sub[pname]
+            out = emb_ops.row_sub_lookup(uids, rows, ids, cfg["_vocab"],
+                                         pad_id=cfg.get("pad_id", -1))
+        elif sub and pname in sub:
             # row-sparse path: look up inside the prefetched row block so
             # gradients flow to the [k, emb] rows, not the whole table
             uids, rows = sub[pname]
+            table = params[pname]
             out = emb_ops.row_sub_lookup(uids, rows, ids, table.shape[0],
                                          pad_id=cfg.get("pad_id", -1))
         else:
+            table = params[pname]
             out = emb_ops.embedding_lookup(table, ids,
                                            pad_id=cfg.get("pad_id", -1))
         if isinstance(val, SequenceBatch):

@@ -24,12 +24,14 @@ Schema v1 — every record carries:
     seq     int     per-process monotonic sequence number
     pid     int     emitting process
     domain  str     trainer | data | serving | engine | checkpoint |
-                    slo | profile
+                    slo | profile | coordinator | lockdep | embed
     kind    str     e.g. nonfinite, rollback, oom, quarantine,
                     data_budget, source_stall, worker_restart,
                     restart_budget, shed, breaker, preemption,
                     step_failure, save, restore, run_start, run_end,
-                    step_regression, breach, window
+                    step_regression, breach, window, stale_grant,
+                    reshard, inversion, gather, update, stale_read,
+                    shard_killed, shard_replaced, sample, online_pass
 
 plus, since observability v2 (docs/observability.md "Trace context &
 postmortems"), the correlation IDs the merge tooling keys on —

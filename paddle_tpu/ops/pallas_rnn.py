@@ -40,6 +40,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from paddle_tpu.core.sequence import SequenceBatch
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams across releases;
+# accept whichever this jax ships.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 
 def _sigmoid(x):
     return jax.nn.sigmoid(x)
@@ -255,7 +259,7 @@ def _lstm_fwd_call(x4, lens2d, w, bias2d, peep2d, interpret,
             pltpu.VMEM((b, h), jnp.float32),
             pltpu.VMEM((b, h), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(lens2d, xt, w.astype(mxu), bias2d, peep2d)
@@ -309,7 +313,7 @@ def _lstm_bwd(interpret, res, ct):
             pltpu.VMEM((b, h), jnp.float32),
             pltpu.VMEM((b, h), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(lens2d, w.astype(mxu), peep2d, gates, cseq, cseq, d_out_tb,

@@ -55,6 +55,29 @@ def tree_isfinite(tree) -> bool:
     return bool(ok)
 
 
+def filter_finite_rows(keys, grads, counter: str = "parallel/poisoned_rows"):
+    """Row-wise form of the :meth:`AsyncSGDIsland.reconcile` isfinite
+    guard, for SPARSE gradient pushes (the embedding client / shard
+    path): a non-finite gradient ROW — one poisoned sample's embedding
+    slice — is dropped from the update (counter + warning) instead of
+    contaminating the shared table, exactly as a poisoned island's tree
+    is dropped from the reconcile average. Returns the surviving
+    ``(keys, grads)`` pair (numpy); all-poisoned batches come back
+    empty, which upstream applies as a no-op."""
+    keys = np.asarray(keys)
+    grads = np.asarray(grads)
+    finite = np.isfinite(grads).reshape(grads.shape[0], -1).all(axis=1)
+    if finite.all():
+        return keys, grads
+    n_bad = int((~finite).sum())
+    global_counters.bump(counter, n_bad)
+    warnings.warn(
+        f"{n_bad} sparse gradient row(s) non-finite at push; dropped "
+        "from the update (reconcile guard applied row-wise)",
+        stacklevel=2)
+    return keys[finite], grads[finite]
+
+
 def average_pytree(tree, valid: Optional[bool] = None):
     """Average a pytree of arrays across all jax processes.
 

@@ -131,11 +131,16 @@ class InferenceServer:
                  latency_window: int = 256,
                  max_batch_memory: Optional[int] = None,
                  engine=None,
+                 sample_log: Optional[Callable] = None,
                  clock: Callable[[], float] = time.monotonic):
         if isinstance(model, (str, bytes)):
             from paddle_tpu.trainer.inference import load_inference_model
             model = load_inference_model(model)
         self._inf = model
+        # online-training feedback seam (paddle_tpu/embed/online.py
+        # serving_sample_log): called with each served batch's samples
+        # from the worker thread, after a successful forward
+        self._sample_log = sample_log
         # optional continuous-batching decode engine
         # (serving/engine.DecodeEngine): generate() routes through its
         # page-aware admission — requests are scheduled by FREE KV
@@ -487,6 +492,11 @@ class InferenceServer:
 
     def _forward(self, samples):
         out = self._inf.forward_batch(samples)
+        if self._sample_log is not None:
+            try:
+                self._sample_log(samples)
+            except Exception:  # noqa: BLE001 — a feedback-journal bug
+                pass           # must never fail the serving request
         return out[0] if len(out) == 1 else out
 
     # ------------------------------------------------------------ snapshots

@@ -111,6 +111,22 @@ and, for prefix-cache / speculative decoding (docs/robustness.md
       token-exact vs the dense reference
       (tests/test_serving_faults.py family (n) acceptance);
 
+and, for the sharded embedding service (docs/robustness.md "Sharded
+embedding service"):
+
+  (o) SIGKILL an embedding shard at a chosen point — ``kill_shard``
+      with ``window="commit"`` dies inside a scatter-update's TORN
+      window (WAL durable, ack never sent: the replacement must replay
+      it and the client's same-seq retry must dedupe to ``dup``), or
+      ``window="rpc"`` dies before any side effect; ``stale_read``
+      ages the client's bounded-staleness cache so reads cross the
+      bound deterministically (stale serves against a dead shard must
+      journal ``embed/stale_read`` violations); ``slow_shard`` stalls
+      chosen shard RPCs by a fixed number of milliseconds (the hot-
+      shard straggler). The invariant every kill must preserve: the
+      final table digest equals the uninterrupted run's
+      (tests/test_embed_faults.py chaos acceptance);
+
 Everything is deterministic given the seed and the schedule, so a chaos
 test that fails replays exactly. See ``tests/test_faults.py`` and
 ``tests/test_serving_faults.py`` for the tests that drive these against
@@ -853,6 +869,132 @@ class FaultPlan:
                 yield b"\xff" + filler
             else:
                 yield rec
+
+    # ------------------------------------------ (o) sharded embeddings
+    @staticmethod
+    @contextlib.contextmanager
+    def kill_shard(server, at: int = 0, window: str = "commit"):
+        """Within the context, SIGKILL-twin an embedding shard at a
+        chosen point (:meth:`EmbeddingShardServer.kill`: every in-flight
+        and future RPC tears its connection with NO response; new
+        connections are refused; no snapshot, no leave — the membership
+        lease just lapses).
+
+        window="commit": die inside the ``at``-th scatter-update's TORN
+        WINDOW — after the WAL append is durable, before the table
+        mutates or the ack is sent (the shard's ``_commit_interceptor``
+        seam). This is the worst-case kill for exactly-once accounting:
+        the replacement must REPLAY the entry and the client's retry of
+        the same seq must come back ``dup``.
+
+        window="rpc": die at the ``at``-th RPC of any kind (the
+        server's ``_rpc_interceptor`` seam) — the request dies BEFORE
+        any side effect; the retry applies cleanly on the replacement.
+
+        Yields a stats dict (``killed_at``: the index it fired on, or
+        None if never reached)."""
+        from paddle_tpu.embed.shard import ShardKilled
+        stats = {"killed_at": None}
+        if window == "commit":
+            shard = server.shard
+            prev = shard._commit_interceptor
+            count = [0]
+
+            def commit_seam(wal_seq):
+                if prev is not None:
+                    prev(wal_seq)
+                i = count[0]
+                count[0] += 1
+                if i == at:
+                    stats["killed_at"] = i
+                    server.kill()
+                    raise ShardKilled(
+                        f"kill_shard: commit #{i} (WAL {wal_seq} "
+                        "durable, ack never sent)")
+
+            shard._commit_interceptor = commit_seam
+            try:
+                yield stats
+            finally:
+                shard._commit_interceptor = prev
+        elif window == "rpc":
+            prev = server._rpc_interceptor
+
+            def rpc_seam(method, idx):
+                if prev is not None:
+                    prev(method, idx)
+                if idx == at:
+                    stats["killed_at"] = idx
+                    server.kill()
+                    raise ShardKilled(
+                        f"kill_shard: rpc #{idx} ({method})")
+
+            server._rpc_interceptor = rpc_seam
+            try:
+                yield stats
+            finally:
+                server._rpc_interceptor = prev
+        else:
+            raise ValueError(f"unknown kill window {window!r}")
+
+    @staticmethod
+    @contextlib.contextmanager
+    def stale_read(client, age_s: float):
+        """Within the context, every row in the client's bounded-
+        staleness cache (present now or fetched later) reads as
+        ``age_s`` seconds OLDER than it is — rows age past the bound
+        deterministically instead of waiting wall-clock time. Against a
+        LIVE shard this forces refetches (the bound doing its job);
+        against a killed shard it forces stale SERVES, which must be
+        journaled as ``embed/stale_read`` violations. Yields a stats
+        dict (``aged``: entries rewritten so far)."""
+        stats = {"aged": 0}
+        lock = client._lock
+        real_gather = client.gather
+
+        def age_now():
+            with lock:
+                for k, (row, ts) in list(client._cache.items()):
+                    client._cache[k] = (row, ts - age_s)
+                    stats["aged"] += 1
+
+        def gather(keys, max_stale_s=None):
+            out = real_gather(keys, max_stale_s=max_stale_s)
+            age_now()            # rows fetched by THIS call age too
+            return out
+
+        age_now()
+        client.gather = gather
+        try:
+            yield stats
+        finally:
+            client.gather = real_gather
+
+    @staticmethod
+    @contextlib.contextmanager
+    def slow_shard(server, ms: float, at: Iterable[int] = (),
+                   every: bool = False):
+        """Within the context, the shard's RPCs STALL ``ms``
+        milliseconds before handling — chosen 0-based RPC indices, or
+        every RPC (``every=True``): the deterministic straggler/hot-
+        shard twin for tail-latency and timeout tests. Yields a stats
+        dict (``slowed``: indices that stalled)."""
+        indices = set(int(i) for i in at)
+        stats = {"slowed": []}
+        prev = server._rpc_interceptor
+
+        def seam(method, idx):
+            if prev is not None:
+                prev(method, idx)
+            if every or idx in indices:
+                stats["slowed"].append(idx)
+                time.sleep(ms / 1000.0)
+
+        server._rpc_interceptor = seam
+        try:
+            yield stats
+        finally:
+            server._rpc_interceptor = prev
 
     # --------------------------------------------- (d) process murder
     @staticmethod

@@ -146,6 +146,12 @@ class FileStore(KVStore):
         return value
 
 
+#: chunk-manifest magic for RpcStore values split across several keys —
+#: multi-MB payloads (embedding shard snapshots) would otherwise hit the
+#: server's single-value size guard and bloat one XML-RPC body
+_CHUNK_MAGIC = b"PTCHUNK1\n"
+
+
 class RpcStore(KVStore):
     """KVStore client over XML-RPC (a :class:`KVStoreServer`) — the
     snapshot store WITHOUT a shared filesystem: the coordinator (or a
@@ -153,39 +159,98 @@ class RpcStore(KVStore):
     reference kept the master state in etcd. Values travel as
     ``xmlrpc.client.Binary`` (JSON snapshots are bytes, not text), every
     call retries transport blips through :func:`call_with_retry`, and a
-    lock serializes calls (a ``ServerProxy`` is not thread-safe)."""
+    lock serializes calls (a ``ServerProxy`` is not thread-safe).
+
+    Values larger than ``chunk_bytes`` are split across
+    ``key + ".chunk.<i>"`` keys with a crc-stamped manifest written at
+    the base key LAST — a reader either sees the old value or a
+    manifest whose chunks are already durable. A torn/corrupt chunk set
+    (partial overwrite, missing chunk, crc mismatch) reads as *absent*
+    with a warning, mirroring :class:`FileStore` torn-frame semantics."""
 
     def __init__(self, host: str, port: int,
-                 retry: Optional["RetryPolicy"] = None):
+                 retry: Optional["RetryPolicy"] = None,
+                 chunk_bytes: int = 2 * 1024 * 1024):
         from xmlrpc.client import ServerProxy
         self._proxy = ServerProxy(f"http://{host}:{port}",
                                   allow_none=True)
         self._retry = retry
+        self.chunk_bytes = int(chunk_bytes)
         self._lock = named_lock("coord.rpcstore")
 
-    def put(self, key, value):
+    def _rpc_put(self, key: str, value: bytes):
         from xmlrpc.client import Binary
         with self._lock:
             # ptlint: disable=R9(the lock serializes the non-thread-safe ServerProxy; the RPC IS the critical section)
             call_with_retry(self._proxy.put, str(key), Binary(value),
                             policy=self._retry)
 
-    def get(self, key):
+    def _rpc_get(self, key: str) -> Optional[bytes]:
         with self._lock:
             # ptlint: disable=R9(the lock serializes the non-thread-safe ServerProxy; the RPC IS the critical section)
             blob = call_with_retry(self._proxy.get, str(key),
                                    policy=self._retry)
         return None if blob is None else blob.data
 
+    def put(self, key, value):
+        import zlib
+        value = bytes(value)
+        if len(value) <= self.chunk_bytes:
+            self._rpc_put(str(key), value)
+            return
+        n = (len(value) + self.chunk_bytes - 1) // self.chunk_bytes
+        for i in range(n):
+            part = value[i * self.chunk_bytes:(i + 1) * self.chunk_bytes]
+            self._rpc_put(f"{key}.chunk.{i}", part)
+        manifest = _CHUNK_MAGIC + json.dumps(
+            {"n": n, "size": len(value),
+             "crc": zlib.crc32(value) & 0xFFFFFFFF}).encode()
+        self._rpc_put(str(key), manifest)
+
+    def get(self, key):
+        import warnings
+        import zlib
+        raw = self._rpc_get(str(key))
+        if raw is None or not raw.startswith(_CHUNK_MAGIC):
+            return raw
+        try:
+            meta = json.loads(raw[len(_CHUNK_MAGIC):].decode())
+            n, size, crc = int(meta["n"]), int(meta["size"]), \
+                int(meta["crc"])
+        except Exception:  # noqa: BLE001 — not a manifest after all
+            return raw
+        parts = []
+        for i in range(n):
+            part = self._rpc_get(f"{key}.chunk.{i}")
+            if part is None:
+                warnings.warn(
+                    f"RpcStore: {key!r} chunk {i}/{n} missing (torn "
+                    "chunked write); treating as absent", stacklevel=2)
+                return None
+            parts.append(part)
+        value = b"".join(parts)
+        if len(value) != size or (zlib.crc32(value) & 0xFFFFFFFF) != crc:
+            warnings.warn(
+                f"RpcStore: {key!r} chunked value torn or corrupt "
+                f"({len(value)} of {size} bytes); treating as absent",
+                stacklevel=2)
+            return None
+        return value
+
 
 class KVStoreServer:
     """Serve any :class:`KVStore` over XML-RPC for :class:`RpcStore`
-    clients (threaded; handler threads named ``pt-coord-kv-*``)."""
+    clients (threaded; handler threads named ``pt-coord-kv-*``). A
+    single-value size guard rejects bodies above ``max_value_bytes`` —
+    big payloads must ride the client's chunked path instead of turning
+    one XML-RPC body into a memory bomb."""
 
     def __init__(self, store: Optional[KVStore] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_value_bytes: int = 8 * 1024 * 1024):
         from xmlrpc.client import Binary
         self.store = store or InMemStore()
+        self.max_value_bytes = int(max_value_bytes)
         self.server = _ThreadingXMLRPCServer(
             (host, port), allow_none=True, logRequests=False,
             thread_prefix="pt-coord-kv")
@@ -194,6 +259,11 @@ class KVStoreServer:
         def put(key, value):
             data = value.data if isinstance(value, Binary) else \
                 bytes(value)
+            if len(data) > self.max_value_bytes:
+                raise ValueError(
+                    f"KVStoreServer: value for {key!r} is {len(data)} "
+                    f"bytes > max_value_bytes={self.max_value_bytes}; "
+                    "use RpcStore's chunked put")
             self.store.put(str(key), data)
             return True
 
@@ -686,6 +756,17 @@ class Coordinator:
             self._expire_workers_locked()
             return sorted(self._workers)
 
+    def worker_info(self, worker_id: str) -> Optional[Dict[str, Any]]:
+        """The info dict the worker registered at :meth:`join` — the
+        membership plane doubles as a service directory (embedding
+        shards publish their RPC endpoint here; clients re-resolve
+        through this after a transport failure). ``None`` once the
+        lease lapsed, so nobody keeps talking to a ghost."""
+        with self._lock:
+            self._expire_workers_locked()
+            ent = self._workers.get(worker_id)
+            return None if ent is None else dict(ent["info"])
+
     def stats(self) -> Dict[str, Any]:
         """One consistent membership/queue snapshot (the /metrics
         collector and the CLI status line read this)."""
@@ -890,7 +971,8 @@ class CoordinatorServer:
     _RPCS = ("get_task", "task_finished", "task_failed", "task_release",
              "heartbeat", "request_save_model", "time",
              "join", "leave", "worker_heartbeat", "put_memory_plan",
-             "stats", "num_dropped", "num_stale_grants", "workers")
+             "stats", "num_dropped", "num_stale_grants", "workers",
+             "worker_info")
 
     def __init__(self, coordinator: Coordinator, host: str = "127.0.0.1",
                  port: int = 0):

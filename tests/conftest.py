@@ -115,7 +115,8 @@ def _no_pipeline_thread_leaks(request):
 
     def leaked():
         from paddle_tpu.reader.pipeline import THREAD_PREFIX
-        prefixes = (THREAD_PREFIX, "pt-serve", "pt-obs", "pt-coord")
+        prefixes = (THREAD_PREFIX, "pt-serve", "pt-obs", "pt-coord",
+                    "pt-embed")
         return [t for t in threading.enumerate()
                 if t.is_alive() and t.name.startswith(prefixes)]
 

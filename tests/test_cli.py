@@ -636,3 +636,91 @@ class TestFlightCLI:
         summary = json.loads(capsys.readouterr().out.strip())
         assert summary["records"] == 2
         assert [r["mseq"] for r in read_journal(out)] == [1, 2]
+
+
+class TestPserverCLI:
+    def test_pserver_daemon_snapshot_restart_restores(self, tmp_path):
+        """`paddle_tpu pserver` is the 2017 parameter-server binary
+        reborn: serve one shard's gather/scatter RPCs, register on a
+        coordinator daemon's membership plane, snapshot on SIGTERM,
+        and restore the key range digest-stable on restart."""
+        import signal
+
+        from paddle_tpu.embed import EmbeddingClient
+        from paddle_tpu.reader import recordio as rio
+        from paddle_tpu.trainer.coordinator import connect
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+
+        def _stop(proc):
+            proc.send_signal(signal.SIGTERM)
+            try:
+                out, _ = proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise
+            assert proc.returncode == 0
+            return json.loads(out.strip().splitlines()[-1])
+
+        data = str(tmp_path / "train.ptr")
+        rio.write_records(data, [b"r0", b"r1"], max_chunk_bytes=64)
+        coord = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.cli", "coordinator",
+             "--data", data, "--worker_lease", "30"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        ps = None
+        try:
+            cport = json.loads(coord.stdout.readline())["port"]
+            ps = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.cli", "pserver",
+                 "--shard_id", "0", "--shards", "1", "--dim", "8",
+                 "--coordinator", f"127.0.0.1:{cport}",
+                 "--snapshot_dir", str(tmp_path / "snap")],
+                stdout=subprocess.PIPE, text=True, env=env)
+            rec = json.loads(ps.stdout.readline())
+            assert rec["status"] == "serving" and rec["shard_id"] == 0
+            assert rec["restored"] is False
+            assert isinstance(rec["generation"], int)
+
+            # the membership directory answers with the daemon's endpoint
+            info = connect("127.0.0.1", cport).worker_info("embed/0")
+            assert info and info["endpoint"] == rec["endpoint"]
+
+            keys = np.arange(6, dtype=np.int64)
+            with EmbeddingClient(1, 8, endpoints={0: rec["endpoint"]},
+                                 client_id="cli-test") as client:
+                before = client.gather(keys)
+                client.push(keys, np.ones((6, 8), np.float32), lr=0.5)
+                assert client.flush(timeout=20.0)
+                after = client.gather(keys, max_stale_s=0.0)
+            np.testing.assert_allclose(after, before - 0.5, rtol=1e-6)
+
+            stopped = _stop(ps)
+            assert stopped["status"] == "stopped"
+            assert stopped["stats"]["applied_updates"] == 1
+
+            # a replacement with the same flags restores the key range
+            ps = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.cli", "pserver",
+                 "--shard_id", "0", "--shards", "1", "--dim", "8",
+                 "--snapshot_dir", str(tmp_path / "snap")],
+                stdout=subprocess.PIPE, text=True, env=env)
+            rec2 = json.loads(ps.stdout.readline())
+            assert rec2["restored"] is True
+            with EmbeddingClient(1, 8, endpoints={0: rec2["endpoint"]},
+                                 client_id="cli-test-2") as client:
+                restored = client.gather(keys)
+            np.testing.assert_array_equal(restored, after)
+            assert _stop(ps)["status"] == "stopped"
+            ps = None
+        finally:
+            if ps is not None:
+                ps.kill()
+            coord.send_signal(signal.SIGTERM)
+            try:
+                coord.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                coord.kill()
+                raise

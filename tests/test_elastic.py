@@ -218,6 +218,37 @@ class TestFileStoreTornFiles:
             f.write(b'{"old": "snapshot"}')  # pre-framing writer
         assert store.get("legacy") == b'{"old": "snapshot"}'
 
+    def test_chunked_rpcstore_roundtrip_and_torn_chunk(self):
+        """RpcStore chunked path: a multi-chunk value survives the
+        round trip; a torn chunk set (lost chunk, crc mismatch) reads
+        as absent with a warning — FileStore torn-frame parity over
+        the RPC plane."""
+        from paddle_tpu.trainer.coordinator import KVStoreServer, RpcStore
+        backing = InMemStore()
+        srv = KVStoreServer(backing, max_value_bytes=256 * 1024).start()
+        try:
+            store = RpcStore("127.0.0.1", srv.port, chunk_bytes=8 * 1024)
+            big = bytes(np.random.default_rng(0).integers(
+                0, 256, size=50 * 1024, dtype=np.uint8))
+            store.put("embed/snap", big)
+            assert store.get("embed/snap") == big
+            assert store.get("embed/snap.chunk.0") is not None  # chunks real
+            store.put("tiny", b"t")              # small values stay direct
+            assert store.get("tiny") == b"t"
+            # torn: one chunk vanishes server-side (partial overwrite)
+            backing.put("embed/snap.chunk.2", b"")
+            with pytest.warns(UserWarning, match="torn or corrupt"):
+                assert store.get("embed/snap") is None
+            backing._data.pop("embed/snap.chunk.2")
+            with pytest.warns(UserWarning, match="missing"):
+                assert store.get("embed/snap") is None
+            # server size guard: oversized single value is refused
+            import xmlrpc.client
+            with pytest.raises(xmlrpc.client.Fault):
+                store._rpc_put("bomb", b"\x00" * (300 * 1024))
+        finally:
+            srv.stop()
+
     def test_coordinator_recovers_fresh_from_torn_snapshot(self,
                                                            tmp_path):
         store = FileStore(str(tmp_path))

@@ -1,0 +1,316 @@
+"""paddle_tpu.embed — the hash-partitioned embedding/parameter store.
+
+Unit coverage for the pserver pair (shard durability + exactly-once
+ledger, client cache/routing/async push), the `layers.embedding(
+remote=True)` transparency contract (bit-equal with a local table),
+the online/continuous-training loop (serving journal -> self-healing
+reader pipeline -> live sparse updates), and the ``paddle_tpu_embed_*``
+gauge catalog. The failure-mode story lives in tests/test_embed_faults.py
+(chaos family (o))."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.registry import ParamAttr, reset_name_counters
+from paddle_tpu.embed import (EmbeddingClient, EmbeddingShard, EmbedService,
+                              RemoteLookup, journal_sample_reader,
+                              log_sample, run_online, serving_sample_log,
+                              shard_of, stable_hash64)
+from paddle_tpu.obs.events import JOURNAL
+from paddle_tpu.trainer.coordinator import (InMemStore, KVStoreServer,
+                                            RpcStore)
+
+DIM = 8
+
+
+class TestRouting:
+    def test_stable_hash_is_process_independent(self):
+        # golden values pin the splitmix64 mix — a drift here would
+        # strand every key on the wrong shard after an upgrade
+        assert stable_hash64(0) == 16294208416658607535
+        assert stable_hash64(1) == 10451216379200822465
+        assert stable_hash64(-1) == 16490336266968443936
+
+    def test_shard_of_in_range_and_spread(self):
+        owners = [shard_of(k, 4) for k in range(1000)]
+        assert set(owners) <= {0, 1, 2, 3}
+        counts = np.bincount(owners, minlength=4)
+        assert counts.min() > 150        # roughly uniform
+
+
+class TestShard:
+    def _shard(self, store=None, **kw):
+        return EmbeddingShard(0, 1, DIM, seed=3, store=store, **kw)
+
+    def test_lazy_init_is_deterministic_and_unmaterialized(self):
+        a, b = self._shard(), self._shard()
+        keys = np.arange(10, dtype=np.int64)
+        np.testing.assert_array_equal(a.gather(keys), b.gather(keys))
+        # gathers must not materialize rows: the digest covers exactly
+        # the UPDATED state, so it is failover-comparable
+        assert a.stats()["rows"] == 0
+        assert a.digest() == b.digest()
+
+    def test_exactly_once_ledger_dup_and_gap(self):
+        s = self._shard()
+        keys = np.arange(4, dtype=np.int64)
+        g = np.ones((4, DIM), np.float32)
+        assert s.apply_updates("c", 1, keys, g, 0.1)["applied"]
+        d0 = s.digest()
+        res = s.apply_updates("c", 1, keys, g, 0.1)       # retry: dedupe
+        assert res["dup"] and not res["applied"]
+        assert s.digest() == d0                           # not re-applied
+        with pytest.raises(ValueError, match="gap"):
+            s.apply_updates("c", 3, keys, g, 0.1)
+        assert s.apply_updates("c", 2, keys, g, 0.1)["applied"]
+        assert s.applied_seqs() == {"c": 2}
+
+    def test_snapshot_plus_wal_replay_restores_digest(self):
+        store = InMemStore()
+        s = self._shard(store=store)
+        rng = np.random.default_rng(0)
+        for seq in (1, 2, 3):
+            s.apply_updates("c", seq, np.arange(seq * 5, dtype=np.int64),
+                            rng.normal(size=(seq * 5, DIM)).astype(
+                                np.float32), 0.1)
+        s.save_snapshot()
+        for seq in (4, 5):        # past the snapshot horizon: WAL only
+            s.apply_updates("c", seq, np.arange(8, dtype=np.int64),
+                            rng.normal(size=(8, DIM)).astype(np.float32),
+                            0.2)
+        r = self._shard(store=store)
+        assert r.restore_from_store()
+        assert r.stats()["replayed_wal"] == 2
+        assert r.digest() == s.digest()
+        assert r.applied_seqs() == {"c": 5}
+
+    def test_multi_mb_snapshot_rides_chunked_rpcstore(self):
+        srv = KVStoreServer(host="127.0.0.1", port=0).start()
+        try:
+            store = RpcStore("127.0.0.1", srv.port, chunk_bytes=4096)
+            s = self._shard(store=store)
+            keys = np.arange(600, dtype=np.int64)
+            s.apply_updates("c", 1,
+                            keys, np.ones((600, DIM), np.float32), 0.5)
+            s.save_snapshot()                # ~19KB frame -> 5 chunks
+            assert srv.store.get("embed/shard0/snap.chunk.0") is not None
+            r = self._shard(store=RpcStore("127.0.0.1", srv.port))
+            assert r.restore_from_store()
+            assert r.digest() == s.digest()
+        finally:
+            srv.stop()
+
+
+class TestClient:
+    def test_cache_hits_and_staleness_bound(self):
+        with EmbedService(2, DIM, seed=1) as svc:
+            with svc.client(client_id="c1", staleness_s=60.0) as c:
+                keys = np.arange(12, dtype=np.int64)
+                first = c.gather(keys)
+                rpc_gathers = sum(svc.shard(s).stats()["gathers"]
+                                  for s in range(2))
+                second = c.gather(keys)              # all cached
+                np.testing.assert_array_equal(first, second)
+                assert sum(svc.shard(s).stats()["gathers"]
+                           for s in range(2)) == rpc_gathers
+                assert c.stats()["cache_hits"] == len(keys)
+                c.gather(keys, max_stale_s=0.0)      # bound 0: refetch
+                assert sum(svc.shard(s).stats()["gathers"]
+                           for s in range(2)) > rpc_gathers
+
+    def test_push_applies_and_invalidates_cache(self):
+        with EmbedService(2, DIM, seed=1) as svc:
+            with svc.client(client_id="c2") as c:
+                keys = np.arange(6, dtype=np.int64)
+                before = c.gather(keys)
+                g = np.full((6, DIM), 2.0, np.float32)
+                c.push(keys, g, lr=0.5)
+                assert c.flush(timeout=15.0)
+                after = c.gather(keys)       # cache invalidated by push
+                np.testing.assert_allclose(after, before - 0.5 * g,
+                                           rtol=1e-6)
+                assert c.stats()["push_failures"] == 0
+
+    def test_duplicate_keys_accumulate(self):
+        with EmbedService(1, DIM, seed=1) as svc:
+            with svc.client(client_id="c3") as c:
+                k = np.array([7, 7], np.int64)
+                before = c.gather(np.array([7], np.int64))
+                g = np.ones((2, DIM), np.float32)
+                c.push(k, g, lr=0.1)         # same row twice in one push
+                assert c.flush(timeout=15.0)
+                after = c.gather(np.array([7], np.int64))
+                np.testing.assert_allclose(after, before - 0.2, rtol=1e-6)
+
+    def test_poisoned_rows_dropped_at_source(self):
+        with EmbedService(1, DIM, seed=1) as svc:
+            with svc.client(client_id="c4") as c:
+                keys = np.arange(3, dtype=np.int64)
+                before = c.gather(keys)
+                g = np.zeros((3, DIM), np.float32)
+                g[1] = np.nan                          # reconcile guard
+                g[0] = g[2] = 1.0
+                c.push(keys, g, lr=1.0)
+                assert c.flush(timeout=15.0)
+                after = c.gather(keys)
+                np.testing.assert_allclose(after[1], before[1])  # survived
+                np.testing.assert_allclose(after[0], before[0] - 1.0,
+                                           rtol=1e-6)
+
+
+def _remote_pair(vocab):
+    """The same 1-layer model twice: local table vs remote=True."""
+    reset_name_counters()
+    paddle.init(seed=11)
+    ids = paddle.layer.data("ids", paddle.data_type.integer_value(vocab))
+    local = paddle.layer.embedding(ids, size=DIM, name="tbl",
+                                   param_attr=ParamAttr(name="_tbl_w"))
+    topo_local = paddle.Topology(local)
+    reset_name_counters()
+    ids = paddle.layer.data("ids", paddle.data_type.integer_value(vocab))
+    rem = paddle.layer.embedding(ids, size=DIM, name="tbl",
+                                 param_attr=ParamAttr(name="_tbl_w"),
+                                 remote=True)
+    return topo_local, paddle.Topology(rem)
+
+
+class TestRemoteLayer:
+    def test_remote_table_never_materializes(self):
+        _, topo_rem = _remote_pair(vocab=40)
+        assert topo_rem.remote_tables() == {"_tbl_w": "ids"}
+        assert "_tbl_w" not in topo_rem.param_specs
+        assert topo_rem.init_params() == {}
+
+    def test_forward_matches_local_table(self):
+        """The transparency contract: with the local table set to the
+        store's rows, remote and local forwards are bit-equal."""
+        import jax.numpy as jnp
+        vocab = 40
+        topo_local, topo_rem = _remote_pair(vocab)
+        with EmbedService(2, DIM, seed=9) as svc:
+            with svc.client(client_id="lkp") as client:
+                lookup = RemoteLookup(topo_rem, client)
+                table = client.gather(np.arange(vocab, dtype=np.int64))
+                ids = np.random.default_rng(4).integers(
+                    0, vocab, 16).astype(np.int64)
+                out_l, _ = topo_local.forward(
+                    {"_tbl_w": jnp.asarray(table)}, {}, {"ids": ids},
+                    mode="test")
+                sub = lookup.sparse_sub({"ids": ids})
+                out_r, _ = topo_rem.forward({}, {}, {"ids": ids},
+                                            mode="test", sparse_sub=sub)
+                np.testing.assert_allclose(np.asarray(out_r["tbl"]),
+                                           np.asarray(out_l["tbl"]),
+                                           rtol=1e-6)
+
+    def test_forward_without_sparse_sub_raises(self):
+        _, topo_rem = _remote_pair(vocab=40)
+        ids = np.arange(4, dtype=np.int64)
+        with pytest.raises(KeyError, match="REMOTE table"):
+            topo_rem.forward({}, {}, {"ids": ids}, mode="test")
+
+    def test_push_grads_updates_store(self):
+        _, topo_rem = _remote_pair(vocab=40)
+        with EmbedService(2, DIM, seed=9) as svc:
+            with svc.client(client_id="upd") as client:
+                lookup = RemoteLookup(topo_rem, client)
+                ids = np.array([3, 3, 11], np.int64)
+                sub = lookup.sparse_sub({"ids": ids})
+                uids, rows = sub["_tbl_w"]
+                np.testing.assert_array_equal(uids, [3, 11])
+                g = np.ones_like(rows)
+                lookup.push_grads(sub, {"_tbl_w": g}, lr=0.25)
+                assert client.flush(timeout=15.0)
+                fresh = client.gather(uids)
+                np.testing.assert_allclose(fresh, rows - 0.25, rtol=1e-6)
+
+
+class TestOnline:
+    def _journal_samples(self, path, n=24, vocab=60):
+        """Serving writes the feedback journal; labels follow a fixed
+        rule so the loop has something to learn."""
+        rng = np.random.default_rng(8)
+        JOURNAL.configure(str(path))
+        try:
+            for _ in range(n):
+                ids = rng.integers(0, vocab, 5)
+                log_sample(ids, float(ids.sum() % 2))
+        finally:
+            JOURNAL.configure(None)
+
+    def test_online_pass_trains_against_live_store(self, tmp_path):
+        path = tmp_path / "serve.jsonl"
+        self._journal_samples(path)
+        with EmbedService(2, DIM, seed=2) as svc:
+            with svc.client(client_id="online") as client:
+                virgin = client.gather(np.arange(10, dtype=np.int64),
+                                       max_stale_s=0.0).copy()
+                stats = run_online(
+                    client, journal_sample_reader(str(path)),
+                    batch_size=4, lr=0.3, num_workers=2, seed=0)
+                assert stats["batches"] == 6
+                assert stats["samples"] == 24
+                assert np.isfinite(stats["loss_mean"])
+                assert stats["client"]["push_failures"] == 0
+                applied = sum(svc.shard(s).stats()["applied_updates"]
+                              for s in range(2))
+                assert applied >= stats["batches"]
+                # the live store moved: the very next lookup (bound 0 —
+                # no cache) observes the trained rows
+                after = client.gather(np.arange(10, dtype=np.int64),
+                                      max_stale_s=0.0)
+                assert not np.allclose(after, virgin)
+        recs = [r for r in JOURNAL.tail(50, domain="embed")
+                if r["kind"] == "online_pass"]
+        assert recs and recs[-1]["batches"] == 6
+
+    def test_serving_sample_log_seam(self):
+        """InferenceServer(sample_log=...) journals every served batch —
+        the feedback record the online loop trains from."""
+        from paddle_tpu.serving import InferenceServer
+        from paddle_tpu.trainer.inference import Inference
+        reset_name_counters()
+        paddle.init(seed=5)
+        ids = paddle.layer.data("ids",
+                                paddle.data_type.integer_value(30))
+        emb = paddle.layer.embedding(ids, size=DIM, name="emb")
+        out = paddle.layer.fc(emb, size=2,
+                              act=paddle.activation.Softmax())
+        params = paddle.create_parameters(paddle.Topology(out))
+        inf = Inference(output_layer=out, parameters=params)
+        srv = InferenceServer(
+            inf, workers=1, breaker=False,
+            sample_log=serving_sample_log(label_fn=lambda s: 1.0)).start()
+        try:
+            srv.infer([(3,), (17,)])
+        finally:
+            srv.shutdown(drain=True)
+        recs = [r for r in JOURNAL.tail(50, domain="embed")
+                if r["kind"] == "sample"]
+        assert len(recs) >= 2
+        assert recs[-2]["ids"] == [3] and recs[-1]["ids"] == [17]
+        assert recs[-1]["label"] == 1.0
+
+
+class TestEmbedObservability:
+    def test_gauge_catalog_and_flight_provider(self):
+        from paddle_tpu.obs.flight import FLIGHT
+        from paddle_tpu.obs.metrics import REGISTRY
+        with EmbedService(2, DIM, seed=1) as svc:
+            with svc.client(client_id="obs") as c:
+                c.gather(np.arange(8, dtype=np.int64))
+                c.push(np.arange(8, dtype=np.int64),
+                       np.ones((8, DIM), np.float32))
+                assert c.flush(timeout=15.0)
+                text = REGISTRY.exposition()
+                for gauge in ("paddle_tpu_embed_shard_rows",
+                              "paddle_tpu_embed_shard_applied_updates",
+                              "paddle_tpu_embed_client_cached_rows",
+                              "paddle_tpu_embed_client_pushes"):
+                    assert gauge in text, f"missing {gauge}"
+                assert 'shard="0"' in text and 'shard="1"' in text
+                state = FLIGHT.bundle(reason="test")["state"]
+                assert "embed" in state
+                assert any(s["shard_id"] == 0
+                           for s in state["embed"]["shards"])

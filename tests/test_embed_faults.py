@@ -1,0 +1,215 @@
+"""Chaos family (o): the sharded embedding service under fire.
+
+THE acceptance run for the embed subsystem (ISSUE 14): three shards on
+the membership plane, a deterministic training pass of sparse pushes,
+one shard SIGKILL'd (in-process twin: :meth:`EmbeddingShardServer.kill`)
+inside a scatter-update's TORN window — WAL durable, table not mutated,
+ack never sent. The replacement restores the key range from
+snapshot+WAL via the store, re-joins under the same worker id, the
+client's retry of the SAME seq dedupes to ``dup``, and the final table
+digest equals an uninterrupted run's bit for bit. Staleness-bound
+violations (stale serves against the dead shard) and every
+kill/replace/restore transition land in the journal under domain
+``embed``.
+
+See paddle_tpu/testing/faults.py (family (o)) and docs/robustness.md
+"Sharded embedding service" for the recipe.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.embed import (EmbeddingClient, EmbedService,
+                              EmbedUnavailable, shard_of)
+from paddle_tpu.obs.events import JOURNAL
+from paddle_tpu.testing.faults import FaultPlan
+from paddle_tpu.trainer.coordinator import Coordinator
+
+DIM = 8
+SHARDS = 3
+SEED = 7
+
+
+def _batches(n=6, rows=16, base=0):
+    """Deterministic training pass: batch b updates ITS OWN key block
+    (no key is touched twice), so the final table is independent of how
+    the push worker coalesces — any digest drift is a lost or doubled
+    update, not float reassociation."""
+    rng = np.random.default_rng(1234)
+    out = []
+    for b in range(n):
+        keys = np.arange(base + b * rows, base + (b + 1) * rows,
+                         dtype=np.int64)
+        grads = rng.normal(0.0, 1.0, (rows, DIM)).astype(np.float32)
+        # per-batch lr => the push worker groups each batch separately
+        # per shard even when it coalesces, so the victim sees one
+        # scatter_update per batch (the kill index is deterministic)
+        out.append((keys, grads, 0.1 + 0.05 * b))
+    return out
+
+
+def _run_reference(batches, client_id):
+    """The uninterrupted run: same seed, same pushes, no faults."""
+    with EmbedService(SHARDS, DIM, seed=SEED) as ref:
+        with ref.client(client_id=client_id) as c:
+            for keys, grads, lr in batches:
+                c.push(keys, grads, lr=lr)
+            assert c.flush(timeout=30.0)
+        digest = ref.table_digest()
+        seqs = {sid: ref.shard(sid).applied_seqs() for sid in range(SHARDS)}
+    return digest, seqs
+
+
+class TestKillShard:
+    def test_sigkill_mid_commit_exactly_once_digest_stable(self):
+        """The chaos acceptance: kill inside the torn window mid-pass,
+        fail over through the membership directory, and prove
+        exactly-once by digest equality with the uninterrupted run."""
+        batches = _batches()
+        victim = 1
+        # every batch must route at least one row to the victim, or the
+        # kill index below would not be reachable
+        for keys, _, _ in batches:
+            assert any(shard_of(int(k), SHARDS) == victim
+                       for k in keys.tolist())
+        ref_digest, ref_seqs = _run_reference(batches, "chaos-client")
+
+        coord = Coordinator(chunks=[], worker_lease_s=30.0)
+        with EmbedService(SHARDS, DIM, seed=SEED, coordinator=coord,
+                          heartbeat_s=0.1) as svc:
+            client = svc.client(client_id="chaos-client",
+                                retry_deadline=20.0)
+            # die at the victim's SECOND commit: WAL entry for seq 2 is
+            # durable, the table never mutates, the ack never leaves
+            with FaultPlan.kill_shard(svc.server(victim), at=1,
+                                      window="commit") as ks:
+                for keys, grads, lr in batches:
+                    client.push(keys, grads, lr=lr)
+                deadline = time.monotonic() + 10.0
+                while ks["killed_at"] is None and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.02)
+                assert ks["killed_at"] == 1, \
+                    "the commit-window kill never fired"
+                # the replacement restores from the SHARED store and
+                # re-joins under the same worker id — the directory now
+                # answers with the new endpoint and the client's
+                # in-flight retry (same seq) lands there
+                replacement = svc.replace(victim)
+                assert client.flush(timeout=30.0), \
+                    "pushes never drained after failover"
+
+            st = replacement.stats()
+            assert replacement.restored
+            assert st["replayed_wal"] >= 1, \
+                "the torn-window WAL entry was not replayed"
+            cst = client.stats()
+            assert cst["dup_acks"] >= 1, \
+                "the same-seq retry should have deduped (exactly-once)"
+            assert cst["push_failures"] == 0
+            assert cst["failovers"] >= 1
+
+            # THE acceptance value: bit-identical table state
+            assert svc.table_digest() == ref_digest
+            for sid in range(SHARDS):
+                assert svc.shard(sid).applied_seqs() == ref_seqs[sid]
+
+            # membership plane: the replacement's endpoint is published
+            info = coord.worker_info(f"embed/{victim}")
+            assert info is not None
+            assert info["endpoint"] == svc.server(victim).endpoint
+            client.close()
+
+        kinds = {r["kind"] for r in JOURNAL.tail(400, domain="embed")}
+        assert {"shard_killed", "shard_replaced", "restore"} <= kinds, \
+            f"failover transitions missing from the journal: {kinds}"
+
+    def test_kill_in_rpc_window_retry_applies_cleanly(self):
+        """window='rpc' dies BEFORE any side effect: no WAL entry, so
+        the retry is a first application on the replacement — applied
+        exactly once, no dup ack."""
+        with EmbedService(1, DIM, seed=3,
+                          coordinator=Coordinator(chunks=[],
+                                                  worker_lease_s=30.0),
+                          heartbeat_s=0.1) as svc:
+            with svc.client(client_id="rpc-kill",
+                            retry_deadline=15.0) as client:
+                keys = np.arange(32, dtype=np.int64)
+                grads = np.ones((32, DIM), np.float32)
+                with FaultPlan.kill_shard(svc.server(0), at=0,
+                                          window="rpc") as ks:
+                    client.push(keys, grads, lr=0.5)
+                    deadline = time.monotonic() + 10.0
+                    while ks["killed_at"] is None and \
+                            time.monotonic() < deadline:
+                        time.sleep(0.02)
+                    assert ks["killed_at"] == 0
+                    svc.replace(0)
+                    assert client.flush(timeout=30.0)
+                st = svc.shard(0).stats()
+                assert st["applied_updates"] == 1
+                assert st["replayed_wal"] == 0
+                assert svc.shard(0).applied_seqs() == {"rpc-kill": 1}
+                assert client.stats()["dup_acks"] == 0
+                assert client.stats()["push_failures"] == 0
+
+
+class TestStaleRead:
+    def test_stale_serve_against_dead_shard_is_journaled(self):
+        """A dead shard past the retry deadline serves from stale cache
+        — availability over freshness — and the violation is journaled
+        under domain ``embed`` with the observed age and the bound."""
+        with EmbedService(1, DIM, seed=5) as svc:
+            with svc.client(client_id="stale-reader", staleness_s=30.0,
+                            retry_deadline=0.3) as client:
+                keys = np.arange(10, dtype=np.int64)
+                fresh = client.gather(keys)           # warm the cache
+                svc.kill(0)
+                with FaultPlan.stale_read(client, age_s=100.0) as st:
+                    rows = client.gather(keys)
+                    assert st["aged"] >= len(keys)
+                np.testing.assert_array_equal(rows, fresh)
+                cst = client.stats()
+                assert cst["stale_serves"] == len(keys)
+                # an uncached key has nothing to stand in — that one
+                # still fails loudly
+                with pytest.raises(EmbedUnavailable):
+                    client.gather(np.array([777], np.int64))
+        recs = [r for r in JOURNAL.tail(100, domain="embed")
+                if r["kind"] == "stale_read"]
+        assert recs, "stale serve was not journaled"
+        assert recs[-1]["age_s"] >= recs[-1]["bound_s"]
+        assert recs[-1]["rows"] == 10
+
+    def test_stale_bound_forces_refetch_against_live_shard(self):
+        """Against a LIVE shard the bound does its job: aged rows
+        refetch instead of serving stale."""
+        with EmbedService(1, DIM, seed=5) as svc:
+            with svc.client(client_id="fresh-reader",
+                            staleness_s=30.0) as client:
+                keys = np.arange(6, dtype=np.int64)
+                client.gather(keys)
+                before = svc.shard(0).stats()["gathers"]
+                with FaultPlan.stale_read(client, age_s=100.0):
+                    client.gather(keys)               # aged -> refetch
+                    client.gather(keys)               # aged again
+                after = svc.shard(0).stats()["gathers"]
+                assert after >= before + 2
+                assert client.stats()["stale_serves"] == 0
+
+
+class TestSlowShard:
+    def test_slow_shard_stalls_chosen_rpcs(self):
+        with EmbedService(1, DIM, seed=5) as svc:
+            with svc.client(client_id="slow-reader") as client:
+                keys = np.arange(4, dtype=np.int64)
+                with FaultPlan.slow_shard(svc.server(0), ms=80.0,
+                                          at=[1]) as st:
+                    client.gather(keys)               # rpc #0: fast
+                    t0 = time.monotonic()
+                    client.gather(keys + 100)         # rpc #1: stalled
+                    stalled = time.monotonic() - t0
+                assert st["slowed"] == [1]
+                assert stalled >= 0.07
