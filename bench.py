@@ -575,7 +575,7 @@ SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine",
               "decode_prefix_hit", "decode_speculative",
               "flight_recorder_overhead", "profiler_overhead",
               "lockdep_overhead", "coord_reshard", "embed_lookup",
-              "embed_update")
+              "embed_update", "fleet_route", "fleet_failover")
 
 
 def _smoke_trainer(batch: int = 16):
@@ -989,6 +989,118 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
                         "push_failures": st["push_failures"],
                         "batches": n_batches,
                     }
+    if "fleet_route" in rows or "fleet_failover" in rows:
+        # ISSUE 15 tentpole: the serving-fleet router. fleet_route
+        # gates the ROUTER'S OVERHEAD — the same request through the
+        # router hop (radix-affinity choose + HTTP stream relay) vs
+        # straight at the replica; both are latency metrics with the
+        # absolute floor, so only a real control-plane regression
+        # (scrape under the route lock, affinity scan gone quadratic)
+        # fails. fleet_failover is an info row: mid-stream kill ->
+        # time-to-resume on the sibling, recorded for trend reading
+        # (docs/robustness.md "Serving fleet").
+        import threading as _th
+        import urllib.request as _rq
+
+        from paddle_tpu.fleet import Router
+        from paddle_tpu.serving import (DecodeEngine, InferenceServer,
+                                        build_http_server)
+        from paddle_tpu.testing import FaultPlan
+
+        def _fleet_replica():
+            eng = DecodeEngine(_smoke_decoder(), num_slots=2,
+                               page_size=4, max_seq_len=32)
+            srv = InferenceServer(None, max_queue=32, workers=1,
+                                  breaker=False, engine=eng).start()
+            httpd = build_http_server(srv, "127.0.0.1", 0)
+            _th.Thread(target=httpd.serve_forever, daemon=True,
+                       name="pt-bench-replica").start()
+            ep = f"http://127.0.0.1:{httpd.server_address[1]}"
+            return {"engine": eng, "server": srv, "httpd": httpd,
+                    "endpoint": ep, "killed": False}
+
+        def _direct(ep, prompt, n):
+            body = json.dumps({"prompt": prompt,
+                               "max_new_tokens": n}).encode()
+            req = _rq.Request(ep + "/generate", data=body,
+                              headers={"Content-Type":
+                                       "application/json"})
+            with _rq.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        reps = [_fleet_replica(), _fleet_replica()]
+        router = Router(endpoints={f"r{i}": rep["endpoint"]
+                                   for i, rep in enumerate(reps)},
+                        affinity="prefix", page_size=4,
+                        scrape_interval=0.1, queue_timeout=10.0)
+        try:
+            rng = np.random.RandomState(3)
+            shared = [int(t) for t in rng.randint(0, 40, (9,))]
+            for rep in reps:                    # compile + warm BOTH
+                _direct(rep["endpoint"], shared, 1)
+            router.refresh()
+            if "fleet_route" in rows:
+                reqs = 16
+                direct_ms, routed_ms = [], []
+                for i in range(reqs):
+                    p = shared + [i % 40]
+                    t0 = time.perf_counter()
+                    _direct(reps[0]["endpoint"], p, 4)
+                    direct_ms.append((time.perf_counter() - t0) * 1e3)
+                for i in range(reqs):
+                    p = shared + [i % 40]
+                    t0 = time.perf_counter()
+                    router.generate(p, 4)
+                    routed_ms.append((time.perf_counter() - t0) * 1e3)
+                direct_ms.sort()
+                routed_ms.sort()
+                st = router.stats()
+                out["fleet_route"] = {
+                    "route_p50_ms": round(
+                        routed_ms[len(routed_ms) // 2], 3),
+                    "route_p99_ms": round(routed_ms[-1], 3),
+                    "direct_p50_ms": round(
+                        direct_ms[len(direct_ms) // 2], 3),
+                    "routed": st["routed"],
+                    "affinity_hits": st["affinity_hits"],
+                }
+            if "fleet_failover" in rows:
+                # pin the stream on a known victim, throttle it so the
+                # kill lands MID-stream, and time kill -> first token
+                # relayed off the sibling
+                prime = router.generate(shared + [38], 2)
+                victim_i = int(prime.replica_chain[-1][1:])
+                victim = reps[victim_i]
+                victim["engine"]._step_interceptor = \
+                    lambda s: time.sleep(0.01)
+                marks = {}
+
+                def _kill():
+                    victim["killed"] = True
+                    marks["kill"] = time.perf_counter()
+                    victim["httpd"].kill()
+
+                def _tok(_t):
+                    if "kill" in marks and "resume" not in marks:
+                        marks["resume"] = time.perf_counter()
+
+                with FaultPlan.kill_replica(
+                        router, f"r{victim_i}", _kill, at=2):
+                    res = router.generate(shared + [38], 10,
+                                          on_token=_tok)
+                out["fleet_failover"] = {
+                    "resume_ms": round(
+                        (marks["resume"] - marks["kill"]) * 1e3, 3),
+                    "hops": res.hops,
+                    "tokens_out": len(res.tokens),
+                }
+        finally:
+            router.shutdown(drain=True, timeout=10)
+            for rep in reps:
+                if not rep["killed"]:
+                    rep["httpd"].shutdown()
+                    rep["httpd"].server_close()
+                rep["server"].shutdown(drain=True, timeout=30)
     return {"v": 1, "suite": "smoke", "rows": out}
 
 

@@ -366,8 +366,13 @@ def _build_server(args, InferenceServer, CircuitBreaker,
     engine = None
     if getattr(args, "decode_config", None):
         engine = (engine_builder or _build_engine)(args)
+    if not args.model and engine is None:
+        raise SystemExit("need --model (a merged artifact for /infer) "
+                         "or --decode_config (a generate-only fleet "
+                         "replica) — got neither")
     server = InferenceServer(
-        args.model, max_queue=args.max_queue, workers=args.workers,
+        args.model or None,
+        max_queue=args.max_queue, workers=args.workers,
         default_deadline=(args.deadline_ms / 1e3
                           if args.deadline_ms else None),
         max_batch_memory=args.max_batch_memory or None,
@@ -390,6 +395,21 @@ def _cmd_serve(args) -> int:
 
     server, httpd = _build_server(args, InferenceServer, CircuitBreaker,
                                   build_http_server)
+    # fleet membership (docs/robustness.md "Serving fleet"): join the
+    # coordinator directory as serve/<replica_id> publishing the HTTP
+    # endpoint, so a `paddle_tpu router` discovers (and fails over)
+    # this replica with no static config
+    registration = None
+    if getattr(args, "coordinator", None):
+        from paddle_tpu.fleet import ReplicaRegistration
+        from paddle_tpu.trainer.coordinator import connect
+        chost, _, cport = args.coordinator.rpartition(":")
+        endpoint = f"http://{args.host}:{httpd.server_address[1]}"
+        replica_id = args.replica_id or \
+            f"{args.host}-{httpd.server_address[1]}"
+        registration = ReplicaRegistration(
+            connect(chost or "127.0.0.1", int(cport)), replica_id,
+            endpoint, heartbeat_s=args.heartbeat).join()
 
     stop = []
 
@@ -409,9 +429,16 @@ def _cmd_serve(args) -> int:
                       "host": args.host,
                       "port": httpd.server_address[1],
                       "workers": args.workers,
-                      "max_queue": args.max_queue}), flush=True)
+                      "max_queue": args.max_queue,
+                      "replica_id": registration.replica_id
+                      if registration else None}), flush=True)
     while not stop:
         time.sleep(0.2)
+    # orderly exit mirrors pserver: the goodbye FIRST (a router
+    # mid-retry sees the directory lose the entry before the endpoint
+    # stops answering), then the transport, then the drain
+    if registration is not None:
+        registration.stop(leave=True)
     httpd.shutdown()            # stop admissions at the transport...
     server.shutdown(drain=True)  # ...then drain the queued requests
     if args.profile_every or args.slo:
@@ -525,6 +552,81 @@ def _cmd_pserver(args) -> int:
     server.stop()
     print(json.dumps({"job": "pserver", "status": "stopped",
                       "stats": shard.stats()}))
+    return 0
+
+
+def _build_router(args, Router, build_router_http_server, connect):
+    """router-flag wiring, split from the signal loop so tests can
+    assert the flags reach Router without a live coordinator
+    (tests/test_cli.py)."""
+    chost, _, cport = args.coordinator.rpartition(":")
+    coord = connect(chost or "127.0.0.1", int(cport))
+    router = Router(coordinator=coord, affinity=args.affinity,
+                    page_size=args.page_size,
+                    scrape_interval=args.scrape_interval,
+                    queue_timeout=args.queue_timeout,
+                    drain_timeout=args.drain_timeout).start()
+    httpd = build_router_http_server(router, args.host, args.port)
+    return router, httpd, coord
+
+
+def _router_teardown(router, registration, httpd) -> None:
+    """The SIGTERM contract, in this order (tests/test_cli.py pins
+    it): DRAIN — stop admitting, let in-flight requests settle on
+    their replicas; LEAVE — drop the router's membership lease so
+    clients resolving through the directory stop finding it; CLOSE —
+    only then stop answering the socket. A client mid-retry never
+    sees a live directory entry pointing at a dead port."""
+    router.shutdown(drain=True)
+    if registration is not None:
+        registration.stop(leave=True)
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _cmd_router(args) -> int:
+    """Run the serving-fleet router daemon (docs/robustness.md
+    "Serving fleet"): front N `paddle_tpu serve --coordinator`
+    replicas with aggregate-KV admission, prefix-affinity routing,
+    drain/deploy and exactly-once mid-stream failover."""
+    import signal
+    import threading
+
+    from paddle_tpu.fleet import Router, build_router_http_server
+    from paddle_tpu.fleet.registry import Registration
+    from paddle_tpu.trainer.coordinator import connect
+
+    router, httpd, coord = _build_router(
+        args, Router, build_router_http_server, connect)
+    endpoint = f"http://{args.host}:{httpd.server_address[1]}"
+    registration = Registration(
+        coord, "fleet/router",
+        {"role": "fleet_router", "endpoint": endpoint},
+        heartbeat_s=args.heartbeat).join()
+
+    stop = []
+
+    def _on_stop_signal(*a):
+        from paddle_tpu.obs.flight import FLIGHT
+        FLIGHT.maybe_autodump("sigterm")
+        stop.append(1)
+
+    signal.signal(signal.SIGTERM, _on_stop_signal)
+    signal.signal(signal.SIGINT, _on_stop_signal)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="pt-fleet-http")
+    t.start()
+    print(json.dumps({"job": "router", "status": "serving",
+                      "host": args.host,
+                      "port": httpd.server_address[1],
+                      "affinity": args.affinity,
+                      "replicas": len(router.balancer.replicas())}),
+          flush=True)
+    while not stop:
+        time.sleep(0.2)
+    _router_teardown(router, registration, httpd)
+    print(json.dumps({"job": "router", "status": "stopped",
+                      "stats": router.stats()}))
     return 0
 
 
@@ -924,8 +1026,10 @@ def main(argv=None) -> int:
 
     sv = sub.add_parser("serve", help="serve a merged artifact over HTTP "
                         "with admission control (docs/robustness.md)")
-    sv.add_argument("--model", required=True,
-                    help="merged .tar from `paddle_tpu merge`")
+    sv.add_argument("--model", default=None,
+                    help="merged .tar from `paddle_tpu merge` "
+                         "(optional when --decode_config makes this a "
+                         "generate-only fleet replica)")
     sv.add_argument("--host", default="127.0.0.1")
     sv.add_argument("--port", type=int, default=0,
                     help="0 picks a free port (printed as JSON)")
@@ -999,6 +1103,63 @@ def main(argv=None) -> int:
                     help="rotate the --event_log file at N bytes "
                          "(0: never)")
     sv.add_argument("--event_log_keep", type=int, default=3,
+                    help="rotated journal segments to keep (default 3)")
+    sv.add_argument("--coordinator", default=None,
+                    help="HOST:PORT of a `paddle_tpu coordinator` "
+                         "daemon — join the membership plane as "
+                         "serve/<replica_id> publishing this HTTP "
+                         "endpoint, so a `paddle_tpu router` "
+                         "discovers and fails over this replica "
+                         "(docs/robustness.md 'Serving fleet')")
+    sv.add_argument("--replica_id", default=None,
+                    help="fleet replica id (default: host-port)")
+    sv.add_argument("--heartbeat", type=float, default=1.0,
+                    help="membership lease heartbeat seconds")
+
+    rt = sub.add_parser("router", help="run the serving-fleet router "
+                        "daemon: KV-aware, prefix-affine dispatch over "
+                        "N serve replicas with mid-stream failover "
+                        "(docs/robustness.md 'Serving fleet')")
+    rt.add_argument("--coordinator", required=True,
+                    help="HOST:PORT of the `paddle_tpu coordinator` "
+                         "whose membership plane the replicas join")
+    rt.add_argument("--host", default="127.0.0.1")
+    rt.add_argument("--port", type=int, default=0,
+                    help="0 picks a free port (printed as JSON)")
+    rt.add_argument("--affinity", choices=["prefix", "load"],
+                    default="prefix",
+                    help="placement policy: 'prefix' steers "
+                         "shared-prefix traffic to the replica whose "
+                         "KV trie holds those pages; 'load' is pure "
+                         "least-loaded-by-KV-headroom")
+    rt.add_argument("--drain_timeout", type=float, default=10.0,
+                    help="seconds to wait for in-flight settles on "
+                         "POST /admin/drain and SIGTERM")
+    rt.add_argument("--page_size", type=int, default=16,
+                    help="KV page size in tokens — must match the "
+                         "replicas' --gen_page_size (the affinity "
+                         "index mirrors their prefix-trie keying)")
+    rt.add_argument("--scrape_interval", type=float, default=0.5,
+                    help="seconds between KV-gauge scrapes of each "
+                         "replica's /metrics")
+    rt.add_argument("--queue_timeout", type=float, default=5.0,
+                    help="how long a request may queue for fleet KV "
+                         "headroom before a typed 429")
+    rt.add_argument("--heartbeat", type=float, default=1.0,
+                    help="the router's own membership lease heartbeat")
+    rt.add_argument("--event_log", default=None,
+                    help="append the fleet journal (route/failover/"
+                         "drain/rejoin records) to this JSONL file")
+    rt.add_argument("--run_id", default=None,
+                    help="correlation id stamped on every journal "
+                         "record/span (default: generated)")
+    rt.add_argument("--flight_dir", default=None,
+                    help="arm flight-recorder auto-dump (SIGTERM and "
+                         "fatal exceptions)")
+    rt.add_argument("--event_log_max_bytes", type=int, default=0,
+                    help="rotate the --event_log file at N bytes "
+                         "(0: never)")
+    rt.add_argument("--event_log_keep", type=int, default=3,
                     help="rotated journal segments to keep (default 3)")
 
     pf = sub.add_parser("profile", help="on-demand deep profile window: "
@@ -1164,6 +1325,20 @@ def main(argv=None) -> int:
         return _cmd_coordinator(args)
     if args.command == "pserver":
         return _cmd_pserver(args)
+    if args.command == "router":
+        from paddle_tpu.obs import context as obs_context
+        from paddle_tpu.obs.events import JOURNAL
+        from paddle_tpu.obs.flight import FLIGHT, install_excepthook
+        if args.run_id:
+            obs_context.set_run_id(args.run_id)
+        if args.event_log:
+            JOURNAL.configure(args.event_log,
+                              max_bytes=args.event_log_max_bytes or None,
+                              keep=args.event_log_keep)
+        if args.flight_dir:
+            FLIGHT.configure(dump_dir=args.flight_dir)
+        install_excepthook()
+        return _cmd_router(args)
     if args.command == "profile":
         return _cmd_profile(args)
     if args.command == "serve":

@@ -1,0 +1,30 @@
+"""Serving fleet v1 (ROADMAP item 4): a KV-aware, prefix-affine
+router over N `paddle_tpu serve` replicas with exactly-once mid-stream
+failover.
+
+- fleet/registry.py — replica membership on the coordinator plane
+  (lease expiry = implicit drain; rejoin = re-admit)
+- fleet/balance.py — aggregate-KV-headroom admission + the radix
+  prefix-affinity index (serving/prefix.py's keying, router-side)
+- fleet/router.py  — dispatch, queueing, drain/deploy, mid-stream
+  failover with trace-id continuity
+- fleet/http.py    — the `paddle_tpu router` daemon's HTTP front
+- fleet/obs.py     — paddle_tpu_fleet_* exposition + flight state
+
+docs/robustness.md "Serving fleet" has the operational story;
+testing/faults.py family (p) + tests/test_fleet_faults.py the chaos
+coverage.
+"""
+
+from paddle_tpu.fleet.balance import (AffinityIndex, FleetBalancer,
+                                      ReplicaState)
+from paddle_tpu.fleet.http import build_router_http_server
+from paddle_tpu.fleet.registry import (Registration, ReplicaRegistration,
+                                       ReplicaRegistry, ReplicaView)
+from paddle_tpu.fleet.router import FleetResult, Router
+
+__all__ = [
+    "AffinityIndex", "FleetBalancer", "FleetResult", "Registration",
+    "ReplicaRegistration", "ReplicaRegistry", "ReplicaState",
+    "ReplicaView", "Router", "build_router_http_server",
+]

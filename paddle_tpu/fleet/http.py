@@ -1,0 +1,154 @@
+"""HTTP front for the fleet router (the `paddle_tpu router` daemon's
+transport; stdlib-only like serving/http.py).
+
+Endpoints:
+  GET  /health          -> Router.health() (fleet membership + drain
+                           marks + in-flight count)
+  GET  /stats           -> Router.stats()
+  GET  /metrics         -> paddle_tpu_fleet_* exposition + the global
+                           registry (fleet/obs.py)
+  POST /generate        -> body {"prompt": [int...],
+                                 "max_new_tokens": int, ...} — routed
+                           through fleet admission / prefix affinity /
+                           failover; the response carries the hop
+                           chain so a client can see a failover
+                           happened without reading the journal
+  POST /admin/drain     -> body {"replica": id} — stop new admissions
+                           to that replica, wait for in-flight settle
+  POST /admin/resume    -> body {"replica": id} — manual re-admit
+
+Error mapping matches serving/http.py, with the fleet's own typed
+reasons: 503 + Retry-After for ``fleet_kv_capacity`` (no replica can
+EVER hold the request) and ``fleet_no_replica``; 429 + Retry-After
+for ``queue_full`` (headroom stayed exhausted past queue_timeout).
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from paddle_tpu.obs import context as obs_context
+from paddle_tpu.serving.server import (Expired, Rejected, ServerClosed,
+                                       ServingError)
+
+from paddle_tpu.fleet.obs import prometheus_text
+from paddle_tpu.fleet.router import Router
+
+__all__ = ["build_router_http_server"]
+
+
+def build_router_http_server(router: Router, host: str = "127.0.0.1",
+                             port: int = 0) -> ThreadingHTTPServer:
+    """An HTTP server bound to (host, port) — port 0 picks a free one.
+    Caller runs .serve_forever() (usually on a thread) and
+    .shutdown()."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def _json(self, code: int, payload: dict, headers=()):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._json(200, router.health())
+            elif self.path == "/stats":
+                self._json(200, router.stats())
+            elif self.path == "/metrics":
+                body = prometheus_text(router).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            if self.path == "/admin/drain":
+                self._admin(req, drain=True)
+                return
+            if self.path == "/admin/resume":
+                self._admin(req, drain=False)
+                return
+            if self.path != "/generate":
+                self._json(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                prompt = req["prompt"]
+                if not isinstance(prompt, list) or not prompt:
+                    raise ValueError("prompt must be a non-empty list "
+                                     "of token ids")
+                max_new = int(req["max_new_tokens"])
+                eos_id = req.get("eos_id")
+                eos_id = int(eos_id) if eos_id is not None else None
+                deadline = req.get("deadline_ms")
+                deadline = float(deadline) / 1e3 \
+                    if deadline is not None else None
+            except (ValueError, KeyError, TypeError) as e:
+                self._json(400, {"error": f"bad request: {e}"})
+                return
+            tid = self.headers.get("X-Trace-Id") or req.get("trace_id")
+            tid = str(tid) if tid else obs_context.new_trace_id()
+            hdr = [("X-Trace-Id", tid)]
+            try:
+                with obs_context.bind(trace_id=tid):
+                    res = router.generate(prompt, max_new,
+                                          eos_id=eos_id,
+                                          deadline=deadline,
+                                          trace_id=tid)
+            except Rejected as e:
+                code = 429 if e.reason == "queue_full" else 503
+                self._json(code, {"error": str(e), "reason": e.reason,
+                                  "retry_after": e.retry_after,
+                                  "trace_id": tid},
+                           headers=hdr + [
+                               ("Retry-After",
+                                f"{max(e.retry_after, 0.01):.3f}")])
+                return
+            except Expired as e:
+                self._json(504, {"error": str(e), "trace_id": tid},
+                           headers=hdr)
+                return
+            except ServerClosed as e:
+                self._json(503, {"error": str(e), "reason": "draining",
+                                 "trace_id": tid}, headers=hdr)
+                return
+            except ServingError as e:
+                self._json(500, {"error": str(e), "trace_id": tid},
+                           headers=hdr)
+                return
+            out = res.as_dict()
+            self._json(200, out, headers=hdr)
+
+        def _admin(self, req: dict, drain: bool):
+            rid = req.get("replica")
+            if not rid:
+                self._json(400, {"error": "body must name a "
+                                          "\"replica\""})
+                return
+            try:
+                out = router.drain(str(rid)) if drain \
+                    else router.undrain(str(rid))
+            except KeyError as e:
+                self._json(404, {"error": str(e)})
+                return
+            self._json(200, out)
+
+    return ThreadingHTTPServer((host, port), Handler)

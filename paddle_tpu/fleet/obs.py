@@ -1,0 +1,55 @@
+"""Fleet observability: the ``paddle_tpu_fleet_*`` exposition + flight
+state (docs/observability.md "Fleet gauges").
+
+Same pattern as serving/http.py: the router's ``stats()`` dict is
+flattened into Prometheus families at scrape time through
+obs.metrics.stats_families — cumulative leaves keep counter semantics,
+everything else is a gauge — and the global REGISTRY rides along so
+one scrape of the router sees the whole process. The flight recorder
+gets a live state provider (in-flight trace_ids by replica, drain
+marks) so a postmortem bundle shows what the router was doing when a
+fault fired.
+"""
+
+from __future__ import annotations
+
+import weakref
+
+from paddle_tpu.obs.flight import FLIGHT
+from paddle_tpu.obs.metrics import REGISTRY, stats_families
+
+__all__ = ["prometheus_text", "register_flight_provider",
+           "_COUNTER_KEYS"]
+
+#: router stats() leaf keys with cumulative (counter) semantics; every
+#: other numeric leaf is a gauge. Flattened names
+#: (paddle_tpu_fleet_routed, paddle_tpu_fleet_failovers,
+#: paddle_tpu_fleet_rejected_kv_capacity ...) are test-pinned.
+_COUNTER_KEYS = {
+    "routed", "affinity_hits", "failovers", "reroutes",
+    "rejected_kv_capacity", "rejected_queue_full",
+    "rejected_no_replica", "drains", "rejoins", "settled",
+    "settled_failover", "queued", "scrape_errors",
+}
+
+
+def prometheus_text(router, prefix: str = "paddle_tpu_fleet") -> str:
+    """Render ``router.stats()`` PLUS the global metrics registry as
+    Prometheus text exposition 0.0.4 — the router's GET /metrics."""
+    return REGISTRY.exposition(
+        extra=stats_families(prefix, router.stats(), _COUNTER_KEYS))
+
+
+def register_flight_provider(router) -> None:
+    """Weakref'd live-state provider: what was in flight (trace_ids by
+    replica) and which replicas were draining when a bundle dumped."""
+    ref = weakref.ref(router)
+
+    def _state():
+        rt = ref()
+        if rt is None:
+            return None
+        return rt.flight_state()
+
+    FLIGHT.register_state_provider(f"fleet-router-{id(router):x}",
+                                   _state)

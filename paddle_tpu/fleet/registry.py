@@ -1,0 +1,225 @@
+"""Replica membership for the serving fleet — the directory IS the
+failover mechanism (the PR-9 membership plane re-used for serving).
+
+A serving replica is a WORKER of the elastic coordinator: it joins as
+``serve/<replica_id>`` publishing its HTTP endpoint (plus a per-process
+``boot_id``) in the join info, renews its lease from a heartbeat thread
+(``pt-fleet-hb-*``), and leaves gracefully on stop. A SIGKILL'd replica
+simply stops heartbeating — its lease lapses, ``worker_info`` starts
+returning None, and the router's next :meth:`ReplicaRegistry.poll`
+sees it gone: **lease expiry is an implicit drain**. When the replica
+(or its replacement) comes back it re-joins under the same worker id
+with a fresh ``boot_id``; the registry reports that transition as a
+rejoin so the router can clear any draining mark and re-admit it.
+
+The same :class:`Registration` keeps the ROUTER's own lease
+(``fleet/router``), so `paddle_tpu trace merge` and the membership
+journal see every fleet process through one directory.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from paddle_tpu.analysis.lockdep import named_lock
+from paddle_tpu.obs import context as obs_context
+
+__all__ = ["Registration", "ReplicaRegistration", "ReplicaRegistry",
+           "ReplicaView"]
+
+
+class Registration:
+    """Keep one fleet process's membership lease alive.
+
+    coordinator: a Coordinator (in-process) or a CoordinatorServer
+    proxy — both expose join/worker_heartbeat/leave. The heartbeat
+    thread re-JOINS when the coordinator answers -1 (our lease lapsed,
+    e.g. a long GC pause or a coordinator restart): the endpoint gets
+    re-published, so directory-based routers recover on their own.
+    ``pause()`` stops renewals WITHOUT leaving — the chaos suite's
+    lease-lapse fault (testing/faults.py family (p)) — and
+    ``unpause()`` restarts them (the next tick re-joins)."""
+
+    def __init__(self, coordinator: Any, worker_id: str,
+                 info: Dict[str, Any], heartbeat_s: float = 1.0):
+        self.coordinator = coordinator
+        self.worker_id = worker_id
+        self.info = dict(info)
+        # one id per PROCESS START: a rejoin under the same worker_id
+        # with a new boot_id is a restart, not a lease blip
+        self.info.setdefault("boot_id", uuid.uuid4().hex[:12])
+        self.heartbeat_s = float(heartbeat_s)
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.generation: Optional[int] = None
+        self.rejoins = 0
+
+    def _info(self) -> Dict[str, Any]:
+        return dict(self.info)
+
+    def join(self) -> "Registration":
+        grant = self.coordinator.join(self.worker_id, self._info())
+        self.generation = grant["generation"]
+        self._thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name=f"pt-fleet-hb-{self.worker_id.replace('/', '-')}")
+        self._thread.start()
+        return self
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.heartbeat_s):
+            if self._paused.is_set():
+                continue               # lease-lapse fault: let it expire
+            try:
+                gen = self.coordinator.worker_heartbeat(self.worker_id)
+                if gen == -1:          # lease lapsed: re-join, re-publish
+                    grant = self.coordinator.join(self.worker_id,
+                                                  self._info())
+                    gen = grant["generation"]
+                    self.rejoins += 1
+                self.generation = gen
+            except Exception:  # noqa: BLE001 — a coordinator blip must
+                pass           # not kill the lease keeper; next tick retries
+
+    def pause(self) -> None:
+        """Stop renewing (without leaving) — the lease will lapse."""
+        self._paused.set()
+
+    def unpause(self) -> None:
+        """Resume renewals; the next heartbeat tick re-joins."""
+        self._paused.clear()
+
+    def stop(self, leave: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if leave:
+            try:
+                self.coordinator.leave(self.worker_id)
+            except Exception:  # noqa: BLE001 — best-effort goodbye
+                pass
+
+
+class ReplicaRegistration(Registration):
+    """One serving replica's lease: ``serve/<replica_id>`` publishing
+    its HTTP endpoint (the address the router dispatches to)."""
+
+    def __init__(self, coordinator: Any, replica_id: str, endpoint: str,
+                 heartbeat_s: float = 1.0):
+        super().__init__(
+            coordinator, f"serve/{replica_id}",
+            {"role": "serve_replica", "replica_id": str(replica_id),
+             "endpoint": endpoint,
+             "run_id": obs_context.ensure_run_id(),
+             "host": obs_context.get_host()},
+            heartbeat_s=heartbeat_s)
+        self.replica_id = str(replica_id)
+        self.endpoint = endpoint
+
+
+class ReplicaView:
+    """The router's picture of one replica, as of the last poll."""
+
+    __slots__ = ("replica_id", "endpoint", "boot_id", "live")
+
+    def __init__(self, replica_id: str, endpoint: str,
+                 boot_id: Optional[str], live: bool = True):
+        self.replica_id = replica_id
+        self.endpoint = endpoint
+        self.boot_id = boot_id
+        self.live = live
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"replica_id": self.replica_id, "endpoint": self.endpoint,
+                "boot_id": self.boot_id, "live": self.live}
+
+
+class ReplicaRegistry:
+    """Router-side replica discovery.
+
+    Backed by the coordinator directory when one is given (``poll()``
+    lists ``serve/*`` workers whose lease is live); a static
+    ``endpoints`` map ({replica_id: endpoint}) otherwise — the
+    in-process test/bench mode. ``on_join`` / ``on_leave`` /
+    ``on_rejoin`` callbacks fire from inside ``poll()`` (the caller's
+    thread) on membership transitions; a rejoin is the same worker id
+    coming back after a lapse, or a boot_id change (a restart)."""
+
+    def __init__(self, coordinator: Any = None,
+                 endpoints: Optional[Dict[str, str]] = None,
+                 on_join: Optional[Callable[[ReplicaView], None]] = None,
+                 on_leave: Optional[Callable[[str], None]] = None,
+                 on_rejoin: Optional[Callable[[ReplicaView], None]] = None):
+        if coordinator is None and not endpoints:
+            raise ValueError("need a coordinator or a static "
+                             "endpoints map")
+        self.coordinator = coordinator
+        self._static = dict(endpoints or {})
+        self._lock = named_lock("fleet.registry")
+        # xmlrpc ServerProxy reuses ONE HTTPConnection and is not
+        # thread-safe: the router polls from both its background
+        # refresh loop and the caller thread of generate(), so the
+        # directory RPCs must be serialized or http.client's state
+        # machine tears (CannotSendRequest / ResponseNotReady)
+        self._rpc_lock = threading.Lock()
+        # last poll's view + ids seen EVER  # ptlint: guarded-by(fleet.registry)
+        self._view: Dict[str, ReplicaView] = {}
+        self._ever: Dict[str, Optional[str]] = {}  # id -> last boot_id
+        self.on_join = on_join
+        self.on_leave = on_leave
+        self.on_rejoin = on_rejoin
+
+    def _scan(self) -> Dict[str, ReplicaView]:
+        if self.coordinator is None:
+            return {rid: ReplicaView(rid, ep, None)
+                    for rid, ep in self._static.items()}
+        out: Dict[str, ReplicaView] = {}
+        with self._rpc_lock:
+            for wid in list(self.coordinator.workers()):
+                if not str(wid).startswith("serve/"):
+                    continue
+                info = self.coordinator.worker_info(wid)
+                if not info or not info.get("endpoint"):
+                    continue          # lease lapsed = implicit drain
+                rid = str(info.get("replica_id") or wid.split("/", 1)[1])
+                out[rid] = ReplicaView(rid, info["endpoint"],
+                                       info.get("boot_id"))
+        return out
+
+    def poll(self) -> Dict[str, ReplicaView]:
+        """Refresh the membership view; fire transition callbacks."""
+        fresh = self._scan()
+        joined, rejoined, left = [], [], []
+        with self._lock:
+            for rid, view in fresh.items():
+                if rid not in self._view:
+                    if rid in self._ever:
+                        rejoined.append(view)   # back after a lapse
+                    else:
+                        joined.append(view)
+                elif (view.boot_id is not None
+                      and self._view[rid].boot_id is not None
+                      and view.boot_id != self._view[rid].boot_id):
+                    rejoined.append(view)       # restarted in place
+                self._ever[rid] = view.boot_id
+            for rid in self._view:
+                if rid not in fresh:
+                    left.append(rid)
+            self._view = dict(fresh)
+        for view in joined:
+            if self.on_join:
+                self.on_join(view)
+        for view in rejoined:
+            if self.on_rejoin:
+                self.on_rejoin(view)
+        for rid in left:
+            if self.on_leave:
+                self.on_leave(rid)
+        return dict(fresh)
+
+    def view(self) -> Dict[str, ReplicaView]:
+        with self._lock:
+            return dict(self._view)

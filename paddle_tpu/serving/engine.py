@@ -1079,6 +1079,23 @@ class DecodeEngine:
                 self._settle(req, "failed", ServerClosed(
                     "engine shut down before this request ran"))
 
+    def drain_admission(self) -> None:
+        """Deploy-drain: stop ADMITTING (submit raises ServerClosed)
+        while the loop keeps stepping everything already in flight —
+        the fleet router's POST /admin/drain leg
+        (docs/robustness.md "Serving fleet"). Reversible via
+        :meth:`resume_admission`; full stop stays :meth:`shutdown`."""
+        with self._cv:
+            self._accepting = False
+
+    def resume_admission(self) -> None:
+        """Re-open admission after :meth:`drain_admission` (no-op on a
+        stopping engine)."""
+        with self._cv:
+            if not self._stopping:
+                self._accepting = True
+                self._cv.notify_all()
+
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = 30.0) -> None:
         """Stop accepting. With ``drain`` in-flight generation
@@ -1132,6 +1149,7 @@ class DecodeEngine:
         active = sum(1 for s in self.slots if s is not None)
         util = (active_sum / (steps * self.num_slots)) if steps else 0.0
         shared = self.pool.shared_pages
+        leaked = self.pool.accounting()["leaked"]
         _PREFIX_SHARED.set(shared)
         out = dict(counters)
         out.update({
@@ -1143,6 +1161,17 @@ class DecodeEngine:
             "kv_pages_free": self.pool.free_pages,
             "kv_pages_used": self.pool.used_pages,
             "kv_pages_shared": shared,
+            # the no-leak invariant, scrapeable: survivors of a chaos
+            # storm must show 0 here (tests/test_fleet_faults.py reads
+            # it over GET /stats)
+            "kv_pages_leaked": leaked,
+            # trie-held pages _admit would evict on demand: a router
+            # judging this replica's headroom off the free list alone
+            # would livelock after a prefix-heavy burst (the trie only
+            # yields pages under admission pressure, which a gated
+            # router never applies)
+            "kv_pages_reclaimable": self.prefix.reclaimable_pages()
+            if self.prefix is not None else 0,
             "kv_page_high_water": self.pool.high_water,
             "page_size": self.page_size,
             "window": self.window,

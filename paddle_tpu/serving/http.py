@@ -26,7 +26,8 @@ Endpoints:
   POST /generate        -> body {"prompt": [int...],
                                  "max_new_tokens": int,
                                  "eos_id": optional,
-                                 "deadline_ms": optional}
+                                 "deadline_ms": optional,
+                                 "stream": optional bool}
                            200 {"tokens": [int...],
                                 "prefix_hit_pages": int,
                                 "accepted_tokens": int} — routed
@@ -34,7 +35,22 @@ Endpoints:
                            engine; the two extra fields report KV
                            pages reused from the shared-prefix cache
                            and draft tokens the target accepted
-                           (501 when no engine is attached)
+                           (501 when no engine is attached).
+                           With "stream": true the 200 body is
+                           close-delimited NDJSON — one
+                           {"token": t} line per generated token as
+                           it lands, then a terminal {"done": true,
+                           "tokens": [...], ...} record (or an
+                           {"error": ...} record when the request
+                           settles with a typed error mid-stream).
+                           The fleet router (paddle_tpu/fleet/)
+                           consumes this mode; a torn stream (no
+                           terminal record) is its failover trigger.
+  POST /admin/drain     -> stop ADMITTING (503 reason "draining" on
+                           new work) while in-flight requests settle
+                           and the transport stays up — the router's
+                           drain/deploy leg. POST /admin/resume
+                           re-opens admission. Both return /health.
 
 Every /infer and /generate request gets ONE trace_id at this front —
 taken from an ``X-Trace-Id`` header or body ``trace_id`` field when a
@@ -55,14 +71,17 @@ Admission failures map onto transport status codes:
 from __future__ import annotations
 
 import json
+import socket
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from paddle_tpu.analysis.lockdep import named_lock
 from paddle_tpu.obs import context as obs_context
 from paddle_tpu.obs.events import JOURNAL
-from paddle_tpu.obs.metrics import REGISTRY, stats_families
+from paddle_tpu.obs.events import emit as journal_emit
+from paddle_tpu.obs.metrics import REGISTRY, SampleFamily, stats_families
 from paddle_tpu.serving.server import (Expired, InferenceServer, Rejected,
                                        ServerClosed, ServingError)
 
@@ -86,15 +105,36 @@ _COUNTER_KEYS = {
 }
 
 
+def replica_identity(endpoint: str = "") -> dict:
+    """The labels that join this replica's series across scrapers and
+    the fleet router without out-of-band config: the process's run_id
+    (obs context), its host tag (PADDLE_TPU_HOST) and the HTTP
+    endpoint it serves on."""
+    return {"run_id": obs_context.ensure_run_id(),
+            "host": obs_context.get_host(),
+            "endpoint": endpoint or ""}
+
+
 def prometheus_text(server: InferenceServer,
-                    prefix: str = "paddle_tpu_serving") -> str:
+                    prefix: str = "paddle_tpu_serving",
+                    endpoint: str = "") -> str:
     """Render ``server.stats()`` (engine sub-dict included) PLUS the
     global metrics registry as Prometheus text exposition 0.0.4 — the
     ONE exposition path (paddle_tpu/obs/metrics.py); the ad-hoc PR-6
     flattening lives on as obs.metrics.stats_families with the same
-    backward-compatible names."""
+    backward-compatible names. The constant-1
+    ``paddle_tpu_serving_replica_info`` gauge carries the replica's
+    identity labels (run_id/host/endpoint) so Prometheus joins and
+    the fleet router can identify per-replica series from the scrape
+    alone."""
+    info = SampleFamily(
+        f"{prefix}_replica_info", "gauge",
+        "replica identity (constant 1; labels are the payload)")
+    info.add({k: str(v) for k, v in
+              replica_identity(endpoint).items()}, 1.0)
     return REGISTRY.exposition(
-        extra=stats_families(prefix, server.stats(), _COUNTER_KEYS))
+        extra=stats_families(prefix, server.stats(), _COUNTER_KEYS)
+        + [info])
 
 
 def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
@@ -106,6 +146,10 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):     # quiet; stats() has it
             pass
+
+        def _endpoint(self) -> str:
+            h, p = self.server.server_address[:2]
+            return f"http://{h}:{p}"
 
         def _json(self, code: int, payload: dict, headers=()):
             body = json.dumps(payload).encode()
@@ -130,11 +174,14 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
         def do_GET(self):
             url = urlparse(self.path)
             if url.path == "/health":
-                self._json(200, server.health())
+                payload = server.health()
+                payload["replica"] = replica_identity(self._endpoint())
+                self._json(200, payload)
             elif url.path == "/stats":
                 self._json(200, server.stats())
             elif url.path == "/metrics":
-                body = prometheus_text(server).encode()
+                body = prometheus_text(
+                    server, endpoint=self._endpoint()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -203,6 +250,7 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 self._json(501, {"error": "no decode engine attached "
                                           "to this server"})
                 return
+            stream = bool(req.get("stream"))
             tid = self._trace_id(req)
             hdr = [("X-Trace-Id", tid)]
             try:
@@ -211,6 +259,9 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                                                  eos_id=eos_id,
                                                  deadline=deadline,
                                                  trace_id=tid)
+                    if stream:
+                        self._stream_generate(gen, tid)
+                        return
                     toks = gen.get()
             except Rejected as e:
                 code = 429 if e.reason == "queue_full" else 503
@@ -238,9 +289,82 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                              "accepted_tokens": gen.accepted_tokens,
                              "trace_id": tid}, headers=hdr)
 
+        def _stream_generate(self, gen, tid: str) -> None:
+            """Relay tokens as the engine produces them: one NDJSON
+            line per token, then the terminal done/error record. The
+            response is close-delimited (HTTP/1.0, no Content-Length)
+            — a TEAR before the terminal record is how a fleet router
+            distinguishes a dead replica from a settled request. A
+            client disconnect cancels the generation (stream
+            semantics: the engine returns the pages and settles with
+            the tokens so far)."""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("X-Trace-Id", tid)
+            self.end_headers()
+            # the replica's side of the fleet trace: a hop that starts
+            # here and never journals a settle is one the process lost
+            # mid-stream (SIGKILL) — `paddle_tpu trace merge` over the
+            # router's + replicas' journals shows exactly that shape
+            journal_emit("serving", "hop", trace_id=tid, phase="start")
+
+            def _line(payload: dict) -> None:
+                self.wfile.write(json.dumps(payload).encode() + b"\n")
+                self.wfile.flush()
+
+            sent = 0
+            try:
+                while True:
+                    finished = gen.done.wait(0.005)
+                    toks = list(gen.tokens)
+                    while sent < len(toks):
+                        _line({"token": int(toks[sent])})
+                        sent += 1
+                    if finished:
+                        break
+                try:
+                    final = gen.get(timeout=1.0)
+                except Rejected as e:
+                    _line({"error": str(e), "reason": e.reason,
+                           "retry_after": e.retry_after,
+                           "trace_id": tid})
+                    return
+                except Expired as e:
+                    _line({"error": str(e), "expired": True,
+                           "trace_id": tid})
+                    return
+                except ServerClosed as e:
+                    _line({"error": str(e), "reason": "draining",
+                           "trace_id": tid})
+                    return
+                except ServingError as e:
+                    _line({"error": str(e), "trace_id": tid})
+                    return
+                _line({"done": True,
+                       "tokens": [int(t) for t in final],
+                       "prefix_hit_pages": gen.prefix_hit_pages,
+                       "accepted_tokens": gen.accepted_tokens,
+                       "trace_id": tid})
+                journal_emit("serving", "hop", trace_id=tid,
+                             phase="settle", tokens=len(final))
+            except (BrokenPipeError, ConnectionError, OSError):
+                gen.cancel()          # client went away mid-stream
+                journal_emit("serving", "hop", trace_id=tid,
+                             phase="torn", streamed=sent)
+
         def do_POST(self):
             if self.path == "/generate":
                 self._do_generate()
+                return
+            if self.path == "/admin/drain":
+                payload = server.drain()
+                payload["replica"] = replica_identity(self._endpoint())
+                self._json(200, payload)
+                return
+            if self.path == "/admin/resume":
+                payload = server.resume()
+                payload["replica"] = replica_identity(self._endpoint())
+                self._json(200, payload)
                 return
             if self.path != "/infer":
                 self._json(404, {"error": f"no route {self.path}"})
@@ -291,4 +415,65 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
             self._json(200, {"outputs": np.asarray(out).tolist(),
                              "trace_id": tid}, headers=hdr)
 
-    return ThreadingHTTPServer((host, port), Handler)
+    class ReplicaHTTPServer(ThreadingHTTPServer):
+        """ThreadingHTTPServer that tracks live connections so
+        ``kill()`` can tear them mid-write — the in-process SIGKILL
+        twin (testing/faults.py family (p), bench row
+        ``fleet_failover``): clients see a reset/EOF, never a
+        goodbye. EmbeddingShardServer.kill() is the RPC-plane
+        precedent."""
+
+        daemon_threads = True
+
+        def __init__(self, addr, handler):
+            super().__init__(addr, handler)
+            self._conn_lock = named_lock("serving.httpd")
+            self._conns = set()   # ptlint: guarded-by(serving.httpd)
+            self._killed = False
+
+        def get_request(self):
+            sock, addr = super().get_request()
+            with self._conn_lock:
+                self._conns.add(sock)
+            return sock, addr
+
+        def shutdown_request(self, request):
+            with self._conn_lock:
+                self._conns.discard(request)
+            super().shutdown_request(request)
+
+        def handle_error(self, request, client_address):
+            # torn sockets (kill(), client disconnects) are expected
+            # under chaos — never traceback-spam the daemon's stderr
+            import sys
+            exc = sys.exc_info()[1]
+            if isinstance(exc, (BrokenPipeError, ConnectionError,
+                                OSError)):
+                return
+            super().handle_error(request, client_address)
+
+        def kill(self) -> None:
+            """Tear every live connection and stop the listener — no
+            drain, no goodbye. Connections are torn FIRST (a SIGKILL
+            is instant; the serve-loop handshake in shutdown() can
+            take up to its poll interval, and a fast replica would
+            finish streaming in that window). In-flight streaming
+            handlers hit BrokenPipe on their next write; their
+            clients see a torn (close-delimited, terminal-record-less)
+            stream."""
+            self._killed = True
+            with self._conn_lock:
+                conns = list(self._conns)
+            for s in conns:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self.shutdown()
+            self.server_close()
+
+    return ReplicaHTTPServer((host, port), Handler)

@@ -231,6 +231,31 @@ class InferenceServer:
             self.engine.start()
         return self
 
+    def drain(self) -> dict:
+        """Deploy-drain (docs/robustness.md "Serving fleet"): stop
+        ADMITTING — submit/submit_generate raise ServerClosed, the
+        HTTP front answers 503 reason "draining" — while workers and
+        the engine keep settling everything already admitted and the
+        transport stays up. The fleet router's POST /admin/drain leg;
+        reversible via :meth:`resume`, unlike :meth:`shutdown`."""
+        with self._cv:
+            self._accepting = False
+        if self.engine is not None:
+            self.engine.drain_admission()
+        journal_emit("serving", "drain", action="drain")
+        return self.health()
+
+    def resume(self) -> dict:
+        """Re-open admission after :meth:`drain` (re-admit on deploy
+        completion / rejoin). No-op on a stopped server."""
+        with self._cv:
+            if self._threads and not self._stopping:
+                self._accepting = True
+        if self.engine is not None:
+            self.engine.resume_admission()
+        journal_emit("serving", "drain", action="resume")
+        return self.health()
+
     def shutdown(self, drain: bool = True,
                  timeout: Optional[float] = 30.0) -> None:
         """Stop accepting. With ``drain`` the queued requests complete

@@ -127,6 +127,25 @@ embedding service"):
       final table digest equals the uninterrupted run's
       (tests/test_embed_faults.py chaos acceptance);
 
+and, for the serving fleet (docs/robustness.md "Serving fleet"):
+
+  (p) kill/drain/lapse fleet replicas under routed load —
+      ``kill_replica`` fires a caller-supplied kill (SIGKILL a
+      subprocess, or the in-process ``httpd.kill()`` tear) the moment
+      the router's stream interceptor has relayed ``at`` tokens from
+      the victim (``mid_stream=True``), or right before dispatch to
+      it (``mid_stream=False``); ``lease_lapse`` pauses a replica's
+      membership heartbeats WITHOUT leaving, so its lease expires
+      (the implicit drain) and resumes them on exit (the rejoin);
+      ``drain_during_burst`` triggers ``router.drain(replica)`` from
+      a side thread once the router has dispatched ``after``
+      requests. The invariants every storm must preserve: every
+      in-flight request settles EXACTLY ONCE (completed on a sibling
+      or typed-rejected), survivors show zero KV-page leaks, and
+      ``paddle_tpu trace merge`` over the router's + replicas'
+      journals reconstructs each victim's hop chain from its
+      trace_id alone (tests/test_fleet_faults.py chaos acceptance);
+
 Everything is deterministic given the seed and the schedule, so a chaos
 test that fails replays exactly. See ``tests/test_faults.py`` and
 ``tests/test_serving_faults.py`` for the tests that drive these against
@@ -1027,3 +1046,114 @@ class FaultPlan:
         raise TimeoutError(
             f"marker {pattern!r} never reached step {step} "
             f"within {timeout}s")
+
+    # --------------------------------------------- (p) fleet chaos
+    @staticmethod
+    @contextlib.contextmanager
+    def kill_replica(router, replica_id: str, kill: Callable[[], None],
+                     at: int = 2, mid_stream: bool = True):
+        """Arm a one-shot replica kill on the router's chaos seams:
+        with ``mid_stream`` the caller's ``kill()`` fires the moment
+        the router has relayed ``at`` tokens of any request streaming
+        off ``replica_id`` (the SIGKILL-mid-generation fault — the
+        victim connection tears before its terminal record, which is
+        the router's failover trigger); without it, ``kill()`` fires
+        right before the router's next dispatch TO that replica (the
+        request dies on connect and fails over with zero streamed
+        tokens). ``kill`` is a subprocess SIGKILL or the in-process
+        ``httpd.kill()`` tear — the seam doesn't care. Yields a stats
+        dict (``fired``: kill count, ``at_tokens``: stream position
+        it fired at, ``victim_traces``: trace_ids that were streaming
+        off the victim when it died)."""
+        stats = {"fired": 0, "at_tokens": None, "victim_traces": []}
+        lock = threading.Lock()
+        prev_stream = router._stream_interceptor
+        prev_route = router._route_interceptor
+
+        def fire(trace_id, n):
+            with lock:
+                if stats["fired"]:
+                    return
+                stats["fired"] = 1
+                stats["at_tokens"] = n
+            if trace_id is not None:
+                stats["victim_traces"].append(trace_id)
+            kill()
+
+        def stream_seam(trace_id, rid, n):
+            if prev_stream is not None:
+                prev_stream(trace_id, rid, n)
+            if mid_stream and rid == replica_id and n >= at:
+                fire(trace_id, n)
+
+        def route_seam(trace_id, rid, hop):
+            if prev_route is not None:
+                prev_route(trace_id, rid, hop)
+            if not mid_stream and rid == replica_id:
+                fire(trace_id, 0)
+
+        router._stream_interceptor = stream_seam
+        router._route_interceptor = route_seam
+        try:
+            yield stats
+        finally:
+            router._stream_interceptor = prev_stream
+            router._route_interceptor = prev_route
+
+    @staticmethod
+    @contextlib.contextmanager
+    def lease_lapse(registration, wait_s: Optional[float] = None):
+        """Pause a replica's membership heartbeats WITHOUT leaving —
+        the long-GC-pause / wedged-process fault. The lease expires
+        (``worker_info`` goes None: the router treats it as an
+        implicit drain and stops routing there) while the replica
+        keeps serving whatever it already holds. On exit the
+        heartbeats resume; the next tick re-joins (the registration's
+        ``rejoins`` counter bumps) and the router re-admits. With
+        ``wait_s`` the context sleeps that long after pausing so the
+        lapse is guaranteed by the time the body runs."""
+        registration.pause()
+        if wait_s:
+            time.sleep(wait_s)
+        try:
+            yield registration
+        finally:
+            registration.unpause()
+
+    @staticmethod
+    @contextlib.contextmanager
+    def drain_during_burst(router, replica_id: str, after: int = 3,
+                           timeout: Optional[float] = None):
+        """Arm a drain-under-load: once the router has dispatched
+        ``after`` requests (any replica), a side thread calls
+        ``router.drain(replica_id)`` — new admissions shift to
+        siblings while the drained replica's in-flight requests
+        settle. Yields a stats dict (``drained``: the drain() result,
+        set once it completes; ``dispatches``: dispatch count seen).
+        Join happens on exit."""
+        stats = {"drained": None, "dispatches": 0}
+        fired = threading.Event()
+        prev_route = router._route_interceptor
+
+        def do_drain():
+            stats["drained"] = router.drain(replica_id,
+                                            timeout=timeout)
+
+        thread = threading.Thread(target=do_drain, daemon=True,
+                                  name="pt-fault-drain")
+
+        def route_seam(trace_id, rid, hop):
+            if prev_route is not None:
+                prev_route(trace_id, rid, hop)
+            stats["dispatches"] += 1
+            if stats["dispatches"] >= after and not fired.is_set():
+                fired.set()
+                thread.start()
+
+        router._route_interceptor = route_seam
+        try:
+            yield stats
+        finally:
+            router._route_interceptor = prev_route
+            if fired.is_set():
+                thread.join(timeout=30)

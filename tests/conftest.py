@@ -160,6 +160,23 @@ def _recompile_budget(request):
         watch.check(budget)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _drop_xla_executables():
+    """Release each module's in-memory XLA executables at teardown.
+
+    Every compiled executable holds mmap'd code pages; across ~1000
+    tests the suite's map count climbs toward the kernel's
+    vm.max_map_count ceiling (65530 default), and crossing it turns
+    later native allocations — thread-stack guard pages included —
+    into segfaults deep in XLA or pthread_create. Clearing per module
+    is nearly free: the persistent disk compile cache above dedupes
+    the recompiles, so only re-tracing is paid."""
+    yield
+    import gc
+    jax.clear_caches()
+    gc.collect()
+
+
 @pytest.fixture(autouse=True)
 def _reset_layer_names():
     """Fresh auto-name counters per test so graphs don't collide."""

@@ -724,3 +724,179 @@ class TestPserverCLI:
             except subprocess.TimeoutExpired:
                 coord.kill()
                 raise
+
+
+class TestRouterCLI:
+    """ISSUE 15 satellite: `paddle_tpu router` flag wiring down to
+    Router, and the SIGTERM teardown contract (drain, leave, close —
+    in that order)."""
+
+    def test_router_flags_parse_with_defaults(self, monkeypatch):
+        from paddle_tpu import cli
+        seen = {}
+        monkeypatch.setattr(cli, "_cmd_router",
+                            lambda args: seen.update(vars(args)) or 0)
+        assert cli.main(["router", "--coordinator",
+                         "127.0.0.1:9001"]) == 0
+        assert seen["coordinator"] == "127.0.0.1:9001"
+        assert seen["host"] == "127.0.0.1" and seen["port"] == 0
+        assert seen["affinity"] == "prefix"
+        assert seen["drain_timeout"] == 10.0
+        assert seen["page_size"] == 16
+        assert seen["scrape_interval"] == 0.5
+        assert seen["queue_timeout"] == 5.0
+        assert seen["heartbeat"] == 1.0
+        assert cli.main(["router", "--coordinator", "h:1",
+                         "--port", "8088", "--affinity", "load",
+                         "--drain_timeout", "3.5", "--page_size", "4",
+                         "--scrape_interval", "0.1",
+                         "--queue_timeout", "2.0"]) == 0
+        assert seen["port"] == 8088 and seen["affinity"] == "load"
+        assert seen["drain_timeout"] == 3.5 and seen["page_size"] == 4
+        # --coordinator is required; --affinity is a closed choice
+        with pytest.raises(SystemExit):
+            cli.main(["router"])
+        with pytest.raises(SystemExit):
+            cli.main(["router", "--coordinator", "h:1",
+                      "--affinity", "random"])
+
+    def test_build_router_wires_flags(self):
+        import argparse
+
+        from paddle_tpu import cli
+
+        coord_sentinel = object()
+        connected = []
+
+        def fake_connect(host, port):
+            connected.append((host, port))
+            return coord_sentinel
+
+        class FakeRouter:
+            def __init__(self, coordinator=None, **kw):
+                self.coordinator = coordinator
+                self.kw = kw
+                self.started = False
+
+            def start(self):
+                self.started = True
+                return self
+
+        built = []
+
+        def fake_http(router, host, port):
+            built.append((router, host, port))
+            return object()
+
+        ns = argparse.Namespace(
+            coordinator="10.0.0.5:4321", affinity="load", page_size=8,
+            scrape_interval=0.25, queue_timeout=3.0, drain_timeout=7.0,
+            host="0.0.0.0", port=8088)
+        router, httpd, coord = cli._build_router(
+            ns, FakeRouter, fake_http, fake_connect)
+        assert connected == [("10.0.0.5", 4321)]
+        assert coord is coord_sentinel
+        assert router.coordinator is coord_sentinel
+        assert router.started
+        assert router.kw == {"affinity": "load", "page_size": 8,
+                             "scrape_interval": 0.25,
+                             "queue_timeout": 3.0,
+                             "drain_timeout": 7.0}
+        assert built == [(router, "0.0.0.0", 8088)]
+
+    def test_router_teardown_order_drain_leave_close(self):
+        from paddle_tpu import cli
+
+        calls = []
+
+        class FakeRouter:
+            def shutdown(self, drain=False, timeout=None):
+                assert drain is True
+                calls.append("drain")
+
+        class FakeReg:
+            def stop(self, leave=False):
+                assert leave is True
+                calls.append("leave")
+
+        class FakeHttpd:
+            def shutdown(self):
+                calls.append("close")
+
+            def server_close(self):
+                calls.append("close_socket")
+
+        cli._router_teardown(FakeRouter(), FakeReg(), FakeHttpd())
+        # the contract: stop admitting + settle in-flight FIRST, then
+        # drop the directory entry, only then kill the socket
+        assert calls == ["drain", "leave", "close", "close_socket"]
+        # a router that never joined the directory still tears down
+        calls.clear()
+        cli._router_teardown(FakeRouter(), None, FakeHttpd())
+        assert calls == ["drain", "close", "close_socket"]
+
+    def test_router_daemon_serves_and_sigterm_drains(self, tmp_path):
+        """End-to-end daemon: a router fronting an EMPTY fleet still
+        serves /health + /stats + /metrics, registers itself on the
+        membership plane, and exits 0 with a stats line on SIGTERM."""
+        import signal
+        import urllib.request
+
+        from paddle_tpu.reader import recordio as rio
+        from paddle_tpu.trainer.coordinator import connect
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        data = str(tmp_path / "train.ptr")
+        rio.write_records(data, [b"r0", b"r1"], max_chunk_bytes=64)
+        coord = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.cli", "coordinator",
+             "--data", data, "--worker_lease", "30"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        rt = None
+        try:
+            cport = json.loads(coord.stdout.readline())["port"]
+            rt = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.cli", "router",
+                 "--coordinator", f"127.0.0.1:{cport}",
+                 "--scrape_interval", "0.1",
+                 "--event_log", str(tmp_path / "router.jsonl")],
+                stdout=subprocess.PIPE, text=True, env=env)
+            rec = json.loads(rt.stdout.readline())
+            assert rec["job"] == "router"
+            assert rec["status"] == "serving" and rec["replicas"] == 0
+            base = f"http://127.0.0.1:{rec['port']}"
+            with urllib.request.urlopen(base + "/health",
+                                        timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["status"] == "no_replicas"
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as r:
+                text = r.read().decode()
+            assert "paddle_tpu_fleet_routed 0" in text
+            # the router keeps its own directory lease
+            info = connect("127.0.0.1", cport).worker_info(
+                "fleet/router")
+            assert info and info["role"] == "fleet_router"
+            assert info["endpoint"] == base
+
+            rt.send_signal(signal.SIGTERM)
+            out, _ = rt.communicate(timeout=30)
+            assert rt.returncode == 0
+            stopped = json.loads(out.strip().splitlines()[-1])
+            assert stopped["status"] == "stopped"
+            assert stopped["stats"]["routed"] == 0
+            rt = None
+            # the goodbye reached the directory before the exit
+            assert connect("127.0.0.1", cport).worker_info(
+                "fleet/router") is None
+        finally:
+            if rt is not None:
+                rt.kill()
+            coord.send_signal(signal.SIGTERM)
+            try:
+                coord.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                coord.kill()
+                raise

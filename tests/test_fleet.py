@@ -1,0 +1,523 @@
+"""Serving fleet v1 — router over N in-process replicas (ISSUE 15).
+
+The acceptance contract pinned here: prefix-affinity keeps >=90% of
+same-prefix requests on one replica and the prefix-cache warm ratio
+survives the router hop; fleet admission rejects typed
+(``fleet_kv_capacity``) only when NO replica could ever hold the
+request; drain redirects new work and re-admits on resume/rejoin;
+lease expiry is an implicit drain and a rejoin re-admits; a replica
+torn mid-stream fails over to a sibling with a TOKEN-EXACT resumed
+continuation and exactly-once settle. Subprocess SIGKILL chaos lives
+in tests/test_fleet_faults.py.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.fleet import (AffinityIndex, FleetBalancer,
+                              ReplicaRegistration, ReplicaRegistry,
+                              Router, build_router_http_server)
+from paddle_tpu.serving import (DecodeEngine, InferenceServer, Rejected,
+                                ServerClosed, build_http_server)
+from paddle_tpu.testing import FaultPlan
+from paddle_tpu.trainer.coordinator import Coordinator
+
+pytestmark = pytest.mark.chaos
+
+DEC_CFG = dict(vocab_size=40, d_model=16, n_heads=2, n_layers=2,
+               d_ff=32, max_len=32)
+PAGE = 4
+
+
+def tiny_decoder(seed=7):
+    paddle.init(use_tpu=False, seed=0)
+    from paddle_tpu.core.registry import reset_name_counters
+    reset_name_counters()
+    spec = models.transformer_lm(**DEC_CFG)
+    costs = spec.cost if isinstance(spec.cost, list) else [spec.cost]
+    topo = paddle.Topology(costs, extra_outputs=[spec.output])
+    params = topo.init_params(jax.random.PRNGKey(seed))
+    return models.TransformerDecoder(params, n_layers=DEC_CFG["n_layers"],
+                                     n_heads=DEC_CFG["n_heads"])
+
+
+class Replica:
+    """One in-process serving replica: decode engine + HTTP front.
+    Same weights (same seed) on every replica — greedy decode is then
+    deterministic across the fleet, which is what makes mid-stream
+    failover token-exact."""
+
+    def __init__(self, rid, decoder=None, **engine_kw):
+        self.rid = rid
+        self.dec = decoder or tiny_decoder()
+        kw = dict(num_slots=2, page_size=PAGE,
+                  max_seq_len=DEC_CFG["max_len"])
+        kw.update(engine_kw)
+        self.engine = DecodeEngine(self.dec, **kw)
+        self.server = InferenceServer(None, max_queue=8, workers=1,
+                                      breaker=False,
+                                      engine=self.engine).start()
+        self.httpd = build_http_server(self.server, "127.0.0.1", 0)
+        self.port = self.httpd.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self._t = threading.Thread(target=self.httpd.serve_forever,
+                                   daemon=True,
+                                   name=f"pt-test-replica-{rid}")
+        self._t.start()
+        self._killed = False
+
+    def kill(self):
+        """In-process SIGKILL twin: tear every live connection."""
+        self._killed = True
+        self.httpd.kill()
+
+    def stop(self):
+        if not self._killed:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        self.server.shutdown(drain=True, timeout=30)
+
+
+def fleet(n=2, router_kw=None, **engine_kw):
+    reps = {f"r{i}": Replica(f"r{i}", **engine_kw) for i in range(n)}
+    kw = dict(affinity="prefix", page_size=PAGE, scrape_interval=0.1,
+              queue_timeout=2.0, queue_poll=0.02, drain_timeout=5.0)
+    kw.update(router_kw or {})
+    router = Router(endpoints={r.rid: r.endpoint
+                               for r in reps.values()}, **kw)
+    return reps, router
+
+
+def stop_fleet(reps, router):
+    router.shutdown(drain=True, timeout=10)
+    for r in reps.values():
+        r.stop()
+
+
+def http_json(url, body=None, timeout=30):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read()), dict(r.headers)
+
+
+class TestAffinityIndex:
+    def test_keying_mirrors_prefix_trie(self):
+        idx = AffinityIndex(page_size=4)
+        # final token is always a query: a 4-token prompt has NO
+        # cacheable page (limit = len-1 = 3 < page_size)
+        assert idx.observe([1, 2, 3, 4], "r0") == 0
+        # 9 tokens -> two aligned pages under the cap
+        assert idx.observe(list(range(9)), "r0") == 2
+        rid, depth = idx.match(list(range(9)))
+        assert (rid, depth) == ("r0", 2)
+        # shared first page, divergent second -> depth-1 match
+        rid, depth = idx.match([0, 1, 2, 3, 9, 9, 9, 9, 9])
+        assert (rid, depth) == ("r0", 1)
+        # unknown path
+        assert idx.match([7, 7, 7, 7, 7, 7, 7, 7, 7]) == (None, 0)
+
+    def test_forget_and_lru_bound(self):
+        idx = AffinityIndex(page_size=2, max_nodes=4)
+        idx.observe([1, 1, 1, 1, 1], "r0")       # 2 nodes
+        idx.observe([2, 2, 2, 2, 2], "r1")       # +2 nodes = cap
+        idx.observe([3, 3, 3, 3, 3], "r2")       # evicts the oldest
+        assert idx.stats()["nodes"] == 4
+        assert idx.match([1, 1, 1, 1, 1])[0] is None   # evicted
+        assert idx.match([3, 3, 3, 3, 3]) == ("r2", 2)
+        assert idx.forget("r2") == 2
+        assert idx.match([3, 3, 3, 3, 3]) == (None, 0)
+
+
+class TestBalancer:
+    def _scraped(self, bal, rid, total, free, ps=4):
+        bal.upsert(rid, f"http://x/{rid}")
+        bal.record_scrape(rid, kv_pages_total=total, kv_pages_free=free,
+                          page_size=ps)
+
+    def test_choose_by_headroom_and_exclude(self):
+        bal = FleetBalancer(affinity="load", page_size=4)
+        assert bal.choose([1, 2], 8) == (None, 0)
+        self._scraped(bal, "a", total=16, free=2)
+        self._scraped(bal, "b", total=16, free=10)
+        assert bal.choose([1, 2], 8)[0] == "b"         # most free pages
+        assert bal.choose([1, 2], 8, exclude={"b"})[0] == "a"
+        # 20 tokens = 5 pages: only b has the free headroom NOW
+        assert bal.choose([1, 2], 20)[0] == "b"
+        assert bal.choose([1, 2], 20, exclude={"b"}) == (None, 0)
+        bal.mark_draining("b", True)
+        assert bal.choose([1, 2], 8)[0] == "a"
+        bal.mark_dead("a")
+        assert bal.choose([1, 2], 8) == (None, 0)
+
+    def test_feasible_anywhere_gates_typed_reject(self):
+        bal = FleetBalancer(affinity="load", page_size=4)
+        bal.upsert("a", "http://x/a")
+        assert bal.feasible_anywhere(10_000)    # unscraped: can't prove
+        self._scraped(bal, "a", total=8, free=0)
+        assert bal.feasible_anywhere(32)        # 8 pages fit... someday
+        assert not bal.feasible_anywhere(33)    # 9 pages NEVER fit
+        self._scraped(bal, "b", total=16, free=16)
+        assert bal.feasible_anywhere(33)        # a sibling could
+
+    def test_scrape_adopts_fleet_page_size_into_affinity_index(self):
+        # a router left at --page_size 16 fronting page-4 engines would
+        # never cut an affinity key for short prompts; the scrape must
+        # re-key the index at the size the fleet actually agrees on
+        bal = FleetBalancer(affinity="prefix", page_size=16)
+        self._scraped(bal, "a", total=16, free=16, ps=4)
+        self._scraped(bal, "b", total=16, free=16, ps=4)
+        assert bal.index.page_size == 4
+        prompt = list(range(9))                 # 2 page-4 keys, 0 page-16
+        bal.observe_served(prompt, "a")
+        assert bal.choose(prompt, 4)[0] == "a"  # affinity now bites
+        # disagreeing sizes: keep the current keying (no thrash)
+        self._scraped(bal, "b", total=16, free=16, ps=8)
+        assert bal.index.page_size == 4
+        # ...until the fleet converges again
+        self._scraped(bal, "a", total=16, free=16, ps=8)
+        assert bal.index.page_size == 8
+
+    def test_scrape_counts_reclaimable_trie_pages_as_headroom(self):
+        # after a prefix-heavy burst the engine's free LIST is ~empty
+        # (the trie holds evictable pages), but the ENGINE would still
+        # admit by evicting on demand. A router gating on the bare
+        # free gauge livelocks: the trie only yields pages under the
+        # dispatch pressure the gate withholds. The scrape must count
+        # engine_kv_pages_reclaimable as placeable headroom.
+        router = Router(endpoints={"a": "http://127.0.0.1:1"},
+                        page_size=4, scrape_interval=3600.0)
+        router._http_get_text = lambda ep, path: (
+            "paddle_tpu_serving_engine_kv_pages_total 16\n"
+            "paddle_tpu_serving_engine_kv_pages_free 0\n"
+            "paddle_tpu_serving_engine_kv_pages_reclaimable 14\n"
+            "paddle_tpu_serving_engine_page_size 4\n")
+        router.refresh()
+        router._scrape("a")
+        st = router.balancer.get("a")
+        assert st.kv_pages_free == 14
+        assert router.balancer.choose([1, 2, 3], 8)[0] == "a"
+
+    def test_affinity_advice_never_overrides_health(self):
+        bal = FleetBalancer(affinity="prefix", page_size=4)
+        self._scraped(bal, "a", total=16, free=16)
+        self._scraped(bal, "b", total=16, free=16)
+        toks = list(range(9))
+        bal.observe_served(toks, "a")
+        assert bal.choose(toks, 12) == ("a", 2)
+        bal.mark_draining("a", True)
+        rid, depth = bal.choose(toks, 12)
+        assert rid == "b" and depth == 0        # advice, not a pin
+
+
+class TestFleetRouting:
+    def test_prefix_affinity_pins_and_warm_ratio_survives_hop(self):
+        reps, router = fleet(2)
+        try:
+            router.refresh()
+            shared = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12]
+            results = []
+            for i in range(10):
+                res = router.generate(shared + [13 + i], 2)
+                results.append(res)
+            homes = [res.replica_chain[-1] for res in results]
+            pin = max(homes.count(h) for h in set(homes))
+            # acceptance: >=90% of same-prefix requests on ONE replica
+            assert pin >= 9, homes
+            assert sum(r.affinity_hit for r in results) >= 9
+            # warm ratio survives the router hop: replaying an exact
+            # earlier prompt hits the home replica's prefix cache and
+            # the hit count rides the fleet response
+            warm = router.generate(shared + [13], 2)
+            assert warm.replica_chain[-1] == homes[0]
+            assert warm.prefix_hit_pages >= 1
+            st = router.stats()
+            assert st["settled"] == 11 and st["failovers"] == 0
+            assert st["affinity_hits"] >= 9
+        finally:
+            stop_fleet(reps, router)
+
+    def test_generate_matches_direct_decode(self):
+        reps, router = fleet(1)
+        try:
+            router.refresh()
+            prompt = [3, 1, 4, 1, 5]
+            want = reps["r0"].dec.generate(
+                np.asarray(prompt, "int32")[None, :],
+                max_len=len(prompt) + 6)[0]
+            streamed = []
+            res = router.generate(prompt, 6, on_token=streamed.append)
+            assert res.tokens == [int(t) for t in want]
+            assert streamed == res.tokens       # live relay, same order
+            assert res.hops == 1 and res.replica_chain == ["r0"]
+        finally:
+            stop_fleet(reps, router)
+
+    def test_fleet_kv_capacity_is_typed_and_journaled(self):
+        reps, router = fleet(2)
+        try:
+            router.refresh()
+            total_pages = max(
+                st.kv_pages_total
+                for st in router.balancer.replicas().values())
+            assert total_pages > 0              # the scrape landed
+            too_big = (total_pages + 1) * PAGE
+            with pytest.raises(Rejected) as ei:
+                router.generate([1] * (too_big - 1), 1)
+            assert ei.value.reason == "fleet_kv_capacity"
+            assert ei.value.retry_after == 0.0
+            assert router.stats()["rejected_kv_capacity"] == 1
+            # a merely-large request is NOT bounced: it fits total
+            res = router.generate([1] * 8, 2)
+            assert len(res.tokens) == 2
+        finally:
+            stop_fleet(reps, router)
+
+    def test_drain_redirects_then_readmit(self):
+        reps, router = fleet(2)
+        try:
+            router.refresh()
+            out = router.drain("r0")
+            assert out["draining"] and out["settled"]
+            # the mark mirrored to the replica's own admission plane
+            health, _ = http_json(reps["r0"].endpoint + "/health")
+            assert health["status"] == "draining"
+            for i in range(4):
+                res = router.generate([20 + i, 1, 2], 2)
+                assert res.replica_chain == ["r1"], i
+            assert router.health()["replicas_draining"] == 1
+            # re-admit, drain the sibling: traffic swings back
+            router.undrain("r0")
+            router.drain("r1")
+            res = router.generate([30, 1, 2], 2)
+            assert res.replica_chain == ["r0"]
+            health, _ = http_json(reps["r0"].endpoint + "/health")
+            assert health["status"] == "ok"
+            assert router.stats()["drains"] == 2
+        finally:
+            stop_fleet(reps, router)
+
+    def test_router_shutdown_is_typed(self):
+        reps, router = fleet(1)
+        try:
+            router.refresh()
+            router.shutdown(drain=True)
+            with pytest.raises(ServerClosed):
+                router.generate([1, 2, 3], 2)
+        finally:
+            for r in reps.values():
+                r.stop()
+
+
+class TestMidStreamFailover:
+    def test_failover_resumes_token_exact(self):
+        """The tentpole invariant, in-process: the victim's transport
+        is torn after 2 streamed tokens; the router replays prompt +
+        streamed tokens on the sibling and the settled stream is
+        token-identical to an undisturbed solo decode. Exactly-once:
+        one return, one settle counter, original trace_id on both
+        hops."""
+        reps, router = fleet(2)
+        try:
+            router.refresh()
+            prompt = [5, 6, 7, 8, 9, 10, 11, 12]
+            # prime affinity so the victim is deterministic
+            first = router.generate(prompt, 2)
+            victim = first.replica_chain[-1]
+            sibling = ("r0", "r1")[victim == "r0"]
+            want = reps[victim].dec.generate(
+                np.asarray(prompt, "int32")[None, :],
+                max_len=len(prompt) + 10)[0]
+            # throttle the victim so the kill genuinely lands
+            # MID-stream (a tiny decoder otherwise finishes before the
+            # router has read its second line)
+            reps[victim].engine._step_interceptor = \
+                lambda s: time.sleep(0.02)
+            streamed = []
+            with FaultPlan.kill_replica(router, victim,
+                                        reps[victim].kill,
+                                        at=2) as chaos:
+                res = router.generate(prompt, 10,
+                                      on_token=streamed.append)
+            assert chaos["fired"] == 1
+            assert chaos["victim_traces"] == [res.trace_id]
+            assert res.hops == 2
+            assert res.replica_chain == [victim, sibling]
+            # token-exact resume: greedy determinism makes the
+            # sibling's continuation exactly what the victim owed
+            assert res.tokens == [int(t) for t in want]
+            assert streamed == res.tokens
+            st = router.stats()
+            assert st["failovers"] == 1
+            assert st["settled_failover"] == 1
+            assert st["settled"] == 2           # prime + failover
+            # the dead replica is out of the fleet; its affinity
+            # entries died with it
+            assert not router.balancer.get(victim).live
+            # exactly-once on the survivor too: its pool balances
+            acc = reps[sibling].engine.page_accounting()
+            assert acc["leaked"] == 0
+            assert reps[sibling].engine.stats()["kv_pages_leaked"] == 0
+        finally:
+            router.shutdown(drain=True)
+            reps[victim].server.shutdown(drain=False, timeout=10)
+            reps[sibling].stop()
+
+    def test_pre_dispatch_kill_fails_over_with_zero_streamed(self):
+        reps, router = fleet(2)
+        try:
+            router.refresh()
+            prompt = [21, 22, 23, 24]
+            first = router.generate(prompt, 2)
+            victim = first.replica_chain[-1]
+            sibling = ("r0", "r1")[victim == "r0"]
+            with FaultPlan.kill_replica(router, victim,
+                                        reps[victim].kill,
+                                        mid_stream=False) as chaos:
+                res = router.generate(prompt, 4)
+            assert chaos["fired"] == 1 and chaos["at_tokens"] == 0
+            assert res.hops == 2
+            assert res.replica_chain == [victim, sibling]
+            assert len(res.tokens) == 4
+        finally:
+            router.shutdown(drain=True)
+            reps[victim].server.shutdown(drain=False, timeout=10)
+            reps[sibling].stop()
+
+
+class TestCoordinatorDiscovery:
+    def test_join_lease_lapse_rejoin(self):
+        """Directory-driven fleet: replicas join the membership plane,
+        a paused heartbeat lapses the lease (implicit drain), and the
+        resumed heartbeat re-joins and re-admits — no router config
+        changes anywhere."""
+        coord = Coordinator([], worker_lease_s=0.6)
+        reps = {f"r{i}": Replica(f"r{i}") for i in range(2)}
+        regs = {rid: ReplicaRegistration(
+                    coord, rid, rep.endpoint,
+                    heartbeat_s=0.15).join()
+                for rid, rep in reps.items()}
+        router = Router(coordinator=coord, page_size=PAGE,
+                        queue_timeout=2.0, queue_poll=0.02)
+        try:
+            router.refresh()
+            assert router.health()["replicas_live"] == 2
+            with FaultPlan.lease_lapse(regs["r0"], wait_s=0.9):
+                router.refresh()
+                assert router.health()["replicas_live"] == 1
+                # traffic keeps flowing on the survivor
+                res = router.generate([1, 2, 3], 2)
+                assert res.replica_chain == ["r1"]
+            # heartbeats resumed: the next tick re-joins and the
+            # router's next poll re-admits
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                router.refresh()
+                if router.health()["replicas_live"] == 2:
+                    break
+                time.sleep(0.05)
+            assert router.health()["replicas_live"] == 2
+            assert regs["r0"].rejoins >= 1
+            assert router.stats()["rejoins"] >= 1
+        finally:
+            router.shutdown(drain=True)
+            for reg in regs.values():
+                reg.stop(leave=True)
+            for rep in reps.values():
+                rep.stop()
+
+    def test_registry_reports_restart_as_rejoin(self):
+        coord = Coordinator([], worker_lease_s=30.0)
+        events = []
+        reg = ReplicaRegistry(
+            coordinator=coord,
+            on_join=lambda v: events.append(("join", v.replica_id)),
+            on_leave=lambda rid: events.append(("leave", rid)),
+            on_rejoin=lambda v: events.append(("rejoin", v.replica_id)))
+        a = ReplicaRegistration(coord, "a", "http://h:1",
+                                heartbeat_s=60).join()
+        reg.poll()
+        assert events == [("join", "a")]
+        # a restart in place: same worker id, fresh boot_id
+        a.stop(leave=False)
+        a2 = ReplicaRegistration(coord, "a", "http://h:2",
+                                 heartbeat_s=60).join()
+        reg.poll()
+        assert events[-1] == ("rejoin", "a")
+        assert reg.view()["a"].endpoint == "http://h:2"
+        a2.stop(leave=True)
+        reg.poll()
+        assert events[-1] == ("leave", "a")
+
+
+class TestFleetHTTP:
+    def test_router_endpoints_and_metrics(self):
+        reps, router = fleet(1)
+        httpd = build_router_http_server(router, "127.0.0.1", 0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                             name="pt-test-router-httpd")
+        t.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            router.refresh()
+            body, headers = http_json(
+                base + "/generate",
+                {"prompt": [1, 2, 3], "max_new_tokens": 3,
+                 "trace_id": "fleet-http-1"})
+            assert len(body["tokens"]) == 3
+            assert body["trace_id"] == "fleet-http-1"
+            assert headers["X-Trace-Id"] == "fleet-http-1"
+            assert body["hops"] == 1 and body["replica_chain"] == ["r0"]
+            health, _ = http_json(base + "/health")
+            assert health["status"] == "ok"
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as r:
+                text = r.read().decode()
+            assert "# TYPE paddle_tpu_fleet_routed counter" in text
+            assert "paddle_tpu_fleet_routed 1" in text
+            assert "# TYPE paddle_tpu_fleet_replicas_live gauge" in text
+            assert "paddle_tpu_fleet_kv_pages_total" in text
+            # admin drain over HTTP, then 404 for a ghost replica
+            out, _ = http_json(base + "/admin/drain", {"replica": "r0"})
+            assert out["draining"] is True
+            try:
+                http_json(base + "/admin/drain", {"replica": "ghost"})
+                assert False, "expected HTTPError"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            stop_fleet(reps, router)
+
+    def test_replica_identity_rides_health_and_metrics(self):
+        rep = Replica("solo")
+        try:
+            health, _ = http_json(rep.endpoint + "/health")
+            ident = health["replica"]
+            assert ident["endpoint"].startswith("http://127.0.0.1:")
+            assert ident["run_id"] and ident["host"]
+            with urllib.request.urlopen(rep.endpoint + "/metrics",
+                                        timeout=10) as r:
+                text = r.read().decode()
+            line = next(l for l in text.splitlines()
+                        if l.startswith(
+                            "paddle_tpu_serving_replica_info{"))
+            assert f'run_id="{ident["run_id"]}"' in line
+            assert f'endpoint="{ident["endpoint"]}"' in line
+            assert f'host="{ident["host"]}"' in line
+            assert line.endswith(" 1")
+        finally:
+            rep.stop()

@@ -1,0 +1,300 @@
+"""Fleet chaos acceptance (ISSUE 15): SIGKILL a serve replica
+MID-STREAM under burst load and hold the tentpole invariants:
+
+- every burst request settles EXACTLY ONCE at the router boundary —
+  a result or one typed error, never both, never neither, and the
+  router journal carries exactly one fleet/settle per trace_id;
+- zero KV-page leaks on the surviving replicas (their own
+  ``kv_pages_leaked`` gauge over GET /stats);
+- ``merge_journals`` over the router's + all replicas' journals
+  reconstructs each failover's hop chain from the trace_id ALONE —
+  the victim's journal shows a hop that starts and never settles
+  (the process died mid-stream), the router shows
+  route(victim) -> failover -> route(sibling) -> settle in order.
+
+Faults come from testing/faults.py family (p): ``kill_replica`` (the
+SIGKILL trigger riding the router's stream-interceptor seam) and
+``drain_during_burst`` (deploy-drain while requests are in flight).
+``lease_lapse`` is covered in tests/test_fleet.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from paddle_tpu.fleet import Router
+from paddle_tpu.obs.events import JOURNAL
+from paddle_tpu.obs.merge import merge_journals
+from paddle_tpu.serving import (Expired, Rejected, ServerClosed,
+                                ServingError)
+from paddle_tpu.testing import FaultPlan
+from paddle_tpu.trainer.coordinator import connect
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# same tiny decoder on every replica (same seed): greedy decode is
+# deterministic across the fleet, which is what makes a mid-stream
+# failover's resumed continuation token-exact
+DEC_SRC = (
+    "import jax\n"
+    "import paddle_tpu as paddle\n"
+    "from paddle_tpu import models\n"
+    "from paddle_tpu.core.registry import reset_name_counters\n"
+    "paddle.init(use_tpu=False, seed=0)\n"
+    "reset_name_counters()\n"
+    "spec = models.transformer_lm(vocab_size=40, d_model=16,\n"
+    "                             n_heads=2, n_layers=2, d_ff=32,\n"
+    "                             max_len=32)\n"
+    "costs = (spec.cost if isinstance(spec.cost, list)\n"
+    "         else [spec.cost])\n"
+    "topo = paddle.Topology(costs, extra_outputs=[spec.output])\n"
+    "params = topo.init_params(jax.random.PRNGKey(7))\n"
+    "decoder = models.TransformerDecoder(params, n_layers=2,\n"
+    "                                    n_heads=2)\n")
+
+TYPED = (Rejected, Expired, ServerClosed, ServingError)
+
+
+def _env(host_tag):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_HOST"] = host_tag
+    return env
+
+
+def _http_json(url, body=None, timeout=60):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class TestSigkillMidStreamUnderBurst:
+    def test_chaos_acceptance(self, tmp_path):
+        dec_cfg = tmp_path / "dec.py"
+        dec_cfg.write_text(DEC_SRC)
+        data = str(tmp_path / "seed.ptr")
+        from paddle_tpu.reader import recordio as rio
+        rio.write_records(data, [b"r0", b"r1"], max_chunk_bytes=64)
+
+        procs = {}
+        router = None
+        coord_proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.cli", "coordinator",
+             "--data", data, "--worker_lease", "2.5"],
+            stdout=subprocess.PIPE, text=True, env=_env("coord"))
+        try:
+            cport = json.loads(coord_proc.stdout.readline())["port"]
+            journals = {"router": str(tmp_path / "router.jsonl")}
+            for rid in ("rA", "rB"):
+                journals[rid] = str(tmp_path / f"{rid}.jsonl")
+                procs[rid] = subprocess.Popen(
+                    [sys.executable, "-m", "paddle_tpu.cli", "serve",
+                     "--decode_config", str(dec_cfg),
+                     "--gen_slots", "2", "--gen_page_size", "4",
+                     "--workers", "1",
+                     "--coordinator", f"127.0.0.1:{cport}",
+                     "--replica_id", rid, "--heartbeat", "0.5",
+                     "--event_log", journals[rid]],
+                    stdout=subprocess.PIPE, text=True, env=_env(rid))
+            endpoints = {}
+            for rid, p in procs.items():
+                rec = json.loads(p.stdout.readline())
+                assert rec["status"] == "serving"
+                assert rec["replica_id"] == rid
+                endpoints[rid] = f"http://127.0.0.1:{rec['port']}"
+            # warm each replica's jit cache OUTSIDE the chaos window
+            for rid, ep in endpoints.items():
+                out = _http_json(ep + "/generate",
+                                 {"prompt": [1, 2], "max_new_tokens": 1,
+                                  "deadline_ms": 120000})
+                assert len(out["tokens"]) == 1, rid
+
+            JOURNAL.configure(journals["router"])
+            router = Router(coordinator=connect("127.0.0.1", cport),
+                            page_size=4, scrape_interval=0.2,
+                            queue_timeout=10.0, queue_poll=0.05).start()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                router.refresh()
+                if router.health()["replicas_live"] == 2:
+                    break
+                time.sleep(0.1)
+            assert router.health()["replicas_live"] == 2
+
+            # prime prefix affinity: the shared-prefix burst will all
+            # steer to ONE replica — the victim
+            shared = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12]
+            prime = router.generate(shared + [39], 2)
+            victim = prime.replica_chain[-1]
+            sibling = ("rA", "rB")[victim == "rA"]
+
+            def one(i):
+                return router.generate(shared + [20 + i], 8)
+
+            with FaultPlan.kill_replica(
+                    router, victim, procs[victim].kill,
+                    at=2) as chaos:
+                results, errors = FaultPlan.burst(one, 8, threads=8,
+                                                  timeout=180)
+            assert chaos["fired"] == 1
+            procs[victim].wait(timeout=30)      # SIGKILL landed
+
+            # exactly-once at the caller: every request is a result
+            # XOR one typed error — no untyped escapes, no losses
+            untyped = [e for e in errors
+                       if e is not None and not isinstance(e, TYPED)]
+            assert untyped == []
+            settled = [r for r in results if r is not None]
+            assert len(settled) + sum(
+                e is not None for e in errors) == 8
+            assert len(settled) >= 4            # the fleet kept serving
+
+            failed_over = [r for r in settled if r.hops >= 2]
+            assert failed_over, [r.replica_chain for r in settled]
+            for r in failed_over:
+                assert r.replica_chain[0] == victim
+                assert r.replica_chain[-1] == sibling
+                assert len(r.tokens) == 8
+            st = router.stats()
+            assert st["failovers"] >= 1
+            assert st["settled_failover"] >= len(failed_over)
+            assert st["settled"] == len(settled) + 1    # + the prime
+
+            # token-exact resume: greedy decode replayed on the
+            # sibling produces what the victim would have — re-asking
+            # the (identically seeded) survivor must agree
+            probe = failed_over[0]
+            idx = results.index(probe)
+            again = router.generate(shared + [20 + idx], 8)
+            assert again.tokens == probe.tokens
+
+            # zero page leaks on the survivor, via its own gauge
+            stats = _http_json(endpoints[sibling] + "/stats")
+            assert stats["engine"]["kv_pages_leaked"] == 0
+            assert _http_json(endpoints[sibling] + "/health")[
+                "status"] == "ok"
+
+            # exactly-once settle per trace_id in the router journal
+            JOURNAL.configure(None)
+            with open(journals["router"]) as fh:
+                recs = [json.loads(l) for l in fh if l.strip()]
+            settles = [r for r in recs if r["domain"] == "fleet"
+                       and r["kind"] == "settle"]
+            tids = [r["trace_id"] for r in settles]
+            assert len(tids) == len(set(tids))
+            assert set(r.trace_id for r in settled) <= set(tids)
+
+            # the merged trace reconstructs the victim hop chain from
+            # the trace_id alone, across all three processes' journals
+            merged = merge_journals([journals["router"],
+                                     journals["rA"], journals["rB"]])
+            tid = probe.trace_id
+            chain = [r for r in merged if r.get("trace_id") == tid]
+            routes = [r for r in chain if r["domain"] == "fleet"
+                      and r["kind"] == "route"]
+            assert [r["replica"] for r in routes][:1] == [victim]
+            assert routes[-1]["replica"] == sibling
+            fails = [r for r in chain if r["domain"] == "fleet"
+                     and r["kind"] == "failover"]
+            assert fails and fails[0]["victim"] == victim
+            # mseq order: dispatch to victim, then the failover, then
+            # the re-dispatch, then the settle
+            order = [r["mseq"] for r in (routes[0], fails[0],
+                                         routes[-1])]
+            assert order == sorted(order)
+            settle_rec = [r for r in chain if r["kind"] == "settle"]
+            assert settle_rec and settle_rec[0]["mseq"] > order[-1]
+            # the victim's OWN journal shows the hop that started and
+            # never settled (the process died mid-stream); the
+            # sibling's shows start + settle
+            victim_hops = [r for r in chain if r["kind"] == "hop"
+                           and r.get("host") == victim]
+            assert [r["phase"] for r in victim_hops] == ["start"]
+            sibling_hops = [r for r in chain if r["kind"] == "hop"
+                            and r.get("host") == sibling]
+            assert [r["phase"] for r in sibling_hops] == \
+                ["start", "settle"]
+        finally:
+            JOURNAL.configure(None)
+            if router is not None:
+                router.shutdown(drain=True, timeout=10)
+            for p in procs.values():
+                if p.poll() is None:
+                    p.terminate()
+                    try:
+                        p.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+            coord_proc.terminate()
+            try:
+                coord_proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                coord_proc.kill()
+                raise
+
+
+class TestDrainDuringBurst:
+    def test_drain_under_load_redirects_and_settles_all(self):
+        """Deploy-drain mid-burst (family (p) ``drain_during_burst``):
+        once 3 requests have dispatched, a side thread drains one
+        replica; everything in flight still settles exactly once,
+        post-drain admissions all land on the sibling, and the drained
+        replica's own admission plane answers 'draining'."""
+        from test_fleet import fleet, http_json, stop_fleet
+
+        reps, router = fleet(2)
+        try:
+            router.refresh()
+            # pin the burst's prefix to r-target so the drain bites a
+            # replica that actually has traffic
+            shared = [2, 4, 6, 8, 10, 12, 14, 16]
+            prime = router.generate(shared + [30], 2)
+            target = prime.replica_chain[-1]
+            other = ("r0", "r1")[target == "r0"]
+            # slow the target a little so the drain lands mid-burst
+            reps[target].engine._step_interceptor = \
+                lambda s: time.sleep(0.01)
+
+            def one(i):
+                return router.generate(shared + [31 + i], 4)
+
+            with FaultPlan.drain_during_burst(
+                    router, target, after=3) as chaos:
+                results, errors = FaultPlan.burst(one, 8, threads=4,
+                                                  timeout=120)
+            reps[target].engine._step_interceptor = None
+            assert chaos["drained"] is not None
+            assert chaos["drained"]["draining"] is True
+            assert chaos["dispatches"] >= 3
+            # exactly-once: every burst request settled with tokens
+            # (a drain sheds nothing — it redirects)
+            untyped = [e for e in errors
+                       if e is not None and not isinstance(e, TYPED)]
+            assert untyped == []
+            settled = [r for r in results if r is not None]
+            assert len(settled) + sum(
+                e is not None for e in errors) == 8
+            assert all(len(r.tokens) == 4 for r in settled)
+            # the replica's own admission plane took the mark
+            health, _ = http_json(reps[target].endpoint + "/health")
+            assert health["status"] == "draining"
+            # post-drain traffic all lands on the sibling
+            after = router.generate(shared + [99], 2)
+            assert after.replica_chain == [other]
+            assert router.stats()["drains"] == 1
+            # no pages stuck anywhere
+            for rep in reps.values():
+                assert rep.engine.stats()["kv_pages_leaked"] == 0
+        finally:
+            stop_fleet(reps, router)
