@@ -261,17 +261,18 @@ class FleetBalancer:
     def feasible_anywhere(self, total_tokens: int) -> bool:
         """Could ANY known replica ever hold this request? (The
         fleet_kv_capacity rejection gate — draining replicas count:
-        they come back.)"""
+        they come back. Dead ones do not: their stale pool sizes must
+        not keep an only-ever-feasible-there request queueing.)"""
         with self._lock:
-            for st in self._replicas.values():
+            live = [st for st in self._replicas.values() if st.live]
+            for st in live:
                 if st.kv_pages_total <= 0:
                     continue          # not scraped yet: unknown, hope
                 if self.pages_for(total_tokens,
                                   st.page_size) <= st.kv_pages_total:
                     return True
             # nothing scraped yet -> can't prove infeasibility
-            return not any(st.kv_pages_total > 0
-                           for st in self._replicas.values())
+            return not any(st.kv_pages_total > 0 for st in live)
 
     def choose(self, tokens: Sequence[int], total_tokens: int,
                exclude: Iterable[str] = ()) -> Tuple[Optional[str], int]:

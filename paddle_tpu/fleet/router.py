@@ -529,6 +529,25 @@ class Router:
                                  streamed=len(tokens))
                     FLIGHT.record("mark", "fleet/failover",
                                   trace_id=trace_id, victim=rid)
+                    if max_new - len(tokens) <= 0 or (
+                            eos_id is not None and tokens
+                            and tokens[-1] == eos_id):
+                        # The victim streamed every token the request
+                        # could produce (budget spent, or EOS out) and
+                        # tore before the done record. There is nothing
+                        # left to replay — a sibling dispatch would
+                        # either ask for max_new_tokens=0 or generate
+                        # past EOS, both of which a non-failed run can
+                        # never do. Settle with what we hold.
+                        with self._cv:
+                            self._counters["settled"] += 1
+                            self._counters["settled_failover"] += 1
+                        journal_emit("fleet", "settle",
+                                     trace_id=trace_id, replica=rid,
+                                     hops=hop, tokens=len(tokens))
+                        return FleetResult(tokens, trace_id, hop, chain,
+                                           prefix_hits, accepted,
+                                           affinity_hit)
                     if hop >= self.max_hops:
                         raise ServingError(
                             f"request failed over {hop} times "
@@ -544,6 +563,23 @@ class Router:
                         exclude.add(rid)
                     journal_emit("fleet", "reroute", trace_id=trace_id,
                                  replica=rid, reason=e.reason)
+                    if self._clock() >= queue_deadline:
+                        # Declines (429/typed 503) must respect the
+                        # same queueing bound as choose() returning
+                        # None, or a replica that keeps answering
+                        # replica_queue_full while its scraped headroom
+                        # looks fine would spin this loop forever.
+                        with self._cv:
+                            self._counters["rejected_queue_full"] += 1
+                        journal_emit("fleet", "reject",
+                                     trace_id=trace_id,
+                                     reason="queue_full")
+                        raise Rejected(
+                            f"replicas kept declining for "
+                            f"{self.queue_timeout:.1f}s "
+                            f"(last: {e.reason})",
+                            retry_after=self.queue_timeout / 2,
+                            reason="queue_full")
                     time.sleep(self.queue_poll)
                     continue
                 finally:

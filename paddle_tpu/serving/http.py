@@ -237,6 +237,8 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     raise ValueError("prompt must be a non-empty list "
                                      "of token ids")
                 max_new = int(req["max_new_tokens"])
+                if max_new < 1:
+                    raise ValueError("max_new_tokens must be >= 1")
                 eos_id = req.get("eos_id")
                 eos_id = int(eos_id) if eos_id is not None else None
                 deadline = req.get("deadline_ms")

@@ -26,6 +26,7 @@ from paddle_tpu import models
 from paddle_tpu.fleet import (AffinityIndex, FleetBalancer,
                               ReplicaRegistration, ReplicaRegistry,
                               Router, build_router_http_server)
+from paddle_tpu.fleet.router import _HopTorn, _Reroute
 from paddle_tpu.serving import (DecodeEngine, InferenceServer, Rejected,
                                 ServerClosed, build_http_server)
 from paddle_tpu.testing import FaultPlan
@@ -170,6 +171,19 @@ class TestBalancer:
         assert not bal.feasible_anywhere(33)    # 9 pages NEVER fit
         self._scraped(bal, "b", total=16, free=16)
         assert bal.feasible_anywhere(33)        # a sibling could
+
+    def test_feasible_anywhere_ignores_dead_replicas(self):
+        # mark_dead keeps the scraped pool size around; a request only
+        # ever feasible on the DEAD replica must reject typed
+        # (fleet_kv_capacity) immediately, not queue for the full
+        # queue_timeout and bounce as retryable queue_full
+        bal = FleetBalancer(affinity="load", page_size=4)
+        self._scraped(bal, "a", total=8, free=8)
+        self._scraped(bal, "b", total=16, free=16)
+        assert bal.feasible_anywhere(33)        # b: 9 pages fit
+        bal.mark_dead("b")
+        assert not bal.feasible_anywhere(33)    # only b could, b is gone
+        assert bal.feasible_anywhere(32)        # a still can, someday
 
     def test_scrape_adopts_fleet_page_size_into_affinity_index(self):
         # a router left at --page_size 16 fronting page-4 engines would
@@ -394,6 +408,97 @@ class TestMidStreamFailover:
             router.shutdown(drain=True)
             reps[victim].server.shutdown(drain=False, timeout=10)
             reps[sibling].stop()
+
+
+class TestFailoverSettleEdges:
+    """Torn-stream boundary cases, pinned on a stubbed dispatch (no
+    HTTP): a tear AFTER the last owed token (or after EOS) but BEFORE
+    the done record must settle with the tokens already held — a
+    sibling replay would ask for max_new_tokens=0 or generate past
+    EOS, neither of which an undisturbed run can produce — and a
+    decline storm must respect the queue_timeout bound."""
+
+    @staticmethod
+    def _stub_router(**kw):
+        kwargs = dict(page_size=4, scrape_interval=3600.0,
+                      queue_timeout=1.0, queue_poll=0.01)
+        kwargs.update(kw)
+        router = Router(endpoints={"a": "http://127.0.0.1:1",
+                                   "b": "http://127.0.0.1:2"}, **kwargs)
+        for rid in ("a", "b"):
+            router.balancer.record_scrape(
+                rid, kv_pages_total=16, kv_pages_free=16, page_size=4)
+        return router
+
+    def test_tear_after_final_token_settles_without_redispatch(self):
+        router = self._stub_router()
+        calls = []
+
+        def torn_dispatch(st, prompt, remaining, eos_id, deadline_s,
+                          trace_id, on_token, base_count):
+            calls.append(st.replica_id)
+            for t in (101, 102, 103):
+                on_token(t)
+            raise _HopTorn([101, 102, 103], "eof before done record")
+
+        router._dispatch_stream = torn_dispatch
+        streamed = []
+        res = router.generate([1, 2, 3, 4], 3,
+                              on_token=streamed.append)
+        # settled exactly once, on the torn hop — NOT replayed on the
+        # sibling with an empty remainder, NOT failed after max_hops
+        assert calls == res.replica_chain and len(calls) == 1
+        assert res.tokens == [101, 102, 103] == streamed
+        assert res.hops == 1
+        st = router.stats()
+        assert st["settled"] == 1 and st["settled_failover"] == 1
+        assert st["failovers"] == 1
+        # the sibling was never marked dead by a cascading 0-token
+        # replay failure
+        assert router.balancer.get(calls[0]).live is False
+        other = ("a", "b")[calls[0] == "a"]
+        assert router.balancer.get(other).live is True
+        router.shutdown(drain=False)
+
+    def test_tear_after_eos_settles_without_redispatch(self):
+        router = self._stub_router()
+        calls = []
+
+        def torn_dispatch(st, prompt, remaining, eos_id, deadline_s,
+                          trace_id, on_token, base_count):
+            calls.append(st.replica_id)
+            raise _HopTorn([55, 7], "read: torn")
+
+        router._dispatch_stream = torn_dispatch
+        res = router.generate([1, 2, 3, 4], 10, eos_id=7)
+        # the replay prompt would END with EOS; a sibling would keep
+        # generating past it (the engine only stops on GENERATED
+        # tokens) and hand the client tokens a clean run never yields
+        assert len(calls) == 1
+        assert res.tokens == [55, 7]
+        assert res.hops == 1
+        router.shutdown(drain=False)
+
+    def test_reroute_storm_respects_queue_timeout(self):
+        router = self._stub_router(queue_timeout=0.3, queue_poll=0.01)
+
+        def declining_dispatch(st, prompt, remaining, eos_id,
+                               deadline_s, trace_id, on_token,
+                               base_count):
+            raise _Reroute("replica_queue_full", exclude=False,
+                           draining=False)
+
+        router._dispatch_stream = declining_dispatch
+        t0 = time.monotonic()
+        with pytest.raises(Rejected) as ei:
+            router.generate([1, 2, 3, 4], 2)
+        # a replica stuck answering 429 while its scraped headroom
+        # looks fine must not spin generate() forever
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after > 0
+        assert time.monotonic() - t0 < 5.0
+        assert router.stats()["rejected_queue_full"] == 1
+        router.shutdown(drain=False)
 
 
 class TestCoordinatorDiscovery:

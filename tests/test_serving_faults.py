@@ -623,6 +623,17 @@ class TestServerEngineIntegration:
                 assert False, "expected HTTPError"
             except urllib.error.HTTPError as e:
                 assert e.code == 400
+            # max_new_tokens < 1 is a 400 too, not the engine's
+            # ValueError escaping as a torn connection
+            bad = urllib.request.Request(
+                base + "/generate",
+                data=b'{"prompt": [1], "max_new_tokens": 0}',
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(bad, timeout=10)
+                assert False, "expected HTTPError"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
         finally:
             httpd.shutdown()
             srv.shutdown(drain=True)
