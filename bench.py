@@ -575,7 +575,8 @@ SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine",
               "decode_prefix_hit", "decode_speculative",
               "flight_recorder_overhead", "profiler_overhead",
               "lockdep_overhead", "coord_reshard", "embed_lookup",
-              "embed_update", "fleet_route", "fleet_failover")
+              "embed_update", "fleet_route", "fleet_failover",
+              "fleet_deploy", "fleet_autoscale", "router_ha")
 
 
 def _smoke_trainer(batch: int = 16):
@@ -1101,6 +1102,162 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
                     rep["httpd"].shutdown()
                     rep["httpd"].server_close()
                 rep["server"].shutdown(drain=True, timeout=30)
+
+    if "fleet_deploy" in rows:
+        # ISSUE 16 tentpole leg (b): the SLO-gated rolling deploy.
+        # failed_requests is the GATED metric (count, slack 0): a
+        # drain->restart->rejoin cycle over every replica under an
+        # open-loop burst must fail NOTHING — the zero-downtime
+        # contract. Wall time is a loose latency trend row.
+        import threading as _th
+
+        from paddle_tpu.fleet import Router
+        from paddle_tpu.fleet.autopilot import RollingDeploy
+        from paddle_tpu.serving import (DecodeEngine, InferenceServer,
+                                        build_http_server)
+        from paddle_tpu.testing import FaultPlan
+
+        class _Watch:                      # no SLO pressure in a bench
+            breaches = 0
+
+        def _dep_replica():
+            eng = DecodeEngine(_smoke_decoder(), num_slots=2,
+                               page_size=4, max_seq_len=32)
+            srv = InferenceServer(None, max_queue=32, workers=1,
+                                  breaker=False, engine=eng).start()
+            httpd = build_http_server(srv, "127.0.0.1", 0)
+            _th.Thread(target=httpd.serve_forever, daemon=True,
+                       name="pt-bench-deploy-replica").start()
+            ep = f"http://127.0.0.1:{httpd.server_address[1]}"
+            return {"server": srv, "httpd": httpd, "endpoint": ep}
+
+        dreps = {f"r{i}": _dep_replica() for i in range(2)}
+        drouter = Router(endpoints={rid: rep["endpoint"]
+                                    for rid, rep in dreps.items()},
+                         affinity="prefix", page_size=4,
+                         scrape_interval=0.1, queue_timeout=10.0,
+                         queue_poll=0.02, drain_timeout=5.0).start()
+        try:
+            drouter.generate([1, 2, 3], 1)      # compile + warm
+            dl = time.monotonic() + 5
+            while time.monotonic() < dl and any(
+                    s.last_scrape == 0 for s in
+                    drouter.balancer.replicas().values()):
+                time.sleep(0.05)
+
+            def _restart(rid):
+                old = dreps[rid]
+                old["httpd"].shutdown()
+                old["httpd"].server_close()
+                old["server"].shutdown(drain=True, timeout=30)
+                dreps[rid] = _dep_replica()
+                return {"endpoint": dreps[rid]["endpoint"]}
+
+            roll = RollingDeploy(drouter, _restart, watchdog=_Watch(),
+                                 settle_timeout=30.0)
+            deploy_out = {}
+
+            def _run_deploy():
+                deploy_out.update(roll.run())
+
+            dt = _th.Thread(target=_run_deploy, daemon=True,
+                            name="pt-bench-deploy")
+            t0 = time.perf_counter()
+            dt.start()
+
+            def _one(i):
+                res = drouter.generate([1 + i % 5, 2, 3], 4)
+                assert len(res.tokens) == 4
+                return res
+            results, errors = FaultPlan.burst(_one, n=24, threads=4,
+                                              timeout=120)
+            dt.join(timeout=60)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            out["fleet_deploy"] = {
+                "failed_requests": sum(e is not None for e in errors),
+                "requests": sum(r is not None for r in results),
+                "deploy_steps": len(deploy_out.get("steps", [])),
+                "deploy_complete": int(
+                    deploy_out.get("status") == "complete"),
+                "deploy_wall_ms": round(wall_ms, 3),
+            }
+        finally:
+            drouter.shutdown(drain=True, timeout=10)
+            for rep in dreps.values():
+                rep["httpd"].shutdown()
+                rep["httpd"].server_close()
+                rep["server"].shutdown(drain=True, timeout=30)
+
+    if "fleet_autoscale" in rows:
+        # ISSUE 16 tentpole leg (a), info row: the hysteresis policy
+        # replayed over the canonical seeded bursty trace (same replay
+        # as tests/test_autopilot.py) — decision counts and how many
+        # ticks the shed spike takes to turn into a spawn decision.
+        from paddle_tpu.fleet.autopilot import AutopilotPolicy
+        from paddle_tpu.testing import FaultPlan
+
+        trace = FaultPlan.bursty_trace(seed=0, ticks=30)
+        pol = AutopilotPolicy(min_replicas=1, max_replicas=2,
+                              up_cooldown_s=2.0, down_cooldown_s=3.0,
+                              down_stable_s=2.0)
+        live, ups, downs, first_up = 1, 0, 0, None
+        burst_edge = 8                       # bursty_trace burst_start
+        for t, load in enumerate(trace):
+            shed = max(0, load - 4 * live)
+            sig = {"replicas_live": live, "shed_rate": float(shed),
+                   "headroom_frac": 0.9 if shed == 0 else 0.2,
+                   "headroom_trend_per_s": 0.0, "slo_breaches": 0}
+            d = pol.decide(sig, float(t))
+            if d is None:
+                continue
+            if d["action"] == "scale_up":
+                ups += 1
+                live += 1
+                if first_up is None:
+                    first_up = t
+            else:
+                downs += 1
+                live -= 1
+        out["fleet_autoscale"] = {
+            "scale_ups": ups,
+            "scale_downs": downs,
+            "decisions": ups + downs,
+            "ticks_to_scale_up": (first_up - burst_edge
+                                  if first_up is not None else -1),
+            "final_replicas": live,
+        }
+
+    if "router_ha" in rows:
+        # ISSUE 16 tentpole leg (c): N independent router planes must
+        # agree on cold-prompt placement (rendezvous over the stable
+        # first-page key — no shared state). placement_agreement is
+        # RATE-gated at >= 0.9 in BENCH_SMOKE_BASELINE.json: the HA
+        # property a client retry on a sibling router depends on.
+        from paddle_tpu.fleet import FleetBalancer
+
+        planes = []
+        for _ in range(2):
+            bal = FleetBalancer(affinity="prefix", page_size=4)
+            for i in range(3):
+                bal.upsert(f"r{i}", f"http://bench:{i}")
+                bal.record_scrape(f"r{i}", kv_pages_total=64,
+                                  kv_pages_free=64, page_size=4)
+            planes.append(bal)
+        rng = np.random.RandomState(11)
+        agree = total = 0
+        homes = set()
+        for _ in range(64):
+            plen = int(rng.randint(6, 20))
+            prompt = [int(v) for v in rng.randint(2, 40, (plen,))]
+            picks = [b.choose(prompt, plen + 4)[0] for b in planes]
+            total += 1
+            agree += int(picks[0] == picks[1])
+            homes.add(picks[0])
+        out["router_ha"] = {
+            "placement_agreement": round(agree / total, 4),
+            "prompts": total,
+            "replicas_spread": len(homes),
+        }
     return {"v": 1, "suite": "smoke", "rows": out}
 
 

@@ -77,7 +77,7 @@ def main(argv=None):
     params = paddle.create_parameters(paddle.Topology(costs))
     trainer = paddle.SGD(cost=costs, parameters=params,
                          update_equation=paddle.optimizer.Adam(
-                             learning_rate=1e-3))
+                             learning_rate=4e-3))
     rng = np.random.RandomState(0)
     n = args.batch_size
 

@@ -357,9 +357,12 @@ def _build_engine(args):
 
 
 def _build_server(args, InferenceServer, CircuitBreaker,
-                  build_http_server, engine_builder=None):
+                  build_http_server, engine_builder=None,
+                  on_quit=None):
     """serve-flag wiring, split from the signal loop so tests can
-    assert the flags reach InferenceServer (tests/test_cli.py)."""
+    assert the flags reach InferenceServer (tests/test_cli.py).
+    ``on_quit`` arms POST /admin/quit — the rolling deploy's restart
+    primitive (fleet/autopilot.py)."""
     breaker = CircuitBreaker(window=args.breaker_window,
                              failure_threshold=args.breaker_threshold,
                              cooldown=args.breaker_cooldown)
@@ -377,7 +380,8 @@ def _build_server(args, InferenceServer, CircuitBreaker,
                           if args.deadline_ms else None),
         max_batch_memory=args.max_batch_memory or None,
         breaker=breaker, engine=engine).start()
-    httpd = build_http_server(server, args.host, args.port)
+    httpd = build_http_server(server, args.host, args.port,
+                              on_quit=on_quit)
     return server, httpd
 
 
@@ -393,8 +397,18 @@ def _cmd_serve(args) -> int:
     from paddle_tpu.serving import (CircuitBreaker, InferenceServer,
                                     build_http_server)
 
+    stop = []
+
+    def _on_admin_quit():
+        # POST /admin/quit rides the SIGTERM path: same postmortem,
+        # same drain -> leave -> close order below
+        from paddle_tpu.obs.flight import FLIGHT
+        FLIGHT.maybe_autodump("admin_quit")
+        stop.append(1)
+
     server, httpd = _build_server(args, InferenceServer, CircuitBreaker,
-                                  build_http_server)
+                                  build_http_server,
+                                  on_quit=_on_admin_quit)
     # fleet membership (docs/robustness.md "Serving fleet"): join the
     # coordinator directory as serve/<replica_id> publishing the HTTP
     # endpoint, so a `paddle_tpu router` discovers (and fails over)
@@ -410,8 +424,6 @@ def _cmd_serve(args) -> int:
         registration = ReplicaRegistration(
             connect(chost or "127.0.0.1", int(cport)), replica_id,
             endpoint, heartbeat_s=args.heartbeat).join()
-
-    stop = []
 
     def _on_stop_signal(*a):
         # the SIGTERM postmortem: a bundle of the last moments before
@@ -557,8 +569,8 @@ def _cmd_pserver(args) -> int:
 
 def _build_router(args, Router, build_router_http_server, connect):
     """router-flag wiring, split from the signal loop so tests can
-    assert the flags reach Router without a live coordinator
-    (tests/test_cli.py)."""
+    assert the flags reach Router (and the autopilot, when enabled)
+    without a live coordinator (tests/test_cli.py)."""
     chost, _, cport = args.coordinator.rpartition(":")
     coord = connect(chost or "127.0.0.1", int(cport))
     router = Router(coordinator=coord, affinity=args.affinity,
@@ -566,17 +578,64 @@ def _build_router(args, Router, build_router_http_server, connect):
                     scrape_interval=args.scrape_interval,
                     queue_timeout=args.queue_timeout,
                     drain_timeout=args.drain_timeout).start()
-    httpd = build_router_http_server(router, args.host, args.port)
-    return router, httpd, coord
+    autopilot = None
+    if getattr(args, "autopilot", False) or \
+            getattr(args, "spawn_cmd", None):
+        autopilot = _build_autopilot(args, router)
+    httpd = build_router_http_server(router, args.host, args.port,
+                                     autopilot=autopilot)
+    return router, httpd, coord, autopilot
 
 
-def _router_teardown(router, registration, httpd) -> None:
+def _build_autopilot(args, router):
+    """autopilot-flag wiring (fleet/autopilot.py): with --spawn_cmd
+    the provisioner runs one subprocess per replica (the {replica_id}
+    template); without, spawning is impossible (journaled
+    ``autopilot/spawn_failed``) but the ROLLING DEPLOY still works —
+    restart asks each replica to POST /admin/quit itself and its
+    supervisor to respawn it (the fresh boot_id rejoin re-admits)."""
+    import shlex
+
+    from paddle_tpu.fleet.autopilot import (Autopilot, AutopilotPolicy,
+                                            CallbackProvisioner,
+                                            SubprocessProvisioner)
+    policy = AutopilotPolicy(min_replicas=args.min_replicas,
+                             max_replicas=args.max_replicas)
+    if getattr(args, "spawn_cmd", None):
+        prov = SubprocessProvisioner(shlex.split(args.spawn_cmd))
+    else:
+        def _no_spawn(rid):
+            raise RuntimeError("no --spawn_cmd: this autopilot can "
+                               "deploy but not spawn")
+
+        def _quit_restart(rid):
+            # supervisor-managed replica: ask it to exit cleanly; the
+            # supervisor respawns it and the fresh boot_id rejoins
+            st = router.balancer.get(rid)
+            if st is None:
+                raise KeyError(f"unknown replica {rid!r}")
+            router._http_post_json(st.endpoint, "/admin/quit", {})
+            return {}
+
+        prov = CallbackProvisioner(spawn=_no_spawn, stop=_no_spawn,
+                                   restart=_quit_restart)
+    return Autopilot(router, prov, policy=policy,
+                     interval=args.autopilot_interval,
+                     drain_timeout=args.drain_timeout)
+
+
+def _router_teardown(router, registration, httpd,
+                     autopilot=None) -> None:
     """The SIGTERM contract, in this order (tests/test_cli.py pins
-    it): DRAIN — stop admitting, let in-flight requests settle on
-    their replicas; LEAVE — drop the router's membership lease so
-    clients resolving through the directory stop finding it; CLOSE —
-    only then stop answering the socket. A client mid-retry never
-    sees a live directory entry pointing at a dead port."""
+    it): AUTOPILOT FIRST — stop the control loop so no scale/deploy
+    decision races the teardown; DRAIN — stop admitting, let
+    in-flight requests settle on their replicas; LEAVE — drop the
+    router's membership lease so clients resolving through the
+    directory stop finding it; CLOSE — only then stop answering the
+    socket. A client mid-retry never sees a live directory entry
+    pointing at a dead port."""
+    if autopilot is not None:
+        autopilot.stop()
     router.shutdown(drain=True)
     if registration is not None:
         registration.stop(leave=True)
@@ -596,8 +655,10 @@ def _cmd_router(args) -> int:
     from paddle_tpu.fleet.registry import Registration
     from paddle_tpu.trainer.coordinator import connect
 
-    router, httpd, coord = _build_router(
+    router, httpd, coord, autopilot = _build_router(
         args, Router, build_router_http_server, connect)
+    if autopilot is not None:
+        autopilot.start()
     endpoint = f"http://{args.host}:{httpd.server_address[1]}"
     registration = Registration(
         coord, "fleet/router",
@@ -620,13 +681,71 @@ def _cmd_router(args) -> int:
                       "host": args.host,
                       "port": httpd.server_address[1],
                       "affinity": args.affinity,
+                      "autopilot": autopilot is not None,
                       "replicas": len(router.balancer.replicas())}),
           flush=True)
     while not stop:
         time.sleep(0.2)
-    _router_teardown(router, registration, httpd)
+    _router_teardown(router, registration, httpd,
+                     autopilot=autopilot)
     print(json.dumps({"job": "router", "status": "stopped",
                       "stats": router.stats()}))
+    return 0
+
+
+def _build_fleet_request(args):
+    """fleet-verb wiring, split from the HTTP call so tests can
+    assert the request shape without a live daemon
+    (tests/test_cli.py): returns (method, url, json_body_or_None)."""
+    base = args.router.rstrip("/")
+    if args.action == "deploy":
+        return "POST", f"{base}/admin/deploy", \
+            {"force": bool(args.force)}
+    if args.action == "scale":
+        if args.replicas is None:
+            raise SystemExit("fleet scale needs --replicas N")
+        return "POST", f"{base}/admin/scale", \
+            {"replicas": int(args.replicas)}
+    return "GET", f"{base}/stats", None
+
+
+def _cmd_fleet(args) -> int:
+    """Operate a RUNNING `paddle_tpu router` daemon over its admin
+    plane (docs/robustness.md "Fleet autopilot"): ``deploy`` runs the
+    SLO-gated rolling restart (exit 1 when it pauses on a breach),
+    ``scale`` resizes through the autopilot, ``status`` prints the
+    fleet + autopilot snapshots."""
+    import urllib.error
+    import urllib.request
+
+    def _call(method, url, body):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"}
+            if data else {})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=args.timeout) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    method, url, body = _build_fleet_request(args)
+    code, payload = _call(method, url, body)
+    out = {"job": "fleet", "action": args.action, "router": args.router,
+           "http_status": code, "result": payload}
+    if args.action == "status" and code == 200:
+        ap_code, ap = _call("GET",
+                            args.router.rstrip("/") + "/autopilot",
+                            None)
+        out["autopilot"] = ap if ap_code == 200 else None
+    print(json.dumps(out))
+    if code != 200:
+        return 1
+    if args.action == "deploy" and \
+            payload.get("status") != "complete":
+        return 1                       # paused rollout is not success
     return 0
 
 
@@ -1161,6 +1280,47 @@ def main(argv=None) -> int:
                          "(0: never)")
     rt.add_argument("--event_log_keep", type=int, default=3,
                     help="rotated journal segments to keep (default 3)")
+    rt.add_argument("--autopilot", action="store_true",
+                    help="run the fleet autopilot control loop "
+                         "(autoscaler + SLO-gated deploys — "
+                         "docs/robustness.md 'Fleet autopilot'); "
+                         "implied by --spawn_cmd")
+    rt.add_argument("--spawn_cmd", default=None,
+                    help="shell command template spawning ONE replica "
+                         "process ({replica_id} substituted; the "
+                         "process must print the serve daemon's JSON "
+                         "status line) — arms scale-up/down; without "
+                         "it the autopilot can deploy (replicas quit, "
+                         "supervisors respawn) but not spawn")
+    rt.add_argument("--min_replicas", type=int, default=1,
+                    help="autoscaler floor (scale-down stops here)")
+    rt.add_argument("--max_replicas", type=int, default=8,
+                    help="autoscaler ceiling (scale-up stops here)")
+    rt.add_argument("--autopilot_interval", type=float, default=1.0,
+                    help="seconds between autopilot control ticks")
+
+    fl = sub.add_parser("fleet", help="operate a running "
+                        "`paddle_tpu router` daemon: SLO-gated "
+                        "rolling deploy, operator scaling, status "
+                        "(docs/robustness.md 'Fleet autopilot')")
+    fl.add_argument("action", choices=["deploy", "scale", "status"],
+                    help="deploy: drain->restart->rejoin each replica "
+                         "one at a time, pausing on SLO breaches; "
+                         "scale: resize to --replicas through the "
+                         "autopilot; status: fleet + autopilot "
+                         "snapshots as JSON")
+    fl.add_argument("--router", required=True,
+                    help="base URL of the router daemon "
+                         "(http://HOST:PORT)")
+    fl.add_argument("--replicas", type=int, default=None,
+                    help="scale: target replica count (clamped to "
+                         "the daemon's --min/--max_replicas)")
+    fl.add_argument("--force", action="store_true",
+                    help="deploy: keep rolling through SLO breaches "
+                         "(the journal still records them)")
+    fl.add_argument("--timeout", type=float, default=600.0,
+                    help="HTTP timeout for the admin call (a deploy "
+                         "waits for every replica to cycle)")
 
     pf = sub.add_parser("profile", help="on-demand deep profile window: "
                         "N traced steps + per-phase/MFU summary "
@@ -1325,6 +1485,8 @@ def main(argv=None) -> int:
         return _cmd_coordinator(args)
     if args.command == "pserver":
         return _cmd_pserver(args)
+    if args.command == "fleet":
+        return _cmd_fleet(args)
     if args.command == "router":
         from paddle_tpu.obs import context as obs_context
         from paddle_tpu.obs.events import JOURNAL

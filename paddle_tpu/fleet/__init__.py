@@ -1,30 +1,48 @@
-"""Serving fleet v1 (ROADMAP item 4): a KV-aware, prefix-affine
-router over N `paddle_tpu serve` replicas with exactly-once mid-stream
-failover.
+"""Serving fleet (ROADMAP item 4): a KV-aware, prefix-affine router
+over N `paddle_tpu serve` replicas with exactly-once mid-stream
+failover — plus the autopilot that resizes, deploys and keeps the
+router plane HA.
 
-- fleet/registry.py — replica membership on the coordinator plane
-  (lease expiry = implicit drain; rejoin = re-admit)
-- fleet/balance.py — aggregate-KV-headroom admission + the radix
-  prefix-affinity index (serving/prefix.py's keying, router-side)
-- fleet/router.py  — dispatch, queueing, drain/deploy, mid-stream
+- fleet/registry.py  — replica membership on the coordinator plane
+  (lease expiry = implicit drain; rejoin = re-admit; coordinator
+  OUTAGE = bounded-staleness last-known view, not a mass leave)
+- fleet/balance.py   — aggregate-KV-headroom admission + the radix
+  prefix-affinity index (serving/prefix.py's keying, router-side) +
+  rendezvous hashing so N independent routers agree on placement
+- fleet/router.py    — dispatch, queueing, drain/deploy, mid-stream
   failover with trace-id continuity
-- fleet/http.py    — the `paddle_tpu router` daemon's HTTP front
-- fleet/obs.py     — paddle_tpu_fleet_* exposition + flight state
+- fleet/autopilot.py — the autoscaler (shed-rate / KV-headroom / SLO
+  signals through a hysteresis policy into a pluggable provisioner)
+  and the SLO-gated rolling deploy
+- fleet/http.py      — the `paddle_tpu router` daemon's HTTP front
+  (streaming NDJSON relay + /admin/deploy + /admin/scale)
+- fleet/obs.py       — paddle_tpu_fleet_* / paddle_tpu_autopilot_*
+  exposition + flight state
 
-docs/robustness.md "Serving fleet" has the operational story;
-testing/faults.py family (p) + tests/test_fleet_faults.py the chaos
+docs/robustness.md "Serving fleet" + "Fleet autopilot" have the
+operational story; testing/faults.py families (p)/(q) +
+tests/test_fleet_faults.py + tests/test_autopilot.py the chaos
 coverage.
 """
 
+from paddle_tpu.fleet.autopilot import (Autopilot, AutopilotPolicy,
+                                        CallbackProvisioner,
+                                        ReplicaProvisioner,
+                                        RollingDeploy,
+                                        SubprocessProvisioner)
 from paddle_tpu.fleet.balance import (AffinityIndex, FleetBalancer,
-                                      ReplicaState)
+                                      ReplicaState, rendezvous_choose,
+                                      stable_prefix_key)
 from paddle_tpu.fleet.http import build_router_http_server
 from paddle_tpu.fleet.registry import (Registration, ReplicaRegistration,
                                        ReplicaRegistry, ReplicaView)
 from paddle_tpu.fleet.router import FleetResult, Router
 
 __all__ = [
-    "AffinityIndex", "FleetBalancer", "FleetResult", "Registration",
-    "ReplicaRegistration", "ReplicaRegistry", "ReplicaState",
-    "ReplicaView", "Router", "build_router_http_server",
+    "AffinityIndex", "Autopilot", "AutopilotPolicy",
+    "CallbackProvisioner", "FleetBalancer", "FleetResult",
+    "Registration", "ReplicaProvisioner", "ReplicaRegistration",
+    "ReplicaRegistry", "ReplicaState", "ReplicaView", "RollingDeploy",
+    "Router", "SubprocessProvisioner", "build_router_http_server",
+    "rendezvous_choose", "stable_prefix_key",
 ]

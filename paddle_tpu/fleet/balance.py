@@ -34,12 +34,53 @@ O(traffic).
 
 from __future__ import annotations
 
+import hashlib
+import struct
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from paddle_tpu.analysis.lockdep import named_lock
 
-__all__ = ["AffinityIndex", "FleetBalancer", "ReplicaState"]
+__all__ = ["AffinityIndex", "FleetBalancer", "ReplicaState",
+           "rendezvous_choose", "stable_prefix_key"]
+
+
+def stable_prefix_key(tokens: Sequence[int],
+                      page_size: int) -> Optional[bytes]:
+    """The consistent-hashing key for a prompt: a digest of its FIRST
+    page-aligned token run (capped at len-1, like AffinityIndex._keys
+    — the final token is always a query). Deterministic across
+    processes (blake2b over the raw token values — no PYTHONHASHSEED
+    exposure), so N independent routers cut the IDENTICAL key from the
+    same prompt. One page is the right granularity: every request
+    sharing at least a page of prefix (same system prompt / few-shot
+    header) maps to the same key and therefore the same home replica.
+    None when the prompt has no complete page to key on."""
+    ps = max(1, int(page_size))
+    if ps > len(tokens) - 1:
+        return None
+    h = hashlib.blake2b(digest_size=8)
+    for t in tokens[:ps]:
+        h.update(struct.pack("<q", int(t)))
+    return h.digest()
+
+
+def rendezvous_choose(key: bytes,
+                      replica_ids: Iterable[str]) -> Optional[str]:
+    """Highest-random-weight (rendezvous) hash: every router ranks
+    (key, replica) pairs identically, so the same prompt routes to the
+    same replica on EVERY router with no shared state — and when the
+    winner dies only its keys move (minimal disruption), unlike a
+    mod-N ring. Ties are impossible in practice (64-bit digests) but
+    break deterministically by replica id."""
+    best_rid, best_rank = None, None
+    for rid in replica_ids:
+        rank = hashlib.blake2b(key + str(rid).encode("utf-8"),
+                               digest_size=8).digest()
+        if best_rank is None or (rank, str(rid)) > best_rank:
+            best_rank = (rank, str(rid))
+            best_rid = rid
+    return best_rid
 
 
 class ReplicaState:
@@ -302,6 +343,21 @@ class FleetBalancer:
                 for st in fits:
                     if st.replica_id == rid:
                         return rid, depth
+            # no learned match: consistent-hash the prompt's first
+            # page to its HOME replica. Rendezvous over the fit set is
+            # a pure function of (prompt, live membership), so N
+            # independent routers cut the identical key and agree on
+            # the home with no shared state — the HA-plane property
+            # (two routers never split one hot prefix across replicas).
+            # The learned index still wins above it: after a failover
+            # or a headroom detour THIS router knows where the pages
+            # actually are, which the hash cannot.
+            key = stable_prefix_key(tokens, self.index.page_size)
+            if key is not None:
+                home = rendezvous_choose(
+                    key, (st.replica_id for st in fits))
+                if home is not None:
+                    return home, 0
         # least-loaded: most free KV pages, ties by fewest inflight
         best = max(fits, key=lambda st: (st.kv_pages_free,
                                          -st.inflight))

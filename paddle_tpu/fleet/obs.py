@@ -32,12 +32,30 @@ _COUNTER_KEYS = {
     "settled_failover", "queued", "scrape_errors",
 }
 
+#: Autopilot stats() leaves with counter semantics — the rest
+#: (replicas_live, shed_rate, headroom_frac, headroom_trend_per_s,
+#: min/max_replicas, last_decision_age_s) export as gauges. Flattened
+#: names (paddle_tpu_autopilot_scale_ups, paddle_tpu_autopilot_ticks,
+#: paddle_tpu_autopilot_deploys_paused ...) are the
+#: docs/observability.md catalog.
+_AUTOPILOT_COUNTER_KEYS = {
+    "ticks", "scale_ups", "scale_downs", "spawn_failures",
+    "slo_breaches_seen", "deploys", "deploys_paused",
+}
 
-def prometheus_text(router, prefix: str = "paddle_tpu_fleet") -> str:
-    """Render ``router.stats()`` PLUS the global metrics registry as
-    Prometheus text exposition 0.0.4 — the router's GET /metrics."""
-    return REGISTRY.exposition(
-        extra=stats_families(prefix, router.stats(), _COUNTER_KEYS))
+
+def prometheus_text(router, prefix: str = "paddle_tpu_fleet",
+                    autopilot=None) -> str:
+    """Render ``router.stats()`` (plus ``autopilot.stats()`` as
+    ``paddle_tpu_autopilot_*`` when one is attached) PLUS the global
+    metrics registry as Prometheus text exposition 0.0.4 — the
+    router's GET /metrics."""
+    extra = stats_families(prefix, router.stats(), _COUNTER_KEYS)
+    if autopilot is not None:
+        extra = extra + stats_families("paddle_tpu_autopilot",
+                                       autopilot.stats(),
+                                       _AUTOPILOT_COUNTER_KEYS)
+    return REGISTRY.exposition(extra=extra)
 
 
 def register_flight_provider(router) -> None:

@@ -19,15 +19,26 @@ journal see every fleet process through one directory.
 
 from __future__ import annotations
 
+import http.client
 import threading
+import time
 import uuid
+import xmlrpc.client
 from typing import Any, Callable, Dict, Optional
 
 from paddle_tpu.analysis.lockdep import named_lock
 from paddle_tpu.obs import context as obs_context
+from paddle_tpu.obs.events import emit as journal_emit
 
 __all__ = ["Registration", "ReplicaRegistration", "ReplicaRegistry",
            "ReplicaView"]
+
+#: transport-level failures that mean the COORDINATOR is unreachable —
+#: categorically different from a lease expiry (the coordinator
+#: answering "that worker is gone"). xmlrpc.client.Fault is NOT here
+#: on purpose: a Fault is the coordinator answering.
+_RPC_ERRORS = (OSError, http.client.HTTPException,
+               xmlrpc.client.ProtocolError)
 
 
 class Registration:
@@ -146,17 +157,31 @@ class ReplicaRegistry:
     in-process test/bench mode. ``on_join`` / ``on_leave`` /
     ``on_rejoin`` callbacks fire from inside ``poll()`` (the caller's
     thread) on membership transitions; a rejoin is the same worker id
-    coming back after a lapse, or a boot_id change (a restart)."""
+    coming back after a lapse, or a boot_id change (a restart).
+
+    **Coordinator outage is not a mass leave.** A transport failure
+    talking to the directory says nothing about the replicas — they
+    are still serving; only the ROUTER went blind. ``poll()``
+    therefore keeps serving the last successful scan as a STALE view
+    (no leave callbacks fire), journals ``fleet/stale_view`` when the
+    outage starts, and tracks its age — exported as the
+    ``paddle_tpu_fleet_registry_stale_s`` gauge via Router.stats().
+    The staleness is bounded: past ``max_stale_s`` the view is too old
+    to trust (replicas may have died unobserved) and poll() reports it
+    empty, which IS the mass-leave — but deliberately, hundreds of
+    poll intervals after the outage began, not on the first blip."""
 
     def __init__(self, coordinator: Any = None,
                  endpoints: Optional[Dict[str, str]] = None,
                  on_join: Optional[Callable[[ReplicaView], None]] = None,
                  on_leave: Optional[Callable[[str], None]] = None,
-                 on_rejoin: Optional[Callable[[ReplicaView], None]] = None):
+                 on_rejoin: Optional[Callable[[ReplicaView], None]] = None,
+                 max_stale_s: float = 300.0):
         if coordinator is None and not endpoints:
             raise ValueError("need a coordinator or a static "
                              "endpoints map")
         self.coordinator = coordinator
+        self.max_stale_s = float(max_stale_s)
         self._static = dict(endpoints or {})
         self._lock = named_lock("fleet.registry")
         # xmlrpc ServerProxy reuses ONE HTTPConnection and is not
@@ -168,14 +193,19 @@ class ReplicaRegistry:
         # last poll's view + ids seen EVER  # ptlint: guarded-by(fleet.registry)
         self._view: Dict[str, ReplicaView] = {}
         self._ever: Dict[str, Optional[str]] = {}  # id -> last boot_id
+        # coordinator-outage state  # ptlint: guarded-by(fleet.registry)
+        self._stale_since: Optional[float] = None
+        self.stale_polls = 0           # ptlint: guarded-by(fleet.registry)
         self.on_join = on_join
         self.on_leave = on_leave
         self.on_rejoin = on_rejoin
 
     def _scan(self) -> Dict[str, ReplicaView]:
         if self.coordinator is None:
+            with self._lock:
+                static = dict(self._static)
             return {rid: ReplicaView(rid, ep, None)
-                    for rid, ep in self._static.items()}
+                    for rid, ep in static.items()}
         out: Dict[str, ReplicaView] = {}
         with self._rpc_lock:
             for wid in list(self.coordinator.workers()):
@@ -190,8 +220,24 @@ class ReplicaRegistry:
         return out
 
     def poll(self) -> Dict[str, ReplicaView]:
-        """Refresh the membership view; fire transition callbacks."""
-        fresh = self._scan()
+        """Refresh the membership view; fire transition callbacks.
+
+        A coordinator-unreachable scan does NOT clear the view (see
+        class doc): the last-known replicas keep routing, marked stale,
+        until ``max_stale_s`` bounds the lie."""
+        try:
+            fresh = self._scan()
+        except _RPC_ERRORS as e:
+            return self._poll_stale(e)
+        recovered_age = None
+        with self._lock:
+            if self._stale_since is not None:
+                recovered_age = time.monotonic() - self._stale_since
+                self._stale_since = None
+        if recovered_age is not None:
+            journal_emit("fleet", "view_recovered",
+                         stale_s=round(recovered_age, 3),
+                         replicas=len(fresh))
         joined, rejoined, left = [], [], []
         with self._lock:
             for rid, view in fresh.items():
@@ -204,6 +250,11 @@ class ReplicaRegistry:
                       and self._view[rid].boot_id is not None
                       and view.boot_id != self._view[rid].boot_id):
                     rejoined.append(view)       # restarted in place
+                elif view.endpoint != self._view[rid].endpoint:
+                    # a static entry relocated (restart on a new port:
+                    # the deploy leg without a directory) — same
+                    # re-admit semantics as a boot_id change
+                    rejoined.append(view)
                 self._ever[rid] = view.boot_id
             for rid in self._view:
                 if rid not in fresh:
@@ -219,6 +270,57 @@ class ReplicaRegistry:
             if self.on_leave:
                 self.on_leave(rid)
         return dict(fresh)
+
+    def _poll_stale(self, err: Exception) -> Dict[str, ReplicaView]:
+        """One unreachable-coordinator poll: keep (and return) the
+        last view, journal the outage once on entry, expire the view
+        past ``max_stale_s``. Leave callbacks only fire on expiry —
+        an outage is the ROUTER blind, not the replicas dead."""
+        now = time.monotonic()
+        expired_ids = []
+        with self._lock:
+            first = self._stale_since is None
+            if first:
+                self._stale_since = now
+            self.stale_polls += 1
+            age = now - self._stale_since
+            if age > self.max_stale_s and self._view:
+                expired_ids = list(self._view)
+                self._view = {}
+            view = dict(self._view)
+        if first:
+            journal_emit("fleet", "stale_view", error=repr(err),
+                         replicas=len(view),
+                         max_stale_s=self.max_stale_s)
+        if expired_ids:
+            journal_emit("fleet", "stale_view_expired",
+                         stale_s=round(age, 3), dropped=expired_ids)
+            for rid in expired_ids:
+                if self.on_leave:
+                    self.on_leave(rid)
+        return view
+
+    def set_static(self, replica_id: str, endpoint: str) -> None:
+        """Add/update a static-mode entry — the provisioner's join leg
+        when no coordinator directory exists (tests/bench/CPU fleets).
+        The next poll() reports it as a join (or a rejoin when the
+        endpoint moved — a restart relocates the replica)."""
+        with self._lock:
+            self._static[str(replica_id)] = endpoint
+
+    def drop_static(self, replica_id: str) -> None:
+        """Remove a static-mode entry; the next poll() reports the
+        leave."""
+        with self._lock:
+            self._static.pop(str(replica_id), None)
+
+    def staleness(self) -> float:
+        """Seconds the current view has been served without a
+        successful coordinator scan (0.0 when fresh / static)."""
+        with self._lock:
+            if self._stale_since is None:
+                return 0.0
+            return time.monotonic() - self._stale_since
 
     def view(self) -> Dict[str, ReplicaView]:
         with self._lock:

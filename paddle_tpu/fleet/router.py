@@ -682,6 +682,10 @@ class Router:
             "kv_pages_total": bal["kv_pages_total"],
             "kv_pages_free": bal["kv_pages_free"],
             "affinity_nodes": bal["index"]["nodes"],
+            # seconds the membership view has been served without a
+            # successful coordinator scan (fleet/registry.py stale-view
+            # degradation) -> paddle_tpu_fleet_registry_stale_s
+            "registry_stale_s": round(self.registry.staleness(), 3),
         })
         return out
 

@@ -104,6 +104,7 @@ class SLOWatchdog:
         self._streak: Dict[str, int] = {}
         self._last_breach_t: Dict[str, float] = {}
         self._breaches = 0
+        self._listeners: List[Callable[[dict], None]] = []
 
     # ------------------------------------------------------------ config
     @property
@@ -145,6 +146,34 @@ class SLOWatchdog:
         server, and profiler each register one."""
         with self._lock:
             self._sources[name] = fn
+
+    def add_breach_listener(self,
+                            fn: Callable[[dict], None]) -> None:
+        """Subscribe to breach records — ``fn(record)`` is called for
+        every ``slo/breach`` and ``slo/step_regression`` the watchdog
+        emits, from the emitting thread, OUTSIDE the watchdog lock and
+        after the journal record. The fleet autopilot's SLO leg rides
+        this seam (fleet/autopilot.py); a raising listener is isolated
+        (the watchdog never lets a subscriber break detection)."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def remove_breach_listener(self,
+                               fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def _notify(self, record: dict) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(dict(record))
+            except Exception:  # noqa: BLE001 — advisory subscribers
+                pass           # must never break breach detection
 
     # --------------------------------------------------- step regression
     def observe_step(self, kind: str, dt_ms: float,
@@ -197,6 +226,7 @@ class SLOWatchdog:
                  streak=breach["streak"], phase=breach["phase"])
             FLIGHT.maybe_autodump(
                 f"slo_step_regression_{breach['phase']}")
+            self._notify({"detector": "step_regression", **breach})
 
     def _attribute_locked(self, kind: str) -> str:
         """The phase whose latest sampled value grew the most over its
@@ -279,6 +309,7 @@ class SLOWatchdog:
             for b in breaches:
                 emit("slo", "breach", **b)
                 FLIGHT.maybe_autodump(f"slo_breach_{b['objective']}")
+                self._notify({"detector": "objective", **b})
         return breaches
 
     def snapshot(self) -> dict:
@@ -314,6 +345,7 @@ class SLOWatchdog:
             self._streak.clear()
             self._last_breach_t.clear()
             self._breaches = 0
+            self._listeners.clear()
 
 
 #: the process-global watchdog (profiler-driven; CLI --slo wires it)

@@ -51,6 +51,14 @@ Endpoints:
                            and the transport stays up — the router's
                            drain/deploy leg. POST /admin/resume
                            re-opens admission. Both return /health.
+  POST /admin/quit      -> ask the daemon to exit cleanly (drain →
+                           leave → close, same order as SIGTERM) —
+                           the rolling deploy's restart primitive for
+                           supervisor-managed replicas (the supervisor
+                           respawns; fleet/autopilot.py drives it).
+                           Answers 200 {"quitting": true} BEFORE the
+                           teardown starts; 501 when the embedding
+                           (CLI daemon) wired no quit hook.
 
 Every /infer and /generate request gets ONE trace_id at this front —
 taken from an ``X-Trace-Id`` header or body ``trace_id`` field when a
@@ -72,6 +80,7 @@ from __future__ import annotations
 
 import json
 import socket
+import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -138,10 +147,13 @@ def prometheus_text(server: InferenceServer,
 
 
 def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
-                      port: int = 0) -> ThreadingHTTPServer:
+                      port: int = 0,
+                      on_quit=None) -> ThreadingHTTPServer:
     """An HTTP server bound to (host, port) — port 0 picks a free one
     (see .server_address). Caller runs .serve_forever() (usually on a
-    thread) and .shutdown()."""
+    thread) and .shutdown(). ``on_quit`` (no-arg callable) arms POST
+    /admin/quit — the CLI daemon passes its orderly-exit trigger so a
+    rolling deploy can restart replicas over HTTP."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):     # quiet; stats() has it
@@ -367,6 +379,18 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 payload = server.resume()
                 payload["replica"] = replica_identity(self._endpoint())
                 self._json(200, payload)
+                return
+            if self.path == "/admin/quit":
+                if on_quit is None:
+                    self._json(501, {"error": "no quit hook wired "
+                                              "(in-process server?)"})
+                    return
+                # answer FIRST — the teardown closes this transport
+                self._json(200, {
+                    "quitting": True,
+                    "replica": replica_identity(self._endpoint())})
+                threading.Thread(target=on_quit, daemon=True,
+                                 name="pt-serving-quit").start()
                 return
             if self.path != "/infer":
                 self._json(404, {"error": f"no route {self.path}"})

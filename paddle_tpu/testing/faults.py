@@ -146,6 +146,28 @@ and, for the serving fleet (docs/robustness.md "Serving fleet"):
       journals reconstructs each victim's hop chain from its
       trace_id alone (tests/test_fleet_faults.py chaos acceptance);
 
+and, for the fleet CONTROL plane (docs/robustness.md "Fleet
+autopilot"):
+
+  (q) kill routers and coordinators out from under the fleet —
+      ``kill_router`` fires a caller-supplied kill (SIGKILL a router
+      subprocess, or the in-process router ``httpd.kill()`` tear) the
+      moment THE ROUTER ITSELF has relayed ``at`` tokens of any
+      stream (``mid_stream=True``) or right before its next dispatch
+      (``mid_stream=False``) — the client's stream tears before the
+      terminal record and it retries the SAME trace_id on a sibling
+      router; ``coordinator_outage`` makes a registry's coordinator
+      proxy raise ``OSError`` on every RPC for the context's duration
+      (the registry must serve its last-known view with bounded
+      staleness, NOT mass-expire the fleet); ``bursty_trace`` is the
+      seeded quiet→spike→quiet per-tick request-count shape the
+      autoscaler chaos test replays. The invariants: exactly one
+      ``fleet/settle`` per trace_id across ALL routers' merged
+      journals (the replica-side hop journal is the dedupe witness),
+      zero KV-page leaks, and a coordinator outage shorter than the
+      staleness bound sheds NOTHING (tests/test_autopilot.py +
+      tests/test_fleet_faults.py family (q) acceptance);
+
 Everything is deterministic given the seed and the schedule, so a chaos
 test that fails replays exactly. See ``tests/test_faults.py`` and
 ``tests/test_serving_faults.py`` for the tests that drive these against
@@ -1157,3 +1179,110 @@ class FaultPlan:
             router._route_interceptor = prev_route
             if fired.is_set():
                 thread.join(timeout=30)
+
+    # ------------------------------------------- (q) control-plane chaos
+    @staticmethod
+    @contextlib.contextmanager
+    def kill_router(router, kill: Callable[[], None], at: int = 2,
+                    mid_stream: bool = True):
+        """Arm a one-shot kill of the ROUTER ITSELF — family (p)'s
+        ``kill_replica`` one level up the plane. With ``mid_stream``
+        the caller's ``kill()`` (the in-process router
+        ``httpd.kill()`` tear, or a subprocess SIGKILL) fires the
+        moment this router has relayed ``at`` tokens of ANY stream;
+        without it, right before its next dispatch. Streaming clients
+        see a torn NDJSON stream (no terminal record) and retry the
+        same trace_id on a sibling router — the replica-side hop
+        journal dedupes fleet-wide. Yields the same stats dict shape
+        as ``kill_replica`` (``fired``, ``at_tokens``,
+        ``victim_traces``)."""
+        stats = {"fired": 0, "at_tokens": None, "victim_traces": []}
+        lock = threading.Lock()
+        prev_stream = router._stream_interceptor
+        prev_route = router._route_interceptor
+
+        def fire(trace_id, n):
+            with lock:
+                if stats["fired"]:
+                    return
+                stats["fired"] = 1
+                stats["at_tokens"] = n
+            if trace_id is not None:
+                stats["victim_traces"].append(trace_id)
+            kill()
+
+        def stream_seam(trace_id, rid, n):
+            if prev_stream is not None:
+                prev_stream(trace_id, rid, n)
+            if mid_stream and n >= at:
+                fire(trace_id, n)
+
+        def route_seam(trace_id, rid, hop):
+            if prev_route is not None:
+                prev_route(trace_id, rid, hop)
+            if not mid_stream:
+                fire(trace_id, 0)
+
+        router._stream_interceptor = stream_seam
+        router._route_interceptor = route_seam
+        try:
+            yield stats
+        finally:
+            router._stream_interceptor = prev_stream
+            router._route_interceptor = prev_route
+
+    @staticmethod
+    @contextlib.contextmanager
+    def coordinator_outage(target, for_s: Optional[float] = None):
+        """Take the coordinator away WITHOUT touching the replicas —
+        every directory RPC raises ``OSError`` until the context
+        exits. ``target`` is a ``ReplicaRegistry`` or anything with a
+        ``.registry`` (a Router). The registry's contract under this
+        fault (fleet/registry.py): keep serving the last-known
+        routable view, journal ``fleet/stale_view`` with the bounded
+        staleness age, and journal ``fleet/view_recovered`` on the
+        first successful poll after exit — NOT a mass leave. With
+        ``for_s`` the context sleeps that long after cutting the wire
+        so at least one poll has failed by the time the body runs
+        (``lease_lapse``'s ``wait_s`` shape)."""
+        registry = getattr(target, "registry", target)
+        if registry.coordinator is None:
+            raise ValueError("static registry has no coordinator to "
+                             "take down")
+
+        class _DownCoordinator:
+            def __getattr__(self, name):
+                def _down(*args, **kwargs):
+                    raise OSError(
+                        f"coordinator outage (injected): {name}")
+                return _down
+
+        real = registry.coordinator
+        registry.coordinator = _DownCoordinator()
+        if for_s:
+            time.sleep(for_s)
+        try:
+            yield registry
+        finally:
+            registry.coordinator = real
+
+    @staticmethod
+    def bursty_trace(seed: int = 0, ticks: int = 30, base: int = 1,
+                     peak: int = 12, burst_start: int = 8,
+                     burst_len: int = 8) -> list:
+        """The canonical autoscaler chaos load shape: a per-tick
+        request-count list — quiet (``base``±1), a hard spike to
+        ``peak``±2 for ``burst_len`` ticks starting at
+        ``burst_start``, then quiet again (the scale-DOWN window).
+        Seeded jitter keeps it deterministic: same seed, same trace,
+        same scaling decisions (tests/test_autopilot.py replays
+        this)."""
+        rng = random.Random(seed)
+        out = []
+        for t in range(int(ticks)):
+            if burst_start <= t < burst_start + burst_len:
+                lo, hi = max(1, peak - 2), peak + 2
+            else:
+                lo, hi = max(0, base - 1), base + 1
+            out.append(rng.randint(lo, hi))
+        return out

@@ -1,0 +1,492 @@
+"""Fleet autopilot (ISSUE 16): the hysteresis policy replayed over
+the seeded bursty trace, the autoscaler against a REAL mini-fleet
+(scales up on the shed spike, down once after cooldown, never flaps,
+every decision journaled with evidence), and the SLO-gated rolling
+deploy (zero failed requests under an open-loop burst; an injected
+breach pauses the rollout)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.fleet import Router
+from paddle_tpu.fleet.autopilot import (Autopilot, AutopilotPolicy,
+                                        CallbackProvisioner,
+                                        ReplicaProvisioner, RollingDeploy)
+from paddle_tpu.obs.events import JOURNAL
+from paddle_tpu.serving import (DecodeEngine, InferenceServer, Rejected,
+                                build_http_server)
+from paddle_tpu.testing import FaultPlan
+
+pytestmark = pytest.mark.chaos
+
+DEC_CFG = dict(vocab_size=40, d_model=16, n_heads=2, n_layers=2,
+               d_ff=32, max_len=32)
+PAGE = 4
+
+
+def tiny_decoder(seed=7):
+    paddle.init(use_tpu=False, seed=0)
+    from paddle_tpu.core.registry import reset_name_counters
+    reset_name_counters()
+    spec = models.transformer_lm(**DEC_CFG)
+    costs = spec.cost if isinstance(spec.cost, list) else [spec.cost]
+    topo = paddle.Topology(costs, extra_outputs=[spec.output])
+    params = topo.init_params(jax.random.PRNGKey(seed))
+    return models.TransformerDecoder(params, n_layers=DEC_CFG["n_layers"],
+                                     n_heads=DEC_CFG["n_heads"])
+
+
+@pytest.fixture(scope="module")
+def decoder():
+    return tiny_decoder()
+
+
+class Replica:
+    """One in-process serving replica (tests/test_fleet.py's shape,
+    with a configurable page pool so the autoscaler tests can make
+    KV headroom genuinely scarce)."""
+
+    def __init__(self, rid, decoder, max_queue=16, **engine_kw):
+        self.rid = rid
+        kw = dict(num_slots=2, page_size=PAGE,
+                  max_seq_len=DEC_CFG["max_len"])
+        kw.update(engine_kw)
+        self.engine = DecodeEngine(decoder, **kw)
+        self.server = InferenceServer(None, max_queue=max_queue,
+                                      workers=1, breaker=False,
+                                      engine=self.engine).start()
+        self.httpd = build_http_server(self.server, "127.0.0.1", 0)
+        self.endpoint = \
+            f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        self._t = threading.Thread(target=self.httpd.serve_forever,
+                                   daemon=True,
+                                   name=f"pt-test-ap-replica-{rid}")
+        self._t.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.server.shutdown(drain=True, timeout=30)
+
+
+def _journal_since(seq, kind=None):
+    return JOURNAL.tail(500, domain="autopilot", kind=kind,
+                        since_seq=seq)
+
+
+class _SIG:
+    """Signal-dict factory for pure policy tests."""
+
+    @staticmethod
+    def make(**kw):
+        sig = dict(replicas_live=1, shed_rate=0.0, headroom_frac=1.0,
+                   headroom_trend_per_s=0.0, slo_breaches=0)
+        sig.update(kw)
+        return sig
+
+
+class TestAutopilotPolicy:
+    def test_scale_up_on_shed_respects_cooldown_and_ceiling(self):
+        p = AutopilotPolicy(min_replicas=1, max_replicas=3,
+                            up_cooldown_s=5.0)
+        d = p.decide(_SIG.make(shed_rate=2.0), 100.0)
+        assert d["action"] == "scale_up"
+        assert "shed_rate" in d["reason"]
+        assert d["evidence"]["shed_rate"] == 2.0
+        # a spawn is already in flight: hold through the cooldown
+        assert p.decide(_SIG.make(shed_rate=2.0, replicas_live=2),
+                        102.0) is None
+        d2 = p.decide(_SIG.make(shed_rate=2.0, replicas_live=2), 106.0)
+        assert d2["action"] == "scale_up"
+        # pinned at the ceiling: pressure no longer scales
+        assert p.decide(_SIG.make(shed_rate=9.0, replicas_live=3),
+                        120.0) is None
+
+    def test_scale_up_on_low_headroom_and_slo_breach(self):
+        p = AutopilotPolicy(headroom_low=0.15)
+        d = p.decide(_SIG.make(headroom_frac=0.10), 0.0)
+        assert d["action"] == "scale_up" and "headroom" in d["reason"]
+        p2 = AutopilotPolicy()
+        d2 = p2.decide(_SIG.make(slo_breaches=2), 0.0)
+        assert d2["action"] == "scale_up"
+        assert "slo_breaches" in d2["reason"]
+
+    def test_scale_down_needs_sustained_calm_and_floor(self):
+        p = AutopilotPolicy(min_replicas=1, max_replicas=4,
+                            down_stable_s=5.0, down_cooldown_s=0.0)
+        calm = _SIG.make(replicas_live=2, headroom_frac=0.9)
+        assert p.decide(calm, 0.0) is None     # calm clock starts
+        assert p.decide(calm, 3.0) is None     # not stable yet
+        d = p.decide(calm, 6.0)
+        assert d["action"] == "scale_down"
+        # ONE down per stability window — no flap
+        assert p.decide(calm, 6.5) is None
+        assert p.decide(calm, 12.0)["action"] == "scale_down"
+        # the floor: never drain below min_replicas
+        at_floor = _SIG.make(replicas_live=1, headroom_frac=0.9)
+        p2 = AutopilotPolicy(min_replicas=1, down_stable_s=0.0,
+                             down_cooldown_s=0.0)
+        p2.decide(at_floor, 0.0)
+        assert p2.decide(at_floor, 1.0) is None
+
+    def test_pressure_resets_the_calm_clock(self):
+        p = AutopilotPolicy(max_replicas=8, down_stable_s=2.0,
+                            down_cooldown_s=0.0)
+        calm = _SIG.make(replicas_live=2, headroom_frac=0.9)
+        assert p.decide(calm, 0.0) is None
+        p.decide(_SIG.make(replicas_live=2, shed_rate=1.0), 1.0)
+        # calm must restart from scratch after the pressure blip
+        assert p.decide(calm, 2.5) is None
+        assert p.decide(calm, 4.0) is None     # 1.5s < 2s stable
+        assert p.decide(calm, 5.0)["action"] == "scale_down"
+
+    def test_bursty_trace_replay_is_bounded_and_converges(self):
+        """The acceptance shape: the seeded trace scales up ON the
+        burst edge, down ONCE after the quiet tail, total decisions
+        bounded — hysteresis, not flapping."""
+        trace = FaultPlan.bursty_trace(seed=0, ticks=30)
+        p = AutopilotPolicy(min_replicas=1, max_replicas=2,
+                            up_cooldown_s=2.0, down_cooldown_s=3.0,
+                            down_stable_s=2.0)
+        live, decisions = 1, []
+        for t, load in enumerate(trace):
+            # toy capacity model: ~4 concurrent requests per replica
+            shed = max(0, load - 4 * live)
+            sig = _SIG.make(replicas_live=live, shed_rate=float(shed),
+                            headroom_frac=0.9 if shed == 0 else 0.2)
+            d = p.decide(sig, float(t))
+            if d is None:
+                continue
+            decisions.append((t, d["action"]))
+            live += 1 if d["action"] == "scale_up" else -1
+        ups = [t for t, a in decisions if a == "scale_up"]
+        downs = [t for t, a in decisions if a == "scale_down"]
+        assert ups and downs
+        assert 8 <= ups[0] <= 10      # the burst edge (burst_start=8)
+        assert downs[0] > max(ups)    # down only after the burst
+        assert len(decisions) <= 4    # bounded: never flaps
+        assert live == 1              # back to the floor
+
+    def test_bursty_trace_is_seed_deterministic(self):
+        a = FaultPlan.bursty_trace(seed=3)
+        assert a == FaultPlan.bursty_trace(seed=3)
+        assert a != FaultPlan.bursty_trace(seed=4)
+        assert max(a[8:16]) >= 10 and max(a[:8] + a[16:]) <= 2
+
+
+class TestAutoscalerChaos:
+    def test_bursty_load_scales_up_then_down_with_journaled_evidence(
+            self, decoder):
+        """The tentpole acceptance: a REAL router + replica under the
+        seeded bursty trace. The shed spike triggers ONE spawn (live
+        provisioner, admitted mid-run), the quiet tail ONE drain after
+        cooldown, decision count stays bounded, and every decision
+        carries its evidence in the journal."""
+        def slow_replica(rid):
+            # tiny waiting queue + throttled decode: a 10-wide burst
+            # makes the replica decline (429) past the router's
+            # queue_timeout — genuine SHEDS, the autoscaler's trigger
+            r = Replica(rid, decoder, max_waiting=1,
+                        prefix_cache=False)
+            r.engine._step_interceptor = lambda n: time.sleep(0.04)
+            return r
+
+        reps = {"r0": slow_replica("r0")}
+        router = Router(endpoints={"r0": reps["r0"].endpoint},
+                        affinity="load", page_size=PAGE,
+                        scrape_interval=0.1, queue_timeout=0.35,
+                        queue_poll=0.02, drain_timeout=5.0).start()
+        time.sleep(0.3)                # first scrape lands
+
+        def spawn(rid):
+            reps[rid] = slow_replica(rid)
+            return {"endpoint": reps[rid].endpoint}
+
+        def stop(rid):
+            reps.pop(rid).stop()
+
+        ap = Autopilot(
+            router, CallbackProvisioner(spawn=spawn, stop=stop),
+            policy=AutopilotPolicy(min_replicas=1, max_replicas=2,
+                                   up_cooldown_s=1.0,
+                                   down_cooldown_s=1.0,
+                                   down_stable_s=0.8),
+            interval=0.2)
+        seq0 = JOURNAL.last_seq
+        trace = FaultPlan.bursty_trace(seed=0, ticks=16, base=0,
+                                       peak=10, burst_start=3,
+                                       burst_len=4)
+        try:
+            for load in trace:
+                if load:
+                    def one(i):
+                        try:
+                            router.generate([2 + i % 7, 3, 5, 7, 11], 8)
+                        except Rejected:
+                            pass
+                    FaultPlan.burst(one, n=load,
+                                    threads=min(load, 10), timeout=30)
+                ap.tick()
+                time.sleep(0.15)
+            # quiet tail: let the calm window + cooldown elapse
+            deadline = time.monotonic() + 8.0
+            while time.monotonic() < deadline and \
+                    ap.stats()["scale_downs"] == 0:
+                ap.tick()
+                time.sleep(0.2)
+            st = ap.stats()
+            assert st["scale_ups"] >= 1, st
+            assert st["scale_downs"] >= 1, st
+            # hysteresis: bounded decision count, no flapping
+            assert st["scale_ups"] + st["scale_downs"] <= 4, st
+            assert st["spawn_failures"] == 0
+            # the fleet converged back to the floor
+            live = [s for s in router.balancer.replicas().values()
+                    if s.live and not s.draining]
+            assert len(live) == 1
+            # every decision journaled WITH its triggering evidence
+            ups = _journal_since(seq0, kind="scale_up")
+            downs = _journal_since(seq0, kind="scale_down")
+            assert len(ups) == st["scale_ups"]
+            assert len(downs) == st["scale_downs"]
+            for rec in ups:
+                ev = rec["evidence"]
+                assert rec["reason"]
+                assert ev["shed_rate"] > 0 or \
+                    ev["headroom_frac"] < 0.15 or ev["slo_breaches"]
+            for rec in downs:
+                assert rec["evidence"]["shed_rate"] == 0
+                assert rec["replica"].startswith("auto-")
+        finally:
+            ap.stop()
+            router.shutdown(drain=True, timeout=10)
+            for r in list(reps.values()):
+                r.stop()
+
+    def test_scale_to_is_bounded_by_policy(self, decoder):
+        """`fleet scale` clamps to [min, max] and journals each
+        action."""
+        reps = {"r0": Replica("r0", decoder)}
+        router = Router(endpoints={"r0": reps["r0"].endpoint},
+                        affinity="load", page_size=PAGE,
+                        scrape_interval=0.1, queue_timeout=1.0).start()
+        time.sleep(0.25)
+
+        def spawn(rid):
+            reps[rid] = Replica(rid, decoder)
+            return {"endpoint": reps[rid].endpoint}
+
+        ap = Autopilot(
+            router,
+            CallbackProvisioner(spawn=spawn,
+                                stop=lambda rid: reps.pop(rid).stop()),
+            policy=AutopilotPolicy(min_replicas=1, max_replicas=3))
+        try:
+            acts = ap.scale_to(99)     # clamped to max_replicas=3
+            assert [a["action"] for a in acts] == ["scale_up"] * 2
+            assert router.stats()["replicas_live"] == 3
+            acts = ap.scale_to(0)      # clamped to min_replicas=1
+            assert [a["action"] for a in acts] == ["scale_down"] * 2
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and \
+                    router.stats()["replicas_live"] != 1:
+                time.sleep(0.05)
+            assert router.stats()["replicas_live"] == 1
+        finally:
+            ap.stop()
+            router.shutdown(drain=True, timeout=10)
+            for r in list(reps.values()):
+                r.stop()
+
+    def test_scale_to_arms_the_hysteresis_clocks(self):
+        """Operator scale-up on an IDLE fleet must not be reverted by
+        the very next policy tick: before the fix, `_calm_since`
+        predated the spawn (the fleet was calm all along) and
+        `_last_action_t` was None, so decide() fired scale_down one
+        tick after `fleet scale` returned."""
+        pol = AutopilotPolicy(min_replicas=1, max_replicas=4,
+                              down_cooldown_s=10.0, down_stable_s=5.0)
+        # fleet idle since t=0: calm clock armed at 100, stable by 106
+        assert pol.decide(_SIG.make(replicas_live=1), 100.0) is None
+        assert pol.decide(_SIG.make(replicas_live=1), 106.0) is None
+        # operator scales to 2 at t=107 (scale_to calls this)
+        pol.note_external_action(107.0)
+        # next ticks: calm again, but stability + cooldown restart at
+        # 107 — no scale_down until BOTH have re-elapsed
+        assert pol.decide(_SIG.make(replicas_live=2), 107.5) is None
+        assert pol.decide(_SIG.make(replicas_live=2), 111.0) is None
+        d = pol.decide(_SIG.make(replicas_live=2), 117.5)
+        assert d is not None and d["action"] == "scale_down"
+
+
+class FakeWatchdog:
+    """SLO watchdog stand-in: .breaches is all RollingDeploy reads."""
+
+    def __init__(self):
+        self.breaches = 0
+
+
+class TestRollingDeploy:
+    def _fleet(self, decoder, n=2):
+        reps = {f"r{i}": Replica(f"r{i}", decoder) for i in range(n)}
+        router = Router(endpoints={rid: r.endpoint
+                                   for rid, r in reps.items()},
+                        affinity="prefix", page_size=PAGE,
+                        scrape_interval=0.1, queue_timeout=10.0,
+                        queue_poll=0.02, drain_timeout=5.0).start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and any(
+                s.last_scrape == 0
+                for s in router.balancer.replicas().values()):
+            time.sleep(0.05)
+        return reps, router
+
+    def test_zero_failed_requests_under_open_loop_burst(self, decoder):
+        """The deploy acceptance: every replica restarts (new port =
+        new endpoint) one at a time while an open-loop burst keeps
+        arriving — and NOT ONE request fails."""
+        reps, router = self._fleet(decoder)
+        old_endpoints = {rid: r.endpoint for rid, r in reps.items()}
+        cycled = []
+
+        def restart(rid):
+            reps[rid].stop()
+            reps[rid] = Replica(rid, decoder)
+            cycled.append(rid)
+            return {"endpoint": reps[rid].endpoint}
+
+        roll = RollingDeploy(router, restart,
+                             watchdog=FakeWatchdog(),
+                             settle_timeout=30.0)
+        seq0 = JOURNAL.last_seq
+        out = {}
+
+        def run_deploy():
+            out.update(roll.run())
+
+        try:
+            dt = threading.Thread(target=run_deploy, daemon=True,
+                                  name="pt-test-deploy")
+            dt.start()
+
+            def one(i):
+                r = router.generate([1 + i % 5, 2, 3, 4], 6)
+                assert len(r.tokens) == 6
+                return r
+            results, errors = FaultPlan.burst(one, n=40, threads=4,
+                                             timeout=120)
+            dt.join(timeout=60)
+            assert not dt.is_alive()
+            failed = [e for e in errors if e is not None]
+            assert failed == []        # ZERO failed requests
+            assert sum(r is not None for r in results) == 40
+            assert out["status"] == "complete", out
+            assert cycled == ["r0", "r1"]
+            assert all(s["ready"] for s in out["steps"])
+            # both replicas really moved (restart = new port)
+            for rid, r in reps.items():
+                assert r.endpoint != old_endpoints[rid]
+            steps = _journal_since(seq0, kind="deploy_step")
+            assert [s["replica"] for s in steps] == ["r0", "r1"]
+            assert _journal_since(seq0, kind="deploy_done")
+        finally:
+            router.shutdown(drain=True, timeout=10)
+            for r in reps.values():
+                r.stop()
+
+    def test_slo_breach_pauses_rollout_and_force_overrides(
+            self, decoder):
+        reps, router = self._fleet(decoder)
+        wd = FakeWatchdog()
+
+        def restart(rid):
+            reps[rid].stop()
+            reps[rid] = Replica(rid, decoder)
+            wd.breaches += 1           # regression surfaces AFTER r0
+            return {"endpoint": reps[rid].endpoint}
+
+        seq0 = JOURNAL.last_seq
+        try:
+            out = RollingDeploy(router, restart, watchdog=wd,
+                                settle_timeout=30.0).run()
+            assert out["status"] == "paused", out
+            assert out["reason"] == "slo_breach"
+            assert [s["replica"] for s in out["steps"]] == ["r0"]
+            assert out["remaining"] == ["r1"]
+            paused = _journal_since(seq0, kind="deploy_paused")
+            assert paused and paused[-1]["replica"] == "r1"
+            assert paused[-1]["breaches"] == 1
+            # --force marches through the breach (journal still has it)
+            out2 = RollingDeploy(router, restart, watchdog=wd,
+                                 force=True,
+                                 settle_timeout=30.0).run(["r1"])
+            assert out2["status"] == "complete"
+        finally:
+            router.shutdown(drain=True, timeout=10)
+            for r in reps.values():
+                r.stop()
+
+
+class TestAdminQuit:
+    def test_quit_endpoint_wires_hook_and_501s_without(self, decoder):
+        r = Replica("rq", decoder)      # built WITHOUT on_quit
+        quits = []
+        server2 = InferenceServer(None, max_queue=4, workers=1,
+                                  breaker=False,
+                                  engine=DecodeEngine(
+                                      decoder, num_slots=2,
+                                      page_size=PAGE,
+                                      max_seq_len=32)).start()
+        httpd2 = build_http_server(server2, "127.0.0.1", 0,
+                                   on_quit=lambda: quits.append(1))
+        t2 = threading.Thread(target=httpd2.serve_forever, daemon=True,
+                              name="pt-test-quit-http")
+        t2.start()
+        ep2 = f"http://127.0.0.1:{httpd2.server_address[1]}"
+        try:
+            req = urllib.request.Request(r.endpoint + "/admin/quit",
+                                         data=b"{}", method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 501
+            req2 = urllib.request.Request(ep2 + "/admin/quit",
+                                          data=b"{}", method="POST")
+            with urllib.request.urlopen(req2, timeout=10) as resp:
+                body = json.loads(resp.read())
+            assert body["quitting"] is True
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not quits:
+                time.sleep(0.02)
+            assert quits == [1]
+        finally:
+            r.stop()
+            httpd2.shutdown()
+            httpd2.server_close()
+            server2.shutdown(drain=True, timeout=30)
+
+
+class TestProvisionerSeam:
+    def test_callback_provisioner_defaults_restart_to_stop_spawn(self):
+        calls = []
+        prov = CallbackProvisioner(
+            spawn=lambda rid: calls.append(("spawn", rid)) or
+            {"endpoint": "http://x"},
+            stop=lambda rid: calls.append(("stop", rid)))
+        info = prov.restart("r7")
+        assert calls == [("stop", "r7"), ("spawn", "r7")]
+        assert info["replica_id"] == "r7"
+        assert info["endpoint"] == "http://x"
+
+    def test_base_provisioner_is_abstract(self):
+        with pytest.raises(NotImplementedError):
+            ReplicaProvisioner().spawn("r0")
+        with pytest.raises(NotImplementedError):
+            ReplicaProvisioner().stop("r0")

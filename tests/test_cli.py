@@ -296,13 +296,14 @@ class TestMemoryFlags:
             host="127.0.0.1", port=0)
         server, httpd = cli._build_server(
             ns, FakeServer, FakeBreaker,
-            lambda srv, host, port: ("httpd", host, port))
+            lambda srv, host, port, on_quit=None:
+            ("httpd", host, port))
         assert server.kw["max_batch_memory"] == 4096
         assert httpd == ("httpd", "127.0.0.1", 0)
 
         ns.max_batch_memory = 0                 # 0 -> disabled (None)
         server, _ = cli._build_server(
-            ns, FakeServer, FakeBreaker, lambda *a: None)
+            ns, FakeServer, FakeBreaker, lambda *a, **k: None)
         assert server.kw["max_batch_memory"] is None
 
 
@@ -481,7 +482,7 @@ class TestDecodeEngineFlags:
             breaker_threshold=0.5, breaker_cooldown=1.0,
             host="127.0.0.1", port=0, decode_config="dec.py")
         server, _ = cli._build_server(
-            ns, FakeServer, FakeBreaker, lambda *a: None,
+            ns, FakeServer, FakeBreaker, lambda *a, **k: None,
             engine_builder=builder)
         assert built == [ns]
         assert server.kw["engine"] is sentinel
@@ -492,7 +493,7 @@ class TestDecodeEngineFlags:
             breaker_threshold=0.5, breaker_cooldown=1.0,
             host="127.0.0.1", port=0)
         server2, _ = cli._build_server(
-            ns2, FakeServer, FakeBreaker, lambda *a: None,
+            ns2, FakeServer, FakeBreaker, lambda *a, **k: None,
             engine_builder=builder)
         assert server2.kw["engine"] is None and len(built) == 1
 
@@ -784,15 +785,15 @@ class TestRouterCLI:
 
         built = []
 
-        def fake_http(router, host, port):
-            built.append((router, host, port))
+        def fake_http(router, host, port, autopilot=None):
+            built.append((router, host, port, autopilot))
             return object()
 
         ns = argparse.Namespace(
             coordinator="10.0.0.5:4321", affinity="load", page_size=8,
             scrape_interval=0.25, queue_timeout=3.0, drain_timeout=7.0,
             host="0.0.0.0", port=8088)
-        router, httpd, coord = cli._build_router(
+        router, httpd, coord, autopilot = cli._build_router(
             ns, FakeRouter, fake_http, fake_connect)
         assert connected == [("10.0.0.5", 4321)]
         assert coord is coord_sentinel
@@ -802,7 +803,9 @@ class TestRouterCLI:
                              "scrape_interval": 0.25,
                              "queue_timeout": 3.0,
                              "drain_timeout": 7.0}
-        assert built == [(router, "0.0.0.0", 8088)]
+        # no --autopilot/--spawn_cmd -> no control loop constructed
+        assert autopilot is None
+        assert built == [(router, "0.0.0.0", 8088, None)]
 
     def test_router_teardown_order_drain_leave_close(self):
         from paddle_tpu import cli
@@ -826,6 +829,10 @@ class TestRouterCLI:
             def server_close(self):
                 calls.append("close_socket")
 
+        class FakeAutopilot:
+            def stop(self):
+                calls.append("autopilot_stop")
+
         cli._router_teardown(FakeRouter(), FakeReg(), FakeHttpd())
         # the contract: stop admitting + settle in-flight FIRST, then
         # drop the directory entry, only then kill the socket
@@ -834,6 +841,13 @@ class TestRouterCLI:
         calls.clear()
         cli._router_teardown(FakeRouter(), None, FakeHttpd())
         assert calls == ["drain", "close", "close_socket"]
+        # with an autopilot, its control loop stops BEFORE the drain:
+        # no scale/deploy decision may race the teardown
+        calls.clear()
+        cli._router_teardown(FakeRouter(), FakeReg(), FakeHttpd(),
+                             autopilot=FakeAutopilot())
+        assert calls == ["autopilot_stop", "drain", "leave", "close",
+                         "close_socket"]
 
     def test_router_daemon_serves_and_sigterm_drains(self, tmp_path):
         """End-to-end daemon: a router fronting an EMPTY fleet still
@@ -900,3 +914,114 @@ class TestRouterCLI:
             except subprocess.TimeoutExpired:
                 coord.kill()
                 raise
+
+
+class TestFleetCLI:
+    """ISSUE 16: the `paddle_tpu fleet` operator verbs and the router
+    daemon's autopilot flag wiring (docs/robustness.md "Fleet
+    autopilot")."""
+
+    def test_fleet_flags_parse(self, monkeypatch):
+        from paddle_tpu import cli
+        seen = {}
+        monkeypatch.setattr(cli, "_cmd_fleet",
+                            lambda args: seen.update(vars(args)) or 0)
+        assert cli.main(["fleet", "deploy", "--router",
+                         "http://127.0.0.1:8088", "--force"]) == 0
+        assert seen["action"] == "deploy" and seen["force"] is True
+        assert seen["timeout"] == 600.0
+        assert cli.main(["fleet", "scale", "--router", "http://h:1",
+                         "--replicas", "3"]) == 0
+        assert seen["action"] == "scale" and seen["replicas"] == 3
+        assert cli.main(["fleet", "status", "--router",
+                         "http://h:1"]) == 0
+        assert seen["action"] == "status"
+        # --router is required; the action is a closed choice
+        with pytest.raises(SystemExit):
+            cli.main(["fleet", "deploy"])
+        with pytest.raises(SystemExit):
+            cli.main(["fleet", "restart", "--router", "http://h:1"])
+
+    def test_build_fleet_request_shapes(self):
+        import argparse
+
+        from paddle_tpu import cli
+        ns = argparse.Namespace(action="deploy", router="http://h:9/",
+                                force=True, replicas=None)
+        assert cli._build_fleet_request(ns) == \
+            ("POST", "http://h:9/admin/deploy", {"force": True})
+        ns = argparse.Namespace(action="scale", router="http://h:9",
+                                force=False, replicas=4)
+        assert cli._build_fleet_request(ns) == \
+            ("POST", "http://h:9/admin/scale", {"replicas": 4})
+        ns = argparse.Namespace(action="status", router="http://h:9",
+                                force=False, replicas=None)
+        assert cli._build_fleet_request(ns) == \
+            ("GET", "http://h:9/stats", None)
+        # scale without a target is an argument error, not a 400
+        ns = argparse.Namespace(action="scale", router="http://h:9",
+                                force=False, replicas=None)
+        with pytest.raises(SystemExit):
+            cli._build_fleet_request(ns)
+
+    def test_router_autopilot_flags_parse(self, monkeypatch):
+        from paddle_tpu import cli
+        seen = {}
+        monkeypatch.setattr(cli, "_cmd_router",
+                            lambda args: seen.update(vars(args)) or 0)
+        assert cli.main(["router", "--coordinator", "h:1"]) == 0
+        assert seen["autopilot"] is False
+        assert seen["spawn_cmd"] is None
+        assert seen["min_replicas"] == 1
+        assert seen["max_replicas"] == 8
+        assert seen["autopilot_interval"] == 1.0
+        assert cli.main(["router", "--coordinator", "h:1",
+                         "--autopilot", "--spawn_cmd",
+                         "serve {replica_id}", "--min_replicas", "2",
+                         "--max_replicas", "5",
+                         "--autopilot_interval", "0.5"]) == 0
+        assert seen["autopilot"] is True
+        assert seen["spawn_cmd"] == "serve {replica_id}"
+        assert seen["min_replicas"] == 2 and seen["max_replicas"] == 5
+        assert seen["autopilot_interval"] == 0.5
+
+    def test_build_router_constructs_autopilot(self):
+        import argparse
+
+        from paddle_tpu import cli
+        from paddle_tpu.fleet.autopilot import (Autopilot,
+                                                SubprocessProvisioner)
+
+        class FakeRouter:
+            def __init__(self, coordinator=None, **kw):
+                self.coordinator = coordinator
+                self.kw = kw
+
+            def start(self):
+                return self
+
+        built = []
+
+        def fake_http(router, host, port, autopilot=None):
+            built.append(autopilot)
+            return object()
+
+        ns = argparse.Namespace(
+            coordinator="h:4321", affinity="prefix", page_size=16,
+            scrape_interval=0.5, queue_timeout=5.0, drain_timeout=10.0,
+            host="127.0.0.1", port=0, autopilot=True,
+            spawn_cmd="serve {replica_id}", min_replicas=2,
+            max_replicas=5, autopilot_interval=0.5)
+        router, httpd, coord, ap = cli._build_router(
+            ns, FakeRouter, fake_http, lambda h, p: object())
+        try:
+            assert isinstance(ap, Autopilot)
+            assert isinstance(ap.provisioner, SubprocessProvisioner)
+            assert ap.provisioner.argv == ["serve", "{replica_id}"]
+            assert ap.policy.min_replicas == 2
+            assert ap.policy.max_replicas == 5
+            assert ap.interval == 0.5
+            # the admin plane got the SAME autopilot instance
+            assert built == [ap]
+        finally:
+            ap.stop()       # unhooks the SLO watchdog listener

@@ -25,8 +25,10 @@ import paddle_tpu as paddle
 from paddle_tpu import models
 from paddle_tpu.fleet import (AffinityIndex, FleetBalancer,
                               ReplicaRegistration, ReplicaRegistry,
-                              Router, build_router_http_server)
+                              Router, build_router_http_server,
+                              rendezvous_choose, stable_prefix_key)
 from paddle_tpu.fleet.router import _HopTorn, _Reroute
+from paddle_tpu.obs.events import JOURNAL
 from paddle_tpu.serving import (DecodeEngine, InferenceServer, Rejected,
                                 ServerClosed, build_http_server)
 from paddle_tpu.testing import FaultPlan
@@ -626,3 +628,170 @@ class TestFleetHTTP:
             assert line.endswith(" 1")
         finally:
             rep.stop()
+
+
+class TestCoordinatorOutage:
+    """ISSUE 16 satellite: coordinator unreachable is the ROUTER
+    blind, not the replicas dead. The registry serves its last-known
+    view (bounded by max_stale_s) and traffic keeps flowing."""
+
+    def test_registry_keeps_last_known_view_no_mass_leave(self):
+        coord = Coordinator([], worker_lease_s=30.0)
+        leaves = []
+        reg = ReplicaRegistry(coordinator=coord,
+                              on_leave=leaves.append)
+        a = ReplicaRegistration(coord, "a", "http://h:1",
+                                heartbeat_s=60).join()
+        reg.poll()
+        assert set(reg.view()) == {"a"}
+        seq0 = JOURNAL.last_seq
+        with FaultPlan.coordinator_outage(reg):
+            for _ in range(3):
+                reg.poll()
+            # the last-known view SURVIVES — no leave storm
+            assert set(reg.view()) == {"a"}
+            assert leaves == []
+            assert reg.staleness() > 0.0
+            assert reg.stale_polls >= 3
+        stale = JOURNAL.tail(50, domain="fleet", kind="stale_view",
+                             since_seq=seq0)
+        assert len(stale) == 1     # once on entry, not per poll
+        assert stale[0]["replicas"] == 1
+        reg.poll()                 # coordinator is back
+        assert reg.staleness() == 0.0
+        rec = JOURNAL.tail(50, domain="fleet", kind="view_recovered",
+                           since_seq=seq0)
+        assert rec and rec[-1]["stale_s"] >= 0
+        a.stop(leave=True)
+
+    def test_staleness_bound_expires_view_and_fires_leaves(self):
+        coord = Coordinator([], worker_lease_s=30.0)
+        leaves = []
+        reg = ReplicaRegistry(coordinator=coord,
+                              on_leave=leaves.append,
+                              max_stale_s=0.05)
+        a = ReplicaRegistration(coord, "a", "http://h:1",
+                                heartbeat_s=60).join()
+        reg.poll()
+        seq0 = JOURNAL.last_seq
+        with FaultPlan.coordinator_outage(reg, for_s=0.12):
+            reg.poll()             # enters staleness
+            time.sleep(0.08)
+            reg.poll()             # past the bound: the lie ends
+            assert reg.view() == {}
+            assert leaves == ["a"]
+        exp = JOURNAL.tail(50, domain="fleet",
+                           kind="stale_view_expired", since_seq=seq0)
+        assert exp and exp[-1]["dropped"] == ["a"]
+        a.stop(leave=True)
+
+    def test_static_registry_rejects_the_fault(self):
+        reg = ReplicaRegistry(endpoints={"r0": "http://h:1"})
+        with pytest.raises(ValueError):
+            with FaultPlan.coordinator_outage(reg):
+                pass
+
+    def test_router_serves_through_outage_with_zero_sheds(self):
+        """The acceptance shape: coordinator dark for >= 2x the poll
+        interval mid-burst — ZERO sheds, traffic flows on the stale
+        view, and the staleness gauge is visible while dark."""
+        coord = Coordinator([], worker_lease_s=30.0)
+        reps = {f"r{i}": Replica(f"r{i}") for i in range(2)}
+        regs = {rid: ReplicaRegistration(coord, rid, rep.endpoint,
+                                         heartbeat_s=60).join()
+                for rid, rep in reps.items()}
+        router = Router(coordinator=coord, page_size=PAGE,
+                        scrape_interval=0.1, queue_timeout=2.0,
+                        queue_poll=0.02).start()
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and \
+                    router.stats()["replicas_live"] < 2:
+                time.sleep(0.05)
+            before = router.stats()
+            stale_seen = []
+            with FaultPlan.coordinator_outage(router, for_s=0.25):
+
+                def one(i):
+                    res = router.generate([1 + i % 5, 2, 3], 3)
+                    assert len(res.tokens) == 3
+                    return res
+                results, errors = FaultPlan.burst(one, n=8, threads=4,
+                                                  timeout=60)
+                assert [e for e in errors if e] == []
+                assert sum(r is not None for r in results) == 8
+                stale_seen.append(router.stats()["registry_stale_s"])
+            assert stale_seen[0] > 0.0    # gauge visible while dark
+            after = router.stats()
+            for k in ("rejected_queue_full", "rejected_kv_capacity",
+                      "rejected_no_replica"):
+                assert after[k] == before[k], k   # ZERO sheds
+            assert after["replicas_live"] == 2
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and \
+                    router.stats()["registry_stale_s"] > 0:
+                time.sleep(0.05)
+            assert router.stats()["registry_stale_s"] == 0.0
+        finally:
+            router.shutdown(drain=True, timeout=10)
+            for reg in regs.values():
+                reg.stop(leave=True)
+            for rep in reps.values():
+                rep.stop()
+
+
+class TestRendezvousHA:
+    """ISSUE 16 tentpole leg (c): N routers agree on placement with no
+    shared state — rendezvous hashing over the stable first-page key
+    is a pure function of (prompt, live membership)."""
+
+    def test_stable_prefix_key_is_deterministic_and_bounded(self):
+        toks = list(range(1, 10))
+        assert stable_prefix_key(toks, 4) == stable_prefix_key(toks, 4)
+        # only the FIRST page matters — and a change inside it moves
+        # the key; a change past it does not
+        assert stable_prefix_key([99] + toks[1:], 4) != \
+            stable_prefix_key(toks, 4)
+        assert stable_prefix_key(toks[:5] + [99] + toks[6:], 4) == \
+            stable_prefix_key(toks, 4)
+        # final token is always a query: too-short prompts have no
+        # cacheable first page, hence no stable key
+        assert stable_prefix_key([1, 2, 3, 4], 4) is None
+        assert stable_prefix_key([], 4) is None
+
+    def test_rendezvous_choose_is_permutation_invariant(self):
+        rids = ["r0", "r1", "r2", "r3"]
+        for key in (f"k{i}".encode() for i in range(20)):
+            a = rendezvous_choose(key, rids)
+            b = rendezvous_choose(key, reversed(rids))
+            assert a == b
+        assert rendezvous_choose(b"k", []) is None
+        # spreads: 50 keys should not all land on one replica
+        homes = {rendezvous_choose(f"key-{i}".encode(), rids)
+                 for i in range(50)}
+        assert len(homes) >= 3
+
+    def test_two_independent_routers_agree_on_placement(self):
+        """Two balancer planes fed the same membership (but NO shared
+        learned state) must route the same cold prompt to the same
+        home — the property that lets a client retry on a sibling
+        router without re-priming the prefix cache."""
+        import random
+        planes = []
+        for _ in range(2):
+            b = FleetBalancer(affinity="prefix", page_size=PAGE)
+            for i in range(3):
+                b.upsert(f"r{i}", f"http://h:{i}")
+                b.record_scrape(f"r{i}", kv_pages_total=64,
+                                kv_pages_free=64, page_size=PAGE)
+            planes.append(b)
+        rng = random.Random(11)
+        agree = total = 0
+        for _ in range(40):
+            prompt = [rng.randrange(2, 40)
+                      for _ in range(rng.randrange(6, 20))]
+            picks = [b.choose(prompt, len(prompt) + 4)[0]
+                     for b in planes]
+            total += 1
+            agree += int(picks[0] == picks[1])
+        assert agree / total >= 0.9, (agree, total)

@@ -298,3 +298,311 @@ class TestDrainDuringBurst:
                 assert rep.engine.stats()["kv_pages_leaked"] == 0
         finally:
             stop_fleet(reps, router)
+
+
+# family (q) control-plane chaos (ISSUE 16): the ROUTER is the victim.
+# A slowed decode step keeps streams open long enough that a kill is
+# genuinely MID-stream (the subprocess serve CLI has no throttle flag,
+# so the decode_config script wraps the paged-decode step itself).
+THROTTLED_DEC_SRC = DEC_SRC + (
+    "import time as _time\n"
+    "_orig_paged = decoder.paged\n"
+    "def _slow_paged(**kw):\n"
+    "    pd = _orig_paged(**kw)\n"
+    "    _step = pd.step\n"
+    "    def _throttled(*a, **k):\n"
+    "        _time.sleep(0.05)\n"
+    "        return _step(*a, **k)\n"
+    "    pd.step = _throttled\n"
+    "    return pd\n"
+    "decoder.paged = _slow_paged\n")
+
+
+def _read_stream(resp, stop_after=None):
+    """Read NDJSON records off a streaming /generate response until
+    the terminal record, EOF, or ``stop_after`` token records.
+    Returns (token_records, done_record_or_None, torn)."""
+    tokens, done, torn = [], None, False
+    try:
+        while True:
+            line = resp.readline()
+            if not line:
+                torn = done is None
+                break
+            rec = json.loads(line)
+            if rec.get("done"):
+                done = rec
+                break
+            if "token" in rec:
+                tokens.append(rec["token"])
+                if stop_after is not None and \
+                        len(tokens) >= stop_after:
+                    break
+    except (OSError, json.JSONDecodeError):
+        torn = True
+    return tokens, done, torn
+
+
+def _stream_open(base, body, timeout=120):
+    req = urllib.request.Request(
+        base + "/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+class TestRouterKillSeam:
+    def test_kill_router_fires_once_mid_stream_and_client_retries(
+            self):
+        """In-process family (q): the seam tears the ROUTER's client
+        connections the moment any stream has relayed ``at`` tokens.
+        The client sees a torn NDJSON stream (no terminal record) and
+        retries the SAME trace_id on a sibling router plane — landing
+        token-exact, with both routers agreeing on the home replica
+        (rendezvous over the prompt's first page, no shared state)."""
+        import threading
+
+        from test_fleet import Replica, stop_fleet
+
+        reps = {f"r{i}": Replica(f"r{i}") for i in range(2)}
+        endpoints = {rid: r.endpoint for rid, r in reps.items()}
+        routers, httpds = [], []
+        from paddle_tpu.fleet import build_router_http_server
+        for i in range(2):
+            router = Router(endpoints=dict(endpoints),
+                            affinity="prefix", page_size=4,
+                            scrape_interval=0.1, queue_timeout=5.0,
+                            queue_poll=0.02,
+                            drain_timeout=5.0).start()
+            httpd = build_router_http_server(router, "127.0.0.1", 0)
+            threading.Thread(target=httpd.serve_forever, daemon=True,
+                             name=f"pt-test-ha-router-{i}").start()
+            routers.append(router)
+            httpds.append(httpd)
+        bases = [f"http://127.0.0.1:{h.server_address[1]}"
+                 for h in httpds]
+        # slow the replicas so the tear is mid-stream, not post-stream
+        for r in reps.values():
+            r.engine._step_interceptor = lambda s: time.sleep(0.02)
+        tid = "q-inproc-1"
+        shared = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5]
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and any(
+                    r.stats()["replicas_live"] < 2 for r in routers):
+                time.sleep(0.05)
+            with FaultPlan.kill_router(routers[0], httpds[0].kill,
+                                       at=2) as chaos:
+                resp = _stream_open(bases[0],
+                                    {"prompt": shared,
+                                     "max_new_tokens": 10,
+                                     "stream": True, "trace_id": tid})
+                tokens1, done1, torn1 = _read_stream(resp)
+            assert chaos["fired"] == 1
+            assert chaos["at_tokens"] >= 2
+            assert chaos["victim_traces"] == [tid]
+            assert torn1 and done1 is None     # no terminal record
+            # seam restored: no interceptors left armed
+            assert routers[0]._stream_interceptor is None
+            assert routers[0]._route_interceptor is None
+            # the client's contract: retry the same trace_id on the
+            # sibling router — token-exact (greedy decode, same fleet)
+            resp2 = _stream_open(bases[1],
+                                 {"prompt": shared,
+                                  "max_new_tokens": 10,
+                                  "stream": True, "trace_id": tid})
+            tokens2, done2, torn2 = _read_stream(resp2)
+            assert not torn2 and done2 is not None
+            assert len(tokens2) == 10
+            assert done2["tokens"] == tokens2
+            assert done2["trace_id"] == tid
+            assert tokens2[:len(tokens1)] == tokens1   # token-exact
+            # both planes agree on the home replica for this prompt
+            picks = {r.balancer.choose(shared, len(shared) + 10)[0]
+                     for r in routers}
+            assert len(picks) == 1
+            for rep in reps.values():
+                rep.engine._step_interceptor = None
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and any(
+                    rep.engine.stats()["kv_pages_leaked"] > 0
+                    or rep.engine.stats()["active_slots"] > 0
+                    for rep in reps.values()):
+                time.sleep(0.1)
+            for rep in reps.values():
+                assert rep.engine.stats()["kv_pages_leaked"] == 0
+        finally:
+            for h in httpds:
+                try:
+                    h.shutdown()
+                    h.server_close()
+                except OSError:
+                    pass
+            stop_fleet(reps, routers[1])
+            routers[0].shutdown(drain=False, timeout=5)
+
+
+class TestRouterSigkillMidStream:
+    def test_family_q_acceptance(self, tmp_path):
+        """The ISSUE 16 family (q) proof, full subprocess topology:
+        coordinator + 2 replicas + 2 INDEPENDENT router daemons.
+        SIGKILL router 1 while it is relaying a stream; the client
+        retries the same trace_id on router 2 and lands token-exact.
+        Across the merged ROUTER journals the trace settles EXACTLY
+        once (the dead router never wrote its settle), the replica-side
+        hop journal is the dedupe witness (start -> torn -> start ->
+        settle on ONE home replica), and no KV page leaks anywhere."""
+        import signal
+
+        dec_cfg = tmp_path / "dec.py"
+        dec_cfg.write_text(THROTTLED_DEC_SRC)
+        data = str(tmp_path / "seed.ptr")
+        from paddle_tpu.reader import recordio as rio
+        rio.write_records(data, [b"r0", b"r1"], max_chunk_bytes=64)
+
+        procs = {}
+        journals = {}
+        coord_proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.cli", "coordinator",
+             "--data", data, "--worker_lease", "5.0"],
+            stdout=subprocess.PIPE, text=True, env=_env("coord"))
+        try:
+            cport = json.loads(coord_proc.stdout.readline())["port"]
+            for rid in ("rA", "rB"):
+                journals[rid] = str(tmp_path / f"{rid}.jsonl")
+                procs[rid] = subprocess.Popen(
+                    [sys.executable, "-m", "paddle_tpu.cli", "serve",
+                     "--decode_config", str(dec_cfg),
+                     "--gen_slots", "2", "--gen_page_size", "4",
+                     "--workers", "1",
+                     "--coordinator", f"127.0.0.1:{cport}",
+                     "--replica_id", rid, "--heartbeat", "0.5",
+                     "--event_log", journals[rid]],
+                    stdout=subprocess.PIPE, text=True, env=_env(rid))
+            endpoints = {}
+            for rid in ("rA", "rB"):
+                rec = json.loads(procs[rid].stdout.readline())
+                assert rec["status"] == "serving"
+                endpoints[rid] = f"http://127.0.0.1:{rec['port']}"
+            bases = {}
+            for rname in ("router1", "router2"):
+                journals[rname] = str(tmp_path / f"{rname}.jsonl")
+                procs[rname] = subprocess.Popen(
+                    [sys.executable, "-m", "paddle_tpu.cli", "router",
+                     "--coordinator", f"127.0.0.1:{cport}",
+                     "--page_size", "4", "--scrape_interval", "0.2",
+                     "--queue_timeout", "10.0",
+                     "--event_log", journals[rname]],
+                    stdout=subprocess.PIPE, text=True,
+                    env=_env(rname))
+                rec = json.loads(procs[rname].stdout.readline())
+                assert rec["status"] == "serving"
+                bases[rname] = f"http://127.0.0.1:{rec['port']}"
+            # both router planes must see the full fleet before chaos
+            for rname, base in bases.items():
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    if _http_json(base + "/stats")[
+                            "replicas_live"] == 2:
+                        break
+                    time.sleep(0.2)
+                assert _http_json(base + "/stats")[
+                    "replicas_live"] == 2, rname
+            # warm the jit caches outside the chaos window
+            for base in bases.values():
+                out = _http_json(base + "/generate",
+                                 {"prompt": [1, 2], "max_new_tokens": 1})
+                assert len(out["tokens"]) == 1
+
+            tid = "q-sigkill-1"
+            shared = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5]
+            resp = _stream_open(bases["router1"],
+                                {"prompt": shared,
+                                 "max_new_tokens": 12,
+                                 "stream": True, "trace_id": tid})
+            tokens1, done1, _ = _read_stream(resp, stop_after=2)
+            assert len(tokens1) == 2 and done1 is None
+            # SIGKILL the router RELAYING the stream — family (q)
+            os.kill(procs["router1"].pid, signal.SIGKILL)
+            procs["router1"].wait(timeout=30)
+            _, done_post, torn = _read_stream(resp)
+            assert done_post is None and torn   # no terminal record
+
+            # the client's retry: SAME trace_id, sibling router
+            resp2 = _stream_open(bases["router2"],
+                                 {"prompt": shared,
+                                  "max_new_tokens": 12,
+                                  "stream": True, "trace_id": tid})
+            tokens2, done2, torn2 = _read_stream(resp2)
+            assert not torn2 and done2 is not None
+            assert len(tokens2) == 12
+            assert done2["trace_id"] == tid
+            assert tokens2[:2] == tokens1       # token-exact resume
+            # greedy decode is deterministic: a control request agrees
+            control = _http_json(bases["router2"] + "/generate",
+                                 {"prompt": shared,
+                                  "max_new_tokens": 12,
+                                  "trace_id": "q-control"})
+            assert control["tokens"] == tokens2
+
+            # zero KV page leaks once the torn stream is reaped
+            for rid in ("rA", "rB"):
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline:
+                    st = _http_json(endpoints[rid] + "/stats")
+                    if st["engine"]["kv_pages_leaked"] == 0 and \
+                            st["engine"]["active_slots"] == 0:
+                        break
+                    time.sleep(0.2)
+                st = _http_json(endpoints[rid] + "/stats")
+                assert st["engine"]["kv_pages_leaked"] == 0, rid
+
+            # stop router2 cleanly so its journal is flushed
+            procs["router2"].terminate()
+            procs["router2"].wait(timeout=30)
+
+            # EXACTLY-ONCE settle across the merged router journals:
+            # the SIGKILLed router never wrote one
+            merged = merge_journals([journals["router1"],
+                                     journals["router2"],
+                                     journals["rA"], journals["rB"]])
+            chain = [r for r in merged if r.get("trace_id") == tid]
+            settles = [r for r in chain if r["domain"] == "fleet"
+                       and r["kind"] == "settle"]
+            assert len(settles) == 1
+            assert settles[0]["host"] == "router2"
+            # router1's journal shows the route that never settled
+            r1 = [r for r in chain if r.get("host") == "router1"]
+            assert any(r["kind"] == "route" for r in r1)
+            assert not any(r["kind"] == "settle" for r in r1)
+            # the replica-side hop journal is the dedupe witness:
+            # start -> torn (router died) -> start -> settle, all on
+            # the ONE home replica both planes agree on
+            hops = [r for r in chain if r["domain"] == "serving"
+                    and r["kind"] == "hop"]
+            assert len({r["host"] for r in hops}) == 1
+            phases = [r["phase"] for r in hops]
+            # two dispatches; the victim's tore, the retry's settled.
+            # (torn may journal AFTER the retry's start — the write
+            # failure only surfaces one throttled token later)
+            assert sorted(phases) == ["settle", "start", "start",
+                                      "torn"]
+            assert phases[0] == "start" and phases[-1] == "settle"
+            torn_rec = next(r for r in hops if r["phase"] == "torn")
+            assert torn_rec["streamed"] >= 2
+            settle_rec = next(r for r in hops
+                              if r["phase"] == "settle")
+            assert settle_rec["tokens"] == 12
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.terminate()
+                    try:
+                        p.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+            coord_proc.terminate()
+            try:
+                coord_proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                coord_proc.kill()
+                raise
