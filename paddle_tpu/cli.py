@@ -693,6 +693,55 @@ def _cmd_router(args) -> int:
     return 0
 
 
+def _build_soak(args, SoakConfig, SoakRunner):
+    """soak-flag wiring, split from the signal loop so tests can
+    assert the flags reach SoakConfig (and the runner) without
+    building a live topology (tests/test_cli.py injects fakes)."""
+    from paddle_tpu.loadgen import SoakSLO
+    cfg = SoakConfig(seed=args.seed, duration_s=args.duration,
+                     workload=args.workload, families=args.faults,
+                     chat_rate=args.chat_rate, ctr_rate=args.ctr_rate,
+                     arrival=args.arrival, journal=args.event_log,
+                     slo=SoakSLO(ttft_p99_ms=args.slo_ttft_ms,
+                                 token_p99_ms=args.slo_token_ms))
+    return SoakRunner(cfg)
+
+
+def _cmd_soak(args) -> int:
+    """Run one seeded soak (docs/robustness.md 'The million-user
+    soak'): open-loop CTR + chat load over the in-process serving
+    estate, the seeded fault schedule injected mid-run, and the
+    verdict report printed as JSON. Exit 0 iff the verdict is OK.
+
+    SIGTERM/SIGINT stop offering load and unwind through the pinned
+    teardown order (generators -> fleet -> coordinator); the partial
+    run still produces a report from whatever the journal holds."""
+    import signal
+
+    from paddle_tpu.loadgen import SoakConfig, SoakRunner
+
+    runner = _build_soak(args, SoakConfig, SoakRunner)
+
+    def _on_stop_signal(*a):
+        runner.stop()
+
+    signal.signal(signal.SIGTERM, _on_stop_signal)
+    signal.signal(signal.SIGINT, _on_stop_signal)
+    print(json.dumps({"job": "soak", "status": "running",
+                      "seed": args.seed, "duration_s": args.duration,
+                      "workload": args.workload,
+                      "faults": args.faults}), flush=True)
+    report = runner.run()
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    print(json.dumps({
+        "job": "soak", "status": "done", "ok": report["ok"],
+        "checks": {k: c["ok"] for k, c in report["checks"].items()},
+        "counts": report["counts"], "journal": report["journal"]}))
+    return 0 if report["ok"] else 1
+
+
 def _build_fleet_request(args):
     """fleet-verb wiring, split from the HTTP call so tests can
     assert the request shape without a live daemon
@@ -1322,6 +1371,46 @@ def main(argv=None) -> int:
                     help="HTTP timeout for the admin call (a deploy "
                          "waits for every replica to cycle)")
 
+    sk = sub.add_parser("soak", help="run the million-user soak: "
+                        "open-loop CTR + chat load over an in-process "
+                        "fleet with seeded multi-family fault "
+                        "injection and an exactly-once settle audit "
+                        "(docs/robustness.md 'The million-user soak')")
+    sk.add_argument("--seed", type=int, default=7,
+                    help="the ONE seed: workloads, arrivals and the "
+                         "fault schedule are all pure functions of it "
+                         "(same seed, same soak)")
+    sk.add_argument("--duration", type=float, default=8.0,
+                    help="soak duration in seconds (the fault windows "
+                         "scale with it)")
+    sk.add_argument("--workload", choices=["mixed", "chat", "ctr"],
+                    default="mixed",
+                    help="mixed runs both loops; ctr implies the "
+                         "online-training freshness loop")
+    sk.add_argument("--faults", default="pokq",
+                    help="fault families to compose, as letters from "
+                         "the docs/robustness.md catalogue: p=replica "
+                         "kill mid-stream, o=embedding shard kill in "
+                         "the commit window, k=lease lapse, "
+                         "q=coordinator outage ('' = no faults)")
+    sk.add_argument("--chat_rate", type=float, default=4.0,
+                    help="mean chat req/s offered (open loop)")
+    sk.add_argument("--ctr_rate", type=float, default=4.0,
+                    help="mean CTR impressions/s offered (open loop)")
+    sk.add_argument("--arrival", default="diurnal",
+                    choices=["constant", "ramp", "diurnal"],
+                    help="arrival shape (mean stays at the rate flags)")
+    sk.add_argument("--event_log", default=None,
+                    help="soak journal JSONL path (default: fresh "
+                         "temp file, printed in the report)")
+    sk.add_argument("--report", default=None,
+                    help="also write the full verdict report JSON "
+                         "to this path")
+    sk.add_argument("--slo_ttft_ms", type=float, default=8000.0,
+                    help="p99 time-to-first-token bound (ms)")
+    sk.add_argument("--slo_token_ms", type=float, default=4000.0,
+                    help="p99 inter-token latency bound (ms)")
+
     pf = sub.add_parser("profile", help="on-demand deep profile window: "
                         "N traced steps + per-phase/MFU summary "
                         "(docs/observability.md 'Profiling & SLOs')")
@@ -1487,6 +1576,8 @@ def main(argv=None) -> int:
         return _cmd_pserver(args)
     if args.command == "fleet":
         return _cmd_fleet(args)
+    if args.command == "soak":
+        return _cmd_soak(args)
     if args.command == "router":
         from paddle_tpu.obs import context as obs_context
         from paddle_tpu.obs.events import JOURNAL

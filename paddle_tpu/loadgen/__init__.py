@@ -1,0 +1,30 @@
+"""paddle_tpu.loadgen — the million-user soak harness (ISSUE 17).
+
+Deterministic-seed, open-loop load generation (synth.py / arrival.py)
+over the in-process serving estate (harness.py), with a seeded
+multi-family fault conductor (conductor.py) and a journal-driven
+verdict engine (verdict.py). ``run_soak`` is the one-call entry the
+soak tests (tests/test_soak.py), the bench ``soak_smoke`` row and the
+``paddle_tpu soak`` CLI verb share. docs/robustness.md ("The
+million-user soak") is the operator-facing story.
+"""
+
+from paddle_tpu.loadgen.arrival import (arrival_fn, constant, diurnal,
+                                        open_loop_schedule, ramp)
+from paddle_tpu.loadgen.conductor import (FaultAction, FaultConductor,
+                                          plan_faults)
+from paddle_tpu.loadgen.harness import (SoakConfig, SoakRunner,
+                                        SoakTopology, run_soak)
+from paddle_tpu.loadgen.synth import (ChatRequest, CtrRequest, RngPlane,
+                                      chat_requests, ctr_requests,
+                                      zipf_pmf)
+from paddle_tpu.loadgen.verdict import SoakSLO, evaluate
+
+__all__ = [
+    "arrival_fn", "constant", "ramp", "diurnal", "open_loop_schedule",
+    "FaultAction", "FaultConductor", "plan_faults",
+    "SoakConfig", "SoakRunner", "SoakTopology", "run_soak",
+    "ChatRequest", "CtrRequest", "RngPlane", "chat_requests",
+    "ctr_requests", "zipf_pmf",
+    "SoakSLO", "evaluate",
+]

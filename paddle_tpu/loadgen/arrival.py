@@ -1,0 +1,88 @@
+"""Open-loop arrival processes for the soak harness.
+
+Open-loop means the generator schedules every request on an ABSOLUTE
+timeline decided before the run starts: a slow server cannot
+backpressure the arrival process into a gentler one (the classic
+closed-loop benchmarking lie — coordinated omission). The harness
+replays the offsets; when it falls behind it sends immediately and
+records the scheduling lag instead of silently thinning the load.
+
+Rate shapes are functions of normalized time ``u in [0, 1]`` →
+requests/second, sampled into concrete offsets by Lewis–Shedler
+thinning of a homogeneous Poisson process — deterministic given the
+seeded generator, so the same seed reproduces the identical arrival
+schedule (tests/test_loadgen.py pins this).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List
+
+import numpy as np
+
+__all__ = ["constant", "ramp", "diurnal", "arrival_fn",
+           "open_loop_schedule"]
+
+RateFn = Callable[[float], float]
+
+
+def constant(rate: float) -> RateFn:
+    """Flat ``rate`` req/s over the whole run."""
+    r = float(rate)
+    return lambda u: r
+
+
+def ramp(lo: float, hi: float) -> RateFn:
+    """Linear ramp from ``lo`` to ``hi`` req/s — the launch-day shape."""
+    lo, hi = float(lo), float(hi)
+    return lambda u: lo + (hi - lo) * u
+
+
+def diurnal(base: float, peak: float, cycles: float = 2.0) -> RateFn:
+    """Sinusoidal day/night swing between ``base`` and ``peak`` req/s,
+    ``cycles`` full periods over the run — a compressed day."""
+    base, peak, cycles = float(base), float(peak), float(cycles)
+    return lambda u: base + (peak - base) * 0.5 * (
+        1.0 - math.cos(2.0 * math.pi * cycles * u))
+
+
+def arrival_fn(kind: str, rate: float) -> RateFn:
+    """Map a CLI/soak-config arrival name to a rate function whose
+    MEAN is ``rate`` req/s (so --duration x --rate stays the expected
+    request budget across shapes)."""
+    if kind == "constant":
+        return constant(rate)
+    if kind == "ramp":
+        return ramp(0.2 * rate, 1.8 * rate)
+    if kind == "diurnal":
+        return diurnal(0.25 * rate, 1.75 * rate)
+    raise ValueError(f"unknown arrival shape {kind!r} "
+                     "(constant|ramp|diurnal)")
+
+
+def open_loop_schedule(rng: np.random.Generator, duration_s: float,
+                       rate_fn: RateFn,
+                       rate_max: float = None) -> List[float]:
+    """Sample absolute arrival offsets on ``[0, duration_s)`` from the
+    inhomogeneous Poisson process ``rate_fn`` by Lewis–Shedler
+    thinning: draw candidates at the envelope rate ``rate_max``, keep
+    each with probability ``rate(t)/rate_max``. Returns sorted
+    offsets in seconds."""
+    duration_s = float(duration_s)
+    if duration_s <= 0:
+        return []
+    if rate_max is None:
+        grid = np.linspace(0.0, 1.0, 257)
+        rate_max = max(float(rate_fn(float(u))) for u in grid)
+    if rate_max <= 0:
+        return []
+    out: List[float] = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / rate_max))
+        if t >= duration_s:
+            return out
+        if float(rng.random()) * rate_max <= float(
+                rate_fn(t / duration_s)):
+            out.append(t)

@@ -1,0 +1,290 @@
+"""The fault conductor — seeded multi-family chaos for one soak run.
+
+The chaos families already exist one at a time (testing/faults.py,
+exercised by tests/test_fleet_faults.py and tests/test_embed_faults.py).
+This module COMPOSES them inside a single live run, on a schedule that
+is a pure function of the soak seed:
+
+- (p) kill a serving replica mid-stream — armed on the router's chaos
+  seam so the kill lands while tokens are flowing off the victim, then
+  the victim's membership heartbeats stop (a dead process does not
+  heartbeat);
+- (o) kill an embedding shard inside a scatter-update's COMMIT window
+  (WAL durable, table unmutated, ack never sent) and replace it;
+- (k) lapse a live replica's lease without killing it (the wedged-
+  process / GC-pause fault) and let it rejoin;
+- (q) a coordinator outage seen by EVERY router at once — the control
+  plane goes away while the data plane keeps serving on the bounded-
+  staleness view.
+
+Every injection is journaled as ``soak/fault_injected`` with the
+family letter, the action, the target, and the evidence handle (the
+victim trace_id for (p)) — the verdict engine (loadgen/verdict.py)
+reconstructs each fault's merged trace chain from those records alone.
+
+True router-process SIGKILL (family (q)'s other leg) needs an actual
+process death — an in-process router front that tears still settles
+its in-flight relays, so a same-trace client retry would settle twice
+by design. That leg stays proven by the subprocess chaos test
+(tests/test_fleet_faults.py::TestRouterSigkillMidStream); the soak's
+(q) slot drives the control-plane outage, which composes cleanly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.obs.events import JOURNAL, emit as journal_emit
+from paddle_tpu.testing.faults import FaultPlan
+
+__all__ = ["FaultAction", "plan_faults", "FaultConductor"]
+
+#: when each family fires, as a fraction of the soak duration — k
+#: first (lapse + rejoin completes while every replica is alive), then
+#: the shard kill, the coordinator outage, and the replica kill last
+#: (after it the fleet runs on the survivor).
+_WINDOWS = {"k": 0.22, "o": 0.38, "q": 0.52, "p": 0.68}
+
+
+@dataclass(frozen=True)
+class FaultAction:
+    """One scheduled injection: ``family`` is the chaos-family letter
+    (docs/robustness.md catalogue), ``target`` an index into the
+    topology's replicas/shards (None for fleet-wide faults)."""
+    family: str
+    action: str
+    at_s: float
+    target: Optional[int]
+
+
+def plan_faults(seed: int, duration_s: float, families: str = "pokq",
+                *, n_replicas: int = 2,
+                n_shards: int = 2) -> List[FaultAction]:
+    """The seeded fault schedule — same seed, same schedule, byte for
+    byte. One injection per requested family, jittered inside its
+    window; (p) and (k) always pick DIFFERENT replicas so the lapsed
+    replica is never the killed one (the soak must end with a live
+    survivor serving)."""
+    import numpy as np
+    rng = np.random.default_rng(
+        np.random.SeedSequence([int(seed) & 0xFFFFFFFF, 0xFA]))
+    duration_s = float(duration_s)
+    p_victim = int(rng.integers(0, n_replicas))
+    k_target = int(rng.integers(0, n_replicas - 1)) \
+        if n_replicas > 1 else p_victim
+    if n_replicas > 1 and k_target >= p_victim:
+        k_target += 1
+    o_target = int(rng.integers(0, n_shards))
+    out: List[FaultAction] = []
+    for fam in "koqp":                    # schedule order, not input order
+        if fam not in families:
+            continue
+        jitter = float(rng.uniform(-0.04, 0.04))
+        at = max(0.1, (_WINDOWS[fam] + jitter) * duration_s)
+        if fam == "p":
+            out.append(FaultAction("p", "kill_replica", at, p_victim))
+        elif fam == "o":
+            out.append(FaultAction("o", "kill_shard_commit", at,
+                                   o_target))
+        elif fam == "k":
+            out.append(FaultAction("k", "lease_lapse", at, k_target))
+        elif fam == "q":
+            out.append(FaultAction("q", "coordinator_outage", at, None))
+    return out
+
+
+class FaultConductor:
+    """Replays a fault schedule against a live :class:`SoakTopology`
+    (loadgen/harness.py) on the soak's absolute timeline. Runs on its
+    own ``pt-loadgen-conductor`` thread; ``stop()`` + ``join()`` is
+    the lifecycle. ``injected`` holds one record per executed action
+    (the same dict each journals as ``soak/fault_injected``)."""
+
+    def __init__(self, topology, actions: List[FaultAction], *,
+                 grace_s: float = 10.0, hold_s: float = 0.8,
+                 outage_s: float = 1.0):
+        self.topology = topology
+        self.actions = list(actions)
+        self.grace_s = float(grace_s)
+        self.hold_s = float(hold_s)
+        self.outage_s = float(outage_s)
+        self.injected: List[Dict[str, Any]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, t0: float) -> "FaultConductor":
+        self._thread = threading.Thread(
+            target=self._run, args=(t0,), daemon=True,
+            name="pt-loadgen-conductor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: float = 60.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _run(self, t0: float) -> None:
+        for act in self.actions:
+            if not self._sleep_until(t0 + act.at_s):
+                return
+            info = self._execute(act)
+            info.update(family=act.family, action=act.action,
+                        target=act.target, at_s=round(act.at_s, 3))
+            self.injected.append(info)
+            journal_emit("soak", "fault_injected", **info)
+
+    def _sleep_until(self, deadline: float) -> bool:
+        """Stop-aware absolute sleep; False once stopped."""
+        while True:
+            if self._stop.is_set():
+                return False
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return True
+            self._stop.wait(min(left, 0.05))
+
+    def _wait(self, pred, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and not self._stop.is_set():
+            if pred():
+                return True
+            time.sleep(0.02)
+        return bool(pred())
+
+    # ------------------------------------------------------------- families
+    def _execute(self, act: FaultAction) -> Dict[str, Any]:
+        if act.family == "p":
+            return self._kill_replica(int(act.target))
+        if act.family == "o":
+            return self._kill_shard(int(act.target))
+        if act.family == "k":
+            return self._lease_lapse(int(act.target))
+        if act.family == "q":
+            return self._coordinator_outage()
+        raise ValueError(f"unknown fault family {act.family!r}")
+
+    def _probe_burst(self, router, rid: str, round_i: int) -> None:
+        """4 CONCURRENT probe streams with distinct prompts: each
+        replica holds num_slots=2, so a 4-wide burst must spill onto
+        the victim regardless of how prefix affinity cold-pinned the
+        open-loop trickle — the armed seam then fires mid-stream, and
+        the probes that outlive the kill fail over (the route ->
+        failover -> settle chain the verdict reconstructs)."""
+        threads = []
+        for j in range(4):
+            tid = f"soak-fault-p-{rid}-{round_i}-{j}"
+            prompt = [2 + j, 3 + j, 5 + j, 7, 11, 13, 17, 19, 23]
+
+            def go(tid=tid, prompt=prompt):
+                try:
+                    router.generate(prompt, 8, trace_id=tid)
+                except Exception:   # noqa: BLE001 — probe may die with
+                    pass            # the victim; the journal has it
+            t = threading.Thread(target=go, daemon=True,
+                                 name=f"pt-loadgen-probe-{j}")
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join(20.0)
+
+    def _kill_replica(self, idx: int) -> Dict[str, Any]:
+        """(p): arm the kill on every router plane's chaos seam so it
+        tears the victim while a stream is mid-flight, then stop its
+        heartbeats (a SIGKILL'd process does not keep its lease).
+        Probe bursts guarantee the victim IS streaming when it dies
+        even if affinity pinned the open-loop load elsewhere."""
+        topo = self.topology
+        rep = topo.replicas[idx]
+        once = threading.Lock()
+        done = []
+
+        def kill_once():
+            with once:
+                if done:
+                    return
+                done.append(True)
+            rep.kill()
+
+        deadline = time.monotonic() + self.grace_s
+        with contextlib.ExitStack() as stack:
+            seams = [stack.enter_context(
+                FaultPlan.kill_replica(r, rep.rid, kill_once, at=1))
+                for r in topo.routers]
+            round_i = 0
+            while not any(s["fired"] for s in seams) \
+                    and time.monotonic() < deadline \
+                    and not self._stop.is_set():
+                self._probe_burst(topo.routers[0], rep.rid, round_i)
+                round_i += 1
+        fired = any(s["fired"] for s in seams)
+        probe = next((s["victim_traces"][0] for s in seams
+                      if s["victim_traces"]), None)
+        if not done:
+            rep.kill()
+        rep.registration.stop(leave=False)
+        topo.note_killed(rep.rid)
+        return {"replica": rep.rid, "fired": fired,
+                "probe_trace": probe}
+
+    def _kill_shard(self, idx: int) -> Dict[str, Any]:
+        """(o): die at the victim shard's next COMMIT (WAL durable,
+        table unmutated, ack withheld — the torn window), then spawn
+        the replacement; the online loop's in-flight retry dedupes."""
+        svc = self.topology.embed
+        with FaultPlan.kill_shard(svc.server(idx), at=0,
+                                  window="commit") as ks:
+            self._wait(lambda: ks["killed_at"] is not None,
+                       self.grace_s)
+        killed = ks["killed_at"] is not None
+        if killed:
+            # the seam sets killed_at BEFORE the dying server journals
+            # shard_killed — wait for the record so the merged chain
+            # reads killed -> replaced -> restore in order
+            self._wait(lambda: any(
+                r["kind"] == "shard_killed"
+                and r.get("shard_id") == idx
+                for r in JOURNAL.tail(200, domain="embed")), 5.0)
+            svc.replace(idx)
+        return {"shard": idx, "fired": killed,
+                "killed_at": ks["killed_at"]}
+
+    def _lease_lapse(self, idx: int) -> Dict[str, Any]:
+        """(k): pause a LIVE replica's heartbeats past the lease (the
+        routers see an implicit drain), hold, resume — the next
+        heartbeat rejoins and the routers re-admit."""
+        topo = self.topology
+        rep = topo.replicas[idx]
+        before = rep.registration.rejoins
+        with FaultPlan.lease_lapse(rep.registration,
+                                   wait_s=topo.lease_s * 1.6):
+            if self._stop.wait(self.hold_s):
+                pass                        # resume even when stopping
+        self._wait(lambda: rep.registration.rejoins > before,
+                   self.grace_s)
+        return {"replica": rep.rid,
+                "fired": rep.registration.rejoins > before,
+                "rejoins": rep.registration.rejoins}
+
+    def _coordinator_outage(self) -> Dict[str, Any]:
+        """(q): every router loses the coordinator at once; the data
+        plane must keep serving on the bounded-staleness view and
+        journal ``fleet/stale_view`` -> ``fleet/view_recovered``."""
+        topo = self.topology
+        with contextlib.ExitStack() as stack:
+            for router in topo.routers:
+                stack.enter_context(
+                    FaultPlan.coordinator_outage(router))
+            self._stop.wait(self.outage_s)
+        # let the next scrape tick observe the healed directory so the
+        # view_recovered record lands before the verdict reads it
+        self._stop.wait(3.0 * topo.scrape_interval)
+        return {"routers": len(topo.routers), "fired": True,
+                "outage_s": self.outage_s}

@@ -1,0 +1,618 @@
+"""The soak harness — topology, load replay, and the run orchestrator.
+
+One in-process million-user-shaped topology (CPU-sized): a membership
+coordinator, N serving replicas (tiny deterministic decoder — the
+tests/bench twin — so failover is token-exact), M independent router
+planes each with an HTTP front, and a K-shard live embedding service,
+all journaling into ONE structured event log. The generators replay
+the pre-built workload (loadgen/synth.py) on the absolute open-loop
+timeline, the fault conductor (loadgen/conductor.py) fires its seeded
+schedule into the same run, and the verdict engine
+(loadgen/verdict.py) reads the journal back out. ``run_soak`` is the
+one-call wrapper the soak tests, the bench row and the CLI verb all
+share.
+
+Teardown order is part of the contract (pinned by tests/test_cli.py):
+generators first (stop offering load), then the serving fleet
+(routers drain, replicas stop, embed shards leave), then the
+coordinator — the reverse of the dependency order, so nothing ever
+heartbeats into a void it didn't create.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.analysis.lockdep import named_lock
+from paddle_tpu.embed import EmbedService, OnlineTrainer, log_sample
+from paddle_tpu.embed.shard import stable_hash64
+from paddle_tpu.fleet import (ReplicaRegistration, Router,
+                              build_router_http_server)
+from paddle_tpu.loadgen.arrival import arrival_fn
+from paddle_tpu.loadgen.conductor import FaultConductor, plan_faults
+from paddle_tpu.loadgen.synth import (ChatRequest, CtrRequest, RngPlane,
+                                      chat_requests, ctr_requests)
+from paddle_tpu.loadgen.verdict import SoakSLO, evaluate
+from paddle_tpu.obs.events import JOURNAL, emit as journal_emit, \
+    read_journal
+from paddle_tpu.serving import DecodeEngine, InferenceServer, \
+    build_http_server
+from paddle_tpu.testing.audit import _load_records
+from paddle_tpu.trainer.coordinator import Coordinator
+
+__all__ = ["SoakConfig", "SoakTopology", "SoakRunner", "run_soak"]
+
+#: the fleet test/bench decoder shape — tiny enough to compile in
+#: seconds on the CPU lane, big enough to stream real KV pages
+DEC_CFG = dict(vocab_size=40, d_model=16, n_heads=2, n_layers=2,
+               d_ff=32, max_len=32)
+PAGE = 4
+
+
+def _tiny_decoder(seed: int = 7):
+    """Same weights on every replica (same seed): greedy decode is
+    deterministic across the fleet, so mid-stream failover resumes
+    token-exact — the property the settle audit leans on."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+    from paddle_tpu.core.registry import reset_name_counters
+    paddle.init(use_tpu=False, seed=0)
+    reset_name_counters()
+    spec = models.transformer_lm(**DEC_CFG)
+    costs = spec.cost if isinstance(spec.cost, list) else [spec.cost]
+    topo = paddle.Topology(costs, extra_outputs=[spec.output])
+    params = topo.init_params(jax.random.PRNGKey(seed))
+    return models.TransformerDecoder(params,
+                                     n_layers=DEC_CFG["n_layers"],
+                                     n_heads=DEC_CFG["n_heads"])
+
+
+class SoakReplica:
+    """One in-process serving replica: decode engine + HTTP front
+    (tests/test_fleet.py's Replica, grown a membership registration).
+    ``kill()`` is the SIGKILL twin — every live connection tears."""
+
+    def __init__(self, rid: str, decoder, *, num_slots: int = 2):
+        self.rid = rid
+        self.engine = DecodeEngine(decoder, num_slots=num_slots,
+                                   page_size=PAGE,
+                                   max_seq_len=DEC_CFG["max_len"])
+        self.server = InferenceServer(None, max_queue=8, workers=1,
+                                      breaker=False,
+                                      engine=self.engine).start()
+        self.httpd = build_http_server(self.server, "127.0.0.1", 0)
+        self.port = self.httpd.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self.registration: Optional[ReplicaRegistration] = None
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name=f"pt-loadgen-replica-{rid}")
+        self._thread.start()
+        self._killed = False
+
+    def kill(self) -> None:
+        self._killed = True
+        self.httpd.kill()
+
+    def stop(self) -> None:
+        if not self._killed:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        self.server.shutdown(drain=True, timeout=30)
+
+
+class SoakTopology:
+    """The full in-process serving estate under soak: coordinator +
+    replicas (registered on the membership plane) + router planes with
+    HTTP fronts + the live embedding service. Duck-typed surface the
+    fault conductor drives: ``replicas`` (rid/kill/registration),
+    ``routers``, ``embed``, ``lease_s``, ``scrape_interval``,
+    ``note_killed``."""
+
+    def __init__(self, *, seed: int = 7, n_replicas: int = 2,
+                 n_routers: int = 2, n_shards: int = 2, dim: int = 8,
+                 lease_s: float = 1.2, heartbeat_s: float = 0.25,
+                 scrape_interval: float = 0.1,
+                 queue_timeout: float = 4.0):
+        self.lease_s = float(lease_s)
+        self.scrape_interval = float(scrape_interval)
+        self.coordinator = Coordinator(chunks=[],
+                                       worker_lease_s=lease_s)
+        decoder = _tiny_decoder(seed)
+        self.replicas = [SoakReplica(f"r{i}", decoder)
+                         for i in range(int(n_replicas))]
+        for rep in self.replicas:
+            rep.registration = ReplicaRegistration(
+                self.coordinator, rep.rid, rep.endpoint,
+                heartbeat_s=heartbeat_s).join()
+        self.routers: List[Router] = []
+        self.fronts = []
+        for i in range(int(n_routers)):
+            router = Router(coordinator=self.coordinator,
+                            affinity="prefix", page_size=PAGE,
+                            scrape_interval=scrape_interval,
+                            queue_timeout=queue_timeout,
+                            queue_poll=0.02,
+                            drain_timeout=5.0).start()
+            front = build_router_http_server(router, "127.0.0.1", 0)
+            threading.Thread(target=front.serve_forever, daemon=True,
+                             name=f"pt-loadgen-router-{i}").start()
+            self.routers.append(router)
+            self.fronts.append(front)
+        self.embed = EmbedService(int(n_shards), int(dim), seed=seed,
+                                  coordinator=self.coordinator,
+                                  heartbeat_s=heartbeat_s)
+        self._killed: set = set()
+
+    # ----------------------------------------------------------- accessors
+    def note_killed(self, rid: str) -> None:
+        self._killed.add(rid)
+
+    def survivors(self) -> List[SoakReplica]:
+        return [r for r in self.replicas if r.rid not in self._killed]
+
+    def front_addrs(self) -> List[Tuple[str, int]]:
+        return [f.server_address[:2] for f in self.fronts]
+
+    # ----------------------------------------------------------- teardown
+    def wait_idle(self, timeout: float = 15.0) -> bool:
+        """Wait for every surviving engine to run dry (disconnected
+        clients' streams keep generating until done — they must settle
+        before the final gauges mean anything)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(r.engine.stats()["active_slots"] == 0
+                   for r in self.survivors()):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def journal_finals(self) -> None:
+        """One ``soak/replica_final`` record per survivor — the KV
+        no-leak evidence the verdict engine audits."""
+        for rep in self.survivors():
+            st = rep.engine.stats()
+            journal_emit("soak", "replica_final", replica=rep.rid,
+                         kv_pages_leaked=st["kv_pages_leaked"],
+                         active_slots=st["active_slots"],
+                         kv_pages_used=st["kv_pages_used"])
+
+    def stop_fleet(self) -> None:
+        for router in self.routers:
+            router.shutdown(drain=True, timeout=10)
+        for front in self.fronts:
+            front.shutdown()
+            front.server_close()
+        for rep in self.replicas:
+            if rep.registration is not None \
+                    and rep.rid not in self._killed:
+                rep.registration.stop(leave=True)
+            rep.stop()
+        self.embed.stop()
+
+    def stop_coordinator(self) -> None:
+        """The in-process Coordinator owns no threads — this seam
+        exists so the teardown ORDER (generators -> fleet ->
+        coordinator) is explicit and pinnable; the CLI daemon closes
+        its CoordinatorServer here."""
+
+
+class ChatGenerator:
+    """Replays the chat request list against the router HTTP fronts on
+    the absolute timeline — open loop: a late dispatch sends
+    immediately and records its scheduling lag; it never thins the
+    offered load. Each request streams close-delimited NDJSON; the
+    scripted disconnects close the socket mid-stream (the relay keeps
+    the fleet request alive and it still settles once — the invariant
+    the verdict audits)."""
+
+    def __init__(self, fronts: List[Tuple[str, int]],
+                 requests: List[ChatRequest], *,
+                 timeout_s: float = 30.0, max_inflight: int = 64):
+        self.fronts = list(fronts)
+        self.requests = list(requests)
+        self.timeout_s = float(timeout_s)
+        self._sem = threading.Semaphore(int(max_inflight))
+        self._stop = threading.Event()
+        self._lock = named_lock("loadgen.chat")
+        self._workers: List[threading.Thread] = []  # ptlint: guarded-by(loadgen.chat)
+        self._dispatcher: Optional[threading.Thread] = None
+
+    def start(self, t0: float) -> "ChatGenerator":
+        self._dispatcher = threading.Thread(
+            target=self._dispatch, args=(t0,), daemon=True,
+            name="pt-loadgen-chat-dispatch")
+        self._dispatcher.start()
+        return self
+
+    def _dispatch(self, t0: float) -> None:
+        for i, req in enumerate(self.requests):
+            deadline = t0 + req.offset_s
+            while not self._stop.is_set():
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._stop.wait(min(left, 0.05))
+            if self._stop.is_set():
+                return
+            lag_ms = max(0.0, (time.monotonic() - deadline) * 1e3)
+            if not self._sem.acquire(blocking=False):  # ptlint: disable=R5(non-blocking try-acquire; the worker's finally releases it on its own thread)
+                journal_emit("soak", "request", workload="chat",
+                             trace_id=req.trace_id,
+                             outcome="overload",
+                             sched_lag_ms=round(lag_ms, 3))
+                continue
+            worker = threading.Thread(
+                target=self._send, args=(req, lag_ms), daemon=True,
+                name=f"pt-loadgen-chat-{i:05d}")
+            with self._lock:
+                self._workers.append(worker)
+            worker.start()
+
+    def _send(self, req: ChatRequest, lag_ms: float) -> None:
+        host, port = self.fronts[
+            stable_hash64(len(req.trace_id) * 1000003
+                          + int(req.trace_id.rsplit("-", 1)[-1]))
+            % len(self.fronts)]
+        outcome, ttft_ms, tok_ms, total_ms, tokens = \
+            "error", None, None, None, 0
+        conn = http.client.HTTPConnection(host, port,
+                                          timeout=self.timeout_s)
+        t_send = time.perf_counter()
+        t_first = t_last = None
+        try:
+            conn.request(
+                "POST", "/generate",
+                body=json.dumps({"prompt": list(req.prompt),
+                                 "max_new_tokens": req.max_new,
+                                 "stream": True}),
+                headers={"Content-Type": "application/json",
+                         "X-Trace-Id": req.trace_id})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                payload = json.loads(resp.read() or b"{}")
+                outcome = "rejected" if "reason" in payload else "error"
+            else:
+                outcome = "torn"           # until a terminal line says else
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break              # close-delimited: stream over
+                    rec = json.loads(line)
+                    if "token" in rec:
+                        tokens += 1
+                        t_last = time.perf_counter()
+                        if t_first is None:
+                            t_first = t_last
+                        if req.disconnect_after is not None \
+                                and tokens >= req.disconnect_after:
+                            outcome = "disconnect"
+                            break          # hang up mid-stream
+                    elif rec.get("done"):
+                        outcome = "done"
+                        break
+                    elif "error" in rec:
+                        outcome = "rejected" if "reason" in rec \
+                            else "error"
+                        break
+        except (OSError, ValueError):
+            outcome = "error"
+        finally:
+            conn.close()
+            self._sem.release()
+        t_end = time.perf_counter()
+        if t_first is not None:
+            ttft_ms = (t_first - t_send) * 1e3
+            total_ms = (t_end - t_send) * 1e3
+            if tokens > 1 and t_last is not None:
+                tok_ms = (t_last - t_first) * 1e3 / (tokens - 1)
+        journal_emit(
+            "soak", "request", workload="chat",
+            trace_id=req.trace_id, outcome=outcome, tokens=tokens,
+            ttft_ms=None if ttft_ms is None else round(ttft_ms, 3),
+            tok_ms=None if tok_ms is None else round(tok_ms, 3),
+            total_ms=None if total_ms is None else round(total_ms, 3),
+            sched_lag_ms=round(lag_ms, 3))
+
+    def join(self, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        if self._dispatcher is not None:
+            self._dispatcher.join(max(0.1, deadline - time.monotonic()))
+        with self._lock:
+            workers = list(self._workers)
+        for w in workers:
+            w.join(max(0.1, deadline - time.monotonic()))
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class CtrGenerator:
+    """Replays the CTR impression stream: gather the Zipf keys through
+    the LIVE embedding client, rank with the online trainer's dense
+    head, journal the click sample (``embed/sample`` — the record the
+    online loop trains from) and the ``soak/request`` outcome."""
+
+    def __init__(self, client, trainer: OnlineTrainer,
+                 requests: List[CtrRequest]):
+        self.client = client
+        self.trainer = trainer
+        self.requests = list(requests)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, t0: float) -> "CtrGenerator":
+        self._thread = threading.Thread(
+            target=self._run, args=(t0,), daemon=True,
+            name="pt-loadgen-ctr")
+        self._thread.start()
+        return self
+
+    def _run(self, t0: float) -> None:
+        for req in self.requests:
+            deadline = t0 + req.offset_s
+            while not self._stop.is_set():
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._stop.wait(min(left, 0.05))
+            if self._stop.is_set():
+                return
+            lag_ms = max(0.0, (time.monotonic() - deadline) * 1e3)
+            ids = np.asarray(req.ids, np.int64)
+            t_g = time.perf_counter()
+            try:
+                rows = self.client.gather(ids)
+                score = float(rows.sum(axis=0) @ self.trainer.w)
+                log_sample(ids, req.label, trace_id=req.trace_id)
+                outcome = "done"
+            except Exception as e:        # noqa: BLE001 — typed below
+                outcome = "error"
+                score = None
+                journal_emit("soak", "ctr_error",
+                             trace_id=req.trace_id, error=repr(e))
+            gather_ms = (time.perf_counter() - t_g) * 1e3
+            journal_emit("soak", "request", workload="ctr",
+                         trace_id=req.trace_id, outcome=outcome,
+                         gather_ms=round(gather_ms, 3),
+                         score=None if score is None
+                         else round(score, 4),
+                         label=req.label,
+                         sched_lag_ms=round(lag_ms, 3))
+
+    def join(self, timeout: float = 60.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class OnlineLoop:
+    """The freshness loop: tail the journal's ``embed/sample`` records
+    and fold them through the OnlineTrainer into LIVE sparse pushes
+    while the same shards keep serving gathers — embed/online.py's
+    continuous loop, incremental over the growing soak journal. Its
+    pushes are also what gives the (o) fault a commit window to kill
+    in."""
+
+    def __init__(self, trainer: OnlineTrainer, journal_path: str, *,
+                 batch_size: int = 8, interval_s: float = 0.4):
+        self.trainer = trainer
+        self.journal_path = journal_path
+        self.batch_size = int(batch_size)
+        self.interval_s = float(interval_s)
+        self._consumed = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "OnlineLoop":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="pt-loadgen-online")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._drain(final=False)
+        self._drain(final=True)
+
+    def _drain(self, final: bool) -> None:
+        try:
+            recs = list(read_journal(self.journal_path,
+                                     domain="embed", kind="sample"))
+        except OSError:
+            return
+        new = recs[self._consumed:]
+        if not new or (not final and len(new) < self.batch_size):
+            return
+        batch = [(np.asarray(r["ids"], np.int64),
+                  float(r.get("label", 0.0))) for r in new]
+        losses = []
+        for i in range(0, len(batch), self.batch_size):
+            chunk = batch[i:i + self.batch_size]
+            if not final and len(chunk) < self.batch_size:
+                break
+            losses.append(self.trainer.step(chunk))
+            self._consumed += len(chunk)
+        if losses:
+            journal_emit("soak", "online_step",
+                         batches=len(losses),
+                         samples=self.trainer.samples,
+                         loss=round(float(losses[-1]), 5))
+
+    def stop_and_join(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+
+@dataclass
+class SoakConfig:
+    """One soak run's knobs — the CLI verb maps its flags onto this."""
+    seed: int = 7
+    duration_s: float = 8.0
+    workload: str = "mixed"               # mixed | chat | ctr
+    families: str = "pokq"
+    chat_rate: float = 4.0
+    ctr_rate: float = 4.0
+    arrival: str = "diurnal"
+    n_replicas: int = 2
+    n_routers: int = 2
+    n_shards: int = 2
+    journal: Optional[str] = None         # default: fresh temp file
+    slo: SoakSLO = field(default_factory=SoakSLO)
+
+
+class SoakRunner:
+    """Builds the topology + workloads + conductor from a
+    :class:`SoakConfig`, runs the soak, and returns the verdict
+    report. ``build()`` is split out as the CLI's testable seam;
+    ``stop()`` (the SIGTERM path) unwinds through the same pinned
+    teardown order as a natural finish."""
+
+    def __init__(self, config: SoakConfig):
+        self.config = config
+        self.topology: Optional[SoakTopology] = None
+        self.conductor: Optional[FaultConductor] = None
+        self.generators: List[Any] = []
+        self.online: Optional[OnlineLoop] = None
+        self.client = None
+        self.journal_path: Optional[str] = None
+        self._stop = threading.Event()
+        self._built = False
+
+    # -------------------------------------------------------------- build
+    def build(self) -> "SoakRunner":
+        cfg = self.config
+        if cfg.workload not in ("mixed", "chat", "ctr"):
+            raise ValueError(f"unknown workload {cfg.workload!r}")
+        self.journal_path = cfg.journal or os.path.join(
+            tempfile.mkdtemp(prefix="paddle_tpu_soak_"),
+            f"soak-{cfg.seed}.jsonl")
+        self.topology = SoakTopology(
+            seed=cfg.seed, n_replicas=cfg.n_replicas,
+            n_routers=cfg.n_routers, n_shards=cfg.n_shards)
+        plane = RngPlane(cfg.seed)
+        self.chat_plan: List[ChatRequest] = []
+        self.ctr_plan: List[CtrRequest] = []
+        if cfg.workload in ("mixed", "chat"):
+            self.chat_plan = chat_requests(
+                plane, cfg.duration_s,
+                arrival_fn(cfg.arrival, cfg.chat_rate),
+                vocab=DEC_CFG["vocab_size"])
+        if cfg.workload in ("mixed", "ctr"):
+            self.ctr_plan = ctr_requests(
+                plane, cfg.duration_s,
+                arrival_fn(cfg.arrival, cfg.ctr_rate))
+        actions = plan_faults(cfg.seed, cfg.duration_s, cfg.families,
+                              n_replicas=cfg.n_replicas,
+                              n_shards=cfg.n_shards) \
+            if cfg.families else []
+        self.conductor = FaultConductor(self.topology, actions)
+        self.client = self.topology.embed.client(
+            client_id=f"soak-{cfg.seed}", retry_deadline=20.0)
+        self.trainer = OnlineTrainer(self.client, lr=0.05,
+                                     seed=cfg.seed)
+        self.generators = []
+        if self.chat_plan:
+            self.generators.append(ChatGenerator(
+                self.topology.front_addrs(), self.chat_plan))
+        if self.ctr_plan:
+            self.generators.append(CtrGenerator(
+                self.client, self.trainer, self.ctr_plan))
+            self.online = OnlineLoop(self.trainer, self.journal_path)
+        self._built = True
+        return self
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> Dict[str, Any]:
+        if not self._built:
+            self.build()
+        cfg = self.config
+        JOURNAL.configure(self.journal_path)
+        try:
+            t0 = time.monotonic()
+            journal_emit("soak", "run_start", seed=cfg.seed,
+                         duration_s=cfg.duration_s,
+                         workload=cfg.workload,
+                         families=cfg.families,
+                         chat_requests=len(self.chat_plan),
+                         ctr_requests=len(self.ctr_plan))
+            for gen in self.generators:
+                gen.start(t0)
+            if self.online is not None:
+                self.online.start()
+            self.conductor.start(t0)
+            for gen in self.generators:
+                gen.join(timeout=cfg.duration_s + 60.0)
+            self.conductor.join(timeout=60.0)
+            if self.online is not None:
+                self.online.stop_and_join()
+            if self.client is not None:
+                self.client.flush(timeout=20.0)
+            self.topology.wait_idle()
+            self.topology.journal_finals()
+            journal_emit("soak", "run_end",
+                         stopped_early=self._stop.is_set())
+        finally:
+            self.teardown()
+            JOURNAL.configure(None)
+        records = _load_records(self.journal_path)
+        report = evaluate(records, cfg.slo)
+        report.update(seed=cfg.seed, duration_s=cfg.duration_s,
+                      workload=cfg.workload, families=cfg.families,
+                      journal=self.journal_path)
+        return report
+
+    # ----------------------------------------------------------- teardown
+    def stop(self) -> None:
+        """SIGTERM path: stop offering load and let ``run()`` unwind
+        through the pinned teardown order."""
+        self._stop.set()
+        for gen in self.generators:
+            gen.stop()
+        if self.conductor is not None:
+            self.conductor.stop()
+
+    def stop_generators(self) -> None:
+        for gen in self.generators:
+            gen.stop()
+            gen.join(timeout=30.0)
+        if self.conductor is not None:
+            self.conductor.stop()
+            self.conductor.join(timeout=30.0)
+        if self.online is not None:
+            self.online.stop_and_join()
+        if self.client is not None:
+            self.client.close()
+            self.client = None
+
+    def teardown(self) -> None:
+        """Generators -> fleet -> coordinator. The order is the
+        contract (tests/test_cli.py pins it): load stops offering
+        first, the fleet drains and leaves cleanly, and the
+        coordinator outlives everyone who heartbeats into it."""
+        self.stop_generators()
+        if self.topology is not None:
+            self.topology.stop_fleet()
+            self.topology.stop_coordinator()
+
+
+def run_soak(seed: int = 7, duration_s: float = 8.0,
+             workload: str = "mixed", families: str = "pokq",
+             **kw) -> Dict[str, Any]:
+    """Build + run one soak and return the verdict report."""
+    cfg = SoakConfig(seed=seed, duration_s=duration_s,
+                     workload=workload, families=families, **kw)
+    return SoakRunner(cfg).run()

@@ -1,0 +1,156 @@
+"""Workload synthesis for the million-user soak (ISSUE 17).
+
+Two generators, one seeded RNG plane: a CTR loop (Zipf-distributed
+sparse keys ranked through the live embedding service, clicks
+journaled back into the online-training stream — the 2017 production
+shape) and a shared-prefix chat-decode loop (a Zipf prefix tree over
+the fleet router's ``/generate``, streamed, with scripted mid-stream
+client disconnects). Everything here is PURE DATA: the full request
+list is materialized up front from :class:`RngPlane`, so the same
+seed reproduces the identical request stream byte for byte — the
+runtime (loadgen/harness.py) only replays it on an absolute timeline.
+
+The RNG plane derives one independent ``numpy`` PCG64 stream per
+named purpose (``chat.arrival``, ``ctr.keys``, ...) by folding the
+stream name through splitmix64 (embed/shard.py's process-independent
+hash) into the seed material — adding a stream never perturbs the
+draws of any other, which is what keeps the golden tests
+(tests/test_loadgen.py) stable as the harness grows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.embed.shard import stable_hash64
+from paddle_tpu.loadgen.arrival import open_loop_schedule
+
+__all__ = ["RngPlane", "zipf_pmf", "ChatRequest", "CtrRequest",
+           "chat_requests", "ctr_requests"]
+
+
+class RngPlane:
+    """Named, independent RNG streams off one soak seed.
+
+    ``plane.stream("chat.arrival")`` always returns a generator seeded
+    by ``(seed, splitmix64(name))`` — deterministic across processes
+    (no salted ``hash``) and independent across names. Repeated calls
+    for the same name return the SAME generator instance, so a
+    workload builder can interleave draws without re-seeding."""
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self._streams: Dict[str, np.random.Generator] = {}
+
+    def stream(self, name: str) -> np.random.Generator:
+        gen = self._streams.get(name)
+        if gen is None:
+            material = [self.seed & 0xFFFFFFFF]
+            h = 0
+            for ch in name:
+                h = stable_hash64(h ^ ord(ch))
+            material += [h & 0xFFFFFFFF, (h >> 32) & 0xFFFFFFFF]
+            gen = np.random.default_rng(np.random.SeedSequence(material))
+            self._streams[name] = gen
+        return gen
+
+
+def zipf_pmf(n: int, alpha: float = 1.1) -> np.ndarray:
+    """Bounded Zipf over ranks ``0..n-1``: p(r) ~ (r+1)^-alpha,
+    normalized. Bounded (unlike ``np.random.zipf``) so a sampled rank
+    is always a valid index into a finite key/prefix table."""
+    ranks = np.arange(1, int(n) + 1, dtype=np.float64)
+    p = ranks ** -float(alpha)
+    return p / p.sum()
+
+
+@dataclass(frozen=True)
+class ChatRequest:
+    """One scheduled chat decode: sent at ``offset_s`` on the absolute
+    soak timeline; ``disconnect_after`` scripts a mid-stream client
+    hangup after that many streamed tokens (None = read to the end)."""
+    offset_s: float
+    trace_id: str
+    prompt: Tuple[int, ...]
+    max_new: int
+    disconnect_after: Optional[int]
+
+
+@dataclass(frozen=True)
+class CtrRequest:
+    """One scheduled CTR impression: gather ``ids`` through the live
+    embedding client, rank, and journal the (ids, label) sample for
+    the online-training loop. The click ``label`` is pre-drawn so the
+    training stream is part of the reproducible request stream."""
+    offset_s: float
+    trace_id: str
+    ids: Tuple[int, ...]
+    label: float
+
+
+def chat_requests(plane: RngPlane, duration_s: float, rate_fn,
+                  *, vocab: int = 40, n_prefixes: int = 12,
+                  prefix_len: int = 5, suffix_max: int = 3,
+                  max_new: int = 6, alpha: float = 1.1,
+                  disconnect_every: int = 7) -> List[ChatRequest]:
+    """The shared-prefix chat workload: a Zipf-popular prefix tree
+    (popular prefixes repeat — the prefix-affinity / prefix-cache
+    path) with per-request fresh suffixes, open-loop arrivals from
+    ``rate_fn``, and every ``disconnect_every``-th request scripted to
+    hang up mid-stream (the exactly-once-under-disconnect probe)."""
+    prefs = plane.stream("chat.prefixes")
+    prefixes = [tuple(int(t) for t in
+                      prefs.integers(1, vocab, size=prefix_len))
+                for _ in range(int(n_prefixes))]
+    offsets = open_loop_schedule(plane.stream("chat.arrival"),
+                                 duration_s, rate_fn)
+    pick = plane.stream("chat.zipf")
+    suffix = plane.stream("chat.suffix")
+    pmf = zipf_pmf(len(prefixes), alpha)
+    out: List[ChatRequest] = []
+    for i, off in enumerate(offsets):
+        rank = int(pick.choice(len(prefixes), p=pmf))
+        tail = tuple(int(t) for t in suffix.integers(
+            1, vocab, size=int(suffix.integers(1, suffix_max + 1))))
+        disconnect = None
+        if disconnect_every and (i + 1) % disconnect_every == 0:
+            disconnect = 2
+        out.append(ChatRequest(
+            offset_s=float(off),
+            trace_id=f"soak-{plane.seed}-chat-{i:05d}",
+            prompt=prefixes[rank] + tail,
+            max_new=int(max_new),
+            disconnect_after=disconnect))
+    return out
+
+
+def ctr_requests(plane: RngPlane, duration_s: float, rate_fn,
+                 *, key_space: int = 4096, slots: int = 6,
+                 alpha: float = 1.05,
+                 base_ctr: float = 0.12) -> List[CtrRequest]:
+    """The CTR impression stream: each request gathers ``slots``
+    Zipf-popular sparse keys (the head keys dominate — the skew that
+    makes shard hot-spotting and staleness bounds worth testing) and
+    carries a pre-drawn click label whose probability rises for
+    head-of-distribution keys (popular items click more — the
+    feedback skew the online loop trains on)."""
+    offsets = open_loop_schedule(plane.stream("ctr.arrival"),
+                                 duration_s, rate_fn)
+    keys = plane.stream("ctr.keys")
+    clicks = plane.stream("ctr.clicks")
+    pmf = zipf_pmf(int(key_space), alpha)
+    out: List[CtrRequest] = []
+    for i, off in enumerate(offsets):
+        ranks = keys.choice(int(key_space), p=pmf, size=int(slots))
+        head = float(np.mean(ranks < key_space // 16))
+        p_click = min(0.9, base_ctr + 0.25 * head)
+        label = 1.0 if float(clicks.random()) < p_click else 0.0
+        out.append(CtrRequest(
+            offset_s=float(off),
+            trace_id=f"soak-{plane.seed}-ctr-{i:05d}",
+            ids=tuple(int(r) for r in ranks),
+            label=label))
+    return out

@@ -1,0 +1,222 @@
+"""The soak verdict engine — a pure function of the merged journals.
+
+Everything the soak proves is read back out of the structured event
+journal (obs/events.py): the generators journal every request they
+sent (``soak/request``), the conductor journals every fault it
+injected (``soak/fault_injected``), the harness journals each
+survivor's final engine gauges (``soak/replica_final``), and the
+fleet/embed planes journal their own settle/failover/kill/restore
+records as they always have. :func:`evaluate` folds those records
+into a machine-readable report:
+
+- **exactly_once** — every accepted chat stream (finished OR
+  deliberately disconnected mid-stream) settled exactly once
+  fleet-wide (testing/audit.py, the shared audit);
+- **latency_slo** — client-measured p99 TTFT and p99 inter-token
+  latency under the bound (open-loop, so coordinated omission can't
+  flatter the tail);
+- **staleness** — no embedding gather served past its staleness bound
+  (``embed/stale_read`` count);
+- **kv_leaks** — zero leaked KV pages and zero stuck slots on every
+  SURVIVING replica;
+- **fault_chains** — for every injected fault, the evidence chain is
+  reconstructible from the merged records alone (route -> failover ->
+  settle for a replica kill; shard_killed -> shard_replaced ->
+  restore for a shard kill; lease_lapse -> rejoin; stale_view ->
+  view_recovered);
+- **ctr_loop** — the CTR freshness loop actually closed: impressions
+  gathered without error and the online trainer consumed clicks into
+  live sparse updates (``soak/online_step``).
+
+Record order: records are evaluated in list position, which is file
+order for a single journal and ``mseq`` order for merged multi-host
+journals (obs/merge.py) — the same total order the trace tooling uses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from paddle_tpu.testing.audit import audit_exactly_once
+
+__all__ = ["SoakSLO", "evaluate"]
+
+
+@dataclass(frozen=True)
+class SoakSLO:
+    """The soak's service-level objectives. Defaults are sized for the
+    CPU fake-TPU lane (conftest.py's 8 virtual devices) — generous on
+    absolute latency, zero-tolerance on correctness counters."""
+    ttft_p99_ms: float = 8000.0
+    token_p99_ms: float = 4000.0
+    max_stale_reads: int = 0
+    max_ctr_errors: int = 0
+
+
+def _p99(values: List[float]) -> Optional[float]:
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values, np.float64), 99.0))
+
+
+def _fault_chain(records: List[dict], fault: dict) -> Dict[str, Any]:
+    """Reconstruct one injected fault's evidence chain from the merged
+    records; ``ok`` iff every link exists in order."""
+    fam = fault.get("family")
+    idx = {id(r): i for i, r in enumerate(records)}
+
+    def where(domain, kind, **match):
+        out = []
+        for r in records:
+            if r.get("domain") != domain or r.get("kind") != kind:
+                continue
+            if all(r.get(k) == v for k, v in match.items()):
+                out.append(idx[id(r)])
+        return out
+
+    if fam == "p":
+        rid, trace = fault.get("replica"), fault.get("probe_trace")
+        routes = where("fleet", "route", trace_id=trace)
+        settles = where("fleet", "settle", trace_id=trace)
+        fails = where("fleet", "failover", victim=rid)
+        ok = bool(routes) and len(settles) == 1 \
+            and routes[0] < settles[0] \
+            and (bool(fails) or not fault.get("fired"))
+        return {"ok": ok, "family": fam, "trace": trace,
+                "routes": len(routes), "settles": len(settles),
+                "failovers_victim": len(fails)}
+    if fam == "o":
+        sid = fault.get("shard")
+        killed = where("embed", "shard_killed", shard_id=sid)
+        replaced = where("embed", "shard_replaced", shard_id=sid)
+        restored = where("embed", "restore", shard_id=sid)
+        ok = bool(killed) and bool(replaced) and bool(restored) \
+            and killed[0] < replaced[-1] and killed[0] < restored[-1]
+        return {"ok": ok, "family": fam, "shard": sid,
+                "killed": len(killed), "replaced": len(replaced),
+                "restored": len(restored)}
+    if fam == "k":
+        rid = fault.get("replica")
+        lapses = where("fleet", "lease_lapse", replica=rid)
+        rejoins = where("fleet", "rejoin", replica=rid)
+        ok = bool(lapses) and bool(rejoins) \
+            and lapses[0] < rejoins[-1]
+        return {"ok": ok, "family": fam, "replica": rid,
+                "lapses": len(lapses), "rejoins": len(rejoins)}
+    if fam == "q":
+        stale = where("fleet", "stale_view")
+        recovered = where("fleet", "view_recovered")
+        ok = bool(stale) and bool(recovered) \
+            and stale[0] < recovered[-1]
+        return {"ok": ok, "family": fam, "stale_views": len(stale),
+                "recoveries": len(recovered)}
+    return {"ok": False, "family": fam, "error": "unknown family"}
+
+
+def evaluate(records: List[dict],
+             slo: Optional[SoakSLO] = None) -> Dict[str, Any]:
+    """Fold the soak's merged journal records into the verdict report.
+
+    ``records`` must already be parsed/merged (testing/audit.py's
+    loader or obs/merge.py both produce the right shape). Returns the
+    machine-readable report; ``report["ok"]`` is the soak verdict."""
+    slo = slo or SoakSLO()
+    requests = [r for r in records
+                if r.get("domain") == "soak"
+                and r.get("kind") == "request"]
+    chat = [r for r in requests if r.get("workload") == "chat"]
+    ctr = [r for r in requests if r.get("workload") == "ctr"]
+    faults = [r for r in records
+              if r.get("domain") == "soak"
+              and r.get("kind") == "fault_injected"]
+    finals = [r for r in records
+              if r.get("domain") == "soak"
+              and r.get("kind") == "replica_final"]
+    checks: Dict[str, Dict[str, Any]] = {}
+
+    # -- exactly-once settle: every ACCEPTED chat stream (done or
+    # deliberately disconnected mid-stream) settles once fleet-wide;
+    # rejected/errored requests never settled and are excluded.
+    expected = [r["trace_id"] for r in chat
+                if r.get("outcome") in ("done", "disconnect")]
+    audit = audit_exactly_once(records, expected)
+    checks["exactly_once"] = {
+        "ok": audit["ok"], "expected": audit["expected"],
+        "settled": audit["settled"],
+        "duplicates": audit["duplicates"], "lost": audit["lost"],
+        "strays": len(audit["strays"])}
+
+    # -- latency SLOs (client-side, open-loop)
+    ttfts = [float(r["ttft_ms"]) for r in chat
+             if r.get("ttft_ms") is not None]
+    toks = [float(r["tok_ms"]) for r in chat
+            if r.get("tok_ms") is not None]
+    ttft_p99, tok_p99 = _p99(ttfts), _p99(toks)
+    lat_ok = (ttft_p99 is None or ttft_p99 <= slo.ttft_p99_ms) and \
+        (tok_p99 is None or tok_p99 <= slo.token_p99_ms)
+    if chat and ttft_p99 is None:
+        lat_ok = False                     # chat ran but nothing streamed
+    checks["latency_slo"] = {
+        "ok": lat_ok, "ttft_p99_ms": ttft_p99, "tok_p99_ms": tok_p99,
+        "streams_measured": len(ttfts),
+        "slo_ttft_p99_ms": slo.ttft_p99_ms,
+        "slo_token_p99_ms": slo.token_p99_ms}
+
+    # -- embedding staleness bound
+    stale = [r for r in records if r.get("domain") == "embed"
+             and r.get("kind") == "stale_read"]
+    checks["staleness"] = {
+        "ok": len(stale) <= slo.max_stale_reads,
+        "stale_reads": len(stale), "bound": slo.max_stale_reads}
+
+    # -- KV integrity on every surviving replica
+    leaks = {r.get("replica"): r for r in finals
+             if r.get("kv_pages_leaked", 0) != 0
+             or r.get("active_slots", 0) != 0}
+    checks["kv_leaks"] = {
+        "ok": bool(finals) and not leaks,
+        "survivors": len(finals),
+        "leaking": sorted(leaks)}
+
+    # -- every injected fault's chain reconstructs from the records.
+    # Zero injections is a FAILURE (a wedged conductor must not pass)
+    # unless the run_start record says no families were planned — the
+    # deliberate --faults '' baseline run.
+    starts = [r for r in records if r.get("domain") == "soak"
+              and r.get("kind") == "run_start"]
+    none_planned = bool(starts) and \
+        all(not r.get("families") for r in starts)
+    chains = [_fault_chain(records, f) for f in faults]
+    checks["fault_chains"] = {
+        "ok": all(c["ok"] for c in chains) and (
+            bool(chains) or none_planned),
+        "injected": len(faults),
+        "families": sorted({f.get("family") for f in faults}),
+        "chains": chains}
+
+    # -- the CTR freshness loop closed (when ctr load ran)
+    if ctr:
+        errors = [r for r in ctr if r.get("outcome") != "done"]
+        steps = [r for r in records if r.get("domain") == "soak"
+                 and r.get("kind") == "online_step"
+                 and r.get("samples", 0) > 0]
+        checks["ctr_loop"] = {
+            "ok": len(errors) <= slo.max_ctr_errors and bool(steps),
+            "impressions": len(ctr), "errors": len(errors),
+            "online_steps": len(steps),
+            "online_samples": sum(int(r.get("samples", 0))
+                                  for r in steps)}
+
+    report = {
+        "ok": all(c["ok"] for c in checks.values()),
+        "checks": checks,
+        "counts": {"requests": len(requests), "chat": len(chat),
+                   "ctr": len(ctr), "faults": len(faults),
+                   "records": len(records)},
+        "faults": [{k: f.get(k) for k in
+                    ("family", "action", "target", "at_s", "fired")}
+                   for f in faults]}
+    return report

@@ -1,6 +1,10 @@
 """Testing utilities — deterministic fault injection for chaos tests
-(docs/robustness.md)."""
+and the shared exactly-once audits (docs/robustness.md)."""
 
+from paddle_tpu.testing.audit import (assert_exactly_once,
+                                      assert_exactly_once_applied,
+                                      audit_exactly_once)
 from paddle_tpu.testing.faults import FaultPlan, WorkerCrash
 
-__all__ = ["FaultPlan", "WorkerCrash"]
+__all__ = ["FaultPlan", "WorkerCrash", "audit_exactly_once",
+           "assert_exactly_once", "assert_exactly_once_applied"]

@@ -70,6 +70,21 @@ def pytest_configure(config):
         "lock-order inversion (chaos/deadlock-witness tests) — skip "
         "the autouse zero-inversions assertion "
         "(paddle_tpu/analysis/lockdep.py)")
+    config.addinivalue_line(
+        "markers",
+        "soak: the long soak acceptance lane (paddle_tpu/loadgen) — "
+        "select with `-m soak`; soak-marked tests are implicitly "
+        "`slow` so tier-1's `-m 'not slow'` never runs them (the "
+        "bounded smoke slice in tests/test_soak.py stays tier-1)")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Every soak-marked test is implicitly slow: `-m soak` selects
+    the lane, tier-1's `-m 'not slow'` excludes it — one marker, both
+    behaviors."""
+    for item in items:
+        if item.get_closest_marker("soak") is not None:
+            item.add_marker(pytest.mark.slow)
 
 
 @pytest.fixture(autouse=True)
@@ -116,7 +131,7 @@ def _no_pipeline_thread_leaks(request):
     def leaked():
         from paddle_tpu.reader.pipeline import THREAD_PREFIX
         prefixes = (THREAD_PREFIX, "pt-serve", "pt-obs", "pt-coord",
-                    "pt-embed")
+                    "pt-embed", "pt-loadgen")
         return [t for t in threading.enumerate()
                 if t.is_alive() and t.name.startswith(prefixes)]
 

@@ -27,6 +27,10 @@ import jax.numpy as jnp                       # noqa: E402
 import numpy as np                            # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# cross-process collectives on the CPU backend need the gloo transport;
+# without it process_allgather raises "Multiprocess computations aren't
+# implemented on the CPU backend" the moment the group forms
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
 def main():

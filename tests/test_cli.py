@@ -1025,3 +1025,127 @@ class TestFleetCLI:
             assert built == [ap]
         finally:
             ap.stop()       # unhooks the SLO watchdog listener
+
+
+class TestSoakCLI:
+    """ISSUE 17 satellite: `paddle_tpu soak` flag wiring down to
+    SoakConfig, and the SIGTERM teardown contract (generators ->
+    fleet -> coordinator, in that order)."""
+
+    def test_soak_flags_parse_with_defaults(self, monkeypatch):
+        from paddle_tpu import cli
+        seen = {}
+        monkeypatch.setattr(cli, "_cmd_soak",
+                            lambda args: seen.update(vars(args)) or 0)
+        assert cli.main(["soak"]) == 0
+        assert seen["seed"] == 7 and seen["duration"] == 8.0
+        assert seen["workload"] == "mixed"
+        assert seen["faults"] == "pokq"
+        assert seen["chat_rate"] == 4.0 and seen["ctr_rate"] == 4.0
+        assert seen["arrival"] == "diurnal"
+        assert seen["event_log"] is None and seen["report"] is None
+        assert seen["slo_ttft_ms"] == 8000.0
+        assert seen["slo_token_ms"] == 4000.0
+        assert cli.main(["soak", "--seed", "23", "--duration", "30",
+                         "--workload", "chat", "--faults", "pk",
+                         "--chat_rate", "12", "--arrival", "ramp",
+                         "--event_log", "/tmp/s.jsonl",
+                         "--report", "/tmp/r.json"]) == 0
+        assert seen["seed"] == 23 and seen["duration"] == 30.0
+        assert seen["workload"] == "chat" and seen["faults"] == "pk"
+        assert seen["chat_rate"] == 12.0
+        assert seen["arrival"] == "ramp"
+        assert seen["event_log"] == "/tmp/s.jsonl"
+        assert seen["report"] == "/tmp/r.json"
+        # --workload / --arrival are closed choices
+        with pytest.raises(SystemExit):
+            cli.main(["soak", "--workload", "batch"])
+        with pytest.raises(SystemExit):
+            cli.main(["soak", "--arrival", "bursty"])
+
+    def test_build_soak_wires_flags(self):
+        import argparse
+
+        from paddle_tpu import cli
+
+        class FakeConfig:
+            def __init__(self, **kw):
+                self.kw = kw
+
+        class FakeRunner:
+            def __init__(self, cfg):
+                self.cfg = cfg
+
+        ns = argparse.Namespace(
+            seed=3, duration=5.0, workload="chat", faults="pk",
+            chat_rate=2.0, ctr_rate=1.5, arrival="ramp",
+            event_log="/tmp/x.jsonl", slo_ttft_ms=123.0,
+            slo_token_ms=45.0)
+        runner = cli._build_soak(ns, FakeConfig, FakeRunner)
+        kw = runner.cfg.kw
+        assert kw["seed"] == 3 and kw["duration_s"] == 5.0
+        assert kw["workload"] == "chat" and kw["families"] == "pk"
+        assert kw["chat_rate"] == 2.0 and kw["ctr_rate"] == 1.5
+        assert kw["arrival"] == "ramp"
+        assert kw["journal"] == "/tmp/x.jsonl"
+        assert kw["slo"].ttft_p99_ms == 123.0
+        assert kw["slo"].token_p99_ms == 45.0
+
+    def test_soak_teardown_order_generators_fleet_coordinator(self):
+        """The pinned contract (loadgen/harness.py): load stops
+        offering FIRST, then the serving fleet drains and leaves,
+        and the coordinator outlives everyone who heartbeats into
+        it."""
+        from paddle_tpu.loadgen import SoakConfig, SoakRunner
+
+        calls = []
+
+        class FakeGen:
+            def stop(self):
+                calls.append("gen_stop")
+
+            def join(self, timeout=None):
+                calls.append("gen_join")
+
+        class FakeConductor:
+            def stop(self):
+                calls.append("conductor_stop")
+
+            def join(self, timeout=None):
+                calls.append("conductor_join")
+
+        class FakeOnline:
+            def stop_and_join(self, timeout=30.0):
+                calls.append("online_stop")
+
+        class FakeClient:
+            def close(self):
+                calls.append("client_close")
+
+        class FakeTopology:
+            def stop_fleet(self):
+                calls.append("fleet_stop")
+
+            def stop_coordinator(self):
+                calls.append("coordinator_stop")
+
+        runner = SoakRunner(SoakConfig())
+        runner.generators = [FakeGen()]
+        runner.conductor = FakeConductor()
+        runner.online = FakeOnline()
+        runner.client = FakeClient()
+        runner.topology = FakeTopology()
+        runner.teardown()
+        assert calls == ["gen_stop", "gen_join", "conductor_stop",
+                         "conductor_join", "online_stop",
+                         "client_close", "fleet_stop",
+                         "coordinator_stop"]
+        # the SIGTERM path only STOPS offering load (run() unwinds
+        # through the same teardown) — it never tears the fleet from
+        # a signal handler
+        calls.clear()
+        runner2 = SoakRunner(SoakConfig())
+        runner2.generators = [FakeGen()]
+        runner2.conductor = FakeConductor()
+        runner2.stop()
+        assert calls == ["gen_stop", "conductor_stop"]

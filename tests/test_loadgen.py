@@ -1,0 +1,387 @@
+"""Unit + golden tests for paddle_tpu.loadgen (ISSUE 17).
+
+Everything here is pure data — no topology, no threads, no journal
+file. The golden literals pin the ISSUE's reproducibility acceptance
+("the same seed reproduces the identical fault schedule and request
+stream"): if a refactor perturbs any seeded draw, these fail with the
+new values so the change is a deliberate re-pin, never an accident.
+
+The verdict tests feed :func:`paddle_tpu.loadgen.evaluate` synthetic
+record lists and assert each check trips on exactly its own failure
+mode (duplicate settle, lost trace, KV leak, stale read, broken fault
+chain, TTFT breach) while the others stay green.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from paddle_tpu.loadgen import (ChatRequest, CtrRequest, FaultAction,
+                                RngPlane, SoakSLO, arrival_fn,
+                                chat_requests, ctr_requests, evaluate,
+                                open_loop_schedule, plan_faults,
+                                zipf_pmf)
+
+
+# --------------------------------------------------------------------------
+# RNG plane
+# --------------------------------------------------------------------------
+
+class TestRngPlane:
+    def test_same_name_same_instance(self):
+        plane = RngPlane(11)
+        assert plane.stream("a") is plane.stream("a")
+
+    def test_streams_independent_of_creation_order(self):
+        # drawing from stream "a" must not perturb stream "b": the
+        # property that keeps the goldens stable as the harness grows
+        p1, p2 = RngPlane(5), RngPlane(5)
+        _ = p1.stream("a").random(100)
+        b_after_a = p1.stream("b").random(8)
+        b_alone = p2.stream("b").random(8)
+        np.testing.assert_array_equal(b_after_a, b_alone)
+
+    def test_different_seeds_different_draws(self):
+        a = RngPlane(1).stream("x").random(8)
+        b = RngPlane(2).stream("x").random(8)
+        assert not np.array_equal(a, b)
+
+
+class TestZipf:
+    def test_pmf_normalized_and_monotone(self):
+        p = zipf_pmf(64, alpha=1.1)
+        assert p.shape == (64,)
+        assert abs(float(p.sum()) - 1.0) < 1e-12
+        assert np.all(np.diff(p) < 0)          # strictly head-heavy
+
+    def test_head_mass_grows_with_alpha(self):
+        assert zipf_pmf(100, 1.5)[0] > zipf_pmf(100, 1.01)[0]
+
+
+# --------------------------------------------------------------------------
+# Open-loop arrivals
+# --------------------------------------------------------------------------
+
+class TestArrival:
+    def test_schedule_deterministic(self):
+        f = arrival_fn("diurnal", 6.0)
+        a = open_loop_schedule(RngPlane(3).stream("x"), 10.0, f)
+        b = open_loop_schedule(RngPlane(3).stream("x"), 10.0, f)
+        assert a == b
+
+    def test_schedule_sorted_and_bounded(self):
+        offs = open_loop_schedule(RngPlane(3).stream("x"), 10.0,
+                                  arrival_fn("constant", 5.0))
+        assert offs == sorted(offs)
+        assert all(0.0 <= o < 10.0 for o in offs)
+
+    def test_schedule_golden(self):
+        offs = open_loop_schedule(RngPlane(3).stream("x"), 10.0,
+                                  arrival_fn("constant", 5.0))
+        assert len(offs) == 51
+        np.testing.assert_allclose(
+            offs[:3], [0.184229, 0.194018, 0.297526], atol=1e-6)
+
+    def test_mean_rate_preserved_across_shapes(self):
+        # arrival_fn contracts that every shape keeps mean ~= rate, so
+        # --duration x --rate stays the expected request budget
+        for kind in ("constant", "ramp", "diurnal"):
+            f = arrival_fn(kind, 8.0)
+            grid = np.linspace(0.0, 1.0, 4097)
+            mean = float(np.mean([f(float(u)) for u in grid]))
+            assert abs(mean - 8.0) < 0.05, (kind, mean)
+
+    def test_unknown_shape_raises(self):
+        with pytest.raises(ValueError):
+            arrival_fn("bursty", 1.0)
+
+    def test_zero_duration_empty(self):
+        assert open_loop_schedule(RngPlane(0).stream("x"), 0.0,
+                                  arrival_fn("constant", 5.0)) == []
+
+
+# --------------------------------------------------------------------------
+# Workload synthesis goldens
+# --------------------------------------------------------------------------
+
+def _build(seed=7):
+    plane = RngPlane(seed)
+    chat = chat_requests(plane, 8.0, arrival_fn("diurnal", 4.0))
+    ctr = ctr_requests(plane, 8.0, arrival_fn("diurnal", 4.0))
+    return chat, ctr
+
+
+class TestWorkloadGoldens:
+    def test_same_seed_same_stream(self):
+        assert _build(7) == _build(7)
+
+    def test_different_seed_different_stream(self):
+        assert _build(7) != _build(8)
+
+    def test_chat_golden(self):
+        chat, _ = _build(7)
+        assert len(chat) == 29
+        assert chat[0] == ChatRequest(
+            offset_s=pytest.approx(0.5981952388624608),
+            trace_id="soak-7-chat-00000",
+            prompt=(18, 24, 6, 26, 12, 19),
+            max_new=6, disconnect_after=None)
+        digest = hashlib.md5(repr(chat).encode()).hexdigest()
+        assert digest == "dc5a5dea8b3525fe363f141b5e698352"
+
+    def test_ctr_golden(self):
+        _, ctr = _build(7)
+        assert len(ctr) == 34
+        assert ctr[0] == CtrRequest(
+            offset_s=pytest.approx(0.38787831297355263),
+            trace_id="soak-7-ctr-00000",
+            ids=(28, 4, 1, 63, 0, 1040), label=0.0)
+        digest = hashlib.md5(repr(ctr).encode()).hexdigest()
+        assert digest == "092e02eb81fdb197bced1db9e5d34243"
+
+    def test_chat_invariants(self):
+        chat, _ = _build(7)
+        traces = [r.trace_id for r in chat]
+        assert len(set(traces)) == len(traces)
+        for i, r in enumerate(chat):
+            assert all(1 <= t < 40 for t in r.prompt)
+            if (i + 1) % 7 == 0:
+                assert r.disconnect_after == 2
+            else:
+                assert r.disconnect_after is None
+
+    def test_ctr_invariants(self):
+        _, ctr = _build(7)
+        for r in ctr:
+            assert len(r.ids) == 6
+            assert all(0 <= k < 4096 for k in r.ids)
+            assert r.label in (0.0, 1.0)
+
+
+# --------------------------------------------------------------------------
+# Fault schedule
+# --------------------------------------------------------------------------
+
+class TestPlanFaults:
+    def test_deterministic(self):
+        assert plan_faults(7, 8.0, "pokq") == plan_faults(7, 8.0, "pokq")
+
+    def test_golden(self):
+        plan = plan_faults(7, 8.0, "pokq")
+        assert [a.family for a in plan] == ["k", "o", "q", "p"]
+        assert plan[0] == FaultAction(
+            "k", "lease_lapse", pytest.approx(1.955938158193134), 0)
+        assert plan[3] == FaultAction(
+            "p", "kill_replica", pytest.approx(5.323156750505509), 1)
+
+    def test_p_and_k_pick_distinct_replicas(self):
+        # the lapsed replica must never be the killed one — the soak
+        # has to end with a live survivor serving
+        for seed in range(40):
+            plan = {a.family: a for a in plan_faults(seed, 10.0, "pk")}
+            assert plan["p"].target != plan["k"].target
+
+    def test_family_subset(self):
+        plan = plan_faults(7, 8.0, "po")
+        assert [a.family for a in plan] == ["o", "p"]
+
+    def test_schedule_ordered_in_time(self):
+        plan = plan_faults(7, 8.0, "pokq")
+        ats = [a.at_s for a in plan]
+        assert ats == sorted(ats)
+        assert all(0.0 < t < 8.0 for t in ats)
+
+
+# --------------------------------------------------------------------------
+# Verdict engine on synthetic records
+# --------------------------------------------------------------------------
+
+def _rec(domain, kind, **fields):
+    return dict(domain=domain, kind=kind, **fields)
+
+
+def _passing_records():
+    """A minimal soak's worth of records where every check passes:
+    two chat streams (one finished, one deliberately disconnected that
+    failed over off the killed replica), one CTR impression consumed
+    by an online step, a (p) fault whose chain reconstructs, and one
+    clean survivor."""
+    return [
+        _rec("soak", "request", workload="chat", trace_id="t1",
+             outcome="done", ttft_ms=12.0, tok_ms=1.5),
+        _rec("soak", "request", workload="chat", trace_id="t2",
+             outcome="disconnect", ttft_ms=15.0, tok_ms=2.0),
+        _rec("soak", "request", workload="ctr", trace_id="c1",
+             outcome="done"),
+        _rec("fleet", "route", trace_id="t1", replica="r0"),
+        _rec("fleet", "settle", trace_id="t1", replica="r0"),
+        _rec("fleet", "route", trace_id="t2", replica="r1"),
+        _rec("soak", "fault_injected", family="p",
+             action="kill_replica", target=1, at_s=1.0, fired=True,
+             replica="r1", probe_trace="t2"),
+        _rec("fleet", "failover", trace_id="t2", victim="r1"),
+        _rec("fleet", "settle", trace_id="t2", replica="r0"),
+        _rec("soak", "online_step", batches=1, samples=3, loss=0.1),
+        _rec("soak", "replica_final", replica="r0",
+             kv_pages_leaked=0, active_slots=0, kv_pages_used=0),
+    ]
+
+
+class TestVerdict:
+    def test_passing_run(self):
+        report = evaluate(_passing_records())
+        assert report["ok"], report
+        assert all(c["ok"] for c in report["checks"].values())
+        assert report["counts"] == {
+            "requests": 3, "chat": 2, "ctr": 1, "faults": 1,
+            "records": 11}
+        assert report["faults"][0]["family"] == "p"
+
+    def test_duplicate_settle_fails(self):
+        recs = _passing_records()
+        recs.append(_rec("fleet", "settle", trace_id="t1",
+                         replica="r1"))
+        report = evaluate(recs)
+        assert not report["ok"]
+        assert not report["checks"]["exactly_once"]["ok"]
+        assert report["checks"]["exactly_once"]["duplicates"] == {
+            "t1": 2}
+
+    def test_lost_trace_fails(self):
+        recs = [r for r in _passing_records()
+                if not (r["kind"] == "settle"
+                        and r.get("trace_id") == "t1")]
+        report = evaluate(recs)
+        assert not report["checks"]["exactly_once"]["ok"]
+        assert report["checks"]["exactly_once"]["lost"] == ["t1"]
+
+    def test_kv_leak_fails(self):
+        recs = _passing_records()
+        recs.append(_rec("soak", "replica_final", replica="r2",
+                         kv_pages_leaked=3, active_slots=0))
+        report = evaluate(recs)
+        assert not report["checks"]["kv_leaks"]["ok"]
+        assert report["checks"]["kv_leaks"]["leaking"] == ["r2"]
+
+    def test_stuck_slot_fails(self):
+        recs = _passing_records()
+        recs.append(_rec("soak", "replica_final", replica="r2",
+                         kv_pages_leaked=0, active_slots=1))
+        assert not evaluate(recs)["checks"]["kv_leaks"]["ok"]
+
+    def test_no_finals_fails(self):
+        recs = [r for r in _passing_records()
+                if r["kind"] != "replica_final"]
+        assert not evaluate(recs)["checks"]["kv_leaks"]["ok"]
+
+    def test_stale_read_fails(self):
+        recs = _passing_records()
+        recs.append(_rec("embed", "stale_read", shard_id=0, rows=4,
+                         age_s=9.0, bound_s=5.0))
+        report = evaluate(recs)
+        assert not report["checks"]["staleness"]["ok"]
+        assert report["checks"]["staleness"]["stale_reads"] == 1
+
+    def test_ttft_slo_breach_fails(self):
+        report = evaluate(_passing_records(),
+                          SoakSLO(ttft_p99_ms=10.0))
+        assert not report["checks"]["latency_slo"]["ok"]
+        assert report["checks"]["latency_slo"]["ttft_p99_ms"] > 10.0
+
+    def test_chat_without_streams_fails_latency(self):
+        recs = [_rec("soak", "request", workload="chat",
+                     trace_id="t9", outcome="rejected"),
+                _rec("soak", "replica_final", replica="r0",
+                     kv_pages_leaked=0, active_slots=0),
+                _rec("soak", "fault_injected", family="q", fired=True,
+                     action="coordinator_outage", target=None,
+                     at_s=1.0),
+                _rec("fleet", "stale_view"),
+                _rec("fleet", "view_recovered")]
+        assert not evaluate(recs)["checks"]["latency_slo"]["ok"]
+
+    def test_missing_failover_breaks_p_chain(self):
+        recs = [r for r in _passing_records()
+                if r["kind"] != "failover"]
+        report = evaluate(recs)
+        assert not report["checks"]["fault_chains"]["ok"]
+        chain = report["checks"]["fault_chains"]["chains"][0]
+        assert chain["family"] == "p" and not chain["ok"]
+
+    def test_no_faults_injected_fails(self):
+        # a wedged conductor (families planned, nothing injected)
+        # must not pass the fault check
+        recs = [r for r in _passing_records()
+                if r["kind"] != "fault_injected"]
+        assert not evaluate(recs)["checks"]["fault_chains"]["ok"]
+
+    def test_faultless_baseline_run_passes(self):
+        # ...but a run whose run_start says NO families were planned
+        # (--faults '') passes the check vacuously
+        recs = [r for r in _passing_records()
+                if r["kind"] != "fault_injected"]
+        recs.insert(0, _rec("soak", "run_start", seed=7,
+                            families=""))
+        report = evaluate(recs)
+        assert report["checks"]["fault_chains"]["ok"]
+        assert report["checks"]["fault_chains"]["injected"] == 0
+        # a run_start that DID plan families still fails without
+        # injections
+        recs[0] = _rec("soak", "run_start", seed=7, families="po")
+        assert not evaluate(recs)["checks"]["fault_chains"]["ok"]
+
+    def test_o_chain_requires_kill_before_restore(self):
+        base = [r for r in _passing_records()
+                if r["kind"] != "fault_injected"]
+        fault = _rec("soak", "fault_injected", family="o",
+                     action="kill_shard_commit", target=0, at_s=1.0,
+                     fired=True, shard=0)
+        good = base + [
+            fault,
+            _rec("embed", "shard_killed", shard_id=0),
+            _rec("embed", "shard_replaced", shard_id=0),
+            _rec("embed", "restore", shard_id=0, rows=2),
+        ]
+        assert evaluate(good)["checks"]["fault_chains"]["ok"]
+        # replacement journaled BEFORE the kill = a broken chain
+        bad = base + [
+            fault,
+            _rec("embed", "shard_replaced", shard_id=0),
+            _rec("embed", "restore", shard_id=0, rows=2),
+            _rec("embed", "shard_killed", shard_id=0),
+        ]
+        assert not evaluate(bad)["checks"]["fault_chains"]["ok"]
+
+    def test_k_chain_lapse_then_rejoin(self):
+        base = [r for r in _passing_records()
+                if r["kind"] != "fault_injected"]
+        fault = _rec("soak", "fault_injected", family="k",
+                     action="lease_lapse", target=0, at_s=1.0,
+                     fired=True, replica="r0")
+        good = base + [fault,
+                       _rec("fleet", "lease_lapse", replica="r0"),
+                       _rec("fleet", "rejoin", replica="r0")]
+        assert evaluate(good)["checks"]["fault_chains"]["ok"]
+        bad = base + [fault,
+                      _rec("fleet", "lease_lapse", replica="r0")]
+        assert not evaluate(bad)["checks"]["fault_chains"]["ok"]
+
+    def test_ctr_errors_fail_loop(self):
+        recs = _passing_records()
+        recs.append(_rec("soak", "request", workload="ctr",
+                         trace_id="c2", outcome="error"))
+        assert not evaluate(recs)["checks"]["ctr_loop"]["ok"]
+
+    def test_ctr_without_online_steps_fails(self):
+        recs = [r for r in _passing_records()
+                if r["kind"] != "online_step"]
+        assert not evaluate(recs)["checks"]["ctr_loop"]["ok"]
+
+    def test_no_ctr_skips_ctr_check(self):
+        recs = [r for r in _passing_records()
+                if r.get("workload") != "ctr"
+                and r["kind"] != "online_step"]
+        report = evaluate(recs)
+        assert "ctr_loop" not in report["checks"]
+        assert report["ok"]

@@ -82,7 +82,7 @@ class TestAsyncSGD:
     (ParameterServer2::asyncSGD parity by redesign; see
     parallel/async_sgd.py)."""
 
-    def _island(self, seed):
+    def _island(self, seed, lr=3e-2):
         import paddle_tpu as paddle
         from paddle_tpu.core import registry
         registry.reset_name_counters()
@@ -93,7 +93,7 @@ class TestAsyncSGD:
         params = paddle.create_parameters(paddle.Topology(cost))
         tr = paddle.SGD(cost=cost, parameters=params,
                         update_equation=paddle.optimizer.Adam(
-                            learning_rate=3e-2))
+                            learning_rate=lr))
         return tr, params
 
     def test_islands_drift_then_reconcile(self):
@@ -137,8 +137,12 @@ class TestAsyncSGD:
             ys = (xs @ w_true).astype("float32")
             return [(xs[i], ys[i]) for i in range(n)]
 
-        tr_a, pa = self._island(0)
-        tr_b, pb = self._island(0)
+        # Adam at 3e-2 leaves |w - w_true| ~0.54 after 60 local-SGD
+        # iterations on this seed; 6e-2 converges to ~0.09 with the
+        # same dynamics — the assertion tests RECONCILED convergence,
+        # not the optimizer's step-size schedule
+        tr_a, pa = self._island(0, lr=6e-2)
+        tr_b, pb = self._island(0, lr=6e-2)
         isl_a = AsyncSGDIsland(tr_a, sync_period=5, sync_group=[pa, pb])
         isl_b = AsyncSGDIsland(tr_b, sync_period=5, sync_group=[pa, pb])
         ra, rb = np.random.RandomState(4), np.random.RandomState(5)

@@ -1,0 +1,102 @@
+"""The million-user soak (ISSUE 17) — live-topology tests.
+
+Two lanes off one harness (paddle_tpu/loadgen):
+
+- **tier-1 smoke slice** (``TestSoakSmoke``, chaos-marked, NOT soak):
+  a seconds-bounded mixed run over the full in-process estate with a
+  reduced fault set, so every tier-1 run proves the soak machinery
+  end to end without paying the acceptance duration;
+- **the soak lane** (``@pytest.mark.soak``, select with ``-m soak``):
+  the ISSUE's acceptance run — coordinator + 2 router planes + 2
+  replicas + 2 embedding shards, all four fault families composed in
+  ONE run, every verdict check green, and the executed fault schedule
+  byte-equal to the recomputed plan (same seed, same schedule).
+
+Both assert on the verdict REPORT, never on harness internals: the
+report is a pure function of the journal, so these tests also pin
+that every proof survives the journal round-trip.
+"""
+
+import pytest
+
+from paddle_tpu.loadgen import plan_faults, run_soak
+
+
+def _assert_verdict(report, families):
+    checks = report["checks"]
+    assert report["ok"], report
+    # exactly-once settle fleet-wide, including the scripted
+    # mid-stream client disconnects
+    eo = checks["exactly_once"]
+    assert eo["ok"] and eo["expected"] > 0
+    assert eo["duplicates"] == {} and eo["lost"] == []
+    # client-side latency SLOs were measured, not vacuous
+    assert checks["latency_slo"]["ok"]
+    assert checks["latency_slo"]["streams_measured"] > 0
+    # no embedding gather served past its staleness bound
+    assert checks["staleness"]["ok"]
+    assert checks["staleness"]["stale_reads"] == 0
+    # zero leaked KV pages / stuck slots on every survivor
+    assert checks["kv_leaks"]["ok"]
+    assert checks["kv_leaks"]["survivors"] > 0
+    # every injected fault's chain reconstructs from the records
+    fc = checks["fault_chains"]
+    assert fc["ok"] and fc["injected"] == len(families)
+    assert fc["families"] == sorted(families)
+    # the CTR freshness loop closed (mixed workload always runs it)
+    assert checks["ctr_loop"]["ok"]
+    assert checks["ctr_loop"]["online_samples"] > 0
+
+
+class TestSoakSmoke:
+    """Tier-1's bounded slice: short duration, two fault families
+    ((o) shard kill in the commit window + (p) replica kill
+    mid-stream), full verdict."""
+
+    @pytest.mark.chaos(timeout=240)
+    def test_smoke_slice_passes_verdict(self):
+        report = run_soak(seed=11, duration_s=4.0, workload="mixed",
+                          families="po")
+        _assert_verdict(report, "po")
+        # the schedule the conductor executed IS the recomputed plan
+        planned = plan_faults(11, 4.0, "po")
+        assert [(f["family"], f["action"], f["target"])
+                for f in report["faults"]] == \
+            [(a.family, a.action, a.target) for a in planned]
+        for f, a in zip(report["faults"], planned):
+            assert f["at_s"] == pytest.approx(a.at_s, abs=1e-3)
+            assert f["fired"]
+
+
+class TestSoakAcceptance:
+    """The acceptance run (`pytest -m soak`): all four fault families
+    composed in one seeded run over the full topology."""
+
+    @pytest.mark.soak
+    @pytest.mark.chaos(timeout=420)
+    def test_full_soak_all_families(self):
+        report = run_soak(seed=7, duration_s=10.0, workload="mixed",
+                          families="pokq")
+        _assert_verdict(report, "kopq")
+        assert len(report["faults"]) >= 3        # ISSUE floor: >=3 families
+        assert report["counts"]["chat"] > 10
+        assert report["counts"]["ctr"] > 10
+        # same seed -> identical fault schedule, replayed verbatim
+        planned = plan_faults(7, 10.0, "pokq")
+        assert [(f["family"], f["action"], f["target"])
+                for f in report["faults"]] == \
+            [(a.family, a.action, a.target) for a in planned]
+        assert all(f["fired"] for f in report["faults"])
+
+    @pytest.mark.soak
+    @pytest.mark.chaos(timeout=420)
+    def test_chat_only_soak(self):
+        """Chat-only workload: no CTR traffic means no ctr_loop check,
+        but exactly-once + latency + KV integrity still prove out
+        under the replica-kill and lease-lapse families."""
+        report = run_soak(seed=23, duration_s=6.0, workload="chat",
+                          families="pk", chat_rate=6.0)
+        assert report["ok"], report
+        assert "ctr_loop" not in report["checks"]
+        assert report["checks"]["exactly_once"]["ok"]
+        assert report["checks"]["fault_chains"]["injected"] == 2
