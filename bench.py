@@ -576,8 +576,8 @@ SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine",
               "flight_recorder_overhead", "profiler_overhead",
               "lockdep_overhead", "coord_reshard", "embed_lookup",
               "embed_update", "fleet_route", "fleet_failover",
-              "fleet_deploy", "fleet_autoscale", "router_ha",
-              "soak_smoke")
+              "cold_start_to_first_token", "fleet_deploy",
+              "fleet_autoscale", "router_ha", "soak_smoke")
 
 
 def _smoke_trainer(batch: int = 16):
@@ -1104,6 +1104,56 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
                     rep["httpd"].server_close()
                 rep["server"].shutdown(drain=True, timeout=30)
 
+    if "cold_start_to_first_token" in rows:
+        # ISSUE 18 tentpole row: what crash recovery / autoscale-up
+        # actually costs. Cold = fresh engine with NOTHING warm (jit
+        # caches and the executable cache both emptied) — construction
+        # plus the first token, compile included. Warm = the same
+        # respawn with the warm-start plane populated: the engine
+        # resolves its executable instead of compiling, so
+        # warm_ttft_ms IS the autoscale-MTTR decode bound and
+        # warm_step_compiles is gated at 0. The artifact store fills
+        # as a side effect (artifacts_built); the cross-process disk
+        # rung is proven in tests/test_artifacts.py.
+        import shutil as _sh
+        import tempfile as _tf
+
+        import jax as _jax
+
+        from paddle_tpu import artifacts as _arts
+        from paddle_tpu.serving import DecodeEngine as _DEng
+
+        _croot = _tf.mkdtemp(prefix="pt_bench_arts_")
+        _cstore = _arts.configure(_croot)
+        try:
+            _arts.EXECUTABLES.clear()
+            _jax.clear_caches()
+
+            def _ttft_ms():
+                t0 = time.perf_counter()
+                eng = _DEng(_smoke_decoder(), num_slots=2, page_size=4,
+                            max_seq_len=32, prefix_cache=False)
+                r = eng.submit(np.array([1, 2, 3, 4], np.int32), 1)
+                eng.run(timeout=300)
+                assert len(r.get(timeout=1)) == 1
+                return (time.perf_counter() - t0) * 1e3
+
+            cold_ms = _ttft_ms()
+            with compile_watch() as _ccw:
+                warm_ms = _ttft_ms()
+            out["cold_start_to_first_token"] = {
+                "cold_ttft_ms": round(cold_ms, 3),
+                "warm_ttft_ms": round(warm_ms, 3),
+                "warm_speedup": round(cold_ms / max(warm_ms, 1e-6), 2),
+                "warm_step_compiles": sum(
+                    v for k, v in _ccw.per_function.items()
+                    if "_step_impl" in k),
+                "artifacts_built": len(_cstore.entries()),
+            }
+        finally:
+            _arts.configure(None)
+            _sh.rmtree(_croot, ignore_errors=True)
+
     if "fleet_deploy" in rows:
         # ISSUE 16 tentpole leg (b): the SLO-gated rolling deploy.
         # failed_requests is the GATED metric (count, slack 0): a
@@ -1139,7 +1189,12 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
                          scrape_interval=0.1, queue_timeout=10.0,
                          queue_poll=0.02, drain_timeout=5.0).start()
         try:
-            drouter.generate([1, 2, 3], 1)      # compile + warm
+            # compile + warm — the same request shape as the burst,
+            # twice: the first caches its prefix pages, the second's
+            # prefix hit resolves the CoW copy_page executable, so
+            # nothing is left to compile during the rollout
+            drouter.generate([1, 2, 3], 4)
+            drouter.generate([1, 2, 3], 4)
             dl = time.monotonic() + 5
             while time.monotonic() < dl and any(
                     s.last_scrape == 0 for s in
@@ -1154,8 +1209,11 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
                 dreps[rid] = _dep_replica()
                 return {"endpoint": dreps[rid]["endpoint"]}
 
+            # max_compiles=0: with the warm-start plane live, a whole
+            # rolling restart must not compile ANYTHING (ISSUE 18) —
+            # the gate keeps rollout_compiles pinned at zero
             roll = RollingDeploy(drouter, _restart, watchdog=_Watch(),
-                                 settle_timeout=30.0)
+                                 settle_timeout=30.0, max_compiles=0)
             deploy_out = {}
 
             def _run_deploy():
@@ -1181,6 +1239,10 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
                 "deploy_complete": int(
                     deploy_out.get("status") == "complete"),
                 "deploy_wall_ms": round(wall_ms, 3),
+                # 99 (not 0) when the deploy thread died: losing the
+                # measurement must FAIL the count gate, not pass it
+                "rollout_compiles": deploy_out.get(
+                    "rollout_compiles", 99),
             }
         finally:
             drouter.shutdown(drain=True, timeout=10)

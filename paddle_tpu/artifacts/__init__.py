@@ -1,0 +1,35 @@
+"""Warm-start artifact plane (docs/robustness.md "Warm start &
+artifact integrity").
+
+Crash recovery, autoscale-up and rolling deploys are only as fast as a
+replica's cold start, and a cold start is compiler-bound. This package
+makes recovery paths zero-compile, bounded-time operations:
+
+- ``fingerprint``  — the identity an executable is reusable under
+  (model shape digest + shape plan + jax/jaxlib/device environment);
+- ``store``        — framed (magic + crc) on-disk artifacts with
+  atomic single-writer publishes; torn/corrupt/stale files are
+  detected, journaled (``artifacts/fallback``) and degrade to JIT;
+- ``aot``          — AOT executable (de)serialization; a deserialized
+  call performs no tracing and no XLA compilation;
+- ``runtime``      — the warm ladder every artifact-aware jitted
+  function resolves through: in-process ExecutableCache -> artifact
+  store -> cold JIT (with backfill);
+- ``cache``        — the persistent XLA compilation cache knobs (the
+  layer under the artifacts: bounded-time when zero-compile misses).
+"""
+
+from paddle_tpu.artifacts import aot, cache, runtime
+from paddle_tpu.artifacts.fingerprint import (Fingerprint,
+                                              device_signature,
+                                              fingerprint, model_digest)
+from paddle_tpu.artifacts.runtime import (EXECUTABLES, configure,
+                                          current_store, resolve)
+from paddle_tpu.artifacts.store import ArtifactStore
+
+__all__ = [
+    "aot", "cache", "runtime",
+    "Fingerprint", "fingerprint", "model_digest", "device_signature",
+    "ArtifactStore", "EXECUTABLES", "configure", "current_store",
+    "resolve",
+]

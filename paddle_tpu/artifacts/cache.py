@@ -1,0 +1,100 @@
+"""Persistent XLA compilation cache — the productionized seam.
+
+tests/conftest.py proved the disk compile cache (keyed by HLO hash)
+carries the suite; this module is the one place the knobs live so the
+trainer, the serving replicas, the router daemon and the soak harness
+all wire it the same way:
+
+- ``enable(dir)`` / ``enable_from_env()`` turn it on for THIS process
+  via ``jax.config`` — deliberately process-local, never by mutating
+  the environment: the SIGKILL chaos tests time kills against a
+  spawned worker's compile-dominated startup, so a child must stay
+  cold unless the parent explicitly forwards ``PADDLE_TPU_COMPILE_CACHE``
+  (fleet/autopilot.py SubprocessProvisioner does, for warm fleets);
+- ``"0"`` (or ``"off"``) disables — the env-var and the CLI
+  ``--compile_cache`` flag share one grammar via ``resolve_dir``;
+- ``disabled()`` is the scoped opt-out (tests/test_oom.py pins
+  OOM-vs-freshly-compiled-executable behavior under it).
+
+The compile cache is the warm-start layer UNDER the AOT artifact
+store: artifacts skip compilation entirely; the cache bounds the cost
+whenever an artifact misses (new shape plan, stale fingerprint,
+serialization-incapable backend).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = ["ENV_VAR", "default_dir", "resolve_dir", "enable",
+           "enable_from_env", "ensure_default", "disabled"]
+
+ENV_VAR = "PADDLE_TPU_COMPILE_CACHE"
+
+#: values of the env var / --compile_cache flag that mean "off"
+_OFF = ("0", "off", "none", "")
+
+
+def default_dir() -> str:
+    return os.path.join(tempfile.gettempdir(), "paddle_tpu_xla_cache")
+
+
+def resolve_dir(value: Optional[str] = None,
+                fallback: Optional[str] = None) -> Optional[str]:
+    """One grammar for flag and env var: an explicit ``value`` wins,
+    else ``PADDLE_TPU_COMPILE_CACHE``, else ``fallback``; "0"/"off"
+    anywhere resolves to None (disabled)."""
+    for v in (value, os.environ.get(ENV_VAR), fallback):
+        if v is None:
+            continue
+        return None if str(v).lower() in _OFF else str(v)
+    return None
+
+
+def enable(value: Optional[str] = None,
+           min_compile_secs: float = 0.05) -> Optional[str]:
+    """Point this process's XLA compilation cache at ``resolve_dir``'s
+    answer (created if missing); ``None`` answer = leave disabled.
+    Returns the directory in effect."""
+    import jax
+    d = resolve_dir(value, fallback=default_dir())
+    if d is None:
+        return None
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
+    return d
+
+
+def enable_from_env(min_compile_secs: float = 0.05) -> Optional[str]:
+    """The conftest seam: env var (or the default tempdir cache)
+    unless the env var says off."""
+    return enable(None, min_compile_secs=min_compile_secs)
+
+
+def ensure_default() -> Optional[str]:
+    """Opt-IN wiring for long-lived entrypoints (trainer startup, the
+    C-ABI host): enable the cache only when ``PADDLE_TPU_COMPILE_CACHE``
+    is set to a directory — a bare process stays cold, preserving the
+    cold-start discipline chaos tests depend on."""
+    d = resolve_dir(None)
+    return enable(d) if d else None
+
+
+@contextmanager
+def disabled():
+    """Scoped compile-cache OFF (reads AND writes): inside, every
+    executable is freshly compiled. The OOM chaos suite races the
+    allocator against compilation and must never be handed a
+    deserialized executable instead."""
+    import jax
+    prev = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", prev)

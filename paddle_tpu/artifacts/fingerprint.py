@@ -1,0 +1,116 @@
+"""Artifact fingerprints — the identity a compiled executable is
+reusable under.
+
+An XLA executable is only valid for the exact (program, shapes,
+backend) it was compiled for, so the artifact plane keys everything on
+a digest over the four axes that change it:
+
+- the MODEL digest: parameter names, shapes and dtypes (values are
+  runtime arguments to every jitted step — two checkpoints of the same
+  architecture share one executable);
+- the PLAN: every shape-determining knob of the jitted function
+  (slots, page size, pool size, window/spec_k, temperature mode,
+  attention path, donation);
+- the ENVIRONMENT: jax + jaxlib versions and the device kind/count —
+  a jaxlib upgrade or a TPU-generation change silently invalidates
+  serialized executables, so it MUST miss instead of deserialize;
+- the KIND: which jitted function this is (paged_step, draft_step,
+  copy_page, ...), so one store holds a model's whole executable set.
+
+The digest is sha256 over the canonical-JSON field dict, truncated to
+16 hex chars — collision-safe at fleet scale and short enough to live
+in filenames and journal records.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, Optional
+
+__all__ = ["Fingerprint", "model_digest", "device_signature",
+           "fingerprint"]
+
+
+def _canonical(obj) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+class Fingerprint:
+    """Immutable field dict + its digest. ``fields`` is JSON-safe by
+    construction so the store can frame it verbatim and ``verify`` can
+    re-derive the digest from what is on disk."""
+
+    __slots__ = ("fields", "digest")
+
+    def __init__(self, fields: Dict):
+        self.fields = fields
+        self.digest = hashlib.sha256(
+            _canonical(fields).encode()).hexdigest()[:16]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Fingerprint) and \
+            self.digest == other.digest
+
+    def __hash__(self) -> int:
+        return hash(self.digest)
+
+    def __repr__(self) -> str:
+        return f"Fingerprint({self.fields.get('kind')!r}, {self.digest})"
+
+    def to_dict(self) -> Dict:
+        return dict(self.fields)
+
+    @classmethod
+    def from_dict(cls, fields: Dict) -> "Fingerprint":
+        return cls(fields)
+
+
+def model_digest(params: Dict) -> str:
+    """Digest over the parameter TABLE SHAPE — sorted (name, shape,
+    dtype) triples, never values. Executables treat parameters as
+    runtime arguments, so an updated checkpoint of the same
+    architecture keeps its warm artifacts."""
+    import numpy as np
+    rows = []
+    for name in sorted(params):
+        v = params[name]
+        shape = tuple(int(s) for s in getattr(v, "shape", ()))
+        dtype = str(np.asarray(v).dtype if not hasattr(v, "dtype")
+                    else v.dtype)
+        rows.append((name, shape, dtype))
+    return hashlib.sha256(_canonical(rows).encode()).hexdigest()[:16]
+
+
+def device_signature() -> Dict:
+    """The environment axis: anything that invalidates a serialized
+    executable when it changes."""
+    import jax
+    import jaxlib
+    dev = jax.devices()[0]
+    return {
+        "backend": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+        "num_devices": jax.device_count(),
+        "jax": jax.__version__,
+        "jaxlib": getattr(jaxlib, "__version__", "unknown"),
+    }
+
+
+def fingerprint(kind: str, model,
+                plan: Optional[Dict] = None) -> Fingerprint:
+    """Build the full fingerprint for one jitted function.
+
+    ``kind`` names the function (paged_step / draft_step / ...),
+    ``model`` is a :func:`model_digest` — or a parameter dict, which
+    is digested here (shapes/dtypes only, so two checkpoints of one
+    architecture fingerprint identically) — and ``plan`` carries every
+    shape-determining config knob (JSON scalars only)."""
+    return Fingerprint({
+        "v": 1,
+        "kind": str(kind),
+        "model": model if isinstance(model, str) else
+        model_digest(model),
+        "plan": dict(plan or {}),
+        "env": device_signature(),
+    })

@@ -154,6 +154,12 @@ def create(model_path) -> int:
             return _fail(ERR_BAD_ARG, 0,
                          f"create: model path must be a string, "
                          f"got {type(model_path).__name__}")
+        # warm start for embedding hosts: honor
+        # PADDLE_TPU_COMPILE_CACHE when the embedding application set
+        # it (opt-in; a bare host stays cold) so the first forward
+        # after a crash-restart reuses the persisted compilation
+        from paddle_tpu.artifacts import cache as _compile_cache
+        _compile_cache.ensure_default()
         from paddle_tpu.trainer.inference import load_inference_model
         try:
             inf = load_inference_model(model_path)

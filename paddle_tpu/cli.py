@@ -409,6 +409,13 @@ def _cmd_serve(args) -> int:
     server, httpd = _build_server(args, InferenceServer, CircuitBreaker,
                                   build_http_server,
                                   on_quit=_on_admin_quit)
+    if server.engine is not None:
+        # resolve the decode executables BEFORE the HTTP thread starts
+        # admitting: with a warm artifact store this is zero-compile
+        # (the deserialized executable traces nothing); cold, the
+        # compile is paid here — never inside a request — and the
+        # store is backfilled for the next respawn
+        server.engine.warmup()
     # fleet membership (docs/robustness.md "Serving fleet"): join the
     # coordinator directory as serve/<replica_id> publishing the HTTP
     # endpoint, so a `paddle_tpu router` discovers (and fails over)
@@ -458,6 +465,52 @@ def _cmd_serve(args) -> int:
         PROFILER.disable()      # joins the pt-obs-profiler thread
     print(json.dumps({"job": "serve", "status": "stopped",
                       "stats": server.stats()}))
+    return 0
+
+
+def _cmd_artifacts(args) -> int:
+    """`paddle_tpu artifacts build|verify|ls` — operate the warm-start
+    store offline: a deploy pipeline builds artifacts ONCE, verifies
+    them, and every replica of the rollout then cold-starts
+    zero-compile from them (docs/robustness.md)."""
+    from paddle_tpu.artifacts import ArtifactStore, configure
+    from paddle_tpu.artifacts.runtime import ENV_STORE
+    root = args.dir or os.environ.get(ENV_STORE)
+    if not root:
+        raise SystemExit("need --dir (or $PADDLE_TPU_ARTIFACTS)")
+    if args.event_log:
+        from paddle_tpu.obs.events import JOURNAL
+        JOURNAL.configure(args.event_log)
+    store = ArtifactStore(root)
+    if args.action == "ls":
+        rows = store.entries()
+        print(json.dumps({"job": "artifacts", "action": "ls",
+                          "dir": store.root, "count": len(rows),
+                          "entries": rows}, indent=2))
+        return 0
+    if args.action == "verify":
+        rows = store.entries()
+        bad = [r for r in rows if not r["ok"]]
+        for r in bad:   # same audit trail as ArtifactStore.verify()
+            from paddle_tpu.obs.events import emit
+            emit("artifacts", "verify_failed", name=r["name"],
+                 path=r["path"], detail=r.get("error"))
+        print(json.dumps({"job": "artifacts", "action": "verify",
+                          "dir": store.root, "checked": len(rows),
+                          "defective": bad}, indent=2))
+        return 1 if bad else 0
+    # build: construct the engine exactly as `paddle_tpu serve` would
+    # and warm it up — resolve() backfills the store with serialized
+    # executables for precisely the serving fingerprints
+    if not args.decode_config:
+        raise SystemExit("artifacts build needs --decode_config")
+    configure(store.root)
+    engine = _build_engine(args)
+    stats = engine.warmup()
+    rows = store.entries()
+    print(json.dumps({"job": "artifacts", "action": "build",
+                      "dir": store.root, "executables": stats,
+                      "entries": rows}, indent=2))
     return 0
 
 
@@ -1175,6 +1228,13 @@ def main(argv=None) -> int:
     tr.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
     tr.add_argument("--seed", type=int, default=0)
+    tr.add_argument("--compile_cache", default=None,
+                    help="persistent XLA compile-cache dir: a "
+                         "relaunched run (auto_resume, elastic "
+                         "replacement) skips recompiling unchanged "
+                         "steps ('0'/'off' disables; default: "
+                         "$PADDLE_TPU_COMPILE_CACHE, else cold — "
+                         "docs/robustness.md 'Warm start')")
     mg = sub.add_parser("merge", help="bundle topology + params into one "
                         "deployable artifact (MergeModel parity)")
     mg.add_argument("--config", required=True,
@@ -1283,6 +1343,19 @@ def main(argv=None) -> int:
                     help="fleet replica id (default: host-port)")
     sv.add_argument("--heartbeat", type=float, default=1.0,
                     help="membership lease heartbeat seconds")
+    sv.add_argument("--compile_cache", default=None,
+                    help="persistent XLA compile-cache dir ('0'/'off' "
+                         "disables; default: $PADDLE_TPU_COMPILE_CACHE, "
+                         "else cold)")
+    sv.add_argument("--artifacts", default=None,
+                    help="AOT executable artifact store dir "
+                         "(docs/robustness.md 'Warm start & artifact "
+                         "integrity'): the decode engine loads "
+                         "fingerprint-verified compiled executables "
+                         "from here at startup — a respawned replica "
+                         "serves with ZERO XLA compiles — and "
+                         "backfills it after a cold build (default: "
+                         "$PADDLE_TPU_ARTIFACTS, else none)")
 
     rt = sub.add_parser("router", help="run the serving-fleet router "
                         "daemon: KV-aware, prefix-affine dispatch over "
@@ -1347,6 +1420,12 @@ def main(argv=None) -> int:
                     help="autoscaler ceiling (scale-up stops here)")
     rt.add_argument("--autopilot_interval", type=float, default=1.0,
                     help="seconds between autopilot control ticks")
+    rt.add_argument("--compile_cache", default=None,
+                    help="persistent XLA compile-cache dir, forwarded "
+                         "to --spawn_cmd replicas so autoscale-up "
+                         "cold starts stay bounded ('0'/'off' "
+                         "disables; default: "
+                         "$PADDLE_TPU_COMPILE_CACHE)")
 
     fl = sub.add_parser("fleet", help="operate a running "
                         "`paddle_tpu router` daemon: SLO-gated "
@@ -1370,6 +1449,40 @@ def main(argv=None) -> int:
     fl.add_argument("--timeout", type=float, default=600.0,
                     help="HTTP timeout for the admin call (a deploy "
                          "waits for every replica to cycle)")
+
+    arts = sub.add_parser("artifacts", help="operate the warm-start "
+                          "artifact store: build AOT decode "
+                          "executables, verify frame integrity, list "
+                          "(docs/robustness.md 'Warm start & "
+                          "artifact integrity')")
+    arts.add_argument("action", choices=["build", "verify", "ls"],
+                      help="build: compile + serialize the decode "
+                           "executables for a --decode_config into "
+                           "--dir, so replica cold starts become "
+                           "zero-compile; verify: re-read every frame "
+                           "(nonzero exit + artifacts/verify_failed "
+                           "journal records on any corrupt/torn "
+                           "file); ls: one JSON row per artifact "
+                           "with age/size/fingerprint")
+    arts.add_argument("--dir", default=None,
+                      help="artifact store directory (default: "
+                           "$PADDLE_TPU_ARTIFACTS)")
+    arts.add_argument("--decode_config", default=None,
+                      help="build: .py script defining `decoder` — "
+                           "the SAME script (and shape flags) the "
+                           "serve replicas run with, or the "
+                           "fingerprints won't match")
+    arts.add_argument("--draft_config", default=None,
+                      help="build: draft decoder script for "
+                           "speculative fleets")
+    arts.add_argument("--spec_k", type=int, default=0)
+    arts.add_argument("--gen_slots", type=int, default=4)
+    arts.add_argument("--gen_page_size", type=int, default=16)
+    arts.add_argument("--prefix_cache", choices=["on", "off"],
+                      default="on")
+    arts.add_argument("--event_log", default=None,
+                      help="append the artifacts journal records to "
+                           "this JSONL file")
 
     sk = sub.add_parser("soak", help="run the million-user soak: "
                         "open-loop CTR + chat load over an in-process "
@@ -1410,6 +1523,10 @@ def main(argv=None) -> int:
                     help="p99 time-to-first-token bound (ms)")
     sk.add_argument("--slo_token_ms", type=float, default=4000.0,
                     help="p99 inter-token latency bound (ms)")
+    sk.add_argument("--compile_cache", default=None,
+                    help="persistent XLA compile-cache dir for the "
+                         "in-process fleet ('0'/'off' disables; "
+                         "default: $PADDLE_TPU_COMPILE_CACHE)")
 
     pf = sub.add_parser("profile", help="on-demand deep profile window: "
                         "N traced steps + per-phase/MFU summary "
@@ -1556,6 +1673,21 @@ def main(argv=None) -> int:
     dg.add_argument("--out", required=True, help="output .dot path")
     args = ap.parse_args(argv)
 
+    if args.command in ("train", "serve", "router", "soak"):
+        # warm-start plane, one seam for every long-lived verb
+        # (docs/robustness.md "Warm start & artifact integrity"):
+        # --compile_cache wins, else $PADDLE_TPU_COMPILE_CACHE, else
+        # cold. Exported so child processes (--spawn_cmd replicas,
+        # subprocess provisioners) inherit the same warm plane.
+        from paddle_tpu.artifacts import cache as _compile_cache
+        if args.compile_cache is not None:
+            d = _compile_cache.enable(args.compile_cache)
+            os.environ[_compile_cache.ENV_VAR] = d if d else "0"
+        else:
+            _compile_cache.ensure_default()
+
+    if args.command == "artifacts":
+        return _cmd_artifacts(args)
     if args.command == "lint":
         return _cmd_lint(args)
     if args.command == "merge":
@@ -1608,6 +1740,11 @@ def main(argv=None) -> int:
             FLIGHT.configure(dump_dir=args.flight_dir)
         install_excepthook()
         _wire_perf_obs(args)
+        if args.artifacts:
+            from paddle_tpu.artifacts import configure
+            from paddle_tpu.artifacts.runtime import ENV_STORE
+            configure(args.artifacts)
+            os.environ[ENV_STORE] = args.artifacts
         return _cmd_serve(args)
     if args.command == "version":
         import paddle_tpu

@@ -44,6 +44,7 @@ and router RPC happens OUTSIDE it.
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import threading
 import time
@@ -123,6 +124,20 @@ class SubprocessProvisioner(ReplicaProvisioner):
                  start_timeout: float = 120.0,
                  stop_timeout: float = 30.0):
         self.argv = list(argv)
+        # Warm-start plane: when the fleet operator points an explicit
+        # env dict at a compile cache / artifact store, every spawned
+        # replica inherits it — an autoscale-up or crash respawn then
+        # cold-starts from artifacts instead of the XLA compiler
+        # (paddle_tpu/artifacts). A None env (inherit the parent's
+        # environment wholesale) already forwards both vars; chaos
+        # tests that need COLD children pass an env that omits them.
+        if env is not None:
+            from paddle_tpu.artifacts import cache as _ccache
+            from paddle_tpu.artifacts.runtime import ENV_STORE
+            env = dict(env)
+            for var in (_ccache.ENV_VAR, ENV_STORE):
+                if var not in env and os.environ.get(var):
+                    env[var] = os.environ[var]
         self.env = env
         self.cwd = cwd
         self.start_timeout = float(start_timeout)
@@ -564,6 +579,7 @@ class RollingDeploy:
                  settle_timeout: float = 60.0,
                  drain_timeout: Optional[float] = None,
                  poll: float = 0.05,
+                 max_compiles: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.router = router
         self.restart = restart
@@ -574,9 +590,31 @@ class RollingDeploy:
         self.settle_timeout = float(settle_timeout)
         self.drain_timeout = drain_timeout
         self.poll = float(poll)
+        # fleet-scope R2 budget (ptlint): XLA compiles observed IN THIS
+        # PROCESS across the whole rollout. A warm artifact plane makes
+        # it literally 0 for in-process restart callables; subprocess
+        # replicas compile in their own process and are kept warm by
+        # SubprocessProvisioner's env forwarding instead. None = report
+        # but don't judge.
+        self.max_compiles = max_compiles
         self._clock = clock
 
     def run(self, replica_ids: Optional[List[str]] = None) -> dict:
+        from paddle_tpu.analysis.sanitizer import compile_watch
+        with compile_watch() as cw:
+            out = self._run(replica_ids)
+        out["rollout_compiles"] = cw.total
+        if self.max_compiles is not None and \
+                cw.total > self.max_compiles:
+            out["compile_budget_ok"] = False
+            journal_emit("autopilot", "deploy_compile_budget_breach",
+                         compiles=cw.total, budget=self.max_compiles,
+                         per_function=dict(cw.per_function))
+        elif self.max_compiles is not None:
+            out["compile_budget_ok"] = True
+        return out
+
+    def _run(self, replica_ids: Optional[List[str]] = None) -> dict:
         t0 = self._clock()
         base_breaches = self.watchdog.breaches
         if replica_ids is None:

@@ -449,14 +449,15 @@ class TransformerDecoder:
               num_pages: int, max_pages_per_slot: int,
               temperature: Optional[float] = None,
               window: int = 1,
-              attention: str = "auto") -> "PagedDecoder":
+              attention: str = "auto",
+              warm_start: bool = True) -> "PagedDecoder":
         """A fixed-shape paged-KV decode step over this decoder's
         parameter table (the serving engine's hot path)."""
         return PagedDecoder(self, num_slots=num_slots,
                             page_size=page_size, num_pages=num_pages,
                             max_pages_per_slot=max_pages_per_slot,
                             temperature=temperature, window=window,
-                            attention=attention)
+                            attention=attention, warm_start=warm_start)
 
     def generate(self, prompt, max_len: int,
                  temperature: Optional[float] = None,
@@ -534,7 +535,8 @@ class PagedDecoder:
                  page_size: int, num_pages: int,
                  max_pages_per_slot: int,
                  temperature: Optional[float] = None,
-                 window: int = 1, attention: str = "auto"):
+                 window: int = 1, attention: str = "auto",
+                 warm_start: bool = True):
         assert num_pages >= 2, "need at least the null page + one real"
         assert max_pages_per_slot * page_size <= \
             dense.p[f"_{dense.name}_pos_emb.w0"].shape[0], (
@@ -576,6 +578,32 @@ class PagedDecoder:
         self._step = jax.jit(self._step_impl, donate_argnums=donate)
         self._copy = jax.jit(self._copy_page_impl,
                              donate_argnums=() if not donate else (0, 1))
+        # warm-start plane (paddle_tpu/artifacts): both jitted
+        # functions resolve through the executable ladder on first
+        # dispatch — an artifact hit (in-process or on-disk) makes the
+        # engine's startup zero-compile. Fingerprints capture every
+        # knob that changes the compiled program.
+        self.warm_start = bool(warm_start)
+        from paddle_tpu.artifacts import fingerprint
+        plan = {"num_slots": self.num_slots,
+                "page_size": self.page_size,
+                "num_pages": self.num_pages,
+                "max_pages_per_slot": self.max_pages_per_slot,
+                "window": self.window,
+                "temperature": self.temperature,
+                "use_kernel": self.use_kernel,
+                "kernel_interpret": self.kernel_interpret}
+        self._step_fp = fingerprint("paged_step", dense.p, plan=plan)
+        self._copy_fp = fingerprint(
+            "paged_copy", dense.p,
+            plan={"num_pages": self.num_pages,
+                  "page_size": self.page_size,
+                  "n_layers": dense.n_layers,
+                  "kv_heads": self.kv_heads,
+                  "head_dim": self.head_dim,
+                  "dtype": str(jnp.dtype(self.dtype))})
+        self._step_exe = None
+        self._copy_exe = None
 
     def init_pools(self):
         """Zeroed (k_pool, v_pool), each [L, n_pages, page_size, g, dh]."""
@@ -663,8 +691,12 @@ class PagedDecoder:
 
     def copy_page(self, k_pool, v_pool, src: int, dst: int):
         """Copy physical page ``src`` -> ``dst`` in both pools."""
-        return self._copy(k_pool, v_pool, jnp.int32(src),
-                          jnp.int32(dst))
+        args = (k_pool, v_pool, jnp.int32(src), jnp.int32(dst))
+        if self._copy_exe is None:
+            from paddle_tpu.artifacts import resolve
+            self._copy_exe = resolve(self._copy_fp, self._copy, args,
+                                     warm=self.warm_start)
+        return self._copy_exe(*args)
 
     def step(self, k_pool, v_pool, tokens, positions, page_tables,
              active, key=None):
@@ -683,11 +715,15 @@ class PagedDecoder:
             tokens = tokens[:, None]
             positions = jnp.asarray(positions, jnp.int32)[:, None]
             active = jnp.asarray(active, jnp.bool_)[:, None]
-        nxt, k_pool, v_pool = self._step(
-            self.dense.p, k_pool, v_pool, tokens,
-            jnp.asarray(positions, jnp.int32),
-            jnp.asarray(page_tables, jnp.int32),
-            jnp.asarray(active, jnp.bool_), key)
+        args = (self.dense.p, k_pool, v_pool, tokens,
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(page_tables, jnp.int32),
+                jnp.asarray(active, jnp.bool_), key)
+        if self._step_exe is None:
+            from paddle_tpu.artifacts import resolve
+            self._step_exe = resolve(self._step_fp, self._step, args,
+                                     warm=self.warm_start)
+        nxt, k_pool, v_pool = self._step_exe(*args)
         if squeeze:
             nxt = nxt[:, 0]
         return nxt, k_pool, v_pool
@@ -714,7 +750,8 @@ class DraftDecoder:
     compiles under churn, same contract as the target step."""
 
     def __init__(self, dense: TransformerDecoder, *, num_slots: int,
-                 max_seq_len: int, window: int = 1):
+                 max_seq_len: int, window: int = 1,
+                 warm_start: bool = True):
         pos_rows = dense.p[f"_{dense.name}_pos_emb.w0"].shape[0]
         assert max_seq_len <= pos_rows, (max_seq_len, pos_rows)
         self.dense = dense
@@ -728,6 +765,14 @@ class DraftDecoder:
         self.dtype = dense.p[f"_{n}_tok_emb.w0"].dtype
         donate = () if jax.default_backend() == "cpu" else (1, 2)
         self._step = jax.jit(self._step_impl, donate_argnums=donate)
+        self.warm_start = bool(warm_start)
+        from paddle_tpu.artifacts import fingerprint
+        self._step_fp = fingerprint(
+            "draft_step", dense.p,
+            plan={"num_slots": self.num_slots,
+                  "max_seq_len": self.max_seq_len,
+                  "window": self.window})
+        self._step_exe = None
 
     def init_caches(self):
         """Zeroed (k, v), each [L, S, T+1, g, dh] — row T is the null
@@ -776,7 +821,12 @@ class DraftDecoder:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), kc, vc
 
     def step(self, kc, vc, tokens, positions, active):
-        return self._step(self.dense.p, kc, vc,
-                          jnp.asarray(tokens, jnp.int32),
-                          jnp.asarray(positions, jnp.int32),
-                          jnp.asarray(active, jnp.bool_))
+        args = (self.dense.p, kc, vc,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(active, jnp.bool_))
+        if self._step_exe is None:
+            from paddle_tpu.artifacts import resolve
+            self._step_exe = resolve(self._step_fp, self._step, args,
+                                     warm=self.warm_start)
+        return self._step_exe(*args)

@@ -314,7 +314,8 @@ class DecodeEngine:
                  clock: Callable[[], float] = time.monotonic,
                  draft=None, spec_k: int = 0,
                  prefix_cache: bool = True,
-                 attention: str = "auto"):
+                 attention: str = "auto",
+                 warm_start: bool = True):
         pos_rows = decoder.p[f"_{decoder.name}_pos_emb.w0"].shape[0]
         if max_seq_len is None:
             max_seq_len = pos_rows
@@ -332,11 +333,13 @@ class DecodeEngine:
         pages_per_slot = -(-self.max_seq_len // self.page_size)
         if num_pages is None:
             num_pages = self.num_slots * pages_per_slot + 1
+        self.warm_start = bool(warm_start)
         self.paged = decoder.paged(
             num_slots=self.num_slots, page_size=self.page_size,
             num_pages=int(num_pages),
             max_pages_per_slot=pages_per_slot, temperature=temperature,
-            window=self.window, attention=attention)
+            window=self.window, attention=attention,
+            warm_start=self.warm_start)
         self.pool = PagePool(int(num_pages))
         self.k_pool, self.v_pool = self.paged.init_pools()
         self.prefix: Optional[PrefixIndex] = (
@@ -347,7 +350,8 @@ class DecodeEngine:
             from paddle_tpu.models.decode import DraftDecoder
             self.draft = DraftDecoder(
                 draft, num_slots=self.num_slots,
-                max_seq_len=self.max_seq_len, window=self.window)
+                max_seq_len=self.max_seq_len, window=self.window,
+                warm_start=self.warm_start)
             self._draft_kc, self._draft_vc = self.draft.init_caches()
         self.max_waiting = int(max_waiting)
         self.temperature = temperature
@@ -1039,6 +1043,31 @@ class DecodeEngine:
                     f"({self.stats()})")
 
     # ------------------------------------------------------------ lifecycle
+    def warmup(self) -> dict:
+        """Resolve the decode executables NOW, before any request is
+        admitted — the warm-start plane's engine hook (docs/
+        robustness.md "Warm start & artifact integrity").
+
+        Dispatches one all-inactive step through the target (and
+        draft, when speculating): inactive slots write only the
+        reserved null page / null row, so pools are semantically
+        untouched, and the dispatch shapes are exactly the serving
+        shapes — the executable resolved here IS the one every later
+        step reuses. With a warm artifact store the whole call is
+        zero-compile (deserialized executables trace nothing); cold,
+        it pays the compile up front and backfills the store, so
+        first-token latency never pays it. Returns resolver stats."""
+        from paddle_tpu.artifacts import EXECUTABLES
+        S, W = self.num_slots, self.window
+        z = np.zeros((S, W), np.int32)
+        inactive = np.zeros((S, W), np.bool_)
+        _, self.k_pool, self.v_pool = self.paged.step(
+            self.k_pool, self.v_pool, z, z, self._tables, inactive)
+        if self.draft is not None:
+            _, self._draft_kc, self._draft_vc = self.draft.step(
+                self._draft_kc, self._draft_vc, z, z, inactive)
+        return dict(EXECUTABLES.stats(), warm_start=self.warm_start)
+
     def start(self) -> "DecodeEngine":
         with self._cv:
             if self._thread is not None:

@@ -1266,6 +1266,125 @@ class FaultPlan:
         finally:
             registry.coordinator = real
 
+    # -------------------------------------------- (r) warm-start artifacts
+    @staticmethod
+    @contextlib.contextmanager
+    def corrupt_artifact(store, name: Optional[str] = None,
+                         mode: str = "payload"):
+        """Damage one on-disk artifact — the torn-write / bit-rot /
+        partial-copy fault the warm-start plane must DETECT and
+        degrade past, never crash on (docs/robustness.md "Warm start
+        & artifact integrity"). ``name`` picks the artifact (default:
+        the newest); ``mode``:
+
+        - ``payload``: flip one payload byte (crc catches it),
+        - ``torn``: truncate mid-payload (a writer died without the
+          atomic rename discipline — or the volume did),
+        - ``magic``: clobber the frame magic (not an artifact at all).
+
+        The contract under this fault: ``store.get`` returns None,
+        counts a fallback, journals ``artifacts/fallback`` with
+        ``reason="corrupt"`` — and the caller serves via JIT,
+        token-identically. Yields ``{"path", "mode"}``; the original
+        bytes are restored on exit."""
+        paths = [r["path"] for r in store.entries()
+                 if name is None or r["name"] == f"{name}.ptaf"]
+        if name is None and paths:
+            paths = [max(paths, key=os.path.getmtime)]
+        if not paths:
+            raise ValueError(f"no artifact to corrupt "
+                             f"(name={name!r}) in {store.root}")
+        path = paths[0]
+        with open(path, "rb") as f:
+            original = f.read()
+        if mode == "payload":
+            blob = original[:-5] + bytes([original[-5] ^ 0xFF]) + \
+                original[-4:]
+        elif mode == "torn":
+            blob = original[:max(9, len(original) // 2)]
+        elif mode == "magic":
+            blob = b"XXXX" + original[4:]
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+        with open(path, "wb") as f:
+            f.write(blob)
+        try:
+            yield {"path": path, "mode": mode}
+        finally:
+            with open(path, "wb") as f:
+                f.write(original)
+
+    @staticmethod
+    @contextlib.contextmanager
+    def stale_fingerprint(store, name: Optional[str] = None):
+        """Rewrite one artifact as an INTERNALLY-CONSISTENT frame
+        built for a different environment — the stale-artifact fault
+        (the store survived a jax upgrade / model change; every byte
+        is intact, the executable is just for the wrong world). The
+        frame passes magic/crc/digest re-derivation, so only the
+        fingerprint comparison can catch it: ``store.get`` must
+        return None with ``reason="stale"`` in the
+        ``artifacts/fallback`` journal record. Yields ``{"path",
+        "doctored_digest"}``; restored on exit."""
+        import json as _json
+        import struct as _struct
+        import zlib as _zlib
+
+        from paddle_tpu.artifacts.fingerprint import Fingerprint
+        from paddle_tpu.artifacts.store import MAGIC
+
+        paths = [r["path"] for r in store.entries()
+                 if name is None or r["name"] == f"{name}.ptaf"]
+        if name is None and paths:
+            paths = [max(paths, key=os.path.getmtime)]
+        if not paths:
+            raise ValueError(f"no artifact to doctor "
+                             f"(name={name!r}) in {store.root}")
+        path = paths[0]
+        with open(path, "rb") as f:
+            original = f.read()
+        (hlen,) = _struct.unpack("<I", original[4:8])
+        header = _json.loads(original[8:8 + hlen])
+        payload = original[8 + hlen:]
+        fields = dict(header["fingerprint"])
+        env = dict(fields.get("env") or {})
+        env["jax"] = "0.0.0-doctored"
+        fields["env"] = env
+        doctored = Fingerprint(fields)
+        header["fingerprint"] = doctored.fields
+        header["digest"] = doctored.digest
+        hbytes = _json.dumps(header, sort_keys=True).encode()
+        blob = MAGIC + _struct.pack("<I", len(hbytes)) + hbytes + \
+            payload
+        assert _zlib.crc32(payload) & 0xFFFFFFFF == \
+            header["payload_crc"]
+        with open(path, "wb") as f:
+            f.write(blob)
+        try:
+            yield {"path": path, "doctored_digest": doctored.digest}
+        finally:
+            with open(path, "wb") as f:
+                f.write(original)
+
+    @staticmethod
+    def cache_race(store, name: str, fp, payloads, threads: int = 8,
+                   timeout: float = 60.0) -> dict:
+        """N writers publish the SAME artifact name concurrently — the
+        fleet-cold-start thundering herd (every replica of a fresh
+        rollout finishes its build at once and races to backfill).
+        The atomic tmp+rename discipline must leave exactly one
+        COMPLETE frame under the final name — readers never observe a
+        partial file — and no writer may raise. Returns ``{"writes",
+        "errors", "winner"}`` where ``winner`` is the surviving
+        frame's inspect() row (``winner["ok"]`` is the assertion)."""
+        results, errors = FaultPlan.burst(
+            lambda i: store.put(name, fp, payloads[i % len(payloads)],
+                                meta={"writer": i}),
+            len(payloads), threads=threads, timeout=timeout)
+        return {"writes": sum(1 for r in results if r is not None),
+                "errors": [e for e in errors if e is not None],
+                "winner": store.inspect(store.path(name))}
+
     @staticmethod
     def bursty_trace(seed: int = 0, ticks: int = 30, base: int = 1,
                      peak: int = 12, burst_start: int = 8,

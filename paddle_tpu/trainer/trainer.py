@@ -844,6 +844,12 @@ class SGD:
         # one run_id for the whole run (generated here if the CLI set
         # none): every span/journal record the run emits carries it
         obs_context.ensure_run_id()
+        # warm start: a relaunched (auto_resume / elastic-replacement)
+        # trainer re-pays the step compile unless the operator pointed
+        # PADDLE_TPU_COMPILE_CACHE at a persistent cache — opt-in, so
+        # chaos tests that time cold starts stay cold
+        from paddle_tpu.artifacts import cache as _compile_cache
+        _compile_cache.ensure_default()
         feeder = DataFeeder(self.topology.data_type(), feeding)
         if checkpoint_manager is None and checkpoint_dir:
             from paddle_tpu.trainer.checkpoint import CheckpointManager

@@ -19,12 +19,25 @@ _FMT = "[%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s"
 _initialized = False
 
 
+class _StderrHandler(logging.StreamHandler):
+    """Resolves ``sys.stderr`` at EMIT time, not construction: glog
+    writes to whatever stderr currently is, so stderr redirection (and
+    pytest's capture) works no matter which module logged first."""
+
+    def __init__(self):
+        logging.Handler.__init__(self)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+
 def initialize_logging(level: int = logging.INFO) -> logging.Logger:
     """initializeLogging parity: root logger with the glog line format."""
     global _initialized
     logger = logging.getLogger("paddle_tpu")
     if not _initialized:
-        handler = logging.StreamHandler(sys.stderr)
+        handler = _StderrHandler()
         handler.setFormatter(logging.Formatter(_FMT, "%m%d %H:%M:%S"))
         logger.addHandler(handler)
         logger.propagate = False

@@ -10,7 +10,6 @@ as long as no computation ran yet).
 """
 
 import os
-import tempfile
 
 if os.environ.get("PADDLE_TPU_SMOKE"):
     # real-hardware lane (tests/test_tpu_smoke.py): keep the default
@@ -37,13 +36,12 @@ else:
     # chaos tests time their kills against a worker subprocess's
     # compile-dominated startup, so spawned workers must stay cold.
     # PADDLE_TPU_COMPILE_CACHE=0 disables; any other value overrides
-    # the directory.
-    _cache_dir = os.environ.get("PADDLE_TPU_COMPILE_CACHE") or \
-        os.path.join(tempfile.gettempdir(), "paddle_tpu_xla_cache")
-    if _cache_dir != "0":
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          0.05)
+    # the directory. The knobs live in paddle_tpu/artifacts/cache.py
+    # (the productionized seam — train/serve/router/soak wire the
+    # same grammar via --compile_cache).
+    from paddle_tpu.artifacts import cache as _compile_cache
+
+    _compile_cache.enable_from_env()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -185,9 +183,13 @@ def _drop_xla_executables():
     later native allocations — thread-stack guard pages included —
     into segfaults deep in XLA or pthread_create. Clearing per module
     is nearly free: the persistent disk compile cache above dedupes
-    the recompiles, so only re-tracing is paid."""
+    the recompiles, so only re-tracing is paid. The warm-start
+    plane's in-process executable cache pins loaded executables the
+    same way, so it drops with them."""
     yield
     import gc
+    from paddle_tpu.artifacts import EXECUTABLES
+    EXECUTABLES.clear()
     jax.clear_caches()
     gc.collect()
 

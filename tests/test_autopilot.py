@@ -435,6 +435,84 @@ class TestRollingDeploy:
                 r.stop()
 
 
+    def test_warm_respawn_rollout_zero_compiles(self, decoder):
+        """Warm-start plane × rolling deploy (ISSUE 18): once the
+        fleet has served a single request, the decode executable
+        lives in the process-global cache — so a FULL rolling restart
+        resolves every respawned engine warm, the deploy's own
+        ``max_compiles=0`` budget gate passes, and post-rollout
+        traffic is token-identical. This is the
+        ``fleet_deploy.rollout_compiles == 0`` bench contract."""
+        from paddle_tpu.analysis.sanitizer import compile_watch
+        reps, router = self._fleet(decoder)
+        try:
+            # prime: one request pays the only compile of the test
+            want = router.generate([1, 2, 3, 4], 6).tokens
+
+            def restart(rid):
+                reps[rid].stop()
+                reps[rid] = Replica(rid, decoder)
+                return {"endpoint": reps[rid].endpoint}
+
+            roll = RollingDeploy(router, restart,
+                                 watchdog=FakeWatchdog(),
+                                 settle_timeout=30.0, max_compiles=0)
+            with compile_watch() as cw:
+                out = roll.run()
+                # traffic lands on respawned replicas, still warm
+                got = router.generate([1, 2, 3, 4], 6).tokens
+            assert out["status"] == "complete", out
+            assert out["rollout_compiles"] == 0, out
+            assert out["compile_budget_ok"] is True
+            step_compiles = {k: v for k, v in cw.per_function.items()
+                             if "_step_impl" in k}
+            assert step_compiles == {}, step_compiles
+            assert got == want
+        finally:
+            router.shutdown(drain=True, timeout=10)
+            for r in reps.values():
+                r.stop()
+
+    def test_compile_budget_breach_is_journaled(self, decoder):
+        """The inverse gate: a rollout that DOES compile (cold
+        executables dropped mid-deploy) reports the breach and
+        journals it with per-function evidence instead of passing
+        silently."""
+        from paddle_tpu import artifacts as A
+        reps, router = self._fleet(decoder)
+        try:
+            def restart(rid):
+                reps[rid].stop()
+                # simulate a cold respawn: the warm rung is emptied,
+                # so the new replica's first decode must re-compile
+                A.EXECUTABLES.clear()
+                jax.clear_caches()
+                reps[rid] = Replica(rid, decoder)
+                r = urllib.request.urlopen(
+                    reps[rid].endpoint + "/generate",
+                    json.dumps({"prompt": [1, 2, 3], "max_new_tokens":
+                                2}).encode(), timeout=60)
+                assert r.status == 200
+                return {"endpoint": reps[rid].endpoint}
+
+            seq0 = JOURNAL.last_seq
+            out = RollingDeploy(router, restart,
+                                watchdog=FakeWatchdog(),
+                                settle_timeout=30.0,
+                                max_compiles=0).run(["r0"])
+            assert out["status"] == "complete"
+            assert out["rollout_compiles"] > 0
+            assert out["compile_budget_ok"] is False
+            breach = _journal_since(
+                seq0, kind="deploy_compile_budget_breach")
+            assert breach and breach[-1]["budget"] == 0
+            assert breach[-1]["per_function"]
+        finally:
+            router.shutdown(drain=True, timeout=10)
+            for r in reps.values():
+                r.stop()
+
+
 class TestAdminQuit:
     def test_quit_endpoint_wires_hook_and_501s_without(self, decoder):
         r = Replica("rq", decoder)      # built WITHOUT on_quit

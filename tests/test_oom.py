@@ -35,13 +35,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.fixture(scope="module", autouse=True)
 def _no_persistent_compile_cache():
     """OOM injection races the allocator against executables that the
-    persistent compile cache (tests/conftest.py) would deserialize from
-    disk; keep this module on freshly-compiled executables."""
-    import jax
-    prev = jax.config.jax_enable_compilation_cache
-    jax.config.update("jax_enable_compilation_cache", False)
-    yield
-    jax.config.update("jax_enable_compilation_cache", prev)
+    persistent compile cache (paddle_tpu/artifacts/cache.py, enabled
+    by tests/conftest.py) would deserialize from disk; keep this
+    module on freshly-compiled executables."""
+    from paddle_tpu.artifacts import cache as compile_cache
+    with compile_cache.disabled():
+        yield
 
 
 def _trainer(lr=0.05):
