@@ -574,7 +574,8 @@ def bench_moe_lm(batch: int = 8, seq_len: int = 1024, d_model: int = 512,
 SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine",
               "decode_prefix_hit", "decode_speculative",
               "flight_recorder_overhead", "profiler_overhead",
-              "lockdep_overhead", "coord_reshard", "embed_lookup",
+              "lockdep_overhead", "protocol_witness_overhead",
+              "contract_check", "coord_reshard", "embed_lookup",
               "embed_update", "fleet_route", "fleet_failover",
               "cold_start_to_first_token", "fleet_deploy",
               "fleet_autoscale", "router_ha", "soak_smoke")
@@ -908,6 +909,60 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
             "ops_per_s_raw": round(raw, 0),
             "ops_per_s_instrumented": round(inst, 0),
             "overhead_ratio": round(raw / inst, 3),
+        }
+    if "protocol_witness_overhead" in rows:
+        # the protocol witness's cost (obs/protocol.py): a start/settle
+        # emit pair into a bare journal vs one with the witness
+        # observing — the witness rides the SAME observer seam in
+        # production (obs/__init__.py), so this ratio bounds what ptproto
+        # adds to every journaled protocol event. Medians of alternating
+        # reps, ratio gated like lockdep_overhead.
+        from paddle_tpu.obs.events import EventJournal
+        from paddle_tpu.obs.protocol import ProtocolWitness
+        n_pairs = 4000
+
+        def _pairs_per_s(j, n=n_pairs):
+            t0 = time.perf_counter()
+            for i in range(n):
+                t = f"bench-{i}"
+                j.emit("serving", "hop", trace_id=t, phase="start")
+                j.emit("serving", "hop", trace_id=t, phase="settle")
+            return n / (time.perf_counter() - t0)
+
+        bare_j = EventJournal()
+        wit_j = EventJournal()
+        witness = ProtocolWitness()
+        wit_j.add_observer(witness.observe_journal)
+        _pairs_per_s(bare_j, 500)               # warm both paths
+        _pairs_per_s(wit_j, 500)
+        bares, wits = [], []
+        for _ in range(5):
+            bares.append(_pairs_per_s(bare_j))
+            wits.append(_pairs_per_s(wit_j))
+        bare = sorted(bares)[len(bares) // 2]
+        wit = sorted(wits)[len(wits) // 2]
+        out["protocol_witness_overhead"] = {
+            "pairs_per_s_bare": round(bare, 0),
+            "pairs_per_s_witnessed": round(wit, 0),
+            "overhead_ratio": round(bare / wit, 3),
+            "violations": witness.violation_count,   # must stay 0
+        }
+    if "contract_check" in rows:
+        # wall time of the full-repo R11/R12/R13 contract sweep (the
+        # `paddle_tpu lint --contracts` view) — info only: it tracks
+        # catalog growth, nothing latency-critical rides on it
+        import os as _os
+
+        from paddle_tpu.analysis.runner import (_contracts_view,
+                                                load_config)
+        t0 = time.perf_counter()
+        res = _contracts_view(
+            load_config(_os.path.dirname(_os.path.abspath(__file__))),
+            use_baseline=True)
+        out["contract_check"] = {
+            "wall_ms": round((time.perf_counter() - t0) * 1000.0, 1),
+            "files": res.files,
+            "findings": len(res.new),
         }
     if "coord_reshard" in rows:
         # elastic-membership control-plane latency: time from a

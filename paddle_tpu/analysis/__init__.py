@@ -29,7 +29,8 @@ from paddle_tpu.analysis.sanitizer import (CompileBudgetExceeded,  # noqa: F401
                                            CompileWatch, compile_watch,
                                            find_tracers, no_leaked_tracers)
 
-# importing the rule modules registers R1..R7 + the lock-discipline
-# rules R8..R10 with the registry
+# importing the rule modules registers R1..R7, the lock-discipline
+# rules R8..R10 and the contract rules R11..R13 with the registry
 import paddle_tpu.analysis.rules  # noqa: F401,E402  isort:skip
 import paddle_tpu.analysis.lockrules  # noqa: F401,E402  isort:skip
+import paddle_tpu.analysis.contractrules  # noqa: F401,E402  isort:skip

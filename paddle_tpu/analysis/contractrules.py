@@ -1,0 +1,650 @@
+"""ptproto static half — R11/R12/R13 contract rules
+(docs/static_analysis.md "Event & protocol contracts").
+
+The single source of truth is :mod:`paddle_tpu.obs.catalog`; these
+rules hold the code (and the docs) to it:
+
+* **R11 journal-contract** — every literal ``emit("domain", "kind",
+  ...)`` site must name a catalogued (domain, kind), pass every
+  required field, and pass no undeclared field.  Catalog entries with
+  zero literal emit sites are reported stale (``stale = true`` in the
+  rule options — the full-repo run; unit fixtures leave it off).
+* **R12 metric-contract** — every registered ``paddle_tpu_*``
+  counter/gauge/histogram/SampleFamily (and every f-string
+  registration prefix) must match the catalog's name/type/labels, the
+  catalog must not declare families nobody registers, and the
+  ``docs/observability.md`` tables must agree with the catalog in
+  BOTH directions.  Cross-file, via ``finalize()`` like R8.
+* **R13 protocol-emission-paths** — in a function that emits a
+  ``check_paths`` protocol's START event, every exit path — returns,
+  raises, fall-through, and the unhandled-exception edge out of
+  ``try`` blocks whose handlers are typed — must reach one of the
+  protocol's declared terminals (a terminal anywhere in a ``finally``
+  covers every path through it) or hand the key to a declared
+  continuation (``handoffs`` option).  This catches the "hop started
+  but never settled" class statically, before the runtime witness
+  ever sees it.
+
+Emit-site recognition (R11/R13): a call whose (alias-canonicalized)
+name ends in ``emit`` with two leading literal-str args — that covers
+``emit(...)``, ``journal_emit(...)``, ``JOURNAL.emit(...)``,
+``j.emit(...)`` — plus the wrapper names in the ``wrappers`` option
+(``{"_emit_coord": "coordinator", ...}``: literal first arg is the
+kind, the wrapper pins the domain).  Sites passing ``**fields`` skip
+the field checks (the catalog still vets the (domain, kind)).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from paddle_tpu.analysis.core import (Finding, FileContext, Rule,
+                                      register_rule)
+from paddle_tpu.analysis.rules import _Names
+from paddle_tpu.obs.catalog import (JOURNALS, METRIC_PREFIXES, METRICS,
+                                    PROTOCOLS, Protocol)
+
+__all__ = ["JournalContractRule", "MetricContractRule",
+           "ProtocolPathsRule"]
+
+CATALOG_PATH = "paddle_tpu/obs/catalog.py"
+
+#: wrapper call names -> pinned domain (first literal arg = kind)
+DEFAULT_WRAPPERS = {"_emit_coord": "coordinator",
+                    "_emit_embed": "embed"}
+
+
+def _literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _emit_site(call: ast.Call, names: _Names,
+               wrappers: Dict[str, str]
+               ) -> Optional[Tuple[str, str, Optional[List[str]]]]:
+    """(domain, kind, literal-kwarg-names | None-for-**) when ``call``
+    is a recognizable literal journal-emit site, else None."""
+    canon = names.canon(call.func)
+    tail = canon.rsplit(".", 1)[-1] if canon else None
+    domain = kind = None
+    if tail == "emit" and len(call.args) >= 2:
+        domain = _literal_str(call.args[0])
+        kind = _literal_str(call.args[1])
+        if domain is None or kind is None:
+            return None
+    elif tail in wrappers and call.args:
+        kind = _literal_str(call.args[0])
+        if kind is None:
+            return None
+        domain = wrappers[tail]
+    else:
+        return None
+    fields: Optional[List[str]] = []
+    for kw in call.keywords:
+        if kw.arg is None:          # **fields — not statically known
+            fields = None
+            break
+        fields.append(kw.arg)
+    return domain, kind, fields
+
+
+def _scoped(rule: Rule, ctx: FileContext,
+            default=("paddle_tpu",)) -> bool:
+    paths = rule.options.get("paths", list(default))
+    return any(ctx.path.startswith(p.rstrip("/") + "/") or
+               ctx.path == p for p in paths)
+
+
+def _catalog_line(needle: str) -> Tuple[int, str]:
+    """(line, stripped source) of the first catalog line containing
+    ``needle`` — anchors stale-entry findings so the baseline can
+    match them."""
+    try:
+        with open(CATALOG_PATH, encoding="utf-8") as f:
+            for i, ln in enumerate(f, 1):
+                if needle in ln:
+                    return i, ln.strip()
+    except OSError:
+        pass
+    return 1, ""
+
+
+# ---------------------------------------------------------------------- R11
+@register_rule
+class JournalContractRule(Rule):
+    id = "R11"
+    name = "journal-contract"
+    description = ("every literal emit() site must match the "
+                   "obs/catalog.py journal contract: known "
+                   "(domain, kind), required fields present, no "
+                   "undeclared fields; stale catalog entries reported")
+
+    def __init__(self, options: Optional[dict] = None):
+        super().__init__(options)
+        self.wrappers = dict(DEFAULT_WRAPPERS)
+        self.wrappers.update(self.options.get("wrappers", {}))
+        self._sites: Dict[Tuple[str, str], int] = {}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not _scoped(self, ctx):
+            return
+        names = _Names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            site = _emit_site(node, names, self.wrappers)
+            if site is None:
+                continue
+            domain, kind, fields = site
+            self._sites[(domain, kind)] = \
+                self._sites.get((domain, kind), 0) + 1
+            decl = JOURNALS.get((domain, kind))
+            if decl is None:
+                yield ctx.finding(
+                    self, node,
+                    f"journal ({domain}/{kind}) is not declared in "
+                    f"{CATALOG_PATH} — add a JournalKind entry or fix "
+                    f"the emit site")
+                continue
+            if fields is None:      # **fields: (domain,kind) vetted only
+                continue
+            missing = [f for f in decl.required if f not in fields]
+            if missing:
+                yield ctx.finding(
+                    self, node,
+                    f"journal ({domain}/{kind}) emit misses required "
+                    f"field(s) {missing} (catalog requires "
+                    f"{list(decl.required)})")
+            legal = set(decl.required) | set(decl.optional)
+            unknown = sorted(f for f in fields if f not in legal)
+            if unknown:
+                yield ctx.finding(
+                    self, node,
+                    f"journal ({domain}/{kind}) emit passes "
+                    f"undeclared field(s) {unknown} — declare them "
+                    f"in {CATALOG_PATH} or drop them")
+
+    def finalize(self) -> Iterable[Finding]:
+        if not self.options.get("stale"):
+            return
+        for (domain, kind), decl in sorted(JOURNALS.items()):
+            if decl.dynamic or self._sites.get((domain, kind)):
+                continue
+            line, src = _catalog_line(f'"{domain}", "{kind}"')
+            yield Finding(
+                self.id, self.name, CATALOG_PATH, line, 1,
+                f"catalog declares journal ({domain}/{kind}) but no "
+                f"literal emit site exists — stale entry (mark "
+                f"dynamic=True if it is emitted via emit_event "
+                f"dispatch)", source=src)
+
+
+# ---------------------------------------------------------------------- R12
+_DOC_TOKEN_RE = re.compile(r"paddle_tpu_[a-z0-9_]+")
+_REG_TAILS = {"counter": "counter", "gauge": "gauge",
+              "histogram": "histogram"}
+
+
+class _MetricReg:
+    __slots__ = ("name", "prefix", "type", "labels", "path", "line",
+                 "source")
+
+    def __init__(self, name, prefix, type_, labels, path, line,
+                 source):
+        self.name = name            # full literal name, or None
+        self.prefix = prefix        # f-string literal head, or None
+        self.type = type_
+        self.labels = labels        # tuple | None when unresolvable
+        self.path = path
+        self.line = line
+        self.source = source
+
+
+@register_rule
+class MetricContractRule(Rule):
+    id = "R12"
+    name = "metric-contract"
+    description = ("every registered paddle_tpu_* metric family must "
+                   "match the obs/catalog.py declaration (name, type, "
+                   "labels) AND the docs/observability.md tables — "
+                   "drift flagged in both directions")
+
+    def __init__(self, options: Optional[dict] = None):
+        super().__init__(options)
+        self._regs: List[_MetricReg] = []
+
+    # -------------------------------------------------------- collection
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not _scoped(self, ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            tail = None
+            if isinstance(node.func, ast.Attribute):
+                tail = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                tail = node.func.id
+            if tail in _REG_TAILS:
+                reg = self._registration(
+                    ctx, node, _REG_TAILS[tail],
+                    labels=self._labelnames(node))
+            elif tail == "SampleFamily":
+                kind = _literal_str(node.args[1]) \
+                    if len(node.args) >= 2 else None
+                reg = self._registration(ctx, node, kind, labels=None)
+            else:
+                continue
+            if reg is not None:
+                self._regs.append(reg)
+        return
+        yield  # pragma: no cover — generator protocol
+
+    @staticmethod
+    def _labelnames(node: ast.Call):
+        """Literal labelnames tuple, () when omitted, None when the
+        expression is not statically resolvable."""
+        expr = None
+        for kw in node.keywords:
+            if kw.arg == "labelnames":
+                expr = kw.value
+        if expr is None and len(node.args) >= 3:
+            expr = node.args[2]
+        if expr is None:
+            return ()
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = []
+            for el in expr.elts:
+                s = _literal_str(el)
+                if s is None:
+                    return None
+                out.append(s)
+            return tuple(out)
+        return None
+
+    def _registration(self, ctx, node, type_, labels):
+        head = node.args[0]
+        name = _literal_str(head)
+        prefix = None
+        if name is None and isinstance(head, ast.JoinedStr) \
+                and head.values:
+            prefix = _literal_str(head.values[0]) if isinstance(
+                head.values[0], ast.Constant) else None
+            if prefix is not None \
+                    and not prefix.startswith("paddle_tpu_"):
+                prefix = None
+        if name is not None and not name.startswith("paddle_tpu_"):
+            return None
+        if name is None and prefix is None:
+            return None
+        line = getattr(node, "lineno", 1)
+        return _MetricReg(name, prefix, type_, labels, ctx.path, line,
+                          ctx.source_line(line))
+
+    # ------------------------------------------------------- cross-check
+    def finalize(self) -> Iterable[Finding]:
+        seen_names = set()
+        for r in self._regs:
+            if r.name is not None:
+                seen_names.add(r.name)
+                yield from self._check_reg(r)
+            elif not any(r.prefix.startswith(p) or p.startswith(r.prefix)
+                         for p in METRIC_PREFIXES):
+                yield Finding(
+                    self.id, self.name, r.path, r.line, 1,
+                    f"metric registration prefix {r.prefix!r} matches "
+                    f"no declared METRIC_PREFIXES entry in "
+                    f"{CATALOG_PATH}", source=r.source)
+        if self.options.get("stale"):
+            for name in sorted(METRICS):
+                if name not in seen_names:
+                    line, src = _catalog_line(f'"{name}"')
+                    yield Finding(
+                        self.id, self.name, CATALOG_PATH, line, 1,
+                        f"catalog declares metric family {name} but "
+                        f"no literal registration site exists — "
+                        f"stale entry", source=src)
+        yield from self._check_docs(seen_names)
+
+    def _check_reg(self, r: _MetricReg) -> Iterable[Finding]:
+        decl = METRICS.get(r.name)
+        if decl is None:
+            if any(r.name.startswith(p) for p in METRIC_PREFIXES):
+                return
+            yield Finding(
+                self.id, self.name, r.path, r.line, 1,
+                f"metric family {r.name} is not declared in "
+                f"{CATALOG_PATH} METRICS (and matches no declared "
+                f"prefix)", source=r.source)
+            return
+        if r.type is not None and r.type != decl.type:
+            yield Finding(
+                self.id, self.name, r.path, r.line, 1,
+                f"metric family {r.name} registered as {r.type} but "
+                f"catalogued as {decl.type}", source=r.source)
+        if r.labels is not None and tuple(r.labels) != decl.labels:
+            yield Finding(
+                self.id, self.name, r.path, r.line, 1,
+                f"metric family {r.name} registered with labels "
+                f"{list(r.labels)} but catalogued with "
+                f"{list(decl.labels)}", source=r.source)
+
+    def _check_docs(self, seen_names) -> Iterable[Finding]:
+        doc_path = self.options.get("doc", "docs/observability.md")
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                doc_lines = f.read().splitlines()
+        except OSError:
+            return                  # no doc to cross-check (unit runs)
+        doc_names: Dict[str, int] = {}
+        doc_prefixes: Dict[str, int] = {}
+        for i, ln in enumerate(doc_lines, 1):
+            for tok in _DOC_TOKEN_RE.findall(ln):
+                if tok.endswith("_"):
+                    doc_prefixes.setdefault(tok, i)
+                else:
+                    doc_names.setdefault(tok, i)
+        # catalog -> docs: every declared family must be documented
+        for name, decl in sorted(METRICS.items()):
+            if name in doc_names or any(
+                    name.startswith(p) for p in doc_prefixes):
+                continue
+            line, src = _catalog_line(f'"{name}"')
+            yield Finding(
+                self.id, self.name, CATALOG_PATH, line, 1,
+                f"metric family {name} is catalogued but absent from "
+                f"{doc_path} — document it (the tables are "
+                f"lint-enforced)", source=src)
+        # docs -> catalog: every documented name must exist
+        legal_prefix = list(METRIC_PREFIXES)
+        for tok, line in sorted(doc_names.items()):
+            if tok in METRICS or any(
+                    tok.startswith(p) for p in legal_prefix):
+                continue
+            yield Finding(
+                self.id, self.name, doc_path, line, 1,
+                f"{doc_path} documents metric {tok} but the catalog "
+                f"declares no such family or prefix — fix the doc or "
+                f"extend {CATALOG_PATH}",
+                source=doc_lines[line - 1].strip())
+        for tok, line in sorted(doc_prefixes.items()):
+            ok = any(tok.startswith(p) or p.startswith(tok)
+                     for p in legal_prefix) or any(
+                n.startswith(tok) for n in METRICS)
+            if not ok:
+                yield Finding(
+                    self.id, self.name, doc_path, line, 1,
+                    f"{doc_path} references metric prefix {tok}* but "
+                    f"no catalogued family or prefix matches it",
+                    source=doc_lines[line - 1].strip())
+
+
+# ---------------------------------------------------------------------- R13
+#: outcome kinds: "fall" (next statement), "exit" (return/raise out),
+#: "continue"/"break" (consumed by the enclosing loop)
+_CLOSED, _OPEN = "closed", "open"
+
+
+@register_rule
+class ProtocolPathsRule(Rule):
+    id = "R13"
+    name = "protocol-emission-paths"
+    description = ("a function emitting a protocol's start event must "
+                   "reach a declared terminal (or a handoff) on EVERY "
+                   "exit path, including the unhandled-exception edge "
+                   "— a terminal anywhere in a finally block covers "
+                   "all paths through it")
+
+    def __init__(self, options: Optional[dict] = None):
+        super().__init__(options)
+        self.wrappers = dict(DEFAULT_WRAPPERS)
+        self.wrappers.update(self.options.get("wrappers", {}))
+        self.handoffs = tuple(self.options.get("handoffs", ()))
+        self._protocols = [p for p in PROTOCOLS.values()
+                           if p.check_paths]
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not _scoped(self, ctx):
+            return
+        names = _Names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for proto, start_node in self._starts(node, names):
+                outcomes = self._analyze(node.body, _CLOSED, names,
+                                         proto)
+                bad = sorted({k for k, st in outcomes
+                              if st == _OPEN and
+                              k in ("fall", "exit")})
+                if bad:
+                    how = " and ".join(
+                        {"fall": "falls off the end",
+                         "exit": "returns/raises (or an unhandled "
+                                 "exception escapes)"}[b]
+                        for b in bad)
+                    yield ctx.finding(
+                        self, start_node,
+                        f"function {node.name}() emits protocol "
+                        f"'{proto.name}' start "
+                        f"({proto.start.domain}/{proto.start.kind}) "
+                        f"but an exit path {how} without a declared "
+                        f"terminal — wrap the tail in try/finally "
+                        f"with a terminal emit, or hand off via "
+                        f"{list(self.handoffs) or 'a handoffs option'}")
+
+    # ------------------------------------------------------- site matching
+    def _starts(self, func, names):
+        """(protocol, call-node) for every start emit directly in this
+        function (nested defs are their own functions)."""
+        out = []
+        for stmt in func.body:
+            for node in self._walk_no_defs(stmt):
+                if isinstance(node, ast.Call):
+                    p = self._match_event(node, names, "start")
+                    if p is not None:
+                        out.append((p, node))
+        return out
+
+    @staticmethod
+    def _walk_no_defs(node):
+        """ast.walk that does not descend into nested function/class
+        bodies (their statements execute on another frame)."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            yield n
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    continue
+                stack.append(child)
+
+    def _match_event(self, call: ast.Call, names: _Names,
+                     role: str) -> Optional[Protocol]:
+        site = _emit_site(call, names, self.wrappers)
+        if site is None:
+            return None
+        domain, kind, fields = site
+        kwvals = {}
+        for kw in call.keywords:
+            if kw.arg is not None:
+                kwvals[kw.arg] = _literal_str(kw.value) \
+                    if isinstance(kw.value, ast.Constant) \
+                    else object()
+        for p in self._protocols:
+            matches = [p.start] if role == "start" else \
+                [t.match for t in p.terminals]
+            for m in matches:
+                if m.domain != domain or m.kind != kind:
+                    continue
+                if all(kwvals.get(k) == v for k, v in m.where):
+                    return p
+        return None
+
+    def _is_terminal_call(self, node, names, proto) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        canon = names.canon(node.func)
+        tail = canon.rsplit(".", 1)[-1] if canon else None
+        if tail in self.handoffs:
+            return True
+        site = _emit_site(node, names, self.wrappers)
+        if site is None:
+            return False
+        domain, kind, _ = site
+        kwvals = {kw.arg: (_literal_str(kw.value)
+                           if isinstance(kw.value, ast.Constant)
+                           else object())
+                  for kw in node.keywords if kw.arg is not None}
+        for t in proto.terminals:
+            m = t.match
+            if m.domain == domain and m.kind == kind and \
+                    all(kwvals.get(k) == v for k, v in m.where):
+                return True
+        return False
+
+    def _subtree_has_terminal(self, node, names, proto) -> bool:
+        return any(self._is_terminal_call(n, names, proto)
+                   for n in self._walk_no_defs(node))
+
+    def _subtree_has_start(self, node, names, proto) -> bool:
+        return any(isinstance(n, ast.Call) and
+                   self._match_event(n, names, "start") is proto
+                   for n in self._walk_no_defs(node))
+
+    # ---------------------------------------------------- path abstraction
+    def _analyze(self, stmts: Sequence[ast.stmt], state: str, names,
+                 proto) -> set:
+        """Abstract-interpret a statement list; returns the set of
+        (outcome, machine-state) pairs reachable from ``state``."""
+        frontier = {state}
+        outcomes = set()
+        for stmt in stmts:
+            if not frontier:
+                break
+            nxt = set()
+            for st in frontier:
+                for k, s2 in self._step(stmt, st, names, proto):
+                    if k == "fall":
+                        nxt.add(s2)
+                    else:
+                        outcomes.add((k, s2))
+            frontier = nxt
+        outcomes.update(("fall", st) for st in frontier)
+        return outcomes
+
+    def _transition(self, stmt, state, names, proto) -> str:
+        if state == _OPEN and \
+                self._subtree_has_terminal(stmt, names, proto):
+            return _CLOSED
+        if self._subtree_has_start(stmt, names, proto):
+            return _OPEN
+        return state
+
+    def _step(self, stmt, state, names, proto) -> set:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return {("fall", state)}
+        if isinstance(stmt, ast.Return):
+            s2 = state
+            if state == _OPEN and stmt.value is not None and \
+                    self._subtree_has_terminal(stmt.value, names,
+                                               proto):
+                s2 = _CLOSED
+            return {("exit", s2)}
+        if isinstance(stmt, ast.Raise):
+            return {("exit", state)}
+        if isinstance(stmt, ast.Continue):
+            return {("continue", state)}
+        if isinstance(stmt, ast.Break):
+            return {("break", state)}
+        if isinstance(stmt, ast.If):
+            r = self._analyze(stmt.body, state, names, proto) | \
+                self._analyze(stmt.orelse, state, names, proto)
+            return {("fall" if k == "fall" else k, st)
+                    for k, st in r}
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._analyze(stmt.body, state, names, proto)
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            return self._loop(stmt, state, names, proto)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, state, names, proto)
+        return {("fall", self._transition(stmt, state, names, proto))}
+
+    def _loop(self, stmt, state, names, proto) -> set:
+        # two passes approximate the back edge: pass 2 re-enters the
+        # body in every state pass 1 could leave an iteration in —
+        # that is how a raise ABOVE the start emit (next iteration)
+        # is seen on an open path
+        r1 = self._analyze(stmt.body, state, names, proto)
+        iter_states = {st for k, st in r1 if k in ("fall", "continue")}
+        r2 = set()
+        for st in iter_states:
+            r2 |= self._analyze(stmt.body, st, names, proto)
+        r = r1 | r2
+        out = {(k, st) for k, st in r if k == "exit"}
+        exit_states = set()
+        infinite = isinstance(stmt, ast.While) and \
+            isinstance(stmt.test, ast.Constant) and bool(
+                stmt.test.value) and not stmt.orelse
+        if not infinite:
+            exit_states.add(state)          # zero iterations
+            exit_states |= {st for k, st in r
+                            if k in ("fall", "continue")}
+        exit_states |= {st for k, st in r if k == "break"}
+        out |= {("fall", st) for st in exit_states}
+        return out
+
+    def _try(self, stmt, state, names, proto) -> set:
+        if stmt.finalbody and any(
+                self._subtree_has_terminal(s, names, proto)
+                for s in stmt.finalbody):
+            # a terminal in finally closes EVERY path through the try
+            r = self._analyze(stmt.body, state, names, proto)
+            for h in stmt.handlers:
+                r |= self._analyze(h.body, state, names, proto)
+            return {(k, _CLOSED) for k, st in r}
+        body_r = self._analyze(stmt.body, state, names, proto)
+        out = set()
+        for k, st in body_r:
+            if k == "fall":
+                if stmt.orelse:
+                    out |= self._analyze(stmt.orelse, st, names,
+                                         proto)
+                else:
+                    out.add(("fall", st))
+            else:
+                out.add((k, st))
+        if any(isinstance(n, ast.Call)
+               for s in stmt.body
+               for n in self._walk_no_defs(s)):
+            # the exception edge: any call may raise, from any state
+            # the body passes through
+            exc_states = {state} | {st for _, st in body_r}
+            broad = self._has_broad_handler(stmt, names)
+            for h in stmt.handlers:
+                for st in exc_states:
+                    out |= self._analyze(h.body, st, names, proto)
+            if not broad:
+                out |= {("exit", st) for st in exc_states}
+        return out
+
+    @staticmethod
+    def _has_broad_handler(stmt: ast.Try, names: _Names) -> bool:
+        for h in stmt.handlers:
+            if h.type is None:
+                return True
+            types = h.type.elts if isinstance(h.type, ast.Tuple) \
+                else [h.type]
+            for t in types:
+                c = names.canon(t)
+                tail = c.rsplit(".", 1)[-1] if c else ""
+                if tail in ("Exception", "BaseException"):
+                    return True
+        return False

@@ -296,6 +296,21 @@ def _lock_graph(cfg: LintConfig, fmt: str = "text") -> str:
     return rule.graph_dot() if fmt == "dot" else rule.graph_text()
 
 
+def _contracts_view(cfg: LintConfig, use_baseline: bool) -> "LintResult":
+    """The `--contracts` view: the R11/R12/R13 contract rules alone,
+    with stale-entry reporting forced ON so catalog entries nobody
+    emits/registers surface as warnings even when pyproject leaves
+    them off (docs/static_analysis.md "Event & protocol contracts")."""
+    cfg.rules = ["R11", "R12", "R13"]
+    for rid in ("R11", "R12"):
+        cfg.rule_options.setdefault(rid, {})["stale"] = True
+    res = lint_paths(cfg, use_baseline=use_baseline)
+    # entries for rules NOT run here are not stale, just out of scope
+    res.stale_baseline = [e for e in res.stale_baseline
+                          if e.get("rule") in set(cfg.rules)]
+    return res
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ptlint",
@@ -322,6 +337,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     choices=["text", "dot"],
                     help="print the global lock-acquisition graph "
                          "discovered by R8 (text or DOT) and exit")
+    ap.add_argument("--contracts", nargs="?", const="text",
+                    choices=["text", "github", "json"],
+                    help="run ONLY the event/metric/protocol contract "
+                         "rules R11-R13, stale catalog entries "
+                         "included, and exit")
     args = ap.parse_args(argv)
 
     try:
@@ -333,6 +353,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.locks:
             print(_lock_graph(cfg, args.locks))
             return 0
+        if args.contracts:
+            res = _contracts_view(cfg,
+                                  use_baseline=not args.no_baseline)
+            print(format_findings(res, args.contracts,
+                                  verbose=args.verbose,
+                                  root=args.root))
+            return 1 if res.new or res.errors else 0
         res = lint_paths(cfg, use_baseline=not args.no_baseline
                          and not args.write_baseline)
     except (ValueError, OSError) as e:

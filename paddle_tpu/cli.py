@@ -869,6 +869,8 @@ def _cmd_lint(args) -> int:
         argv.append("--verbose")
     if getattr(args, "locks", None):
         argv += ["--locks", args.locks]
+    if getattr(args, "contracts", None):
+        argv += ["--contracts", args.contracts]
     return lint_main(argv)
 
 
@@ -964,9 +966,18 @@ def _cmd_events(args) -> int:
 
 
 def _cmd_obs(args) -> int:
-    """`paddle_tpu obs dump|selfcheck` — the flight-recorder verbs
-    (docs/observability.md "Trace context & postmortems")."""
+    """`paddle_tpu obs dump|selfcheck|catalog` — the flight-recorder
+    verbs (docs/observability.md "Trace context & postmortems") plus
+    the declared-contract dump (ptproto)."""
     from paddle_tpu.obs.flight import FLIGHT
+    if args.action == "catalog":
+        # the machine-readable contract: every legal journal
+        # (domain, kind) + fields, metric family, protocol machine and
+        # fault-family mapping — what ptlint R11-R13 and the runtime
+        # witness both enforce
+        from paddle_tpu.obs.catalog import catalog_as_dict
+        print(json.dumps(catalog_as_dict(), indent=2, sort_keys=True))
+        return 0
     if args.action == "dump":
         if args.url:
             # a RUNNING process's bundle over its /flight endpoint
@@ -1575,11 +1586,13 @@ def main(argv=None) -> int:
     ob = sub.add_parser("obs", help="flight-recorder verbs: postmortem "
                         "dump + observability selfcheck "
                         "(docs/observability.md)")
-    ob.add_argument("action", choices=["dump", "selfcheck"],
+    ob.add_argument("action", choices=["dump", "selfcheck", "catalog"],
                     help="dump: write a postmortem bundle (this "
                          "process, or --url for a running one); "
                          "selfcheck: exercise metrics/journal/trace/"
-                         "recorder end-to-end")
+                         "recorder end-to-end; catalog: print the "
+                         "declared journal/metric/protocol contracts "
+                         "as JSON")
     ob.add_argument("--url", default=None,
                     help="dump: base URL of a running process's obs "
                          "endpoint (serving front or train "
@@ -1615,6 +1628,11 @@ def main(argv=None) -> int:
                     choices=["text", "dot"],
                     help="print the global lock-acquisition graph "
                          "discovered by R8 (text or DOT) and exit")
+    ln.add_argument("--contracts", nargs="?", const="text",
+                    choices=["text", "github", "json"],
+                    help="run ONLY the journal/metric/protocol "
+                         "contract rules R11-R13 (stale catalog "
+                         "entries included) and exit")
 
     co = sub.add_parser("coordinator", help="run the elastic-training "
                         "coordinator daemon (go/cmd/master parity)")

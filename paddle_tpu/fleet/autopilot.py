@@ -625,37 +625,54 @@ class RollingDeploy:
         journal_emit("autopilot", "deploy_start",
                      replicas=list(replica_ids), force=self.force)
         steps: List[dict] = []
-        for i, rid in enumerate(replica_ids):
-            breaches = self.watchdog.breaches - base_breaches
-            if breaches > 0 and not self.force:
+        settled = False             # a deploy_done/paused was journaled
+        current = ""
+        try:
+            for i, rid in enumerate(replica_ids):
+                current = rid
+                breaches = self.watchdog.breaches - base_breaches
+                if breaches > 0 and not self.force:
+                    journal_emit("autopilot", "deploy_paused",
+                                 replica=rid, breaches=breaches,
+                                 completed=[s["replica"]
+                                            for s in steps],
+                                 remaining=list(replica_ids[i:]))
+                    settled = True
+                    FLIGHT.record("mark", "autopilot/deploy_paused",
+                                  replica=rid, breaches=breaches)
+                    return {"status": "paused", "reason": "slo_breach",
+                            "breaches": breaches, "steps": steps,
+                            "remaining": list(replica_ids[i:]),
+                            "wall_s": round(self._clock() - t0, 3)}
+                step = self._step(rid)
+                steps.append(step)
+                if not step["ready"] and not self.force:
+                    journal_emit("autopilot", "deploy_paused",
+                                 replica=rid, breaches=0,
+                                 reason="replica_not_ready",
+                                 remaining=list(replica_ids[i + 1:]))
+                    settled = True
+                    return {"status": "paused",
+                            "reason": "replica_not_ready",
+                            "breaches": 0, "steps": steps,
+                            "remaining": list(replica_ids[i + 1:]),
+                            "wall_s": round(self._clock() - t0, 3)}
+            wall = round(self._clock() - t0, 3)
+            journal_emit("autopilot", "deploy_done",
+                         replicas=len(steps), wall_s=wall)
+            settled = True
+            return {"status": "complete", "steps": steps,
+                    "breaches": self.watchdog.breaches - base_breaches,
+                    "wall_s": wall}
+        finally:
+            if not settled:
+                # an exception is unwinding out of a started deploy:
+                # close the autopilot_deploy machine (ptproto) with a
+                # paused record so the journal never shows a deploy
+                # that silently vanished
                 journal_emit("autopilot", "deploy_paused",
-                             replica=rid, breaches=breaches,
-                             completed=[s["replica"] for s in steps],
-                             remaining=list(replica_ids[i:]))
-                FLIGHT.record("mark", "autopilot/deploy_paused",
-                              replica=rid, breaches=breaches)
-                return {"status": "paused", "reason": "slo_breach",
-                        "breaches": breaches, "steps": steps,
-                        "remaining": list(replica_ids[i:]),
-                        "wall_s": round(self._clock() - t0, 3)}
-            step = self._step(rid)
-            steps.append(step)
-            if not step["ready"] and not self.force:
-                journal_emit("autopilot", "deploy_paused",
-                             replica=rid, breaches=0,
-                             reason="replica_not_ready",
-                             remaining=list(replica_ids[i + 1:]))
-                return {"status": "paused",
-                        "reason": "replica_not_ready",
-                        "breaches": 0, "steps": steps,
-                        "remaining": list(replica_ids[i + 1:]),
-                        "wall_s": round(self._clock() - t0, 3)}
-        wall = round(self._clock() - t0, 3)
-        journal_emit("autopilot", "deploy_done",
-                     replicas=len(steps), wall_s=wall)
-        return {"status": "complete", "steps": steps,
-                "breaches": self.watchdog.breaches - base_breaches,
-                "wall_s": wall}
+                             replica=current or "none", breaches=0,
+                             reason="exception", remaining=[])
 
     def _step(self, rid: str) -> dict:
         st = self.router.balancer.get(rid)

@@ -441,6 +441,8 @@ class Router:
         prefix_hits = 0
         accepted = 0
         affinity_hit = False
+        routed = False          # a fleet/route was journaled
+        terminal = False        # a fleet/settle|reject was journaled
         try:
             while True:
                 if deadline_s is not None and self._clock() > deadline_s:
@@ -455,6 +457,7 @@ class Router:
                         journal_emit("fleet", "reject", trace_id=trace_id,
                                      reason="fleet_kv_capacity",
                                      total_tokens=total)
+                        terminal = True
                         raise Rejected(
                             f"request needs {total} positions but no "
                             "replica's KV pool can ever hold it",
@@ -470,6 +473,7 @@ class Router:
                             journal_emit("fleet", "reject",
                                          trace_id=trace_id,
                                          reason="fleet_no_replica")
+                            terminal = True
                             raise Rejected(
                                 "no live replica left to place this "
                                 "request on", retry_after=1.0,
@@ -478,6 +482,7 @@ class Router:
                             self._counters["rejected_queue_full"] += 1
                         journal_emit("fleet", "reject", trace_id=trace_id,
                                      reason="queue_full")
+                        terminal = True
                         raise Rejected(
                             f"fleet KV headroom stayed exhausted for "
                             f"{self.queue_timeout:.1f}s",
@@ -510,6 +515,7 @@ class Router:
                              affinity_pages=depth,
                              prompt_len=len(prompt) + len(tokens),
                              max_new=max_new - len(tokens))
+                routed = True
                 FLIGHT.record("mark", "fleet/route", trace_id=trace_id,
                               replica=rid, hop=hop)
                 try:
@@ -545,6 +551,7 @@ class Router:
                         journal_emit("fleet", "settle",
                                      trace_id=trace_id, replica=rid,
                                      hops=hop, tokens=len(tokens))
+                        terminal = True
                         return FleetResult(tokens, trace_id, hop, chain,
                                            prefix_hits, accepted,
                                            affinity_hit)
@@ -574,6 +581,7 @@ class Router:
                         journal_emit("fleet", "reject",
                                      trace_id=trace_id,
                                      reason="queue_full")
+                        terminal = True
                         raise Rejected(
                             f"replicas kept declining for "
                             f"{self.queue_timeout:.1f}s "
@@ -599,11 +607,24 @@ class Router:
                 journal_emit("fleet", "settle", trace_id=trace_id,
                              replica=rid, hops=hop + 1,
                              tokens=len(tokens))
+                terminal = True
                 return FleetResult(tokens, trace_id, hop + 1, chain,
                                    prefix_hits, accepted, affinity_hit)
         finally:
             with self._cv:
                 self._inflight.pop(trace_id, None)
+            if routed and not terminal:
+                # an Expired deadline, max-hops ServingError, or an
+                # unexpected error is unwinding out of a ROUTED
+                # request: terminate the fleet_request machine
+                # (ptproto) so a routed trace with no terminal record
+                # can only mean a lost process
+                with self._cv:
+                    self._counters["rejected_router_error"] = \
+                        self._counters.get("rejected_router_error",
+                                           0) + 1
+                journal_emit("fleet", "reject", trace_id=trace_id,
+                             reason="router_error")
 
     # ---------------------------------------------------------------- drain
     def drain(self, replica_id: str,

@@ -40,6 +40,7 @@ from typing import Any, Dict, Iterable, List, Optional
 
 import numpy as np
 
+from paddle_tpu.obs.catalog import FAULT_FAMILIES, PROTOCOLS
 from paddle_tpu.testing.audit import audit_exactly_once
 
 __all__ = ["SoakSLO", "evaluate"]
@@ -64,56 +65,74 @@ def _p99(values: List[float]) -> Optional[float]:
 
 def _fault_chain(records: List[dict], fault: dict) -> Dict[str, Any]:
     """Reconstruct one injected fault's evidence chain from the merged
-    records; ``ok`` iff every link exists in order."""
-    fam = fault.get("family")
-    idx = {id(r): i for i, r in enumerate(records)}
+    records; ``ok`` iff every link exists in order.
 
-    def where(domain, kind, **match):
+    The chain shapes are NOT hand-coded here: each fault family maps
+    (``obs.catalog.FAULT_FAMILIES``) onto a declared protocol machine,
+    and the links are that protocol's start/intermediate/terminal
+    matchers — the same objects the runtime ``ProtocolWitness``
+    advances, so verdict and witness cannot drift apart
+    (tests/test_protocol.py pins the one-definition property)."""
+    fam = fault.get("family")
+    spec = FAULT_FAMILIES.get(fam)
+    if spec is None:
+        return {"ok": False, "family": fam, "error": "unknown family"}
+    proto = PROTOCOLS[spec.protocol]
+    key = fault.get(spec.fault_key) if spec.fault_key else None
+
+    def where(match, **extra):
+        """Record positions matched by a catalog EventMatch, keyed on
+        the protocol's correlation field (``extra`` overrides the key
+        constraint — family p's failover is keyed by victim)."""
+        constraints = dict(extra)
+        if not constraints and proto.key is not None:
+            constraints[proto.key] = key
         out = []
-        for r in records:
-            if r.get("domain") != domain or r.get("kind") != kind:
-                continue
-            if all(r.get(k) == v for k, v in match.items()):
-                out.append(idx[id(r)])
+        for i, r in enumerate(records):
+            if match.matches(r) and \
+                    all(r.get(k) == v for k, v in constraints.items()):
+                out.append(i)
         return out
 
     if fam == "p":
-        rid, trace = fault.get("replica"), fault.get("probe_trace")
-        routes = where("fleet", "route", trace_id=trace)
-        settles = where("fleet", "settle", trace_id=trace)
-        fails = where("fleet", "failover", victim=rid)
+        # fleet_request: start=route, terminal=settle; the failover
+        # intermediate is keyed by which replica DIED, not by trace
+        routes = where(proto.start)
+        settles = where(proto.terminal("settle").match)
+        fails = where(proto.intermediate("failover"),
+                      victim=fault.get("replica"))
         ok = bool(routes) and len(settles) == 1 \
             and routes[0] < settles[0] \
             and (bool(fails) or not fault.get("fired"))
-        return {"ok": ok, "family": fam, "trace": trace,
+        return {"ok": ok, "family": fam, "trace": key,
                 "routes": len(routes), "settles": len(settles),
                 "failovers_victim": len(fails)}
     if fam == "o":
-        sid = fault.get("shard")
-        killed = where("embed", "shard_killed", shard_id=sid)
-        replaced = where("embed", "shard_replaced", shard_id=sid)
-        restored = where("embed", "restore", shard_id=sid)
+        # embed_shard_failover: killed -> replaced -> restore
+        killed = where(proto.start)
+        replaced = where(proto.intermediate("shard_replaced"))
+        restored = where(proto.terminal("restore").match)
         ok = bool(killed) and bool(replaced) and bool(restored) \
             and killed[0] < replaced[-1] and killed[0] < restored[-1]
-        return {"ok": ok, "family": fam, "shard": sid,
+        return {"ok": ok, "family": fam, "shard": key,
                 "killed": len(killed), "replaced": len(replaced),
                 "restored": len(restored)}
     if fam == "k":
-        rid = fault.get("replica")
-        lapses = where("fleet", "lease_lapse", replica=rid)
-        rejoins = where("fleet", "rejoin", replica=rid)
+        # fleet_lease: lease_lapse -> rejoin
+        lapses = where(proto.start)
+        rejoins = where(proto.terminal("rejoin").match)
         ok = bool(lapses) and bool(rejoins) \
             and lapses[0] < rejoins[-1]
-        return {"ok": ok, "family": fam, "replica": rid,
+        return {"ok": ok, "family": fam, "replica": key,
                 "lapses": len(lapses), "rejoins": len(rejoins)}
-    if fam == "q":
-        stale = where("fleet", "stale_view")
-        recovered = where("fleet", "view_recovered")
-        ok = bool(stale) and bool(recovered) \
-            and stale[0] < recovered[-1]
-        return {"ok": ok, "family": fam, "stale_views": len(stale),
-                "recoveries": len(recovered)}
-    return {"ok": False, "family": fam, "error": "unknown family"}
+    # fam == "q" — fleet_registry_view: stale_view -> view_recovered
+    # (global machine, key None)
+    stale = where(proto.start)
+    recovered = where(proto.terminal("view_recovered").match)
+    ok = bool(stale) and bool(recovered) \
+        and stale[0] < recovered[-1]
+    return {"ok": ok, "family": fam, "stale_views": len(stale),
+            "recoveries": len(recovered)}
 
 
 def evaluate(records: List[dict],

@@ -50,6 +50,7 @@ from paddle_tpu.obs.httpd import (build_obs_http_server,  # noqa: F401
 from paddle_tpu.obs.metrics import (REGISTRY, MetricsRegistry,  # noqa: F401
                                     stats_families)
 from paddle_tpu.obs.profile import PROFILER, StepProfiler  # noqa: F401
+from paddle_tpu.obs.protocol import WITNESS, ProtocolWitness  # noqa: F401
 from paddle_tpu.obs.slo import (WATCHDOG, Objective,  # noqa: F401
                                 SLOWatchdog, parse_objective)
 from paddle_tpu.obs.trace import TRACER, Tracer, span  # noqa: F401
@@ -61,6 +62,7 @@ __all__ = [
     "TRACER", "Tracer", "span",
     "FLIGHT", "FlightRecorder",
     "PROFILER", "StepProfiler",
+    "WITNESS", "ProtocolWitness",
     "WATCHDOG", "SLOWatchdog", "Objective", "parse_objective",
     "context", "bind", "current_fields", "new_trace_id",
     "build_obs_http_server", "start_obs_server",
@@ -71,6 +73,15 @@ __all__ = [
 # auto-dumps on the trigger kinds — wired once at import so any entry
 # point into the obs package arms it
 JOURNAL.add_observer(FLIGHT.observe_journal)
+
+# the protocol witness rides the same observer seam: every record
+# advances the catalog-declared machines, and a violation's own
+# protocol/violation emission is a flight auto-dump trigger
+JOURNAL.add_observer(WITNESS.observe_journal)
+from paddle_tpu.obs.protocol import _install_collector as \
+    _install_protocol_collector  # noqa: E402
+
+_install_protocol_collector()
 
 
 def reset_all() -> None:
@@ -90,3 +101,4 @@ def reset_all() -> None:
     global_counters.reset()
     global_stat.reset()
     LOCKDEP.reset()
+    WITNESS.reset()

@@ -57,12 +57,18 @@ from paddle_tpu.obs import context as obs_context
 from paddle_tpu.analysis.lockdep import named_lock
 from paddle_tpu.utils.logging import get_logger
 
-__all__ = ["SCHEMA_VERSION", "REQUIRED_FIELDS", "EventJournal", "JOURNAL",
+__all__ = ["SCHEMA_VERSION", "REQUIRED_FIELDS", "RESERVED_FIELDS",
+           "EventJournal", "JOURNAL",
            "emit", "emit_event", "tail", "validate", "read_journal",
            "journal_segments"]
 
 SCHEMA_VERSION = 1
 REQUIRED_FIELDS = ("v", "ts", "seq", "pid", "domain", "kind")
+#: field names emit() REJECTS — they would collide with the envelope
+#: keys the journal stamps itself (run_id/host ride in from
+#: obs/context.py and must not be spoofed per-record either)
+RESERVED_FIELDS = frozenset(("v", "ts", "seq", "pid", "run_id",
+                             "host"))
 
 
 def _jsonable(v):
@@ -204,14 +210,30 @@ class EventJournal:
 
     def emit(self, domain: str, kind: str, **fields) -> dict:
         """Build, ring-buffer, and (when configured) persist one
-        record. Never raises into the caller's hot path — a failed
-        file write is counted and warned once. Correlation IDs
+        record. Never raises into the caller's hot path ONCE the call
+        is well-formed — a failed file write is counted and warned
+        once; a malformed call (empty/non-str domain or kind, or a
+        field colliding with an envelope key) raises immediately,
+        because a record that silently overwrote its own seq/run_id
+        would poison every downstream consumer. Correlation IDs
         (run_id/host always; trace_id/step when bound on the emitting
         thread — obs/context.py) are stamped unless the caller passed
         its own."""
+        if not isinstance(domain, str) or not domain:
+            raise ValueError(
+                f"journal domain must be a non-empty str, got "
+                f"{domain!r}")
+        if not isinstance(kind, str) or not kind:
+            raise ValueError(
+                f"journal kind must be a non-empty str, got {kind!r}")
+        reserved = RESERVED_FIELDS.intersection(fields)
+        if reserved:
+            raise ValueError(
+                f"journal fields {sorted(reserved)} collide with "
+                f"envelope keys (reserved: "
+                f"{sorted(RESERVED_FIELDS)})")
         rec = {"v": SCHEMA_VERSION, "ts": time.time(),
-               "pid": os.getpid(), "domain": str(domain),
-               "kind": str(kind)}
+               "pid": os.getpid(), "domain": domain, "kind": kind}
         for k, v in obs_context.current_fields().items():
             if k not in fields:
                 rec[k] = _jsonable(v)

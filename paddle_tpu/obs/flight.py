@@ -63,6 +63,7 @@ AUTO_DUMP_TRIGGERS = {
     ("engine", "step_failure"),
     ("serving", "breaker"),
     ("lockdep", "inversion"),   # would-be deadlock witnessed
+    ("protocol", "violation"),  # declared machine broken (ptproto)
 }
 
 

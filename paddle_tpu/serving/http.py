@@ -327,6 +327,7 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 self.wfile.flush()
 
             sent = 0
+            settled = False
             try:
                 while True:
                     finished = gen.done.wait(0.005)
@@ -342,17 +343,29 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     _line({"error": str(e), "reason": e.reason,
                            "retry_after": e.retry_after,
                            "trace_id": tid})
+                    journal_emit("serving", "hop", trace_id=tid,
+                                 phase="error", reason="rejected")
+                    settled = True
                     return
                 except Expired as e:
                     _line({"error": str(e), "expired": True,
                            "trace_id": tid})
+                    journal_emit("serving", "hop", trace_id=tid,
+                                 phase="error", reason="expired")
+                    settled = True
                     return
                 except ServerClosed as e:
                     _line({"error": str(e), "reason": "draining",
                            "trace_id": tid})
+                    journal_emit("serving", "hop", trace_id=tid,
+                                 phase="error", reason="draining")
+                    settled = True
                     return
                 except ServingError as e:
                     _line({"error": str(e), "trace_id": tid})
+                    journal_emit("serving", "hop", trace_id=tid,
+                                 phase="error", reason="serving_error")
+                    settled = True
                     return
                 _line({"done": True,
                        "tokens": [int(t) for t in final],
@@ -361,10 +374,25 @@ def build_http_server(server: InferenceServer, host: str = "127.0.0.1",
                        "trace_id": tid})
                 journal_emit("serving", "hop", trace_id=tid,
                              phase="settle", tokens=len(final))
+                settled = True
             except (BrokenPipeError, ConnectionError, OSError):
                 gen.cancel()          # client went away mid-stream
                 journal_emit("serving", "hop", trace_id=tid,
                              phase="torn", streamed=sent)
+                settled = True
+            finally:
+                if not settled:
+                    # an unexpected exception is unwinding through the
+                    # handler: terminate the hop machine (ptproto
+                    # serving_hop) so only a process LOSS can leave a
+                    # start with no terminal in the journal
+                    try:
+                        gen.cancel()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    journal_emit("serving", "hop", trace_id=tid,
+                                 phase="torn", streamed=sent,
+                                 reason="exception")
 
         def do_POST(self):
             if self.path == "/generate":

@@ -74,6 +74,13 @@ def pytest_configure(config):
         "select with `-m soak`; soak-marked tests are implicitly "
         "`slow` so tier-1's `-m 'not slow'` never runs them (the "
         "bounded smoke slice in tests/test_soak.py stays tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "protocol_violation_expected: this test deliberately breaks a "
+        "declared event protocol (orphan terminals etc.) — skip the "
+        "autouse zero-violations assertion of the protocol witness "
+        "(paddle_tpu/obs/protocol.py; docs/observability.md "
+        "'Protocol contracts')")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -238,6 +245,35 @@ def _lockdep_witness(request):
         "on different paths (one interleaving deadlocks). The journal "
         "holds a lockdep/inversion record with both stacks; see "
         "docs/static_analysis.md 'Lock discipline'")
+
+
+@pytest.fixture(autouse=True)
+def _protocol_witness(request):
+    """Protocol witness for tier-1: every test runs under the
+    declared-protocol state machines (paddle_tpu/obs/protocol.py — a
+    journal observer advancing obs.catalog.PROTOCOLS per correlation
+    key) and FAILS at teardown if any machine was BROKEN (a terminal
+    for a key never started), unless marked
+    ``protocol_violation_expected``. Machines merely left open are NOT
+    violations here — a SIGKILL'd replica legitimately leaves a hop
+    that never settles (tests/test_fleet_faults.py); only an explicit
+    ``WITNESS.finalize()`` reports those. State is reset per-test by
+    _reset_observability (obs.reset_all -> WITNESS.reset)."""
+    yield
+    if request.node.get_closest_marker("protocol_violation_expected"):
+        return
+    rep = getattr(request.node, "rep_call", None)
+    if rep is None or not rep.passed:
+        return
+    from paddle_tpu.obs import WITNESS
+    count = WITNESS.violation_count
+    assert count == 0, (
+        f"protocol witness observed {count} protocol violation(s) "
+        "during this test — a declared event machine "
+        "(obs/catalog.py PROTOCOLS) saw a terminal for a key it never "
+        "tracked. The journal holds a protocol/violation record with "
+        "the offending chain; see docs/observability.md "
+        "'Protocol contracts'")
 
 
 @pytest.fixture

@@ -337,6 +337,13 @@ class TestFleetRouting:
 
 
 class TestMidStreamFailover:
+    # Both replicas share this process's journal, and the victim's
+    # serve thread emits its serving/hop torn terminal asynchronously —
+    # it can land AFTER the sibling's hop start for the same trace_id,
+    # closing the sibling's witness machine and orphaning its settle
+    # (timing-dependent). Exactly-once is proven by the settle
+    # counter/audit below, not the live witness.
+    @pytest.mark.protocol_violation_expected
     def test_failover_resumes_token_exact(self):
         """The tentpole invariant, in-process: the victim's transport
         is torn after 2 streamed tokens; the router replays prompt +

@@ -32,7 +32,11 @@ def test_configured_paths_cover_the_tree():
     assert "tools" in cfg.paths
     assert "tests" in cfg.paths
     assert cfg.rules == ["R1", "R2", "R3", "R4", "R5", "R6", "R7",
-                         "R8", "R9", "R10"]
+                         "R8", "R9", "R10", "R11", "R12", "R13"]
+    # the contract rules run with stale-entry reporting ON in the
+    # full-repo sweep (pyproject [tool.ptlint.journal-contract] etc.)
+    assert cfg.rule_options.get("R11", {}).get("stale") is True
+    assert cfg.rule_options.get("R12", {}).get("stale") is True
 
 
 def test_repo_is_lint_clean():
@@ -46,6 +50,22 @@ def test_repo_is_lint_clean():
         "suppress with '# ptlint: disable=RULE(reason)' (see "
         "docs/static_analysis.md):\n"
         + "\n".join(f.format() for f in res.new))
+
+
+def test_contract_rules_clean_repo_wide():
+    """The ptproto gate: zero non-baselined R11/R12/R13 findings over
+    the whole tree, stale catalog entries INCLUDED — emit sites,
+    metric registrations, docs/observability.md tables and
+    obs/catalog.py must all agree (docs/static_analysis.md 'Event &
+    protocol contracts')."""
+    from paddle_tpu.analysis.runner import _contracts_view
+    res = _contracts_view(load_config(ROOT), use_baseline=True)
+    assert not res.errors, "\n".join(res.errors)
+    assert not res.new, (
+        f"{len(res.new)} contract finding(s) — the catalog, the code "
+        "and the docs drifted apart:\n"
+        + "\n".join(f.format() for f in res.new))
+    assert not res.stale_baseline
 
 
 def test_no_stale_baseline_entries():
@@ -89,6 +109,9 @@ def test_github_format_renders_annotations(tmp_path):
     cfg = load_config(ROOT)
     cfg.paths = [str(bad)]
     cfg.baseline = ""
+    # R2 is the finding under test; the contract rules' stale sweep
+    # (R11/R12) would add repo-level findings to this one-file run
+    cfg.rules = ["R2"]
     res = lint_paths(cfg, use_baseline=False)
     assert len(res.new) == 1
     out = format_findings(res, "github")
@@ -112,6 +135,7 @@ def test_github_format_renders_stale_baseline_as_warning(tmp_path):
     cfg = load_config(ROOT)
     cfg.paths = [str(bad)]
     cfg.baseline = str(tmp_path / "baseline.json")
+    cfg.rules = ["R2"]
     res = lint_paths(cfg, use_baseline=False)
     assert len(res.new) == 1
     write_baseline(cfg.baseline, res.new, [])

@@ -846,3 +846,350 @@ class TestSanitizer:
                     # ptlint: disable=R3(the leak under test)
                     lambda x: (leaked.append(x), x * 3)[1]
                 )(jnp.ones(3))
+
+
+# ================================================================== R11
+class TestJournalContract:
+    """R11 journal-contract: literal emit sites proven against
+    obs/catalog.py (docs/static_analysis.md 'Event & protocol
+    contracts')."""
+
+    def _run(self, src, path="paddle_tpu/mod.py", options=None):
+        import paddle_tpu.analysis.contractrules as CR
+        return run_rule(CR.JournalContractRule, src, path=path,
+                        options=options)
+
+    def test_catches_undeclared_domain_kind(self):
+        hits = self._run("""
+            from paddle_tpu.obs.events import emit as journal_emit
+            def f():
+                journal_emit("nope", "nada", x=1)
+        """)
+        assert len(hits) == 1 and hits[0].rule == "R11"
+        assert "(nope/nada)" in hits[0].message
+
+    def test_catches_missing_required_field(self):
+        # serving/drain requires `action`
+        hits = self._run("""
+            from paddle_tpu.obs.events import emit as journal_emit
+            def f():
+                journal_emit("serving", "drain")
+        """)
+        assert len(hits) == 1
+        assert "required" in hits[0].message
+        assert "action" in hits[0].message
+
+    def test_catches_undeclared_field(self):
+        hits = self._run("""
+            from paddle_tpu.obs.events import emit as journal_emit
+            def f():
+                journal_emit("serving", "drain", action="begin",
+                             bogus=1)
+        """)
+        assert len(hits) == 1 and "bogus" in hits[0].message
+
+    def test_quiet_on_conforming_site_and_method_form(self):
+        assert not self._run("""
+            from paddle_tpu.obs.events import JOURNAL
+            def f():
+                JOURNAL.emit("serving", "drain", action="begin")
+        """)
+
+    def test_star_kwargs_vets_kind_only(self):
+        # **fields is not statically knowable: (domain, kind) is
+        # still checked, the field lists are not
+        assert not self._run("""
+            from paddle_tpu.obs.events import emit
+            def f(kw):
+                emit("serving", "drain", **kw)
+        """)
+        hits = self._run("""
+            from paddle_tpu.obs.events import emit
+            def f(kw):
+                emit("nope", "nada", **kw)
+        """)
+        assert len(hits) == 1
+
+    def test_scoped_to_paddle_tpu_tree(self):
+        # tests/ may emit anything (fixtures fabricate records)
+        assert not self._run("""
+            from paddle_tpu.obs.events import emit
+            def f():
+                emit("nope", "nada")
+        """, path="tests/test_x.py")
+
+    def test_wrapper_option_pins_domain(self):
+        opts = {"wrappers": {"_emit_x": "serving"}}
+        assert not self._run("""
+            def f():
+                _emit_x("drain", action="begin")
+        """, options=opts)
+        hits = self._run("""
+            def f():
+                _emit_x("nada")
+        """, options=opts)
+        assert len(hits) == 1 and "(serving/nada)" in hits[0].message
+
+    def test_stale_entry_reported_in_finalize(self):
+        import paddle_tpu.analysis.contractrules as CR
+        rule = CR.JournalContractRule({"stale": True})
+        ctx = parse_file("<mem>", "paddle_tpu/mod.py", text=textwrap.dedent("""
+            from paddle_tpu.obs.events import emit
+            def f():
+                emit("serving", "drain", action="begin")
+        """))
+        assert not list(rule.check(ctx))
+        stale = list(rule.finalize())
+        # everything but serving/drain is unseen by this one-file run;
+        # every stale finding anchors at the catalog itself, and
+        # dynamic kinds (emit_event dispatch) are exempt
+        assert stale
+        assert all(f.path == CR.CATALOG_PATH for f in stale)
+        assert not any("(serving/drain)" in f.message for f in stale)
+        assert not any("(data/source_stall)" in f.message
+                       for f in stale)
+
+    def test_no_stale_without_option(self):
+        import paddle_tpu.analysis.contractrules as CR
+        rule = CR.JournalContractRule(None)
+        assert not list(rule.finalize())
+
+
+# ================================================================== R12
+class TestMetricContract:
+    """R12 metric-contract: registered paddle_tpu_* families vs the
+    catalog vs docs/observability.md, drift both directions."""
+
+    def _run(self, src, path="paddle_tpu/mod.py", options=None):
+        import paddle_tpu.analysis.contractrules as CR
+        opts = {"doc": "/nonexistent-ptlint-doc.md"}
+        opts.update(options or {})
+        rule = CR.MetricContractRule(opts)
+        ctx = parse_file("<mem>", path, text=textwrap.dedent(src))
+        assert ctx is not None
+        found = list(rule.check(ctx))
+        return found + list(rule.finalize())
+
+    def test_catches_undeclared_family(self):
+        hits = self._run("""
+            from paddle_tpu.obs.metrics import REGISTRY
+            REGISTRY.counter("paddle_tpu_bogus_total")
+        """)
+        assert len(hits) == 1 and hits[0].rule == "R12"
+        assert "paddle_tpu_bogus_total" in hits[0].message
+
+    def test_catches_type_mismatch(self):
+        # catalogued as counter
+        hits = self._run("""
+            from paddle_tpu.obs.metrics import REGISTRY
+            REGISTRY.gauge("paddle_tpu_prefix_hit_pages")
+        """)
+        assert len(hits) == 1 and "counter" in hits[0].message
+
+    def test_catches_label_mismatch(self):
+        # catalogued with labels ("kind",)
+        hits = self._run("""
+            from paddle_tpu.obs.metrics import REGISTRY
+            REGISTRY.gauge("paddle_tpu_profile_step_ms")
+        """)
+        assert len(hits) == 1 and "label" in hits[0].message
+
+    def test_quiet_on_conforming_registrations(self):
+        assert not self._run("""
+            from paddle_tpu.obs.metrics import REGISTRY, SampleFamily
+            REGISTRY.counter("paddle_tpu_prefix_hit_pages")
+            REGISTRY.gauge("paddle_tpu_profile_step_ms",
+                           "mean wall ms", labelnames=("kind",))
+            fam = SampleFamily("paddle_tpu_protocol_tracked", "gauge")
+        """)
+
+    def test_fstring_prefix_vetted(self):
+        assert not self._run("""
+            from paddle_tpu.obs.metrics import REGISTRY
+            def reg(name):
+                REGISTRY.counter(f"paddle_tpu_serving_{name}")
+        """)
+        hits = self._run("""
+            from paddle_tpu.obs.metrics import REGISTRY
+            def reg(name):
+                REGISTRY.counter(f"paddle_tpu_bogus_{name}")
+        """)
+        assert len(hits) == 1 and "prefix" in hits[0].message
+
+    def test_doc_drift_both_directions(self, tmp_path):
+        doc = tmp_path / "observability.md"
+        doc.write_text("| `paddle_tpu_made_up_total` | counter |\n")
+        hits = self._run("""
+            from paddle_tpu.obs.metrics import REGISTRY
+            REGISTRY.counter("paddle_tpu_prefix_hit_pages")
+        """, options={"doc": str(doc)})
+        # docs -> catalog: the documented name does not exist
+        assert any("paddle_tpu_made_up_total" in h.message
+                   and str(doc) in h.path for h in hits)
+        # catalog -> docs: declared families missing from the doc
+        assert any("paddle_tpu_prefix_hit_pages" in h.message
+                   and "absent" in h.message for h in hits)
+
+    def test_real_docs_agree_with_catalog(self):
+        # the repo's own doc tables are lint-enforced: zero drift
+        hits = self._run("""
+            x = 1
+        """, options={"doc": "docs/observability.md"})
+        assert hits == []
+
+
+# ================================================================== R13
+class TestProtocolPaths:
+    """R13 protocol-emission-paths: a function emitting a check_paths
+    protocol's start must reach a declared terminal on every exit
+    path, exception edges included."""
+
+    def _run(self, src, path="paddle_tpu/mod.py", options=None):
+        import paddle_tpu.analysis.contractrules as CR
+        return run_rule(CR.ProtocolPathsRule, src, path=path,
+                        options=options)
+
+    def test_catches_return_without_terminal(self):
+        hits = self._run("""
+            from paddle_tpu.obs.events import emit
+            def f(t):
+                emit("serving", "hop", trace_id=t, phase="start")
+                work()
+                return 1
+        """)
+        assert len(hits) == 1 and hits[0].rule == "R13"
+        assert "serving_hop" in hits[0].message
+
+    def test_catches_branch_missing_terminal(self):
+        hits = self._run("""
+            from paddle_tpu.obs.events import emit
+            def f(t, ok):
+                emit("serving", "hop", trace_id=t, phase="start")
+                if ok:
+                    emit("serving", "hop", trace_id=t, phase="settle")
+                    return 1
+                return 0
+        """)
+        assert len(hits) == 1
+
+    def test_catches_typed_handler_exception_edge(self):
+        # the try body can raise something OTHER than ValueError: that
+        # edge exits the function with the machine still open
+        hits = self._run("""
+            from paddle_tpu.obs.events import emit
+            def f(t):
+                emit("serving", "hop", trace_id=t, phase="start")
+                try:
+                    work()
+                except ValueError:
+                    pass
+                emit("serving", "hop", trace_id=t, phase="settle")
+        """)
+        assert len(hits) == 1
+
+    def test_quiet_when_finally_holds_terminal(self):
+        # the satellite-3 positive case: a terminal on the exception
+        # path via try/finally proves every exit
+        assert not self._run("""
+            from paddle_tpu.obs.events import emit
+            def f(t):
+                emit("serving", "hop", trace_id=t, phase="start")
+                try:
+                    work()
+                    emit("serving", "hop", trace_id=t, phase="settle",
+                         tokens=3)
+                    return 1
+                finally:
+                    emit("serving", "hop", trace_id=t, phase="torn",
+                         reason="exception")
+        """)
+
+    def test_quiet_when_broad_handler_emits_terminal(self):
+        assert not self._run("""
+            from paddle_tpu.obs.events import emit
+            def f(t):
+                emit("serving", "hop", trace_id=t, phase="start")
+                try:
+                    work()
+                    emit("serving", "hop", trace_id=t, phase="settle")
+                except Exception:
+                    emit("serving", "hop", trace_id=t, phase="error",
+                         reason="boom")
+        """)
+
+    def test_quiet_on_terminal_every_branch(self):
+        assert not self._run("""
+            from paddle_tpu.obs.events import emit
+            def f(t, ok):
+                emit("serving", "hop", trace_id=t, phase="start")
+                if ok:
+                    emit("serving", "hop", trace_id=t, phase="settle")
+                    return 1
+                emit("serving", "hop", trace_id=t, phase="error",
+                     reason="no")
+                return 0
+        """)
+
+    def test_catches_raise_at_loop_top_after_open(self):
+        # iteration 2 can hit the raise with iteration 1's machine
+        # open — the two-pass back-edge approximation sees it
+        hits = self._run("""
+            from paddle_tpu.obs.events import emit
+            def f(reqs):
+                for t in reqs:
+                    if stale(t):
+                        raise RuntimeError(t)
+                    emit("serving", "hop", trace_id=t, phase="start")
+                    work()
+        """)
+        assert len(hits) == 1
+
+    def test_handoff_option_closes_machine(self):
+        opts = {"handoffs": ["enqueue_settle"]}
+        assert not self._run("""
+            from paddle_tpu.obs.events import emit
+            def f(t):
+                emit("serving", "hop", trace_id=t, phase="start")
+                enqueue_settle(t)
+                return 1
+        """, options=opts)
+
+    def test_non_protocol_emit_ignored(self):
+        assert not self._run("""
+            from paddle_tpu.obs.events import emit
+            def f(t):
+                emit("serving", "hop", trace_id=t, phase="settle")
+                return 1
+        """)
+
+    def test_suppression_and_baseline_funnel(self, tmp_path):
+        """R13 findings ride the standard funnel: inline disable with
+        a reason, and a baselined finding with a `why`."""
+        import json as _json
+        (tmp_path / "pkg").mkdir()
+        src = textwrap.dedent("""
+            from paddle_tpu.obs.events import emit
+            def f(t):
+                emit("serving", "hop", trace_id=t, phase="start")  # ptlint: disable=R13(handoff: settled by the engine callback)
+                return 1
+        """)
+        (tmp_path / "pkg" / "a.py").write_text(src)
+        cfg = LintConfig(root=str(tmp_path), paths=["pkg"],
+                         rules=["R13"], baseline="",
+                         rule_options={"R13": {"paths": ["pkg"]}})
+        res = lint_paths(cfg, use_baseline=False)
+        assert not res.new and len(res.suppressed) == 1
+        # same finding, no suppression -> baseline it
+        (tmp_path / "pkg" / "a.py").write_text(
+            src.replace("  # ptlint: disable=R13(handoff: settled "
+                        "by the engine callback)", ""))
+        res2 = lint_paths(cfg, use_baseline=False)
+        assert len(res2.new) == 1
+        write_baseline(str(tmp_path / "baseline.json"), res2.new, [])
+        raw = _json.loads((tmp_path / "baseline.json").read_text())
+        for e in raw["entries"]:
+            e["why"] = "legacy path, settled by the engine callback"
+        (tmp_path / "baseline.json").write_text(_json.dumps(raw))
+        cfg.baseline = "baseline.json"
+        res3 = lint_paths(cfg, use_baseline=True)
+        assert not res3.new and len(res3.baselined) == 1

@@ -1,0 +1,224 @@
+"""ptproto runtime-witness acceptance (paddle_tpu/obs/protocol.py;
+docs/observability.md "Protocol contracts").
+
+Three planes under test:
+
+- the **witness machines**: declared protocols advance per correlation
+  key off the REAL journal observer seam (not a private API) —
+  completion, supersede-vs-extend on restart, orphan-terminal
+  violations, explicit ``finalize()`` for unterminated machines;
+- the **chaos acceptance**: a deliberately torn hop yields exactly one
+  ``protocol/violation`` record whose chain reconstructs the machine's
+  history, and the flight recorder auto-dumps a bundle naming the key;
+- the **one-definition pin**: the soak verdict and the witness consume
+  the SAME ``obs.catalog`` declaration objects, so they cannot drift.
+
+Plus the EventJournal.emit argument-validation regression (reserved
+envelope fields, empty domain/kind).
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from paddle_tpu import obs
+from paddle_tpu.obs import catalog
+from paddle_tpu.obs.events import RESERVED_FIELDS, emit
+from paddle_tpu.obs.flight import FLIGHT
+
+
+class TestWitnessMachines:
+    def test_start_then_terminal_completes(self):
+        emit("serving", "hop", trace_id="t-ok", phase="start")
+        assert obs.WITNESS.counts()["tracked"] == {"serving_hop": 1}
+        emit("serving", "hop", trace_id="t-ok", phase="settle",
+             tokens=3)
+        c = obs.WITNESS.counts()
+        assert c["tracked"] == {}
+        assert c["completed"] == {"serving_hop": 1}
+        assert c["violations"] == 0
+
+    def test_keys_are_independent_machines(self):
+        emit("serving", "hop", trace_id="t-a", phase="start")
+        emit("serving", "hop", trace_id="t-b", phase="start")
+        emit("serving", "hop", trace_id="t-a", phase="error",
+             reason="boom")
+        c = obs.WITNESS.counts()
+        assert c["tracked"] == {"serving_hop": 1}
+        assert c["completed"] == {"serving_hop": 1}
+
+    def test_restart_supersedes_serving_hop(self):
+        emit("serving", "hop", trace_id="t-s", phase="start")
+        emit("serving", "hop", trace_id="t-s", phase="start")
+        c = obs.WITNESS.counts()
+        assert c["tracked"] == {"serving_hop": 1}
+        assert c["superseded"] == {"serving_hop": 1}
+        emit("serving", "hop", trace_id="t-s", phase="settle")
+        assert obs.WITNESS.counts()["completed"] == {"serving_hop": 1}
+
+    def test_restart_extends_fleet_request(self):
+        # a re-route after failover CONTINUES the same request machine
+        # (catalog: fleet_request.on_restart == "extend"), and the
+        # failover intermediate lands in the chain
+        emit("fleet", "route", trace_id="t-f", replica="r0", hop=1)
+        emit("fleet", "failover", trace_id="t-f", victim="r0",
+             next="r1")
+        emit("fleet", "route", trace_id="t-f", replica="r1", hop=2)
+        c = obs.WITNESS.counts()
+        assert c["tracked"] == {"fleet_request": 1}
+        assert c["superseded"] == {}
+        [machine] = obs.WITNESS.open_machines()
+        kinds = [r["kind"] for r in machine["chain"]]
+        assert kinds == ["route", "failover", "route"]
+        emit("fleet", "settle", trace_id="t-f", replica="r1",
+             hops=2, failovers=1)
+        assert obs.WITNESS.counts()["completed"] == {"fleet_request": 1}
+
+    @pytest.mark.protocol_violation_expected
+    def test_orphan_terminal_is_live_violation(self):
+        # settle for a trace never started — exactly-once broken
+        emit("fleet", "settle", trace_id="t-orphan", replica="r0",
+             hops=1, failovers=0)
+        [v] = obs.WITNESS.violations()
+        assert v["protocol"] == "fleet_request"
+        assert v["key"] == "t-orphan"
+        assert v["reason"] == "orphan_terminal"
+
+    def test_orphan_reject_is_not_violation(self):
+        # reject is a declared terminal with orphan_violates=False: a
+        # router can reject before ever routing (queue_full at admission)
+        emit("fleet", "reject", trace_id="t-rej", reason="queue_full")
+        assert obs.WITNESS.violation_count == 0
+
+    def test_unterminated_only_on_finalize(self):
+        # a hop that never settles is NOT a live violation — a
+        # SIGKILL'd replica legitimately leaves one
+        # (tests/test_fleet_faults.py pins that shape)
+        emit("serving", "hop", trace_id="t-open", phase="start")
+        assert obs.WITNESS.violation_count == 0
+
+    def test_gauges_ride_the_registry_collector(self):
+        from paddle_tpu.obs.metrics import REGISTRY
+        emit("serving", "hop", trace_id="t-m1", phase="start")
+        emit("serving", "hop", trace_id="t-m1", phase="settle")
+        emit("serving", "hop", trace_id="t-m2", phase="start")
+        text = REGISTRY.exposition()
+        assert ('paddle_tpu_protocol_completed{protocol="serving_hop"}'
+                ' 1') in text
+        assert ('paddle_tpu_protocol_tracked{protocol="serving_hop"}'
+                ' 1') in text
+
+
+class TestChaosAcceptance:
+    """The ISSUE acceptance: a deliberately torn hop -> exactly one
+    protocol/violation with a reconstructible chain, and the flight
+    recorder's auto-dumped bundle names the key."""
+
+    @pytest.mark.protocol_violation_expected
+    def test_torn_hop_journals_one_violation_with_chain(self):
+        emit("serving", "hop", trace_id="t-torn", phase="start")
+        out = obs.WITNESS.finalize()
+        assert len(out) == 1
+        v = out[0]
+        assert v["protocol"] == "serving_hop"
+        assert v["key"] == "t-torn"
+        assert v["reason"] == "unterminated"
+        # the chain reconstructs the machine's history by seq
+        assert [r["kind"] for r in v["chain"]] == ["hop"]
+        assert v["chain"][0]["phase"] == "start"
+        assert v["chain"][0]["trace_id"] == "t-torn"
+        assert isinstance(v["chain"][0]["seq"], int)
+        # exactly one protocol/violation record in the journal ring
+        recs = obs.JOURNAL.tail(50, domain="protocol")
+        assert len(recs) == 1
+        assert recs[0]["kind"] == "violation"
+        assert recs[0]["key"] == "t-torn"
+        assert recs[0]["reason"] == "unterminated"
+        # finalize is idempotent once machines are drained
+        assert obs.WITNESS.finalize() == []
+
+    @pytest.mark.protocol_violation_expected
+    def test_violation_autodumps_bundle_naming_the_key(self, tmp_path):
+        FLIGHT.configure(dump_dir=str(tmp_path))
+        emit("serving", "hop", trace_id="t-dump", phase="settle")
+        bundles = glob.glob(os.path.join(str(tmp_path), "flight-*.json"))
+        assert len(bundles) == 1
+        assert "protocol_violation" in os.path.basename(bundles[0])
+        with open(bundles[0], encoding="utf-8") as f:
+            b = json.load(f)
+        assert b["reason"] == "protocol_violation"
+        tail = b["journal"]["tail"]
+        viol = [r for r in tail if r.get("domain") == "protocol"]
+        assert len(viol) == 1 and viol[0]["key"] == "t-dump"
+
+
+class TestOneDefinition:
+    """Verdict, witness, and R13 all consume obs.catalog.PROTOCOLS —
+    one declaration, pinned here so a fork can never drift."""
+
+    def test_verdict_imports_the_same_objects(self):
+        from paddle_tpu.loadgen import verdict
+        assert verdict.PROTOCOLS is catalog.PROTOCOLS
+        assert verdict.FAULT_FAMILIES is catalog.FAULT_FAMILIES
+
+    def test_witness_consumes_the_same_objects(self):
+        assert obs.WITNESS._protocols == catalog.PROTOCOLS
+
+    def test_every_fault_family_maps_to_a_protocol(self):
+        for fam, spec in catalog.FAULT_FAMILIES.items():
+            proto = catalog.PROTOCOLS[spec.protocol]
+            assert proto.terminals, fam
+            if spec.fault_key is not None:
+                assert proto.key is not None, fam
+
+    def test_every_protocol_event_is_a_catalogued_journal_kind(self):
+        for p in catalog.PROTOCOLS.values():
+            matches = [p.start] + list(p.intermediates) + \
+                [t.match for t in p.terminals]
+            for m in matches:
+                assert (m.domain, m.kind) in catalog.JOURNALS, \
+                    f"{p.name}: ({m.domain}/{m.kind}) not catalogued"
+
+    def test_verdict_chain_reconstruction_from_declarations(self):
+        # family k through the declared fleet_lease machine
+        from paddle_tpu.loadgen.verdict import _fault_chain
+        records = [
+            {"domain": "fleet", "kind": "lease_lapse", "replica": "r1"},
+            {"domain": "fleet", "kind": "rejoin", "replica": "r1"},
+        ]
+        out = _fault_chain(records, {"family": "k", "replica": "r1"})
+        assert out["ok"] and out["lapses"] == 1 and out["rejoins"] == 1
+        out2 = _fault_chain(list(reversed(records)),
+                            {"family": "k", "replica": "r1"})
+        assert not out2["ok"]
+
+
+class TestEmitValidation:
+    """EventJournal.emit argument validation (satellite 6): empty or
+    non-str domain/kind and envelope-reserved fields are rejected at
+    the emit site, not discovered downstream by a reader."""
+
+    def test_rejects_empty_or_nonstr_domain_kind(self):
+        with pytest.raises(ValueError, match="domain"):
+            emit("", "kind")
+        with pytest.raises(ValueError, match="domain"):
+            emit(None, "kind")
+        with pytest.raises(ValueError, match="kind"):
+            emit("obs", "")
+        with pytest.raises(ValueError, match="kind"):
+            emit("obs", 7)
+
+    def test_rejects_reserved_envelope_fields(self):
+        for bad in sorted(RESERVED_FIELDS):
+            with pytest.raises(ValueError, match="reserved"):
+                emit("obs", "selfcheck", **{bad: "x", "probe": 1})
+
+    def test_caller_trace_id_and_step_still_allowed(self):
+        # trace_id/step are context-stamped but caller-overridable by
+        # design — they are NOT reserved
+        emit("serving", "hop", trace_id="t-v", phase="start")
+        emit("serving", "hop", trace_id="t-v", phase="settle", step=4)
+        rec = obs.JOURNAL.tail(1)[0]
+        assert rec["trace_id"] == "t-v" and rec["step"] == 4

@@ -53,6 +53,14 @@ class TestSoakSmoke:
     ((o) shard kill in the commit window + (p) replica kill
     mid-stream), full verdict."""
 
+    # The soak topology writes every plane into ONE journal and the
+    # (p) family kills a replica mid-stream, so the victim's late
+    # serving/hop torn terminal can race the failover attempt's hop
+    # machine for the same trace_id and orphan its settle (timing-
+    # dependent). Exactly-once in the soak is proven by the verdict's
+    # journal audit, not the live witness (docs/observability.md
+    # "Protocol contracts").
+    @pytest.mark.protocol_violation_expected
     @pytest.mark.chaos(timeout=240)
     def test_smoke_slice_passes_verdict(self):
         report = run_soak(seed=11, duration_s=4.0, workload="mixed",
@@ -72,6 +80,7 @@ class TestSoakAcceptance:
     """The acceptance run (`pytest -m soak`): all four fault families
     composed in one seeded run over the full topology."""
 
+    @pytest.mark.protocol_violation_expected
     @pytest.mark.soak
     @pytest.mark.chaos(timeout=420)
     def test_full_soak_all_families(self):
@@ -88,6 +97,7 @@ class TestSoakAcceptance:
             [(a.family, a.action, a.target) for a in planned]
         assert all(f["fired"] for f in report["faults"])
 
+    @pytest.mark.protocol_violation_expected
     @pytest.mark.soak
     @pytest.mark.chaos(timeout=420)
     def test_chat_only_soak(self):
