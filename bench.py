@@ -578,7 +578,9 @@ SMOKE_ROWS = ("train_tiny", "serving_infer", "decode_engine",
               "contract_check", "coord_reshard", "embed_lookup",
               "embed_update", "fleet_route", "fleet_failover",
               "cold_start_to_first_token", "fleet_deploy",
-              "fleet_autoscale", "router_ha", "soak_smoke")
+              "fleet_autoscale", "router_ha", "soak_smoke",
+              "kv_capacity_multiplier", "kv_dequant_overhead",
+              "kv_restore_latency")
 
 
 def _smoke_trainer(batch: int = 16):
@@ -1399,6 +1401,93 @@ def bench_smoke(train_steps: int = 12, serve_requests: int = 16,
             if ttft is not None else 1e9,
             "requests": report["counts"]["requests"],
             "faults_injected": report["counts"]["faults"],
+        }
+
+    if "kv_capacity_multiplier" in rows:
+        # ISSUE 20 tentpole leg (a): int8 pages with per-row scales
+        # must hold >= 2x the KV tokens per HBM byte of the fp32 pools.
+        # tokens_per_byte_x is RATE-gated at >= 2.0 (0.75x of the
+        # 4*dh/(dh+4) = 2.67 analytic value at dh=8) — deterministic,
+        # computed from the engines' REAL pool buffers, not the
+        # formula. effective_pages adds the spill tier on top.
+        from paddle_tpu.serving import DecodeEngine
+        e32 = DecodeEngine(_smoke_decoder(), num_slots=2, page_size=4,
+                           max_seq_len=32)
+        e8 = DecodeEngine(_smoke_decoder(), num_slots=2, page_size=4,
+                          max_seq_len=32, kv_quant="int8",
+                          kv_spill_pages=16)
+        b32, b8 = e32.paged.pool_bytes(), e8.paged.pool_bytes()
+        acc = e8.page_accounting()
+        out["kv_capacity_multiplier"] = {
+            "tokens_per_byte_x": round(b32 / b8, 3),
+            "fp32_pool_bytes": b32,
+            "int8_pool_bytes": b8,
+            "device_pages": acc["total_usable"],
+            "effective_pages": acc["total_usable"]
+            + acc["spill_capacity"],
+        }
+
+    if "kv_dequant_overhead" in rows:
+        # the dequant read path's decode-throughput cost: int8 vs fp32
+        # over identical engines and prompts. throughput_ratio is
+        # ratio-gated (rate, loose floor) — it catches the dequant
+        # path falling off a cliff, not CPU timing noise.
+        from paddle_tpu.serving import DecodeEngine
+
+        def _toks_per_s(kv_quant):
+            eng = DecodeEngine(_smoke_decoder(), num_slots=2,
+                               page_size=4, max_seq_len=32,
+                               kv_quant=kv_quant)
+            rng = np.random.RandomState(3)
+            prompts = [[int(t) for t in rng.randint(0, 40, 6)]
+                       for _ in range(4)]
+            warm = eng.submit(prompts[0], 2)   # compile prefill + step
+            eng.run(timeout=300)
+            warm.get(timeout=1)
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, 8) for p in prompts]
+            eng.run(timeout=300)
+            toks = sum(len(r.get(timeout=1)) for r in reqs)
+            return toks / (time.perf_counter() - t0)
+
+        f32 = _toks_per_s(None)
+        i8 = _toks_per_s("int8")
+        out["kv_dequant_overhead"] = {
+            "throughput_ratio": round(i8 / f32, 3),
+            "fp32_toks_per_s": round(f32, 2),
+            "int8_toks_per_s": round(i8, 2),
+        }
+
+    if "kv_restore_latency" in rows:
+        # ISSUE 20 tentpole leg (b), info row: cost of bringing a
+        # spilled prefix back from the host store on a revisit —
+        # end-to-end revisit wall time and the per-page restore share.
+        from paddle_tpu.serving import DecodeEngine
+        from paddle_tpu.testing import FaultPlan as _FPk
+        eng = DecodeEngine(_smoke_decoder(), num_slots=2, page_size=4,
+                           max_seq_len=20, num_pages=9,
+                           kv_spill_pages=16)
+        plan = _FPk(seed=5)
+        # revisit_from past the last wave: the storm only spills, so
+        # the store still holds the early prompts' pages afterwards
+        schedule, submitted = plan.spill_storm(
+            eng, waves=4, per_wave=2, gap=4, prompt_len=8, max_new=3,
+            vocab=40, revisit_from=4)
+        with _FPk.decode_script(eng, schedule):
+            eng.run(timeout=300)
+        acc0 = eng.page_accounting()
+        p0 = submitted[0][1]
+        t0 = time.perf_counter()
+        req = eng.submit(p0, 3)
+        eng.run(timeout=300)
+        req.get(timeout=1)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        acc1 = eng.page_accounting()
+        restored = acc1["spill_restores"] - acc0["spill_restores"]
+        out["kv_restore_latency"] = {
+            "revisit_ms": round(dt_ms, 3),
+            "restored_pages": restored,
+            "restore_ms_per_page": round(dt_ms / max(restored, 1), 3),
         }
     return {"v": 1, "suite": "smoke", "rows": out}
 

@@ -349,11 +349,14 @@ def _build_engine(args):
         if draft is None:
             raise SystemExit("--draft_config must define `decoder` "
                              "(or `draft_decoder`)")
+    kv_quant = getattr(args, "kv_quant", "none")
     return DecodeEngine(
         decoder, num_slots=args.gen_slots,
         page_size=args.gen_page_size,
         draft=draft, spec_k=args.spec_k,
-        prefix_cache=args.prefix_cache == "on")
+        prefix_cache=args.prefix_cache == "on",
+        kv_quant=None if kv_quant == "none" else kv_quant,
+        kv_spill_pages=getattr(args, "kv_spill_pages", 0))
 
 
 def _build_server(args, InferenceServer, CircuitBreaker,
@@ -655,7 +658,15 @@ def _build_autopilot(args, router):
     policy = AutopilotPolicy(min_replicas=args.min_replicas,
                              max_replicas=args.max_replicas)
     if getattr(args, "spawn_cmd", None):
-        prov = SubprocessProvisioner(shlex.split(args.spawn_cmd))
+        cmd = shlex.split(args.spawn_cmd)
+        # fleet KV mode rides into every autoscaled replica: a spawn
+        # that comes up single-tier/fp32 in an int8+spill fleet would
+        # scrape mismatched capacity and break restore-path affinity
+        if getattr(args, "kv_quant", "none") not in (None, "none"):
+            cmd += ["--kv_quant", args.kv_quant]
+        if getattr(args, "kv_spill_pages", 0):
+            cmd += ["--kv_spill_pages", str(args.kv_spill_pages)]
+        prov = SubprocessProvisioner(cmd)
     else:
         def _no_spawn(rid):
             raise RuntimeError("no --spawn_cmd: this autopilot can "
@@ -1312,6 +1323,18 @@ def main(argv=None) -> int:
                     help="decode engine slot count")
     sv.add_argument("--gen_page_size", type=int, default=16,
                     help="KV page size in tokens")
+    sv.add_argument("--kv_quant", choices=["none", "int8"],
+                    default="none",
+                    help="KV page dtype: int8 stores quantized pages "
+                         "with per-row scales (~2.7x the tokens per "
+                         "HBM byte; docs/robustness.md 'Two-tier KV "
+                         "cache')")
+    sv.add_argument("--kv_spill_pages", type=int, default=0,
+                    help="host-RAM spill store capacity in pages: "
+                         "cold trie pages spill there instead of "
+                         "being freed and restore on the next prefix "
+                         "match (0 disables the second tier; needs "
+                         "--prefix_cache on)")
     sv.add_argument("--event_log", default=None,
                     help="append the structured event journal (sheds, "
                          "breaker flips, engine preemptions) to this "
@@ -1425,6 +1448,17 @@ def main(argv=None) -> int:
                          "status line) — arms scale-up/down; without "
                          "it the autopilot can deploy (replicas quit, "
                          "supervisors respawn) but not spawn")
+    rt.add_argument("--kv_quant", choices=["none", "int8"],
+                    default="none",
+                    help="fleet KV mode, appended to --spawn_cmd so "
+                         "autoscaled replicas boot in the same "
+                         "two-tier configuration as the hand-started "
+                         "ones (affinity keys and restore paths only "
+                         "line up fleet-wide when every replica "
+                         "agrees)")
+    rt.add_argument("--kv_spill_pages", type=int, default=0,
+                    help="per-replica host spill capacity, appended "
+                         "to --spawn_cmd replicas (0: omit)")
     rt.add_argument("--min_replicas", type=int, default=1,
                     help="autoscaler floor (scale-down stops here)")
     rt.add_argument("--max_replicas", type=int, default=8,

@@ -88,6 +88,8 @@ class ReplicaState:
 
     __slots__ = ("replica_id", "endpoint", "live", "draining",
                  "kv_pages_total", "kv_pages_free", "page_size",
+                 "kv_pages_reclaimable", "kv_spill_headroom",
+                 "kv_pages_spilled_now",
                  "inflight", "last_scrape", "scrape_failures")
 
     def __init__(self, replica_id: str, endpoint: str):
@@ -98,6 +100,11 @@ class ReplicaState:
         self.kv_pages_total = 0      # 0 until the first scrape lands
         self.kv_pages_free = 0
         self.page_size = 0
+        # the two-tier spill gauges (0 on single-tier replicas):
+        # reclaimable trie pages, spill slots left, host-resident pages
+        self.kv_pages_reclaimable = 0
+        self.kv_spill_headroom = 0
+        self.kv_pages_spilled_now = 0
         self.inflight = 0            # router-dispatched, not yet settled
         self.last_scrape = 0.0
         self.scrape_failures = 0
@@ -105,11 +112,26 @@ class ReplicaState:
     def routable(self) -> bool:
         return self.live and not self.draining
 
+    def lossless_headroom(self) -> int:
+        """Pages this replica can yield WITHOUT destroying cache: the
+        raw free list plus the reclaimable trie pages its spill store
+        still has room for (those route host-ward and restore on the
+        next prefix match, instead of being evicted lossily).
+        ``kv_pages_free`` already includes ALL reclaimable pages — the
+        admission headroom — so this subtracts the part the spill
+        store could not catch."""
+        losable = max(0, self.kv_pages_reclaimable
+                      - self.kv_spill_headroom)
+        return max(0, self.kv_pages_free - losable)
+
     def as_dict(self) -> dict:
         return {"replica_id": self.replica_id, "endpoint": self.endpoint,
                 "live": self.live, "draining": self.draining,
                 "kv_pages_total": self.kv_pages_total,
                 "kv_pages_free": self.kv_pages_free,
+                "kv_pages_reclaimable": self.kv_pages_reclaimable,
+                "kv_spill_headroom": self.kv_spill_headroom,
+                "kv_pages_spilled_now": self.kv_pages_spilled_now,
                 "page_size": self.page_size, "inflight": self.inflight,
                 "scrape_failures": self.scrape_failures}
 
@@ -255,7 +277,10 @@ class FleetBalancer:
             return dict(self._replicas)
 
     def record_scrape(self, replica_id: str, *, kv_pages_total: int,
-                      kv_pages_free: int, page_size: int) -> None:
+                      kv_pages_free: int, page_size: int,
+                      kv_pages_reclaimable: int = 0,
+                      kv_spill_headroom: int = 0,
+                      kv_pages_spilled_now: int = 0) -> None:
         with self._lock:
             st = self._replicas.get(replica_id)
             if st is None:
@@ -263,6 +288,9 @@ class FleetBalancer:
             st.kv_pages_total = int(kv_pages_total)
             st.kv_pages_free = int(kv_pages_free)
             st.page_size = int(page_size)
+            st.kv_pages_reclaimable = int(kv_pages_reclaimable)
+            st.kv_spill_headroom = int(kv_spill_headroom)
+            st.kv_pages_spilled_now = int(kv_pages_spilled_now)
             st.last_scrape = self._clock()
             st.scrape_failures = 0
             # adopt the fleet's ACTUAL page granularity: affinity keys
@@ -358,8 +386,14 @@ class FleetBalancer:
                     key, (st.replica_id for st in fits))
                 if home is not None:
                     return home, 0
-        # least-loaded: most free KV pages, ties by fewest inflight
-        best = max(fits, key=lambda st: (st.kv_pages_free,
+        # least-loaded, tier-aware: prefer the replica that can absorb
+        # this request WITHOUT lossily evicting cached pages (its spill
+        # store catches reclaimed trie pages), then most free KV pages,
+        # ties by fewest inflight. On a single-tier fleet the first key
+        # degenerates to kv_pages_free minus reclaimable — still a
+        # sensible "don't trash the hottest cache" ordering.
+        best = max(fits, key=lambda st: (st.lossless_headroom(),
+                                         st.kv_pages_free,
                                          -st.inflight))
         return best.replica_id, 0
 
@@ -387,5 +421,12 @@ class FleetBalancer:
                 "kv_pages_free": sum(r["kv_pages_free"]
                                      for r in reps.values()
                                      if r["live"] and not r["draining"]),
+                "kv_pages_spilled_now": sum(r["kv_pages_spilled_now"]
+                                            for r in reps.values()
+                                            if r["live"]),
+                "kv_spill_headroom": sum(r["kv_spill_headroom"]
+                                         for r in reps.values()
+                                         if r["live"]
+                                         and not r["draining"]),
                 "index": self.index.stats(),
                 "per_replica": reps}

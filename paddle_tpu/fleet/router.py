@@ -222,6 +222,8 @@ class Router:
             name, _, val = line.rpartition(" ")
             for key in ("engine_kv_pages_total", "engine_kv_pages_free",
                         "engine_kv_pages_reclaimable",
+                        "engine_kv_pages_spilled_now",
+                        "engine_kv_spill_headroom",
                         "engine_page_size"):
                 if name == f"paddle_tpu_serving_{key}":
                     try:
@@ -240,7 +242,17 @@ class Router:
                 kv_pages_free=(
                     vals.get("engine_kv_pages_free", 0)
                     + vals.get("engine_kv_pages_reclaimable", 0)),
-                page_size=vals.get("engine_page_size", 0))
+                page_size=vals.get("engine_page_size", 0),
+                # two-tier gauges (0 on single-tier replicas): they let
+                # choose() prefer a replica whose spill store can catch
+                # the reclaim, keeping warm prefixes restorable instead
+                # of lossily evicted
+                kv_pages_reclaimable=vals.get(
+                    "engine_kv_pages_reclaimable", 0),
+                kv_spill_headroom=vals.get(
+                    "engine_kv_spill_headroom", 0),
+                kv_pages_spilled_now=vals.get(
+                    "engine_kv_pages_spilled_now", 0))
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "Router":
@@ -702,6 +714,8 @@ class Router:
             "replicas_draining": bal["replicas_draining"],
             "kv_pages_total": bal["kv_pages_total"],
             "kv_pages_free": bal["kv_pages_free"],
+            "kv_pages_spilled_now": bal["kv_pages_spilled_now"],
+            "kv_spill_headroom": bal["kv_spill_headroom"],
             "affinity_nodes": bal["index"]["nodes"],
             # seconds the membership view has been served without a
             # successful coordinator scan (fleet/registry.py stale-view

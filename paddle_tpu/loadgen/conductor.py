@@ -15,7 +15,12 @@ is a pure function of the soak seed:
   process / GC-pause fault) and let it rejoin;
 - (q) a coordinator outage seen by EVERY router at once — the control
   plane goes away while the data plane keeps serving on the bounded-
-  staleness view.
+  staleness view;
+- (s) a two-tier KV spill storm — distinct-prefix probe streams push
+  the replicas' tries past pool capacity so cold pages spill
+  host-ward, then the earliest prompts are revisited so admission
+  restores them (needs spill-enabled engines; the soak harness turns
+  ``kv_spill_pages`` + int8 pages on whenever (s) is requested).
 
 Every injection is journaled as ``soak/fault_injected`` with the
 family letter, the action, the target, and the evidence handle (the
@@ -45,9 +50,10 @@ __all__ = ["FaultAction", "plan_faults", "FaultConductor"]
 
 #: when each family fires, as a fraction of the soak duration — k
 #: first (lapse + rejoin completes while every replica is alive), then
-#: the shard kill, the coordinator outage, and the replica kill last
-#: (after it the fleet runs on the survivor).
-_WINDOWS = {"k": 0.22, "o": 0.38, "q": 0.52, "p": 0.68}
+#: the spill storm (every replica alive and the tries warm), the shard
+#: kill, the coordinator outage, and the replica kill last (after it
+#: the fleet runs on the survivor).
+_WINDOWS = {"k": 0.22, "s": 0.30, "o": 0.38, "q": 0.52, "p": 0.68}
 
 
 @dataclass(frozen=True)
@@ -80,7 +86,7 @@ def plan_faults(seed: int, duration_s: float, families: str = "pokq",
         k_target += 1
     o_target = int(rng.integers(0, n_shards))
     out: List[FaultAction] = []
-    for fam in "koqp":                    # schedule order, not input order
+    for fam in "ksoqp":                   # schedule order, not input order
         if fam not in families:
             continue
         jitter = float(rng.uniform(-0.04, 0.04))
@@ -94,6 +100,8 @@ def plan_faults(seed: int, duration_s: float, families: str = "pokq",
             out.append(FaultAction("k", "lease_lapse", at, k_target))
         elif fam == "q":
             out.append(FaultAction("q", "coordinator_outage", at, None))
+        elif fam == "s":
+            out.append(FaultAction("s", "spill_storm", at, None))
     return out
 
 
@@ -169,6 +177,8 @@ class FaultConductor:
             return self._lease_lapse(int(act.target))
         if act.family == "q":
             return self._coordinator_outage()
+        if act.family == "s":
+            return self._spill_storm()
         raise ValueError(f"unknown fault family {act.family!r}")
 
     def _probe_burst(self, router, rid: str, round_i: int) -> None:
@@ -272,6 +282,54 @@ class FaultConductor:
         return {"replica": rep.rid,
                 "fired": rep.registration.rejoins > before,
                 "rejoins": rep.registration.rejoins}
+
+    def _spill_storm(self) -> Dict[str, Any]:
+        """(s): distinct-prefix probe streams stack the replicas'
+        prefix tries past pool capacity, so admission routes cold
+        pages host-ward (``engine/page_spill``) instead of destroying
+        them; then the EARLIEST prompts are revisited — by now the
+        coldest paths, most likely spilled — and admission must
+        restore their pages (``engine/page_restore``) before prefill
+        is charged. Evidence is the engines' own journal records; the
+        verdict's family-s chain requires spill -> restore in order."""
+        topo = self.topology
+        router = topo.routers[0]
+
+        def count(kind):
+            return sum(1 for r in JOURNAL.tail(4000, domain="engine")
+                       if r["kind"] == kind)
+
+        def probe(i, tag, uid):
+            # uid keeps the trace_id unique even when the PROMPT is a
+            # revisit — the exactly-once audit is per trace_id
+            tid = f"soak-fault-s-{tag}-{uid}"
+            prompt = [(3 + i + j) % 37 + 2 for j in range(9)]
+            try:
+                router.generate(prompt, 8, trace_id=tid)
+            except Exception:   # noqa: BLE001 — the journal has it
+                pass
+
+        base_spill = count("page_spill")
+        base_restore = count("page_restore")
+        deadline = time.monotonic() + self.grace_s
+        i = 0
+        # phase 1: churn distinct prefixes until at least one spill
+        while count("page_spill") == base_spill \
+                and time.monotonic() < deadline \
+                and not self._stop.is_set():
+            probe(i, "churn", i)
+            i += 1
+        # phase 2: revisit the earliest prompts until one restores
+        j = 0
+        while count("page_restore") == base_restore \
+                and time.monotonic() < deadline \
+                and not self._stop.is_set():
+            probe(j % max(i, 1), "revisit", j)
+            j += 1
+        spilled = count("page_spill") - base_spill
+        restored = count("page_restore") - base_restore
+        return {"fired": spilled > 0 and restored > 0,
+                "spilled": spilled, "restored": restored}
 
     def _coordinator_outage(self) -> Dict[str, Any]:
         """(q): every router loses the coordinator at once; the data

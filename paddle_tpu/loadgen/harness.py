@@ -82,11 +82,15 @@ class SoakReplica:
     (tests/test_fleet.py's Replica, grown a membership registration).
     ``kill()`` is the SIGKILL twin — every live connection tears."""
 
-    def __init__(self, rid: str, decoder, *, num_slots: int = 2):
+    def __init__(self, rid: str, decoder, *, num_slots: int = 2,
+                 kv_quant: Optional[str] = None,
+                 kv_spill_pages: int = 0):
         self.rid = rid
         self.engine = DecodeEngine(decoder, num_slots=num_slots,
                                    page_size=PAGE,
-                                   max_seq_len=DEC_CFG["max_len"])
+                                   max_seq_len=DEC_CFG["max_len"],
+                                   kv_quant=kv_quant,
+                                   kv_spill_pages=kv_spill_pages)
         self.server = InferenceServer(None, max_queue=8, workers=1,
                                       breaker=False,
                                       engine=self.engine).start()
@@ -123,13 +127,17 @@ class SoakTopology:
                  n_routers: int = 2, n_shards: int = 2, dim: int = 8,
                  lease_s: float = 1.2, heartbeat_s: float = 0.25,
                  scrape_interval: float = 0.1,
-                 queue_timeout: float = 4.0):
+                 queue_timeout: float = 4.0,
+                 kv_quant: Optional[str] = None,
+                 kv_spill_pages: int = 0):
         self.lease_s = float(lease_s)
         self.scrape_interval = float(scrape_interval)
         self.coordinator = Coordinator(chunks=[],
                                        worker_lease_s=lease_s)
         decoder = _tiny_decoder(seed)
-        self.replicas = [SoakReplica(f"r{i}", decoder)
+        self.replicas = [SoakReplica(f"r{i}", decoder,
+                                     kv_quant=kv_quant,
+                                     kv_spill_pages=kv_spill_pages)
                          for i in range(int(n_replicas))]
         for rep in self.replicas:
             rep.registration = ReplicaRegistration(
@@ -470,6 +478,9 @@ class SoakConfig:
     n_replicas: int = 2
     n_routers: int = 2
     n_shards: int = 2
+    kv_quant: Optional[str] = None        # None | "int8"
+    kv_spill_pages: int = 0               # 0: single-tier (family s
+    #                                       defaults it on in build())
     journal: Optional[str] = None         # default: fresh temp file
     slo: SoakSLO = field(default_factory=SoakSLO)
 
@@ -500,9 +511,16 @@ class SoakRunner:
         self.journal_path = cfg.journal or os.path.join(
             tempfile.mkdtemp(prefix="paddle_tpu_soak_"),
             f"soak-{cfg.seed}.jsonl")
+        # family (s) needs a spill store to storm against — default it
+        # on (and int8 pages with it) when the family is requested
+        spill_pages = cfg.kv_spill_pages or (
+            16 if "s" in cfg.families else 0)
+        kv_quant = cfg.kv_quant or (
+            "int8" if "s" in cfg.families else None)
         self.topology = SoakTopology(
             seed=cfg.seed, n_replicas=cfg.n_replicas,
-            n_routers=cfg.n_routers, n_shards=cfg.n_shards)
+            n_routers=cfg.n_routers, n_shards=cfg.n_shards,
+            kv_quant=kv_quant, kv_spill_pages=spill_pages)
         plane = RngPlane(cfg.seed)
         self.chat_plan: List[ChatRequest] = []
         self.ctr_plan: List[CtrRequest] = []

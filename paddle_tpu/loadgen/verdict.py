@@ -23,7 +23,8 @@ into a machine-readable report:
   reconstructible from the merged records alone (route -> failover ->
   settle for a replica kill; shard_killed -> shard_replaced ->
   restore for a shard kill; lease_lapse -> rejoin; stale_view ->
-  view_recovered);
+  view_recovered; page_spill -> page_restore for a two-tier KV spill
+  storm);
 - **ctr_loop** — the CTR freshness loop actually closed: impressions
   gathered without error and the online trainer consumed clicks into
   live sparse updates (``soak/online_step``).
@@ -125,6 +126,18 @@ def _fault_chain(records: List[dict], fault: dict) -> Dict[str, Any]:
             and lapses[0] < rejoins[-1]
         return {"ok": ok, "family": fam, "replica": key,
                 "lapses": len(lapses), "rejoins": len(rejoins)}
+    if fam == "s":
+        # kv_page_spill: page_spill -> page_restore (the storm's
+        # revisit forces the restore leg; integrity drops are counted
+        # as evidence of the degrade path, never required)
+        spills = where(proto.start)
+        restores = where(proto.terminal("page_restore").match)
+        drops = where(proto.terminal("spill_integrity").match)
+        ok = bool(spills) and bool(restores) \
+            and spills[0] < restores[-1]
+        return {"ok": ok, "family": fam, "spills": len(spills),
+                "restores": len(restores),
+                "integrity_drops": len(drops)}
     # fam == "q" — fleet_registry_view: stale_view -> view_recovered
     # (global machine, key None)
     stale = where(proto.start)

@@ -450,14 +450,16 @@ class TransformerDecoder:
               temperature: Optional[float] = None,
               window: int = 1,
               attention: str = "auto",
-              warm_start: bool = True) -> "PagedDecoder":
+              warm_start: bool = True,
+              kv_quant: Optional[str] = None) -> "PagedDecoder":
         """A fixed-shape paged-KV decode step over this decoder's
         parameter table (the serving engine's hot path)."""
         return PagedDecoder(self, num_slots=num_slots,
                             page_size=page_size, num_pages=num_pages,
                             max_pages_per_slot=max_pages_per_slot,
                             temperature=temperature, window=window,
-                            attention=attention, warm_start=warm_start)
+                            attention=attention, warm_start=warm_start,
+                            kv_quant=kv_quant)
 
     def generate(self, prompt, max_len: int,
                  temperature: Optional[float] = None,
@@ -529,14 +531,25 @@ class PagedDecoder:
     masks later positions. ``attention`` selects the cache-read path:
     "gather" (the exact einsum over the full page view), "kernel" (the
     allocated-pages Pallas kernel — ops/pallas_decode.py), or "auto"
-    (kernel on TPU when supported, gather elsewhere)."""
+    (kernel on TPU when supported, gather elsewhere).
+
+    ``kv_quant="int8"`` switches the pools to the two-tier INT8 layout:
+    each pool becomes a pytree ``{"q": int8 [L, N, ps, g, dh],
+    "s": float32 [L, N, ps, g]}`` — the scatter quantizes each K/V row
+    per (token, kv-head) with ops/pallas_decode.quantize_kv (a pure
+    function of the row, so prefix-shared pages stay bit-identical
+    across owners) and attention reads through the dequant-fused
+    kernel or the dequantizing gather fallback. ~4x pages per HBM
+    byte at fp32 base dtype; greedy output is prefix-identical to the
+    fp path under the pinned INT8_KV_* contract."""
 
     def __init__(self, dense: TransformerDecoder, *, num_slots: int,
                  page_size: int, num_pages: int,
                  max_pages_per_slot: int,
                  temperature: Optional[float] = None,
                  window: int = 1, attention: str = "auto",
-                 warm_start: bool = True):
+                 warm_start: bool = True,
+                 kv_quant: Optional[str] = None):
         assert num_pages >= 2, "need at least the null page + one real"
         assert max_pages_per_slot * page_size <= \
             dense.p[f"_{dense.name}_pos_emb.w0"].shape[0], (
@@ -544,6 +557,8 @@ class PagedDecoder:
             "it would silently clamp to its last row")
         assert window >= 1, window
         assert attention in ("auto", "kernel", "gather"), attention
+        assert kv_quant in (None, "int8"), kv_quant
+        self.kv_quant = kv_quant
         self.dense = dense
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
@@ -559,9 +574,13 @@ class PagedDecoder:
         from paddle_tpu.ops import pallas_decode as paged_ops
         probe_q = jax.ShapeDtypeStruct(
             (self.num_slots, self.window, h, self.head_dim), self.dtype)
+        kv_dtype = jnp.int8 if self.kv_quant == "int8" else self.dtype
         probe_k = jax.ShapeDtypeStruct(
             (self.num_pages, self.page_size, self.kv_heads,
-             self.head_dim), self.dtype)
+             self.head_dim), kv_dtype)
+        probe_s = jax.ShapeDtypeStruct(
+            (self.num_pages, self.page_size, self.kv_heads),
+            jnp.float32) if self.kv_quant == "int8" else None
         on_tpu = jax.default_backend() == "tpu"
         if attention == "kernel":
             self.use_kernel = True
@@ -569,7 +588,8 @@ class PagedDecoder:
             self.use_kernel = False
         else:
             self.use_kernel = on_tpu and \
-                paged_ops.paged_kernel_supported(probe_q, probe_k)
+                paged_ops.paged_kernel_supported(probe_q, probe_k,
+                                                 probe_s)
         self.kernel_interpret = self.use_kernel and not on_tpu
         # donating the pools lets XLA update pages in place (the pools
         # ARE the device memory budget); the CPU backend has no donation
@@ -592,29 +612,53 @@ class PagedDecoder:
                 "window": self.window,
                 "temperature": self.temperature,
                 "use_kernel": self.use_kernel,
-                "kernel_interpret": self.kernel_interpret}
+                "kernel_interpret": self.kernel_interpret,
+                "kv_quant": self.kv_quant}
         self._step_fp = fingerprint("paged_step", dense.p, plan=plan)
-        self._copy_fp = fingerprint(
-            "paged_copy", dense.p,
-            plan={"num_pages": self.num_pages,
-                  "page_size": self.page_size,
-                  "n_layers": dense.n_layers,
-                  "kv_heads": self.kv_heads,
-                  "head_dim": self.head_dim,
-                  "dtype": str(jnp.dtype(self.dtype))})
+        page_plan = {"num_pages": self.num_pages,
+                     "page_size": self.page_size,
+                     "n_layers": dense.n_layers,
+                     "kv_heads": self.kv_heads,
+                     "head_dim": self.head_dim,
+                     "dtype": str(jnp.dtype(self.dtype)),
+                     "kv_quant": self.kv_quant}
+        self._copy_fp = fingerprint("paged_copy", dense.p,
+                                    plan=page_plan)
+        self._read_fp = fingerprint("paged_read", dense.p,
+                                    plan=page_plan)
+        self._write_fp = fingerprint("paged_write", dense.p,
+                                     plan=page_plan)
+        self._read = jax.jit(self._read_page_impl)
+        self._write = jax.jit(self._write_page_impl,
+                              donate_argnums=() if not donate
+                              else (0, 1))
         self._step_exe = None
         self._copy_exe = None
+        self._read_exe = None
+        self._write_exe = None
 
     def init_pools(self):
-        """Zeroed (k_pool, v_pool), each [L, n_pages, page_size, g, dh]."""
+        """Zeroed (k_pool, v_pool): each [L, n_pages, page_size, g, dh]
+        arrays at the base dtype, or — under ``kv_quant="int8"`` — the
+        two-tier pytrees ``{"q": int8 values, "s": float32 per-row
+        scales [L, n_pages, page_size, g]}``."""
         shape = (self.dense.n_layers, self.num_pages, self.page_size,
                  self.kv_heads, self.head_dim)
+        if self.kv_quant == "int8":
+            def one():
+                return {"q": jnp.zeros(shape, jnp.int8),
+                        "s": jnp.zeros(shape[:-1], jnp.float32)}
+            return one(), one()
         return jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype)
 
     def pool_bytes(self) -> int:
-        return 2 * int(jnp.dtype(self.dtype).itemsize) * \
-            self.dense.n_layers * self.num_pages * self.page_size * \
-            self.kv_heads * self.head_dim
+        rows = self.dense.n_layers * self.num_pages * \
+            self.page_size * self.kv_heads
+        if self.kv_quant == "int8":
+            # 1 byte/element + one float32 scale per row, per pool
+            return 2 * rows * (self.head_dim + 4)
+        return 2 * int(jnp.dtype(self.dtype).itemsize) * rows * \
+            self.head_dim
 
     def _paged_block(self, p, i, x, k_pool, v_pool, page_idx, offs,
                      page_tables, kv_lens):
@@ -632,16 +676,31 @@ class PagedDecoder:
         # window tokens attend to earlier ones (in-window causality via
         # each token's kv_len). Masked tokens were routed to the null
         # page by the caller.
-        k_pool = k_pool.at[i, page_idx.reshape(-1), offs.reshape(-1)
-                           ].set(k.reshape(S * W, g, -1)
-                                 .astype(k_pool.dtype))
-        v_pool = v_pool.at[i, page_idx.reshape(-1), offs.reshape(-1)
-                           ].set(v.reshape(S * W, g, -1)
-                                 .astype(v_pool.dtype))
-        attn = paged_ops.paged_window_attention(
-            q, k_pool[i], v_pool[i], page_tables, kv_lens,
-            use_kernel=self.use_kernel,
-            interpret=self.kernel_interpret)
+        rows_p = page_idx.reshape(-1)
+        rows_o = offs.reshape(-1)
+        if self.kv_quant == "int8":
+            kq, ks = paged_ops.quantize_kv(k.reshape(S * W, g, -1))
+            vq, vs = paged_ops.quantize_kv(v.reshape(S * W, g, -1))
+            k_pool = {"q": k_pool["q"].at[i, rows_p, rows_o].set(kq),
+                      "s": k_pool["s"].at[i, rows_p, rows_o].set(ks)}
+            v_pool = {"q": v_pool["q"].at[i, rows_p, rows_o].set(vq),
+                      "s": v_pool["s"].at[i, rows_p, rows_o].set(vs)}
+            attn = paged_ops.paged_window_attention(
+                q, k_pool["q"][i], v_pool["q"][i], page_tables,
+                kv_lens, use_kernel=self.use_kernel,
+                interpret=self.kernel_interpret,
+                k_scales=k_pool["s"][i], v_scales=v_pool["s"][i])
+        else:
+            k_pool = k_pool.at[i, rows_p, rows_o
+                               ].set(k.reshape(S * W, g, -1)
+                                     .astype(k_pool.dtype))
+            v_pool = v_pool.at[i, rows_p, rows_o
+                               ].set(v.reshape(S * W, g, -1)
+                                     .astype(v_pool.dtype))
+            attn = paged_ops.paged_window_attention(
+                q, k_pool[i], v_pool[i], page_tables, kv_lens,
+                use_kernel=self.use_kernel,
+                interpret=self.kernel_interpret)
         x = x + attn.reshape(x.shape) @ p[f"_{n}_l{i}_proj.w0"]
         return d0._ffn(p, i, x), k_pool, v_pool
 
@@ -673,21 +732,49 @@ class PagedDecoder:
                 self.temperature).astype(jnp.int32)
         return nxt, k_pool, v_pool
 
+    @staticmethod
+    def _page_slice(leaf, page):
+        """[L, 1, ...] view of one physical page — rank-generic so it
+        covers both the value leaves [L, N, ps, g, dh] and the int8
+        layout's scale leaves [L, N, ps, g]."""
+        start = (0, page) + (0,) * (leaf.ndim - 2)
+        return jax.lax.dynamic_slice(
+            leaf, start, (leaf.shape[0], 1) + leaf.shape[2:])
+
+    @staticmethod
+    def _page_update(leaf, data, page):
+        start = (0, page) + (0,) * (leaf.ndim - 2)
+        return jax.lax.dynamic_update_slice(
+            leaf, data.astype(leaf.dtype), start)
+
     def _copy_page_impl(self, k_pool, v_pool, src, dst):
         """Device-side page copy (all layers) — the copy-on-write step
         behind partial-page prefix reuse (serving/prefix.py). src/dst
         are TRACED int32 scalars, so every (src, dst) pair shares ONE
-        compilation."""
-        L = k_pool.shape[0]
-        tail = k_pool.shape[2:]
-
+        compilation. tree_map'd over the pool pytree, so the int8
+        layout copies values AND scales."""
         def cp(pool):
-            page = jax.lax.dynamic_slice(
-                pool, (0, src, 0, 0, 0), (L, 1) + tail)
-            return jax.lax.dynamic_update_slice(
-                pool, page, (0, dst, 0, 0, 0))
+            return jax.tree_util.tree_map(
+                lambda leaf: self._page_update(
+                    leaf, self._page_slice(leaf, src), dst), pool)
 
         return cp(k_pool), cp(v_pool)
+
+    def _read_page_impl(self, k_pool, v_pool, page):
+        """Device -> host leg of page spill (serving/spill.py): one
+        physical page of both pools as [L, 1, ...] leaves. ``page`` is
+        a traced scalar — one compilation covers every spill."""
+        rd = lambda pool: jax.tree_util.tree_map(
+            lambda leaf: self._page_slice(leaf, page), pool)
+        return rd(k_pool), rd(v_pool)
+
+    def _write_page_impl(self, k_pool, v_pool, k_page, v_page, page):
+        """Host -> device leg of page restore: the inverse of
+        :meth:`_read_page_impl`."""
+        wr = lambda pool, data: jax.tree_util.tree_map(
+            lambda leaf, d: self._page_update(leaf, d, page),
+            pool, data)
+        return wr(k_pool, k_page), wr(v_pool, v_page)
 
     def copy_page(self, k_pool, v_pool, src: int, dst: int):
         """Copy physical page ``src`` -> ``dst`` in both pools."""
@@ -697,6 +784,26 @@ class PagedDecoder:
             self._copy_exe = resolve(self._copy_fp, self._copy, args,
                                      warm=self.warm_start)
         return self._copy_exe(*args)
+
+    def read_page(self, k_pool, v_pool, page: int):
+        """One physical page of both pools as [L, 1, ...] pytrees —
+        the spill store's device->host read (serving/engine.py)."""
+        args = (k_pool, v_pool, jnp.int32(page))
+        if self._read_exe is None:
+            from paddle_tpu.artifacts import resolve
+            self._read_exe = resolve(self._read_fp, self._read, args,
+                                     warm=self.warm_start)
+        return self._read_exe(*args)
+
+    def write_page(self, k_pool, v_pool, k_page, v_page, page: int):
+        """Write [L, 1, ...] page pytrees back into physical ``page``
+        of both pools — the restore leg of page spill."""
+        args = (k_pool, v_pool, k_page, v_page, jnp.int32(page))
+        if self._write_exe is None:
+            from paddle_tpu.artifacts import resolve
+            self._write_exe = resolve(self._write_fp, self._write,
+                                      args, warm=self.warm_start)
+        return self._write_exe(*args)
 
     def step(self, k_pool, v_pool, tokens, positions, page_tables,
              active, key=None):

@@ -158,6 +158,23 @@ _JOURNAL_DECLS = (
     _j("engine", "draft_failure", ("error", "engine_step")),
     _j("engine", "step_failure", ("error", "engine_step"),
        ("trace_ids", "waiting_trace_ids")),
+    # -- engine two-tier KV plane (PR 20)
+    _j("engine", "page_spill", ("page", "key_pages", "spilled_now",
+                                "free_pages", "engine_step"),
+       desc="cold trie page spilled device->host instead of freed "
+            "(protocol: kv_page_spill)"),
+    _j("engine", "page_restore", ("page", "key_pages", "spilled_now",
+                                  "engine_step"),
+       desc="spilled page restored host->device on a prefix match, "
+            "before prefill was charged"),
+    _j("engine", "spill_integrity", ("reason", "engine_step"),
+       ("error", "page", "key_pages"),
+       desc="spill entry dropped (crc_mismatch / read_failed / "
+            "restore_write_failed) — degrades to a prefix miss, "
+            "never restores a torn page"),
+    _j("engine", "dequant_fallback", ("reason", "kv_quant"),
+       desc="int8 KV requested but the fused dequant kernel is "
+            "unsupported here; decode uses the exact-einsum path"),
     # -- fleet (router plane, PR 15/16)
     _j("fleet", "join", ("replica", "endpoint")),
     _j("fleet", "rejoin", ("replica", "endpoint")),
@@ -215,7 +232,7 @@ _JOURNAL_DECLS = (
     _j("soak", "fault_injected", ("family", "action", "target",
                                   "at_s"),
        ("fired", "replica", "shard", "probe_trace", "rejoins",
-        "killed_at", "routers", "outage_s")),
+        "killed_at", "routers", "outage_s", "spilled", "restored")),
     _j("soak", "replica_final", ("replica", "kv_pages_leaked",
                                  "active_slots", "kv_pages_used")),
     _j("soak", "online_step", ("batches", "samples", "loss")),
@@ -273,6 +290,11 @@ _METRIC_DECLS = (
     _m("paddle_tpu_prefix_shared_pages", "gauge"),
     _m("paddle_tpu_spec_proposed_tokens_total", "counter"),
     _m("paddle_tpu_spec_accepted_tokens_total", "counter"),
+    # two-tier KV plane (serving/engine.py + serving/spill.py, PR 20)
+    _m("paddle_tpu_kv_pages_spilled_total", "counter"),
+    _m("paddle_tpu_kv_pages_restored_total", "counter"),
+    _m("paddle_tpu_kv_spill_integrity_drops_total", "counter"),
+    _m("paddle_tpu_kv_pages_spilled_now", "gauge"),
     # continuous profiler (obs/profile.py)
     _m("paddle_tpu_profile_step_ms", "gauge", ("kind",)),
     _m("paddle_tpu_profile_mfu", "gauge", ("kind",)),
@@ -454,6 +476,20 @@ _PROTOCOL_DECLS = (
         description="bounded-staleness registry outage: a stale view "
                     "either recovers or expires"),
     Protocol(
+        "kv_page_spill", None,
+        start=EventMatch("engine", "page_spill"),
+        terminals=(
+            Terminal(EventMatch("engine", "page_restore"), False),
+            Terminal(EventMatch("engine", "spill_integrity"), False),
+        ),
+        description="two-tier KV lifecycle: a spilled page is later "
+                    "restored or dropped with journaled integrity "
+                    "evidence; still-spilled is legal (capacity "
+                    "headroom, audited by page_accounting) — spill "
+                    "and restore are emitted by different engine "
+                    "paths, so this is runtime/verdict-only, not "
+                    "check_paths"),
+    Protocol(
         "autopilot_deploy", None,
         start=EventMatch("autopilot", "deploy_start"),
         intermediates=(
@@ -489,6 +525,7 @@ FAULT_FAMILIES: Dict[str, FaultChainSpec] = {
     "o": FaultChainSpec("o", "embed_shard_failover", "shard"),
     "k": FaultChainSpec("k", "fleet_lease", "replica"),
     "q": FaultChainSpec("q", "fleet_registry_view", None),
+    "s": FaultChainSpec("s", "kv_page_spill", None),
 }
 
 

@@ -74,6 +74,40 @@ LOG2E = 1.4426950408889634
 # this the caller falls back to XLA rather than risk a VMEM OOM
 _VMEM_BYTES = 8 * 1024 * 1024
 
+# ---- int8 KV token-identity contract (the two-tier KV plane) ----
+# The int8 paged path must be GREEDY-PREFIX-IDENTICAL to the fp
+# single-tier baseline on the pinned suite (mirroring speculation's
+# acceptance rule, serving/engine.py) and its attention output within
+# this tolerance of the exact-einsum reference. These constants ARE
+# the contract — tests/test_paged_decode.py pins against them, and a
+# change here is a semantics change, not a tuning knob.
+INT8_KV_RTOL = 2e-2
+INT8_KV_ATOL = 2e-2
+# smallest representable per-row scale: keeps all-zero K/V rows (the
+# null page, unwritten pool rows) exactly zero after dequant while
+# never dividing by zero in the quantizer
+INT8_KV_SCALE_EPS = 1e-12
+
+
+def quantize_kv(x):
+    """Symmetric per-(row, kv-head) int8 quantization of K/V rows:
+    ``x`` [..., dh] -> (int8 values [..., dh], float32 scales [...]).
+    absmax/127 scaling with deterministic round-half-even — the paged
+    scatter must be a pure function of the token run for prefix-reuse
+    token identity to survive quantization (serving/prefix.py)."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1),
+                    INT8_KV_SCALE_EPS) / 127.0
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def dequantize_kv(q, scales, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv`: int8 values [..., dh] * scales
+    [...] -> ``dtype`` values [..., dh]."""
+    return (q.astype(jnp.float32)
+            * scales.astype(jnp.float32)[..., None]).astype(dtype)
+
 
 def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, out_ref, *, scale,
                    rep):
@@ -153,8 +187,18 @@ def gather_pages(pages, page_table):
     return pages[page_table].reshape(b, pp * ps, g, dh)
 
 
+def gather_scales(scales, page_table):
+    """Per-row dequant scales gathered like :func:`gather_pages`:
+    ``scales`` [n_pages, page_size, g] through ``page_table`` [b, P]
+    -> [b, P*page_size, g]."""
+    b, pp = page_table.shape
+    _, ps, g = scales.shape
+    return scales[page_table].reshape(b, pp * ps, g)
+
+
 def paged_attention(q, k_pages, v_pages, page_table, kv_lens, *,
-                    scale=None, use_kernel=False, interpret=False):
+                    scale=None, use_kernel=False, interpret=False,
+                    k_scales=None, v_scales=None):
     """Decode attention over a PAGED KV cache (the serving engine's hot
     path — serving/engine.py).
 
@@ -181,6 +225,15 @@ def paged_attention(q, k_pages, v_pages, page_table, kv_lens, *,
         scale = dh ** -0.5
     k = gather_pages(k_pages, page_table)              # [b, T, g, dh]
     v = gather_pages(v_pages, page_table)
+    if k_scales is not None:
+        # int8 pools: dequantize the GATHERED view (T rows, not the
+        # whole pool) and fall through to the identical exact-einsum
+        # formulation — the dequant analogue of the kernel-gate
+        # fallback below
+        k = dequantize_kv(k, gather_scales(k_scales, page_table),
+                          q.dtype)
+        v = dequantize_kv(v, gather_scales(v_scales, page_table),
+                          q.dtype)
     lens = jnp.asarray(kv_lens, jnp.int32).reshape(-1)
     if use_kernel:
         kt = k.transpose(0, 2, 3, 1)                   # [b, g, dh, T]
@@ -266,17 +319,89 @@ def _paged_window_kernel(tables_ref, used_ref, lens_ref, q_ref, k_ref,
         out_ref[0] = o.reshape(w, g * rep, dh).astype(out_ref.dtype)
 
 
-def paged_kernel_supported(q, k_pages) -> bool:
+def _paged_window_dequant_kernel(tables_ref, used_ref, lens_ref, q_ref,
+                                 k_ref, v_ref, ks_ref, vs_ref, out_ref,
+                                 m_ref, l_ref, acc_ref, *, scale, rep,
+                                 page_size, window):
+    """The dequant-FUSED twin of :func:`_paged_window_kernel`: same
+    grid, same clamped index maps (scale blocks ride the same
+    ``_table_map``, so a skipped page DMA skips its scale DMA too),
+    same online-softmax recurrence — the only delta is the per-row
+    rescale ``int8 * scale`` applied in VMEM right after the K/V block
+    lands, so the HBM read is 1 byte/element + 4 bytes/row instead of
+    the float pool's 2-4 bytes/element."""
+    p = pl.program_id(1)
+    s = pl.program_id(0)
+    used = used_ref[s]
+    g = m_ref.shape[0]
+    wr = m_ref.shape[1]                                # window * rep
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    @pl.when(p < used)
+    def _accumulate():
+        # fused dequant: [ps, g, dh] int8 * [ps, g, 1] f32 scales
+        k = k_ref[0].astype(jnp.float32) * \
+            ks_ref[0].astype(jnp.float32)[..., None]
+        v = v_ref[0].astype(jnp.float32) * \
+            vs_ref[0].astype(jnp.float32)[..., None]
+        q = q_ref[0].astype(jnp.float32)               # [W, h, dh]
+        lens = lens_ref[0]                             # [W] int32
+        lens_rep = jnp.repeat(lens, rep)               # [W*rep]
+        cols = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (wr, page_size), 1)
+        live = cols < lens_rep[:, None]
+        for gi in range(g):
+            kg = k[:, gi, :]                           # [ps, dh]
+            vg = v[:, gi, :]
+            qg = q[:, gi * rep:(gi + 1) * rep, :].reshape(wr, -1)
+            sc = jax.lax.dot_general(
+                qg, kg, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * (scale * LOG2E)
+            sc = jnp.where(live, sc, NEG_INF)          # [wr, ps]
+            m_prev = m_ref[gi]                         # [wr, 1]
+            m_cur = jnp.maximum(m_prev,
+                                jnp.max(sc, axis=1, keepdims=True))
+            alpha = jnp.exp2(m_prev - m_cur)
+            pm = jnp.exp2(sc - m_cur)                  # [wr, ps]
+            l_ref[gi] = l_ref[gi] * alpha + \
+                jnp.sum(pm, axis=1, keepdims=True)
+            acc_ref[gi] = acc_ref[gi] * alpha + jax.lax.dot_general(
+                pm, vg, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[gi] = m_cur
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)             # [g, wr, 1]
+        o = acc_ref[...] / l                           # [g, wr, dh]
+        dh = o.shape[-1]
+        w = wr // rep
+        o = o.reshape(g, w, rep, dh).transpose(1, 0, 2, 3)
+        out_ref[0] = o.reshape(w, g * rep, dh).astype(out_ref.dtype)
+
+
+def paged_kernel_supported(q, k_pages, k_scales=None) -> bool:
     """Gate for the allocated-pages kernel: tile-friendly head dim and
-    a per-page K+V block inside the VMEM budget."""
+    a per-page K+V block inside the VMEM budget. With ``k_scales``
+    (the int8 two-tier layout) the budget counts the int8 block plus
+    its float32 per-row scales."""
     ps, g, dh = k_pages.shape[1:]
     esize = jnp.dtype(k_pages.dtype).itemsize
-    return dh % 8 == 0 and 2 * ps * g * dh * esize <= _VMEM_BYTES
+    block = 2 * ps * g * dh * esize
+    if k_scales is not None:
+        block += 2 * ps * g * jnp.dtype(k_scales.dtype).itemsize
+    return dh % 8 == 0 and block <= _VMEM_BYTES
 
 
 def paged_window_attention(q, k_pages, v_pages, page_tables, kv_lens,
                            *, scale=None, use_kernel=False,
-                           interpret=False):
+                           interpret=False, k_scales=None,
+                           v_scales=None):
     """Decode attention over the paged pool for a W-token window per
     slot (W = 1 is the classic one-token step; the speculative engine
     feeds W = spec_k + 1 — serving/engine.py).
@@ -294,7 +419,15 @@ def paged_window_attention(q, k_pages, v_pages, page_tables, kv_lens,
     page tables and per-slot used-page counts are scalar-prefetched,
     the page-axis block index is clamped to the last allocated page so
     revisited blocks skip their DMA, and cache-read traffic is
-    ceil(len/page_size) pages instead of P."""
+    ceil(len/page_size) pages instead of P.
+
+    ``k_scales``/``v_scales`` [n_pages, page_size, g] switch the pools
+    to the INT8 two-tier layout (:func:`quantize_kv` rows): the gather
+    path dequantizes the gathered view then runs the same exact einsum
+    (the dequant analogue of the existing kernel-gate fallback), and
+    the kernel path runs :func:`_paged_window_dequant_kernel`, which
+    fuses the per-row rescale into the online-softmax page walk —
+    int8 K/V never round-trips through HBM at float width."""
     S, W, h, dh = q.shape
     n_pages, ps, g, _ = k_pages.shape
     P = page_tables.shape[1]
@@ -303,11 +436,12 @@ def paged_window_attention(q, k_pages, v_pages, page_tables, kv_lens,
     if scale is None:
         scale = dh ** -0.5
     lens = jnp.asarray(kv_lens, jnp.int32).reshape(S, W)
+    quant = k_scales is not None
     if not use_kernel:
         out = paged_attention(
             q.reshape(S * W, h, dh), k_pages, v_pages,
             jnp.repeat(page_tables, W, axis=0), lens.reshape(-1),
-            scale=scale)
+            scale=scale, k_scales=k_scales, v_scales=v_scales)
         return out.reshape(S, W, h, dh)
     # pages actually holding live KV for each slot (>= 1 so the null
     # page still feeds the pipeline for idle slots)
@@ -316,19 +450,30 @@ def paged_window_attention(q, k_pages, v_pages, page_tables, kv_lens,
     def _table_map(si, pi, tables, used_):
         return (tables[si, jnp.minimum(pi, used_[si] - 1)], 0, 0, 0)
 
+    def _scale_map(si, pi, tables, used_):
+        return (tables[si, jnp.minimum(pi, used_[si] - 1)], 0, 0)
+
+    kfn = _paged_window_dequant_kernel if quant else \
+        _paged_window_kernel
     kernel = functools.partial(
-        _paged_window_kernel, scale=scale, rep=rep, page_size=ps,
-        window=W)
+        kfn, scale=scale, rep=rep, page_size=ps, window=W)
+    in_specs = [
+        pl.BlockSpec((1, W), lambda si, pi, tables, used_: (si, 0)),
+        pl.BlockSpec((1, W, h, dh),
+                     lambda si, pi, tables, used_: (si, 0, 0, 0)),
+        pl.BlockSpec((1, ps, g, dh), _table_map),
+        pl.BlockSpec((1, ps, g, dh), _table_map),
+    ]
+    operands = [jnp.asarray(page_tables, jnp.int32),
+                used.astype(jnp.int32), lens, q, k_pages, v_pages]
+    if quant:
+        in_specs += [pl.BlockSpec((1, ps, g), _scale_map),
+                     pl.BlockSpec((1, ps, g), _scale_map)]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, P),
-        in_specs=[
-            pl.BlockSpec((1, W), lambda si, pi, tables, used_: (si, 0)),
-            pl.BlockSpec((1, W, h, dh),
-                         lambda si, pi, tables, used_: (si, 0, 0, 0)),
-            pl.BlockSpec((1, ps, g, dh), _table_map),
-            pl.BlockSpec((1, ps, g, dh), _table_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, W, h, dh),
             lambda si, pi, tables, used_: (si, 0, 0, 0)),
@@ -341,5 +486,4 @@ def paged_window_attention(q, k_pages, v_pages, page_tables, kv_lens,
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, W, h, dh), q.dtype),
         interpret=interpret,
-    )(jnp.asarray(page_tables, jnp.int32), used.astype(jnp.int32),
-      lens, q, k_pages, v_pages)
+    )(*operands)

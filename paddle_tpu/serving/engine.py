@@ -82,6 +82,7 @@ from paddle_tpu.obs.profile import PROFILER
 from paddle_tpu.serving.prefix import PrefixIndex
 from paddle_tpu.serving.server import (Expired, Rejected, ServerClosed,
                                        ServingError)
+from paddle_tpu.serving.spill import SpillEntry, SpillStore
 from paddle_tpu.utils.stats import global_counters, stat_timer
 
 # /metrics families for the round-9 multipliers (idempotent: the
@@ -105,6 +106,22 @@ _SPEC_PROPOSED = _METRICS.counter(
 _SPEC_ACCEPTED = _METRICS.counter(
     "paddle_tpu_spec_accepted_tokens_total",
     "draft proposals the target model accepted (greedy token match)")
+# the two-tier KV plane (int8 pages + host spill — docs/robustness.md
+# "Two-tier KV cache")
+_SPILL_PAGES = _METRICS.counter(
+    "paddle_tpu_kv_pages_spilled_total",
+    "cold prefix-cache pages spilled device->host instead of freed")
+_SPILL_RESTORED = _METRICS.counter(
+    "paddle_tpu_kv_pages_restored_total",
+    "spilled pages restored host->device on a prefix match, before "
+    "prefill was charged")
+_SPILL_INTEGRITY = _METRICS.counter(
+    "paddle_tpu_kv_spill_integrity_drops_total",
+    "spill entries dropped on checksum mismatch or transfer failure "
+    "— a torn page degrades to a prefix miss, never a restore")
+_SPILLED_NOW = _METRICS.gauge(
+    "paddle_tpu_kv_pages_spilled_now",
+    "pages currently resident in the host-RAM spill tier")
 
 
 class PagePool:
@@ -315,7 +332,9 @@ class DecodeEngine:
                  draft=None, spec_k: int = 0,
                  prefix_cache: bool = True,
                  attention: str = "auto",
-                 warm_start: bool = True):
+                 warm_start: bool = True,
+                 kv_quant: Optional[str] = None,
+                 kv_spill_pages: int = 0):
         pos_rows = decoder.p[f"_{decoder.name}_pos_emb.w0"].shape[0]
         if max_seq_len is None:
             max_seq_len = pos_rows
@@ -334,17 +353,37 @@ class DecodeEngine:
         if num_pages is None:
             num_pages = self.num_slots * pages_per_slot + 1
         self.warm_start = bool(warm_start)
+        self.kv_quant = kv_quant
         self.paged = decoder.paged(
             num_slots=self.num_slots, page_size=self.page_size,
             num_pages=int(num_pages),
             max_pages_per_slot=pages_per_slot, temperature=temperature,
             window=self.window, attention=attention,
-            warm_start=self.warm_start)
+            warm_start=self.warm_start, kv_quant=kv_quant)
+        if kv_quant is not None and not self.paged.use_kernel:
+            # int8 pages without the dequant-fused kernel: attention
+            # reads through the dequantizing gather (exact einsum) —
+            # correct, just full-table-width traffic. Journaled once at
+            # construction so a fleet-wide scrape can spot replicas
+            # paying the fallback.
+            journal_emit("engine", "dequant_fallback",
+                         reason="kernel_unsupported", kv_quant=kv_quant)
         self.pool = PagePool(int(num_pages))
         self.k_pool, self.v_pool = self.paged.init_pools()
         self.prefix: Optional[PrefixIndex] = (
             PrefixIndex(self.pool, self.page_size) if prefix_cache
             else None)
+        if kv_spill_pages and not prefix_cache:
+            raise ValueError(
+                "kv_spill_pages needs the prefix cache: spilled pages "
+                "are keyed and restored by their trie token path")
+        self.spill: Optional[SpillStore] = (
+            SpillStore(int(kv_spill_pages)) if kv_spill_pages else None)
+        # chaos seam (testing/faults.py family (s)): called at the
+        # "read" and "commit" stages of every spill — kill_during_spill
+        # raises WorkerCrash here to prove the ordering contract
+        self._spill_interceptor: Optional[
+            Callable[[str, tuple, int], None]] = None
         self.draft = None
         if draft is not None and self.spec_k > 0:
             from paddle_tpu.models.decode import DraftDecoder
@@ -385,7 +424,11 @@ class DecodeEngine:
                           "prefix_evicted_pages": 0,
                           "spec_proposed_tokens": 0,
                           "spec_accepted_tokens": 0,
-                          "draft_failures": 0}
+                          "draft_failures": 0,
+                          "kv_pages_spilled": 0,
+                          "kv_pages_restored": 0,
+                          "kv_spill_integrity_drops": 0,
+                          "kv_spill_cleared": 0}
         import jax
         self._key0 = jax.random.PRNGKey(0)
         # live-state provider for postmortem bundles: the slot table
@@ -630,9 +673,16 @@ class DecodeEngine:
     def _alloc_page(self) -> Optional[int]:
         """One page from the pool, reclaiming cold prefix-cache leaves
         (LRU, trie-only refcount) when the free list is dry — the trie
-        gives pages back BEFORE any running request is preempted."""
+        gives pages back BEFORE any running request is preempted. With
+        a spill store attached, cold pages route device->host
+        (:meth:`_spill_cold_pages`) instead of being destroyed; the
+        lossy ``evict_lru`` path remains the fallback when spilling
+        can't free anything (no candidates, or a failed device read)."""
         page = self.pool.alloc()
         while page is None and self.prefix is not None:
+            if self.spill is not None and self._spill_cold_pages(1):
+                page = self.pool.alloc()
+                continue
             freed = self.prefix.evict_lru(1)
             if not freed:
                 return None
@@ -642,6 +692,156 @@ class DecodeEngine:
                          engine_step=self._steps)
             page = self.pool.alloc()
         return page
+
+    # ------------------------------------------------------------- spill
+    @staticmethod
+    def _flatten_page(tag: str, tree, out: dict) -> None:
+        """Pool-page pytree -> named host arrays (fp pools are bare
+        arrays; int8 pools are {"q", "s"} dicts)."""
+        if isinstance(tree, dict):
+            for kk in sorted(tree):
+                out[f"{tag}.{kk}"] = np.asarray(tree[kk])
+        else:
+            out[tag] = np.asarray(tree)
+
+    @staticmethod
+    def _unflatten_page(tag: str, like, payload: dict):
+        import jax.numpy as jnp
+        if isinstance(like, dict):
+            return {kk: jnp.asarray(payload[f"{tag}.{kk}"])
+                    for kk in like}
+        return jnp.asarray(payload[tag])
+
+    def _spill_cold_pages(self, n: int, avoid=None) -> int:
+        """Spill up to ``n`` cold trie-only pages to the host store.
+        The crash-safety ordering (serving/spill.py module doc): read
+        + checksum first (no state changed), THEN evict the node and
+        free the device page, THEN commit the entry — a crash at any
+        point leaves the accounting balanced and can never leave a
+        page both device-owned and host-stored.
+
+        ``avoid`` (a token tuple) skips candidates on that path — the
+        restore path passes the replay it is extending so making room
+        can never spill the very match it is restoring into."""
+        freed = 0
+        cands = self.prefix.spill_candidates(
+            n if avoid is None else n + 8)
+        for path, page in cands:
+            if freed >= n:
+                break
+            if avoid is not None and avoid[:len(path)] == path:
+                continue
+            hook = self._spill_interceptor
+            if hook is not None:
+                hook("read", path, page)
+            try:
+                payload: dict = {}
+                k_page, v_page = self.paged.read_page(
+                    self.k_pool, self.v_pool, page)
+                self._flatten_page("k", k_page, payload)
+                self._flatten_page("v", v_page, payload)
+                entry = SpillEntry(payload)
+            # ptlint: disable=R7(a failed device read falls back to the lossy evict path — the serving loop must not die for a cache optimization)
+            except Exception as e:
+                self._counters["kv_spill_integrity_drops"] += 1
+                _SPILL_INTEGRITY.inc()
+                journal_emit("engine", "spill_integrity",
+                             reason="read_failed",
+                             error=repr(e)[:200], page=page,
+                             engine_step=self._steps)
+                return freed
+            if self.prefix.evict_exact(path) is None:
+                continue               # node changed under us — skip
+            if hook is not None:
+                hook("commit", path, page)
+            self.spill.put(path, entry)
+            freed += 1
+            self._counters["kv_pages_spilled"] += 1
+            _SPILL_PAGES.inc()
+            journal_emit("engine", "page_spill", page=page,
+                         key_pages=len(path) // self.page_size,
+                         spilled_now=len(self.spill),
+                         free_pages=self.pool.free_pages,
+                         engine_step=self._steps)
+        return freed
+
+    def _restore_spilled(self, replay) -> int:
+        """Walk ``replay``'s token path past the trie match and restore
+        consecutive spilled pages host->device BEFORE admission charges
+        prefill — the spill-hit path of the two-tier cache. Each
+        restore allocates a device page (which may cascade-spill colder
+        pages), verifies the entry's checksum, uploads, and re-inserts
+        the trie node; a torn entry is dropped and journaled
+        (``engine/spill_integrity``) so the lookup degrades to a
+        prefix miss."""
+        ps = self.page_size
+        limit = len(replay) - 1
+        restored = 0
+        avoid = tuple(int(t) for t in replay)
+        while True:
+            match = self.prefix.match(replay)
+            nxt = match.matched + ps
+            if nxt > limit:
+                break
+            key = avoid[:nxt]
+            if not self.spill.has(key):
+                break
+            # make room by spilling colder OTHER branches only — never
+            # the lossy evict path (destroying cache to restore cache)
+            # and never this replay's own match (the ``avoid`` guard)
+            page = self.pool.alloc()
+            while page is None:
+                if not self._spill_cold_pages(1, avoid=avoid):
+                    break
+                page = self.pool.alloc()
+            if page is None:
+                break                  # pool truly full — stay spilled
+            entry = self.spill.pop(key)
+            if entry is None:
+                self.pool.free([page])
+                break
+            if not entry.verify():
+                self.pool.free([page])
+                self.spill.dropped_integrity += 1
+                self._counters["kv_spill_integrity_drops"] += 1
+                _SPILL_INTEGRITY.inc()
+                journal_emit("engine", "spill_integrity",
+                             reason="crc_mismatch",
+                             key_pages=nxt // ps,
+                             engine_step=self._steps)
+                break
+            try:
+                k_page = self._unflatten_page("k", self.k_pool,
+                                              entry.payload)
+                v_page = self._unflatten_page("v", self.v_pool,
+                                              entry.payload)
+                self.k_pool, self.v_pool = self.paged.write_page(
+                    self.k_pool, self.v_pool, k_page, v_page, page)
+            # ptlint: disable=R7(a failed upload degrades to a prefix miss — never kills admission)
+            except Exception as e:
+                self.pool.free([page])
+                self.spill.dropped_integrity += 1
+                self._counters["kv_spill_integrity_drops"] += 1
+                _SPILL_INTEGRITY.inc()
+                journal_emit("engine", "spill_integrity",
+                             reason="restore_write_failed",
+                             error=repr(e)[:200], page=page,
+                             engine_step=self._steps)
+                break
+            # trie takes the page over: insert refs it (2), dropping
+            # our alloc ref leaves it trie-only (1) — exactly the
+            # state it was spilled from
+            self.prefix.insert(key, match.pages + [page])
+            self.pool.free([page])
+            self.spill.restored_count += 1
+            restored += 1
+            self._counters["kv_pages_restored"] += 1
+            _SPILL_RESTORED.inc()
+            journal_emit("engine", "page_restore", page=page,
+                         key_pages=nxt // ps,
+                         spilled_now=len(self.spill),
+                         engine_step=self._steps)
+        return restored
 
     def _attach_prefix(self, s: int, slot: _Slot, match) -> None:
         """Wire a PrefixMatch into slot ``s``: one slot ref per shared
@@ -708,6 +908,12 @@ class DecodeEngine:
                     continue
                 req = self._waiting[0]
                 replay = req.prompt + req.tokens
+                if self.spill is not None and len(self.spill) and \
+                        self.prefix is not None:
+                    # spill-hit TTFT path: restored pages join the
+                    # match below, so admission charges only what is
+                    # NOVEL beyond both tiers
+                    self._restore_spilled(replay)
                 match = self.prefix.match(replay) \
                     if self.prefix is not None else None
                 shared = len(match.pages) if match is not None else 0
@@ -1015,6 +1221,10 @@ class DecodeEngine:
             # and repoint at the rebuilt allocator
             self.prefix.reset()
             self.prefix.pool = self.pool
+        if self.spill is not None:
+            # host entries were carved from the dead trie — NEVER
+            # restore across a rebuild (torn-state resurrection)
+            self._counters["kv_spill_cleared"] += self.spill.clear()
         if self.draft is not None:
             self._draft_kc, self._draft_vc = self.draft.init_caches()
         self._tables[:, :] = 0
@@ -1158,12 +1368,24 @@ class DecodeEngine:
         """Pool truth vs slot + trie holdings — the chaos suite's
         no-leak assertion reads ``leaked`` (== 0 always) and
         cross-checks ``refs_total`` == ``held_by_slots`` +
-        ``held_by_trie`` (zero refcount underflows)."""
+        ``held_by_trie`` (zero refcount underflows). With a spill
+        store the dict grows the SECOND tier (``spilled``,
+        ``spill_capacity``, ...): the extended invariant
+        (tests/test_serving_faults.py ``assert_pool_balanced``) also
+        proves host-tier conservation — spills in == restores +
+        integrity drops + LRU drops + recovery clears + still-resident
+        entries."""
         acc = self.pool.accounting()
         acc["held_by_slots"] = sum(
             len(s.pages) for s in self.slots if s is not None)
         acc["held_by_trie"] = self.prefix.page_count() \
             if self.prefix is not None else 0
+        if self.spill is not None:
+            acc.update(self.spill.accounting())
+            acc["spill_cleared"] = self._counters["kv_spill_cleared"]
+        else:
+            acc["spilled"] = 0
+            acc["spill_capacity"] = 0
         return acc
 
     def stats(self) -> dict:
@@ -1180,6 +1402,9 @@ class DecodeEngine:
         shared = self.pool.shared_pages
         leaked = self.pool.accounting()["leaked"]
         _PREFIX_SHARED.set(shared)
+        spilled_now = len(self.spill) if self.spill is not None else 0
+        spill_cap = self.spill.capacity if self.spill is not None else 0
+        _SPILLED_NOW.set(spilled_now)
         out = dict(counters)
         out.update({
             "slots": self.num_slots,
@@ -1202,6 +1427,16 @@ class DecodeEngine:
             "kv_pages_reclaimable": self.prefix.reclaimable_pages()
             if self.prefix is not None else 0,
             "kv_page_high_water": self.pool.high_water,
+            # the second tier: current host-resident pages, capacity,
+            # and the lossless headroom the router counts toward this
+            # replica's admission (fleet/balance.py)
+            "kv_pages_spilled_now": spilled_now,
+            "kv_spill_capacity": spill_cap,
+            "kv_spill_headroom": max(0, spill_cap - spilled_now),
+            "kv_quant": self.kv_quant or "none",
+            "kv_quant_bits": 8 if self.kv_quant == "int8" else
+            int(np.dtype(getattr(self.paged, "dtype", "float32"))
+                .itemsize) * 8,
             "page_size": self.page_size,
             "window": self.window,
             "spec_k": self.spec_k,

@@ -194,6 +194,66 @@ class PrefixIndex:
             self.evicted_pages += len(freed)
         return freed
 
+    # --------------------------------------------------------------- spill
+    @staticmethod
+    def _path_of(node: _Node) -> tuple:
+        """Full token path from the root through ``node`` — the spill
+        store's key (serving/spill.py): restores look the SAME token
+        run back up, so the key must be reconstructable from the
+        request's replay alone."""
+        keys = []
+        while node.key is not None:
+            keys.append(node.key)
+            node = node.parent
+        out = []
+        for key in reversed(keys):
+            out.extend(key)
+        return tuple(out)
+
+    def spill_candidates(self, n: int = 1) -> List[Tuple[tuple, int]]:
+        """Up to ``n`` least-recently-used LEAF pages whose only owner
+        is the trie, as ``(token_path, physical_page)`` — NO mutation.
+        The engine spills these device->host and then calls
+        :meth:`evict_exact` per page, keeping the crash-safety
+        ordering (read, evict+free, commit) under ITS control."""
+        with self._lock:
+            leaves = []
+            stack = [self._root]
+            while stack:
+                nd = stack.pop()
+                if nd.page is not None and not nd.children and \
+                        self.pool.refcount(nd.page) == 1:
+                    leaves.append(nd)
+                stack.extend(nd.children.values())
+            leaves.sort(key=lambda nd: nd.last_used)
+            return [(self._path_of(nd), nd.page) for nd in leaves[:n]]
+
+    def evict_exact(self, path: tuple) -> Optional[int]:
+        """Remove the node at exactly ``path`` (a full token path) and
+        free its page — the evict+free step of the spill ordering. The
+        node must still be a trie-only (refcount 1) childless leaf;
+        returns the freed page, or None if the node changed since
+        :meth:`spill_candidates` picked it (grew children, gained a
+        slot ref, vanished) — the caller then simply skips the spill."""
+        ps = self.page_size
+        path = tuple(int(t) for t in path)
+        if not path or len(path) % ps != 0:
+            return None
+        with self._lock:
+            node = self._root
+            for i in range(0, len(path), ps):
+                node = node.children.get(path[i:i + ps])
+                if node is None:
+                    return None
+            if node.children or self.pool.refcount(node.page) != 1:
+                return None
+            page = node.page
+            del node.parent.children[node.key]
+            self._nodes -= 1
+            self.pool.free([page])
+            self.evicted_pages += 1
+            return page
+
     def reclaimable_pages(self) -> int:
         """Pages an eviction loop could eventually return to the free
         list: trie pages no slot is also holding (refcount 1)."""

@@ -168,6 +168,27 @@ autopilot"):
       staleness bound sheds NOTHING (tests/test_autopilot.py +
       tests/test_fleet_faults.py family (q) acceptance);
 
+and, for the two-tier KV plane (docs/robustness.md "Two-tier KV
+cache"):
+
+  (s) drive spill/restore churn against the two-tier engine —
+      ``spill_storm`` joins waves of distinct-prefix requests THEN
+      revisits earlier prompts, so pool pressure spills cold trie
+      pages host-ward (journaled ``engine/page_spill``) and the
+      revisits restore them (``engine/page_restore``);
+      ``corrupt_spilled_page`` bit-flips or torn-truncates one stored
+      entry WITHOUT touching its CRC (the restore must journal
+      ``engine/spill_integrity`` and degrade to a prefix miss — a
+      torn page is never restored); ``kill_during_spill`` raises
+      :class:`WorkerCrash` inside the engine's ``_spill_interceptor``
+      seam at the "read" or "commit" stage of the spill ordering —
+      the SIGKILL-mid-spill twin. The invariants every storm must
+      preserve: ``page_accounting()`` balanced across BOTH tiers
+      (zero device leaks AND host-tier conservation:
+      puts == restores + lru + integrity-drops + cleared + resident),
+      and every surviving request token-exact vs the single-tier
+      reference (tests/test_serving_faults.py TestTwoTierChaos);
+
 Everything is deterministic given the seed and the schedule, so a chaos
 test that fails replays exactly. See ``tests/test_faults.py`` and
 ``tests/test_serving_faults.py`` for the tests that drive these against
@@ -631,6 +652,107 @@ class FaultPlan:
             w * gap: fire for w in range(1, waves)}
         fire()
         return schedule, submitted
+
+    # ------------------------------------- (s) two-tier KV spill chaos
+    def spill_storm(self, engine, *, waves: int = 5, per_wave: int = 2,
+                    gap: int = 4, prompt_len: int = 8, max_new: int = 3,
+                    vocab: int = 32, revisit_from: int = 2):
+        """``prefix_evict_storm``'s two-tier twin: join ``per_wave``
+        DISTINCT-prefix requests every ``gap`` engine steps so pool
+        pressure spills cold trie leaves to the host store
+        (``engine/page_spill``) — and, from wave ``revisit_from`` on,
+        each wave ALSO re-submits one of the earliest prompts, whose
+        pages are by then the coldest and most likely spilled: the
+        revisit's admission walks the same token path and must restore
+        them (``engine/page_restore``) before prefill is charged.
+        Returns ``(schedule, submitted)`` in the evict-storm shape —
+        run the engine under ``decode_script(engine, schedule)``, then
+        assert both-tier balance and token identity."""
+        rng = np.random.RandomState(self.seed + 2)
+        prompts = [[int(t) for t in rng.randint(0, vocab, prompt_len)]
+                   for _ in range(waves * per_wave)]
+        submitted: list = []
+        wave_no = [0]
+
+        def fire():
+            w = wave_no[0]
+            wave_no[0] += 1
+            for j in range(per_wave):
+                prompt = prompts[(w * per_wave + j) % len(prompts)]
+                submitted.append((engine.submit(prompt, max_new),
+                                  prompt))
+            if w >= revisit_from:
+                prompt = prompts[w % revisit_from]
+                submitted.append((engine.submit(prompt, max_new),
+                                  prompt))
+
+        schedule: Dict[int, Callable] = {
+            w * gap: fire for w in range(1, waves)}
+        fire()
+        return schedule, submitted
+
+    def corrupt_spilled_page(self, engine,
+                             mode: str = "bitflip") -> Optional[tuple]:
+        """Corrupt ONE entry in the engine's spill store in place —
+        ``mode="bitflip"`` (seeded single-byte flip: bit-rot) or
+        ``"truncate"`` (zero the tail: a torn write) — WITHOUT
+        touching its recorded CRC. The next restore of that key must
+        fail verification, journal ``engine/spill_integrity``
+        (``reason="crc_mismatch"``), drop the entry, and degrade to a
+        prefix miss: the request recomputes and stays token-exact.
+        Returns the corrupted key (a token path), or None if the
+        store is empty. Use as a decode_script action to land the
+        corruption between two exact steps."""
+        if engine.spill is None:
+            raise ValueError("engine has no spill store "
+                             "(kv_spill_pages=0)")
+        return engine.spill.corrupt_one(mode, rng=self._rng)
+
+    @staticmethod
+    @contextlib.contextmanager
+    def kill_during_spill(engine, at: int = 0, stage: str = "commit"):
+        """Within the context, raise :class:`WorkerCrash` from the
+        engine's ``_spill_interceptor`` seam at the ``at``-th firing
+        of the named ``stage`` — the SIGKILL-mid-spill twin, landing
+        at an exact point of the crash-safety ordering
+        (serving/spill.py):
+
+        - ``stage="read"``: before the device page is read — nothing
+          has changed; the trie still owns the page and the store has
+          no entry;
+        - ``stage="commit"``: after the trie node is evicted and the
+          device page freed, before ``put()`` commits — the page is
+          simply free and the store has no entry (cache contents
+          lost, accounting intact).
+
+        Either way the ordering contract guarantees no page is both
+        device-owned and host-stored, and ``page_accounting()`` on
+        the survivor stays balanced across both tiers. Yields a stats
+        dict (``fired``, ``stage``, ``path``)."""
+        if stage not in ("read", "commit"):
+            raise ValueError(f"unknown spill stage {stage!r}")
+        stats = {"fired": 0, "stage": stage, "path": None}
+        count = [0]
+        prev = engine._spill_interceptor
+
+        def seam(point, path, page):
+            if prev is not None:
+                prev(point, path, page)
+            if point != stage:
+                return
+            i = count[0]
+            count[0] += 1
+            if i == at:
+                stats["fired"] += 1
+                stats["path"] = path
+                raise WorkerCrash(
+                    f"kill_during_spill: {stage} #{i} page={page}")
+
+        engine._spill_interceptor = seam
+        try:
+            yield stats
+        finally:
+            engine._spill_interceptor = prev
 
     @staticmethod
     def cancel_mid_verify(request, at: int = 2) -> Dict[int, Callable]:

@@ -531,6 +531,78 @@ class TestDecodeEngineFlags:
         with pytest.raises(SystemExit):
             cli._build_engine(ns3)
 
+    def test_two_tier_kv_flags_reach_engine(self, tmp_path):
+        """ISSUE 20 satellite: serve --kv_quant/--kv_spill_pages parse
+        (with single-tier defaults) and wire through _build_engine to
+        the int8 pools and the host spill store."""
+        import argparse
+
+        from paddle_tpu import cli
+
+        seen = {}
+
+        def _grab(args):
+            seen.update(vars(args))
+            return 0
+
+        import unittest.mock as mock
+        with mock.patch.object(cli, "_cmd_serve", _grab):
+            assert cli.main(["serve", "--model", "m.tar"]) == 0
+            assert seen["kv_quant"] == "none"
+            assert seen["kv_spill_pages"] == 0
+            assert cli.main(["serve", "--model", "m.tar",
+                             "--kv_quant", "int8",
+                             "--kv_spill_pages", "32"]) == 0
+            assert seen["kv_quant"] == "int8"
+            assert seen["kv_spill_pages"] == 32
+
+        dec = tmp_path / "dec.py"
+        dec.write_text(self.DEC_SRC.format(seed=7, name="decoder"))
+        ns = argparse.Namespace(
+            decode_config=str(dec), draft_config=None, spec_k=0,
+            prefix_cache="on", gen_slots=2, gen_page_size=4,
+            kv_quant="int8", kv_spill_pages=8)
+        eng = cli._build_engine(ns)
+        st = eng.stats()
+        assert st["kv_quant"] == "int8" and st["kv_quant_bits"] == 8
+        assert st["kv_spill_capacity"] == 8
+        assert eng.spill is not None
+        # defaults stay single-tier fp32
+        ns2 = argparse.Namespace(
+            decode_config=str(dec), draft_config=None, spec_k=0,
+            prefix_cache="on", gen_slots=2, gen_page_size=4,
+            kv_quant="none", kv_spill_pages=0)
+        eng2 = cli._build_engine(ns2)
+        assert eng2.spill is None and eng2.kv_quant is None
+
+    def test_router_kv_flags_extend_spawn_cmd(self):
+        """router --kv_quant/--kv_spill_pages append to the autopilot
+        spawn command so autoscaled replicas boot in the fleet's KV
+        mode."""
+        import argparse
+
+        from paddle_tpu import cli
+
+        class _Router:
+            pass
+
+        ns = argparse.Namespace(
+            spawn_cmd="paddle_tpu serve --decode_config d.py",
+            kv_quant="int8", kv_spill_pages=16, min_replicas=1,
+            max_replicas=2, autopilot_interval=1.0, drain_timeout=5.0)
+        ap = cli._build_autopilot(ns, _Router())
+        argv = ap.provisioner.argv
+        assert argv[-4:] == ["--kv_quant", "int8",
+                             "--kv_spill_pages", "16"]
+        # single-tier defaults: the spawn command is left untouched
+        ns2 = argparse.Namespace(
+            spawn_cmd="paddle_tpu serve --decode_config d.py",
+            kv_quant="none", kv_spill_pages=0, min_replicas=1,
+            max_replicas=2, autopilot_interval=1.0, drain_timeout=5.0)
+        ap2 = cli._build_autopilot(ns2, _Router())
+        assert "--kv_quant" not in ap2.provisioner.argv
+        assert "--kv_spill_pages" not in ap2.provisioner.argv
+
 
 class TestFlightCLI:
     """ISSUE 8 satellites: `obs selfcheck`/`obs dump`, `events tail

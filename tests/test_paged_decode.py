@@ -205,6 +205,113 @@ class TestPagedWindowKernel:
         assert not paged_kernel_supported(q, k_odd)
 
 
+class TestDequantWindowKernel:
+    """ISSUE 20: the dequant-fused variant of the allocated-pages
+    kernel over INT8 pools (quantize_kv rows + per-(row, kv-head)
+    float32 scales). Three pins: the fused kernel matches the
+    dequantizing gather/einsum path bit-for-tolerance, both int8 paths
+    stay within the pinned INT8_KV_RTOL/ATOL contract of the exact
+    float32 attention, and the VMEM gate accounts for the scale
+    blocks."""
+
+    def _quant_pools(self, rng, npages, ps, g, dh):
+        from paddle_tpu.ops.pallas_decode import quantize_kv
+        k = rng.randn(npages, ps, g, dh).astype(np.float32)
+        v = rng.randn(npages, ps, g, dh).astype(np.float32)
+        kq, ks = quantize_kv(jax.numpy.asarray(k))
+        vq, vs = quantize_kv(jax.numpy.asarray(v))
+        return k, v, kq, ks, vq, vs
+
+    @pytest.mark.parametrize("h,g", [(4, 4), (4, 2), (4, 1)])
+    def test_dequant_kernel_matches_gather_path(self, h, g):
+        """GQA/MQA widths, out-of-order physical pages, ragged mid-page
+        lengths: the fused kernel (interpret mode) vs the dequantizing
+        gather + exact einsum — same int8 inputs, same numbers."""
+        from paddle_tpu.ops.pallas_decode import paged_window_attention
+        rng = np.random.RandomState(13)
+        S, W, dh, ps, npages = 3, 3, 8, 4, 12
+        _, _, kq, ks, vq, vs = self._quant_pools(rng, npages, ps, g, dh)
+        q = jax.numpy.asarray(
+            rng.randn(S, W, h, dh).astype(np.float32))
+        tables = jax.numpy.asarray(
+            np.array([[3, 1, 7, 0, 0],
+                      [2, 9, 4, 11, 8],
+                      [5, 6, 0, 0, 0]], np.int32))
+        base = np.array([9, 15, 5], np.int32)
+        lens = jax.numpy.asarray(
+            (base[:, None] + np.arange(W)[None, :]).astype(np.int32))
+        want = np.asarray(paged_window_attention(
+            q, kq, vq, tables, lens, k_scales=ks, v_scales=vs))
+        got = np.asarray(paged_window_attention(
+            q, kq, vq, tables, lens, k_scales=ks, v_scales=vs,
+            use_kernel=True, interpret=True))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_int8_within_pinned_contract_of_fp32(self):
+        """The token-identity tolerance contract: int8 attention
+        outputs (gather AND fused kernel) sit within INT8_KV_RTOL/ATOL
+        of the exact float32 attention over the same pre-quantization
+        pages — the bound under which tiny-model greedy argmax stays
+        stable (TestTwoTierChaos pins the end-to-end identity)."""
+        from paddle_tpu.ops.pallas_decode import (
+            INT8_KV_ATOL, INT8_KV_RTOL, paged_window_attention)
+        rng = np.random.RandomState(14)
+        S, W, h, g, dh, ps, npages = 2, 2, 4, 2, 8, 4, 10
+        k, v, kq, ks, vq, vs = self._quant_pools(rng, npages, ps, g, dh)
+        q = jax.numpy.asarray(
+            rng.randn(S, W, h, dh).astype(np.float32))
+        tables = jax.numpy.asarray(
+            np.array([[1, 4, 2, 0], [3, 5, 7, 0]], np.int32))
+        base = np.array([10, 7], np.int32)
+        lens = jax.numpy.asarray(
+            (base[:, None] + np.arange(W)[None, :]).astype(np.int32))
+        exact = np.asarray(paged_window_attention(
+            q, jax.numpy.asarray(k), jax.numpy.asarray(v),
+            tables, lens))
+        for use_kernel in (False, True):
+            got = np.asarray(paged_window_attention(
+                q, kq, vq, tables, lens, k_scales=ks, v_scales=vs,
+                use_kernel=use_kernel, interpret=use_kernel))
+            np.testing.assert_allclose(got, exact, rtol=INT8_KV_RTOL,
+                                       atol=INT8_KV_ATOL)
+
+    def test_quantize_roundtrip_properties(self):
+        """quantize_kv is a pure per-row function (token identity
+        across prefix reuse needs the same row to quantize the same
+        way in any batch) and all-zero rows — the null page — stay
+        exactly zero after dequant."""
+        from paddle_tpu.ops.pallas_decode import (dequantize_kv,
+                                                  quantize_kv)
+        rng = np.random.RandomState(15)
+        rows = jax.numpy.asarray(rng.randn(6, 4, 2, 8)
+                                 .astype(np.float32))
+        q1, s1 = quantize_kv(rows)
+        q2, s2 = quantize_kv(rows[2:5])      # different batch context
+        np.testing.assert_array_equal(np.asarray(q1)[2:5],
+                                      np.asarray(q2))
+        np.testing.assert_array_equal(np.asarray(s1)[2:5],
+                                      np.asarray(s2))
+        zq, zs = quantize_kv(jax.numpy.zeros((1, 4, 2, 8), np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(dequantize_kv(zq, zs)), 0.0)
+        # max quantization error bounded by scale/2 per element
+        back = np.asarray(dequantize_kv(q1, s1))
+        err = np.abs(back - np.asarray(rows))
+        bound = np.asarray(s1)[..., None] * 0.5 + 1e-7
+        assert (err <= bound).all()
+
+    def test_gate_counts_scale_blocks(self):
+        from paddle_tpu.ops.pallas_decode import paged_kernel_supported
+        q = jax.numpy.zeros((2, 2, 4, 8), np.float32)
+        k8 = jax.numpy.zeros((8, 4, 2, 8), jax.numpy.int8)
+        sc = jax.numpy.zeros((8, 4, 2), np.float32)
+        assert paged_kernel_supported(q, k8, sc)
+        # odd head dim still falls back, scales or not
+        k_odd = jax.numpy.zeros((8, 4, 2, 6), jax.numpy.int8)
+        assert not paged_kernel_supported(
+            q, k_odd, jax.numpy.zeros((8, 4, 2), np.float32))
+
+
 class TestPagePool:
     def test_alloc_free_accounting(self):
         pool = PagePool(8)              # 7 usable, page 0 reserved

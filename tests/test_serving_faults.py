@@ -75,6 +75,18 @@ def assert_pool_balanced(eng):
     assert acc["free"] + acc["held_by_trie"] == acc["total_usable"]
     assert acc["refs_total"] == \
         acc["held_by_slots"] + acc["held_by_trie"]
+    # two-tier extension (ISSUE 20): with a spill store attached, the
+    # HOST tier must conserve too — every page ever spilled is either
+    # restored, dropped (LRU / integrity / recovery clear) or still
+    # resident, and residency never exceeds the configured capacity.
+    # A SIGKILL mid-spill (kill_during_spill) must not break this: the
+    # ordering contract means a torn spill leaves no store entry.
+    if getattr(eng, "spill", None) is not None:
+        assert 0 <= acc["spilled"] <= acc["spill_capacity"]
+        assert acc["spill_puts"] == (
+            acc["spill_restores"] + acc["spill_evicted_lru"]
+            + acc["spill_dropped_integrity"] + acc["spill_cleared"]
+            + acc["spilled"]), acc
     return acc
 
 
@@ -770,4 +782,158 @@ class TestPrefixSpecChaos:
             assert req.get(timeout=1) == self._want(dec, p, 8), i
         st = eng.stats()
         assert st["spec_proposed_tokens"] > 0
+        assert_pool_balanced(eng)
+
+
+class TestTwoTierChaos:
+    """FaultPlan family (s): two-tier KV spill/restore chaos (ISSUE
+    20). The invariants under every scenario: BOTH tiers balance
+    (``assert_pool_balanced`` incl. host-tier conservation), every
+    settled request is token-exact, and a torn spill — crash at the
+    read or the commit point — never leaves a page simultaneously
+    device-owned and host-stored."""
+
+    def _want(self, dec, prompt, max_new):
+        p = np.asarray(prompt, "int32")
+        return [int(t) for t in
+                dec.generate(p[None, :], max_len=len(p) + max_new)[0]]
+
+    def _engine(self, dec, **over):
+        kw = dict(num_slots=2, page_size=4, max_seq_len=20,
+                  num_pages=9, kv_spill_pages=8)
+        kw.update(over)
+        return DecodeEngine(dec, **kw)
+
+    def test_spill_storm_restores_and_balances(self):
+        """Distinct-prompt waves overflow the tiny pool so cold trie
+        leaves spill host-ward; later waves revisit the earliest
+        prompts and must RESTORE their pages. Every stream token-exact,
+        both tiers conserved."""
+        dec = tiny_decoder()
+        eng = self._engine(dec)
+        plan = FaultPlan(seed=31)
+        schedule, submitted = plan.spill_storm(
+            eng, waves=5, per_wave=2, gap=4, prompt_len=8, max_new=3,
+            vocab=40, revisit_from=2)
+        with FaultPlan.decode_script(eng, schedule) as script:
+            eng.run(timeout=300)
+        assert script["fired"] == sorted(schedule)
+        for i, (req, prompt) in enumerate(submitted):
+            assert req.get(timeout=1) == self._want(dec, prompt, 3), i
+        acc = assert_pool_balanced(eng)
+        # the storm genuinely exercised BOTH directions of the tier
+        # boundary — pages went host-ward and came back
+        assert acc["spill_puts"] >= 1
+        assert acc["spill_restores"] >= 1
+        st = eng.stats()
+        assert st["finished"] == len(submitted)
+        assert st["kv_pages_spilled_now"] == acc["spilled"]
+
+    def test_spill_storm_int8_identity(self):
+        """The same storm over int8-quantized pages: restore feeds the
+        dequant read path and greedy decode stays token-identical to
+        the dense float reference (the pinned int8 tolerance contract
+        — INT8_KV_RTOL/ATOL on attention outputs keeps argmax stable
+        at this scale)."""
+        dec = tiny_decoder()
+        eng = self._engine(dec, kv_quant="int8")
+        assert eng.stats()["kv_quant_bits"] == 8
+        plan = FaultPlan(seed=32)
+        schedule, submitted = plan.spill_storm(
+            eng, waves=4, per_wave=2, gap=4, prompt_len=8, max_new=3,
+            vocab=40, revisit_from=2)
+        with FaultPlan.decode_script(eng, schedule):
+            eng.run(timeout=300)
+        for i, (req, prompt) in enumerate(submitted):
+            assert req.get(timeout=1) == self._want(dec, prompt, 3), i
+        acc = assert_pool_balanced(eng)
+        assert acc["spill_puts"] >= 1
+
+    def test_corrupt_spilled_page_degrades_to_miss(self):
+        """Bit-rot EVERY host-resident entry (CRC left stale), then
+        revisit the stormed prompts: each attempted restore must fail
+        verification, drop the entry (``spill_dropped_integrity``) and
+        degrade to a prefix miss — recompute, token-exact, balanced."""
+        dec = tiny_decoder()
+        eng = self._engine(dec)
+        plan = FaultPlan(seed=33)
+        # revisit_from past the last wave: storm only spills, so the
+        # store is populated (not drained) when the corruption lands
+        schedule, submitted = plan.spill_storm(
+            eng, waves=4, per_wave=2, gap=4, prompt_len=8, max_new=3,
+            vocab=40, revisit_from=4)
+        with FaultPlan.decode_script(eng, schedule):
+            eng.run(timeout=300)
+        acc0 = assert_pool_balanced(eng)
+        assert acc0["spilled"] >= 1
+
+        class _Rotate:  # deterministic rng stub: hit EVERY entry once
+            def __init__(self):
+                self.i = 0
+
+            def choice(self, xs):
+                xs = sorted(xs)
+                v = xs[self.i % len(xs)]
+                self.i += 1
+                return v
+
+            def randrange(self, n):
+                return 0
+
+        rot = _Rotate()
+        for _ in range(acc0["spilled"]):
+            assert eng.spill.corrupt_one("bitflip", rng=rot) is not None
+        # revisit every distinct stormed prompt: restores are attempted
+        # against corrupted entries only
+        prompts = []
+        for _, p in submitted:
+            if p not in prompts:
+                prompts.append(p)
+        reqs = [eng.submit(p, 3) for p in prompts]
+        eng.run(timeout=300)
+        for i, (req, p) in enumerate(zip(reqs, prompts)):
+            assert req.get(timeout=1) == self._want(dec, p, 3), i
+        acc = assert_pool_balanced(eng)
+        # at least one corrupted entry was hit, failed CRC and was
+        # dropped (the revisit churn may also spill-and-restore FRESH
+        # uncorrupted pages, so restores can legitimately grow — the
+        # pinned contract is that corruption is always caught)
+        assert acc["spill_dropped_integrity"] >= 1
+
+    @pytest.mark.parametrize("stage", ["read", "commit"])
+    def test_kill_during_spill_stays_balanced(self, stage):
+        """WorkerCrash at the read point (nothing changed) or the
+        commit point (trie evicted + page freed, store entry NOT yet
+        committed): the SIGKILL twin. The survivor's accounting must
+        show no page both device-owned and host-stored, and a resumed
+        engine drains every request token-exact."""
+        from paddle_tpu.testing import WorkerCrash
+        dec = tiny_decoder()
+        eng = self._engine(dec)
+        plan = FaultPlan(seed=34)
+        schedule, submitted = plan.spill_storm(
+            eng, waves=4, per_wave=2, gap=4, prompt_len=8, max_new=3,
+            vocab=40, revisit_from=4)
+        with FaultPlan.decode_script(eng, schedule):
+            with FaultPlan.kill_during_spill(eng, at=0, stage=stage) \
+                    as ks:
+                with pytest.raises(WorkerCrash):
+                    eng.run(timeout=300)
+        assert ks["fired"] == 1 and ks["path"] is not None
+        # mid-crash: slots still hold in-flight pages, but nothing
+        # leaked, refs match, and the host tier conserves — the torn
+        # spill left NO store entry for the in-flight path
+        acc = eng.page_accounting()
+        assert acc["leaked"] == 0
+        assert acc["refs_total"] == \
+            acc["held_by_slots"] + acc["held_by_trie"]
+        assert acc["spill_puts"] == (
+            acc["spill_restores"] + acc["spill_evicted_lru"]
+            + acc["spill_dropped_integrity"] + acc["spill_cleared"]
+            + acc["spilled"])
+        assert tuple(ks["path"]) not in eng.spill._entries
+        # the interceptor is disarmed; the engine finishes the storm
+        eng.run(timeout=300)
+        for i, (req, prompt) in enumerate(submitted):
+            assert req.get(timeout=1) == self._want(dec, prompt, 3), i
         assert_pool_balanced(eng)
